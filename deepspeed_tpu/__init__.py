@@ -1,0 +1,112 @@
+"""deepspeed_tpu — a TPU-native large-model training/inference framework.
+
+Brand-new JAX/XLA/Pallas/pjit implementation with the capability surface of
+DeepSpeed v0.9.1 (reference at deepspeed/__init__.py): ``initialize``,
+``init_inference``, ``init_distributed``, ``add_config_arguments``, the JSON
+config system, ZeRO 0-3, pipeline/tensor/expert/sequence parallelism,
+checkpointing, monitoring, profiling — re-designed for SPMD device meshes and
+the XLA compilation model.
+"""
+
+from .version import __version__
+from . import comm
+from .accelerator import get_accelerator, set_accelerator
+from .runtime.config import DeepSpeedConfig
+from .parallel import (initialize_mesh, get_mesh_manager, DeviceMeshManager,
+                       ProcessTopology)
+from .utils.logging import logger, log_dist
+
+git_hash = None
+git_branch = None
+__git_hash__ = git_hash
+__git_branch__ = git_branch
+
+
+def init_distributed(dist_backend="xla", **kwargs):
+    """Bootstrap multi-host JAX (reference deepspeed.init_distributed,
+    comm/comm.py:526)."""
+    return comm.init_distributed(dist_backend=dist_backend, **kwargs)
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               mesh_manager=None):
+    """Initialize the training engine (reference deepspeed.initialize,
+    __init__.py:54).
+
+    `model` is a deepspeed_tpu model spec/module (see models/): an object with
+    ``init(rng) -> params`` and ``apply(params, batch, ...) -> loss`` (or a
+    flax module adapter). Returns (engine, optimizer, dataloader, lr_scheduler)
+    like the reference.
+    """
+    from .runtime.engine import DeepSpeedEngine
+    from .runtime.pipe.engine import PipelineEngine
+    from .runtime.pipe.module import PipelineModule
+
+    if config is None:
+        config = config_params
+    if config is None and args is not None:
+        config = getattr(args, "deepspeed_config", None)
+    if config is None:
+        raise ValueError("DeepSpeed requires --deepspeed_config or the config kwarg")
+
+    if isinstance(model, PipelineModule):
+        engine = PipelineEngine(args=args,
+                                model=model,
+                                optimizer=optimizer,
+                                model_parameters=model_parameters,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler,
+                                mpu=mpu,
+                                collate_fn=collate_fn,
+                                config=config,
+                                mesh_manager=mesh_manager)
+    else:
+        engine = DeepSpeedEngine(args=args,
+                                 model=model,
+                                 optimizer=optimizer,
+                                 model_parameters=model_parameters,
+                                 training_data=training_data,
+                                 lr_scheduler=lr_scheduler,
+                                 mpu=mpu,
+                                 collate_fn=collate_fn,
+                                 config=config,
+                                 mesh_manager=mesh_manager)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model, config=None, **kwargs):
+    """Initialize the inference engine (reference deepspeed.init_inference,
+    __init__.py:251)."""
+    from .inference.engine import InferenceEngine
+    from .inference.config import DeepSpeedInferenceConfig
+    if config is None:
+        config = {}
+    if isinstance(config, dict):
+        config = {**config, **kwargs}
+        config = DeepSpeedInferenceConfig.from_dict(config)
+    return InferenceEngine(model, config)
+
+
+def add_config_arguments(parser):
+    """Add --deepspeed / --deepspeed_config argparse flags (reference
+    __init__.py:228)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag for user code)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeed json configuration file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated enable flag")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated config path")
+    return parser

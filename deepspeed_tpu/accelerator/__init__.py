@@ -1,0 +1,4 @@
+from .abstract_accelerator import DeepSpeedAccelerator
+from .real_accelerator import get_accelerator, set_accelerator
+from .tpu_accelerator import TPU_Accelerator
+from .cpu_accelerator import CPU_Accelerator

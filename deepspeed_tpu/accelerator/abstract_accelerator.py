@@ -1,0 +1,101 @@
+"""Accelerator abstraction.
+
+Re-design of the reference DeepSpeedAccelerator ABC
+(accelerator/abstract_accelerator.py:10, ~40 abstract methods). The reference
+facade exists to hide torch.cuda behind a portability seam; in JAX the runtime
+already abstracts the backend, so this ABC keeps the *meaningful* subset:
+device identity/count, memory introspection, dtype support, RNG, synchronize,
+profiler ranges, and the op-builder dispatch seam
+(accelerator/cuda_accelerator.py:238-247) through which backends supply their
+kernel implementations (Pallas-TPU vs interpreted-CPU here).
+
+Stream/event APIs from the reference are intentionally absent: XLA owns
+scheduling; `synchronize()` maps to blocking on async dispatch.
+"""
+
+import abc
+from typing import Any, Dict
+
+
+class DeepSpeedAccelerator(abc.ABC):
+
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+
+    # ---- device APIs ----
+    @abc.abstractmethod
+    def device_name(self, device_index=None) -> str: ...
+
+    @abc.abstractmethod
+    def device(self, device_index=None): ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int: ...
+
+    @abc.abstractmethod
+    def current_device(self): ...
+
+    def current_device_name(self) -> str:
+        return self.device_name()
+
+    @abc.abstractmethod
+    def synchronize(self, device_index=None): ...
+
+    # ---- RNG ----
+    @abc.abstractmethod
+    def manual_seed(self, seed): ...
+
+    def initial_seed(self):
+        return self._seed
+
+    # ---- memory ----
+    @abc.abstractmethod
+    def memory_stats(self, device_index=None) -> Dict[str, Any]: ...
+
+    def memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def total_memory(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index=None):
+        stats = self.memory_stats(device_index)
+        return stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
+
+    # ---- dtype support ----
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool: ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool: ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self): ...
+
+    # ---- misc ----
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str: ...
+
+    def is_available(self) -> bool:
+        return self.device_count() > 0
+
+    def range_push(self, msg):
+        """Profiler trace annotation (reference: nvtx range_push)."""
+
+    def range_pop(self):
+        pass
+
+    def default_dtype(self):
+        import jax.numpy as jnp
+        return jnp.float32
+
+    # ---- op builder dispatch seam ----
+    @abc.abstractmethod
+    def create_op_builder(self, class_name: str): ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, class_name: str): ...
+
+    def on_accelerator(self, tensor) -> bool:
+        return True

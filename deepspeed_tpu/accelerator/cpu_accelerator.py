@@ -1,0 +1,65 @@
+"""CPU accelerator — the "fake backend" the reference lacks (SURVEY §4).
+
+Used by the test harness: with XLA_FLAGS=--xla_force_host_platform_device_count=N
+a single host presents N virtual devices, letting multi-chip sharding run
+without TPU hardware. Pallas kernels dispatch in interpret mode here (see
+ops/op_builder).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class CPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "xla"
+        self._seed = 42
+
+    def device_name(self, device_index=None):
+        return "cpu" if device_index is None else f"cpu:{device_index}"
+
+    def device(self, device_index=None):
+        return jax.devices("cpu")[device_index or 0]
+
+    def device_count(self):
+        return len(jax.devices("cpu"))
+
+    def current_device(self):
+        return self.device(0)
+
+    def synchronize(self, device_index=None):
+        jax.effects_barrier()
+
+    def manual_seed(self, seed):
+        self._seed = seed
+
+    def rng_key(self):
+        return jax.random.PRNGKey(self._seed)
+
+    def memory_stats(self, device_index=None):
+        return {}
+
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return True
+
+    def supported_dtypes(self):
+        return [jnp.float32, jnp.bfloat16, jnp.float16]
+
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    def create_op_builder(self, class_name):
+        builder_cls = self.get_op_builder(class_name)
+        return builder_cls() if builder_cls else None
+
+    def get_op_builder(self, class_name):
+        from ..ops.op_builder import get_builder_class
+        return get_builder_class(class_name, backend="cpu")

@@ -1,0 +1,40 @@
+"""Accelerator auto-detection + singleton.
+
+Mirrors accelerator/real_accelerator.py:37 get_accelerator() /
+:55 set_accelerator(): detection order is TPU → CPU, overridable via the
+DSTPU_ACCELERATOR env var or set_accelerator().
+"""
+
+import os
+
+_ACCELERATOR = None
+
+
+def _detect():
+    from .tpu_accelerator import TPU_Accelerator
+    from .cpu_accelerator import CPU_Accelerator
+    name = os.environ.get("DSTPU_ACCELERATOR")
+    if name == "cpu":
+        return CPU_Accelerator()
+    if name == "tpu":
+        return TPU_Accelerator()
+    try:
+        import jax
+        if any(d.platform != "cpu" for d in jax.devices()):
+            return TPU_Accelerator()
+    except Exception:
+        pass
+    return CPU_Accelerator()
+
+
+def get_accelerator():
+    global _ACCELERATOR
+    if _ACCELERATOR is None:
+        _ACCELERATOR = _detect()
+    return _ACCELERATOR
+
+
+def set_accelerator(accel):
+    global _ACCELERATOR
+    _ACCELERATOR = accel
+    return _ACCELERATOR

@@ -1,0 +1,91 @@
+"""TPU accelerator (the analogue of accelerator/cuda_accelerator.py)."""
+
+import jax
+import jax.numpy as jnp
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "xla"
+        self._seed = 42
+
+    def _devices(self):
+        return [d for d in jax.devices() if d.platform != "cpu"] or jax.devices()
+
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index=None):
+        devs = self._devices()
+        return devs[device_index or 0]
+
+    def device_count(self):
+        return len(self._devices())
+
+    def current_device(self):
+        return self._devices()[0]
+
+    def synchronize(self, device_index=None):
+        # XLA async dispatch: block until all queued work is done.
+        jax.block_until_ready(jax.device_put(0, self.device(device_index)))
+        try:
+            self.device(device_index).synchronize_all_activity()
+        except Exception:
+            pass
+
+    def manual_seed(self, seed):
+        self._seed = seed
+
+    def rng_key(self):
+        return jax.random.PRNGKey(self._seed)
+
+    def memory_stats(self, device_index=None):
+        try:
+            return dict(self.device(device_index).memory_stats() or {})
+        except Exception:
+            return {}
+
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return True
+
+    def supported_dtypes(self):
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
+
+    def default_dtype(self):
+        return jnp.bfloat16
+
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    def range_push(self, msg):
+        self._trace = jax.profiler.TraceAnnotation(msg)
+        self._trace.__enter__()
+
+    def range_pop(self):
+        if getattr(self, "_trace", None) is not None:
+            self._trace.__exit__(None, None, None)
+            self._trace = None
+
+    def create_op_builder(self, class_name):
+        builder_cls = self.get_op_builder(class_name)
+        return builder_cls() if builder_cls else None
+
+    def get_op_builder(self, class_name):
+        from ..ops.op_builder import get_builder_class
+        return get_builder_class(class_name, backend="tpu")
+
+    def on_accelerator(self, tensor):
+        try:
+            return any(d.platform != "cpu" for d in tensor.devices())
+        except Exception:
+            return False

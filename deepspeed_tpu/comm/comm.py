@@ -1,0 +1,227 @@
+"""deepspeed_tpu.comm — the communication layer.
+
+TPU-native re-design of the reference comm wrapper (deepspeed/comm/comm.py:
+torch.distributed-compatible API over NCCL). On TPU there are two distinct
+planes, and this module covers both:
+
+1. **Host/control plane** — process bootstrap and eager cross-host ops:
+   ``init_distributed`` → ``jax.distributed.initialize`` (the reference's
+   rendezvous, comm.py:526), ``get_rank``/``get_world_size`` →
+   process indices, ``barrier``/``broadcast_obj`` via multihost utils.
+
+2. **Device/compute plane** — collectives *inside* compiled programs:
+   thin named wrappers over ``jax.lax`` collectives (psum/all_gather/
+   psum_scatter/all_to_all/ppermute) for use under ``shard_map``. Each wrapper
+   routes through ``timed_op`` so the CommsLogger records op/size/participants
+   exactly like the reference's @timed_op (comm.py:104) — at trace time, since
+   XLA owns execution scheduling.
+
+The reference's capability fallbacks (reduce_scatter_fn → allgather+reduce,
+comm.py:239) are unnecessary: XLA provides every primitive on every backend.
+"""
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.logging import logger
+from .logging import get_comms_logger
+
+_INITIALIZED = False
+
+
+class ReduceOp:
+    SUM = "sum"
+    AVG = "avg"
+    MAX = "max"
+    MIN = "min"
+    PRODUCT = "prod"
+
+
+# --------------------------------------------------------------------------
+# Host/control plane
+# --------------------------------------------------------------------------
+
+def init_distributed(dist_backend: str = "xla",
+                     auto_mpi_discovery: bool = True,
+                     distributed_port: int = 29500,
+                     verbose: bool = True,
+                     timeout=None,
+                     init_method: Optional[str] = None,
+                     dist_init_required: Optional[bool] = None,
+                     config=None,
+                     rank: int = -1,
+                     world_size: int = -1):
+    """Bootstrap multi-host JAX. Mirrors deepspeed.init_distributed
+    (comm.py:526) including env-based discovery (comm.py:591-689): honors
+    the launcher's WORLD_SIZE/RANK/MASTER_ADDR/MASTER_PORT, plus OMPI_* and
+    SLURM_* variables.
+
+    Single-process (the common TPU dev loop and the CI fake-multichip mode)
+    is a no-op: jax already sees its local devices.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+
+    env = os.environ
+    nprocs = world_size if world_size > 0 else int(
+        env.get("DSTPU_NUM_PROCESSES",
+                env.get("WORLD_SIZE", env.get("OMPI_COMM_WORLD_SIZE",
+                                              env.get("SLURM_NTASKS", "1")))))
+    proc_id = rank if rank >= 0 else int(
+        env.get("RANK", env.get("OMPI_COMM_WORLD_RANK", env.get("SLURM_PROCID", "0"))))
+
+    if nprocs > 1 and jax.process_count() == 1:
+        coordinator = init_method
+        if coordinator is None:
+            addr = env.get("MASTER_ADDR", "127.0.0.1")
+            port = env.get("MASTER_PORT", str(distributed_port))
+            coordinator = f"{addr}:{port}"
+        if verbose:
+            logger.info(
+                f"Initializing jax.distributed: coordinator={coordinator} "
+                f"rank={proc_id} world={nprocs}")
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=nprocs,
+                                   process_id=proc_id)
+    _INITIALIZED = True
+
+
+def is_initialized():
+    return _INITIALIZED or jax.process_count() > 1
+
+
+def get_rank(group=None) -> int:
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    return jax.process_count()
+
+
+def get_local_rank() -> int:
+    return int(os.environ.get("LOCAL_RANK", 0))
+
+
+def barrier(group=None):
+    """Cross-process barrier via a tiny psum on every device."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
+
+
+def broadcast_object(obj, src: int = 0):
+    """Host-level object broadcast (reference p2p pickled-object sends)."""
+    if jax.process_count() == 1:
+        return obj
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(obj)
+
+
+def destroy_process_group():
+    global _INITIALIZED
+    if jax.process_count() > 1:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+    _INITIALIZED = False
+
+
+# --------------------------------------------------------------------------
+# Device/compute plane — collectives for use inside shard_map
+# --------------------------------------------------------------------------
+
+def _size_bytes(x):
+    try:
+        return x.size * x.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def _log(name, tensor, axis_name):
+    cl = get_comms_logger()
+    if cl is not None and cl.enabled:
+        cl.append(name, _size_bytes(tensor), str(axis_name))
+
+
+def all_reduce(x, op: str = ReduceOp.SUM, axis_name="data"):
+    """lax.psum/pmax/pmin over a mesh axis. [COLLECTIVE]"""
+    _log("all_reduce", x, axis_name)
+    if op == ReduceOp.SUM:
+        return lax.psum(x, axis_name)
+    if op == ReduceOp.AVG:
+        return lax.pmean(x, axis_name)
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, axis_name)
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, axis_name)
+    if op == ReduceOp.PRODUCT:
+        return jnp.exp(lax.psum(jnp.log(x), axis_name))
+    raise ValueError(f"Unsupported reduce op {op}")
+
+
+def all_gather(x, axis_name="data", axis: int = 0, tiled: bool = True):
+    """Gather shards along `axis` from every member of the mesh axis."""
+    _log("all_gather", x, axis_name)
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name="data", axis: int = 0, op: str = ReduceOp.SUM):
+    """psum_scatter: the ZeRO-2/3 gradient primitive
+    (reference runtime/comm/coalesced_collectives.py:29)."""
+    _log("reduce_scatter", x, axis_name)
+    out = lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+    if op == ReduceOp.AVG:
+        out = out / axis_size(axis_name)
+    return out
+
+
+def all_to_all(x, axis_name="expert", split_axis: int = 0, concat_axis: int = 0):
+    """MoE dispatch/combine primitive (reference sharded_moe.py:90 _AllToAll)."""
+    _log("all_to_all", x, axis_name)
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def broadcast(x, src: int = 0, axis_name="data"):
+    """Select src's shard on every member (psum of masked value)."""
+    _log("broadcast", x, axis_name)
+    idx = lax.axis_index(axis_name)
+    mask = (idx == src).astype(x.dtype)
+    return lax.psum(x * mask, axis_name)
+
+
+def ppermute(x, perm: Sequence, axis_name="pipe"):
+    """Point-to-point ring/pipeline exchange (reference pipe/p2p.py)."""
+    _log("ppermute", x, axis_name)
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def send_recv_next(x, axis_name="pipe"):
+    """Shift +1 along axis (stage i → stage i+1), wrapping."""
+    n = axis_size(axis_name)
+    return ppermute(x, [(i, (i + 1) % n) for i in range(n)], axis_name)
+
+
+def send_recv_prev(x, axis_name="pipe"):
+    n = axis_size(axis_name)
+    return ppermute(x, [(i, (i - 1) % n) for i in range(n)], axis_name)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.axis_size(axis_name)
+
+
+def log_summary():
+    cl = get_comms_logger()
+    if cl is not None:
+        cl.log_summary()

@@ -1,0 +1,1 @@
+from .op_builder import OpBuilder, get_builder_class, builder_names

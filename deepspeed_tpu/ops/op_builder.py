@@ -1,0 +1,139 @@
+"""Op-builder registry — the hardware-portability seam.
+
+Re-design of op_builder/builder.py:94 ``OpBuilder``. The reference JIT-builds
+CUDA extensions; here an op "build" resolves to one of:
+  - a Pallas-TPU kernel (backend="tpu"),
+  - the same kernel in interpret mode or a jnp reference path (backend="cpu"),
+  - a compiled C++ host extension (CPU Adam / AIO), built via the C toolchain.
+
+Accelerators dispatch through get_op_builder() by class name exactly like
+accelerator/cuda_accelerator.py:238-247, so an alternate accelerator can
+supply alternate builders.
+"""
+
+import importlib
+from typing import Dict, Optional, Type
+
+from ..utils.logging import logger
+
+
+class OpBuilder:
+    """Base builder: `load()` returns a namespace of callables."""
+
+    NAME = "base"
+    # module path holding the op implementations; must expose
+    # `get_ops(backend: str) -> object`
+    MODULE: Optional[str] = None
+
+    def __init__(self, backend: str = "tpu"):
+        self.backend = backend
+        self._loaded = None
+
+    def is_compatible(self, verbose=True) -> bool:
+        try:
+            self._import_module()
+            return True
+        except Exception as e:  # missing toolchain / backend
+            if verbose:
+                logger.warning(f"op {self.NAME} incompatible: {e}")
+            return False
+
+    def _import_module(self):
+        assert self.MODULE is not None, f"{self.NAME} has no module"
+        return importlib.import_module(self.MODULE, package=__package__)
+
+    def load(self, verbose=True):
+        if self._loaded is None:
+            mod = self._import_module()
+            self._loaded = mod.get_ops(self.backend)
+        return self._loaded
+
+
+class FlashAttentionBuilder(OpBuilder):
+    NAME = "flash_attn"
+    MODULE = ".flash_attention"
+
+
+class FusedAdamBuilder(OpBuilder):
+    NAME = "fused_adam"
+    MODULE = ".adam.fused_adam_ops"
+
+
+class FusedLambBuilder(OpBuilder):
+    NAME = "fused_lamb"
+    MODULE = ".lamb_ops"
+
+
+class CPUAdamBuilder(OpBuilder):
+    NAME = "cpu_adam"
+    MODULE = ".adam.cpu_adam_ops"
+
+
+class CPUAdagradBuilder(OpBuilder):
+    NAME = "cpu_adagrad"
+    MODULE = ".adam.cpu_adagrad_ops"
+
+
+class QuantizerBuilder(OpBuilder):
+    NAME = "quantizer"
+    MODULE = ".quantizer_ops"
+
+
+class TransformerBuilder(OpBuilder):
+    NAME = "transformer"
+    MODULE = ".transformer.fused_ops"
+
+
+class InferenceBuilder(OpBuilder):
+    NAME = "transformer_inference"
+    MODULE = ".transformer.inference_ops"
+
+
+class SparseAttnBuilder(OpBuilder):
+    NAME = "sparse_attn"
+    MODULE = ".sparse_attention_ops"
+
+
+class RandomLTDBuilder(OpBuilder):
+    NAME = "random_ltd"
+    MODULE = ".random_ltd_ops"
+
+
+class AsyncIOBuilder(OpBuilder):
+    NAME = "async_io"
+    MODULE = ".aio_ops"
+
+
+class UtilsBuilder(OpBuilder):
+    NAME = "utils"
+    MODULE = ".utils_ops"
+
+
+_BUILDERS: Dict[str, Type[OpBuilder]] = {
+    cls.NAME: cls
+    for cls in [
+        FlashAttentionBuilder, FusedAdamBuilder, FusedLambBuilder,
+        CPUAdamBuilder, CPUAdagradBuilder, QuantizerBuilder, TransformerBuilder,
+        InferenceBuilder, SparseAttnBuilder, RandomLTDBuilder, AsyncIOBuilder,
+        UtilsBuilder
+    ]
+}
+# reference-style class-name aliases (e.g. accelerator.get_op_builder("FusedAdamBuilder"))
+_BUILDERS.update({cls.__name__: cls for cls in list(_BUILDERS.values())})
+
+
+def get_builder_class(name: str, backend: str = "tpu"):
+    cls = _BUILDERS.get(name)
+    if cls is None:
+        return None
+
+    class _Bound(cls):
+        def __init__(self):
+            super().__init__(backend=backend)
+
+    _Bound.__name__ = cls.__name__
+    return _Bound
+
+
+def builder_names():
+    return sorted({c.NAME for c in _BUILDERS.values()})

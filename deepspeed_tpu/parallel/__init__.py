@@ -1,0 +1,3 @@
+from .topology import (ProcessTopology, DeviceMeshManager, initialize_mesh,
+                       get_mesh_manager, reset_mesh, MESH_AXES, DP_AXES,
+                       PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)

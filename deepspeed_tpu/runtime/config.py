@@ -1,0 +1,284 @@
+"""DeepSpeedConfig — the cross-cutting config spine.
+
+TPU-native re-design of the reference config system
+(deepspeed/runtime/config.py:674 ``DeepSpeedConfig``): one JSON dict (or path)
+parsed into typed per-subsystem models; the batch-size triangle
+``train_batch_size = micro_batch_per_device × gradient_accumulation_steps ×
+dp_world_size`` is auto-solved and validated exactly like the reference
+(config.py:872-980).
+
+Additions over the reference key set (TPU-first parallelism is config-driven
+rather than delegated to a user mpu): ``tensor_parallel_size``,
+``pipeline_parallel_size``, ``sequence_parallel_size``,
+``expert_parallel_size`` select the device-mesh axis sizes.
+"""
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional
+
+from . import constants as C
+from .config_utils import DeepSpeedConfigModel, ConfigError
+from .zero.config import DeepSpeedZeroConfig
+from ..utils.logging import logger
+
+
+@dataclasses.dataclass
+class FP16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+    fp16_master_weights_and_grads: bool = False
+
+    @property
+    def dynamic_loss_scale(self):
+        return self.loss_scale == 0
+
+
+@dataclasses.dataclass
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    immediate_grad_update: bool = False
+
+
+@dataclasses.dataclass
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: str = "adamw"
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    legacy_fusion: bool = False
+
+    def validate(self):
+        self.type = self.type.lower()
+
+
+@dataclasses.dataclass
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """Reference: runtime/activation_checkpointing/checkpointing.py:789 configure()."""
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+@dataclasses.dataclass
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    """Reference: utils/comms_logging.py CommsLogger config."""
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class MonitorSinkConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+    # tensorboard/wandb extras
+    team: Optional[str] = None
+    group: Optional[str] = None
+    project: Optional[str] = None
+    _ALLOW_EXTRA = True
+
+
+@dataclasses.dataclass
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+    recompute_fwd_factor: float = 0.0
+
+
+@dataclasses.dataclass
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    async_save: bool = False
+
+    def validate(self):
+        if str(self.tag_validation).lower() not in ("ignore", "warn", "fail"):
+            raise ConfigError(f"checkpoint.tag_validation must be Ignore|Warn|Fail")
+
+
+class DeepSpeedConfig:
+    """Parse + validate the full config. Reference: runtime/config.py:674."""
+
+    def __init__(self, config: Any, mpu=None, mesh_shape: Optional[Dict[str, int]] = None,
+                 world_size: Optional[int] = None):
+        if isinstance(config, str):
+            if not os.path.exists(config):
+                raise ConfigError(f"Config file not found: {config}")
+            with open(config) as f:
+                self._param_dict = json.load(f)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        elif config is None:
+            self._param_dict = {}
+        else:
+            raise ConfigError(
+                f"Expected a dict or json path for config, got {type(config)}")
+
+        pd = self._param_dict
+        self.mpu = mpu
+
+        # ---- parallel sizes (TPU mesh axes) ----
+        self.tensor_parallel_size = int(pd.get(C.TENSOR_PARALLEL_SIZE, 1))
+        self.pipeline_parallel_size = int(pd.get(C.PIPELINE_PARALLEL_SIZE, 1))
+        self.sequence_parallel_size = int(pd.get(C.SEQUENCE_PARALLEL_SIZE, 1))
+        self.expert_parallel_size = int(pd.get(C.EXPERT_PARALLEL_SIZE, 1))
+
+        if world_size is None:
+            try:
+                import jax
+                world_size = jax.device_count()
+            except Exception:
+                world_size = 1
+        self.world_size = world_size
+        model_parallel = (self.tensor_parallel_size * self.pipeline_parallel_size *
+                          self.sequence_parallel_size)
+        if world_size % model_parallel != 0:
+            raise ConfigError(
+                f"world size {world_size} not divisible by tp*pp*sp={model_parallel}")
+        self.data_parallel_size = world_size // model_parallel
+
+        # ---- batch triangle ----
+        self.train_batch_size = pd.get(C.TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu = pd.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps = pd.get(C.GRADIENT_ACCUMULATION_STEPS)
+        self._configure_train_batch_size()
+
+        # ---- subsystem models ----
+        self.optimizer = (OptimizerConfig.from_dict(pd[C.OPTIMIZER])
+                          if C.OPTIMIZER in pd else None)
+        self.scheduler = (SchedulerConfig.from_dict(pd[C.SCHEDULER])
+                          if C.SCHEDULER in pd else None)
+        self.fp16 = FP16Config.from_dict(pd.get(C.FP16, {}))
+        bf16_dict = pd.get(C.BFLOAT16, pd.get(C.BFLOAT16_OLD, {}))
+        self.bf16 = BF16Config.from_dict(bf16_dict)
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ConfigError("fp16 and bf16 cannot both be enabled")
+        self.zero_config = DeepSpeedZeroConfig.from_dict(pd.get(C.ZERO_OPTIMIZATION, {}))
+        self.activation_checkpointing = ActivationCheckpointingConfig.from_dict(
+            pd.get(C.ACTIVATION_CHECKPOINTING, {}))
+        self.comms_logger = CommsLoggerConfig.from_dict(pd.get(C.COMMS_LOGGER, {}))
+        self.tensorboard = MonitorSinkConfig.from_dict(pd.get(C.TENSORBOARD, {}))
+        self.wandb = MonitorSinkConfig.from_dict(pd.get(C.WANDB, {}))
+        self.csv_monitor = MonitorSinkConfig.from_dict(pd.get(C.CSV_MONITOR, {}))
+        self.flops_profiler = FlopsProfilerConfig.from_dict(pd.get(C.FLOPS_PROFILER, {}))
+        self.checkpoint_config = CheckpointConfig.from_dict(pd.get(C.CHECKPOINT, {}))
+
+        # ---- scalars ----
+        self.steps_per_print = pd.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.gradient_clipping = float(pd.get(C.GRADIENT_CLIPPING,
+                                              C.GRADIENT_CLIPPING_DEFAULT))
+        self.prescale_gradients = pd.get(C.PRESCALE_GRADIENTS,
+                                         C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = float(
+            pd.get(C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT))
+        self.sparse_gradients_enabled = pd.get(C.SPARSE_GRADIENTS,
+                                               C.SPARSE_GRADIENTS_DEFAULT)
+        self.communication_data_type = pd.get(C.COMMUNICATION_DATA_TYPE, None)
+        self.gradient_accumulation_dtype = pd.get(C.GRADIENT_ACCUMULATION_DTYPE, None)
+        self.wall_clock_breakdown = pd.get(C.WALL_CLOCK_BREAKDOWN,
+                                           C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = pd.get(C.MEMORY_BREAKDOWN, False)
+        self.dump_state = pd.get(C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.zero_allow_untested_optimizer = pd.get(C.ZERO_ALLOW_UNTESTED_OPTIMIZER, False)
+        self.dataloader_drop_last = pd.get(C.DATALOADER_DROP_LAST,
+                                           C.DATALOADER_DROP_LAST_DEFAULT)
+        self.load_universal_checkpoint = pd.get(C.LOAD_UNIVERSAL_CHECKPOINT, False)
+        self.disable_allgather = pd.get(C.DISABLE_ALLGATHER, False)
+        self.seed = pd.get("seed", 42)
+        self.elasticity = pd.get(C.ELASTICITY, {})
+        self.autotuning = pd.get(C.AUTOTUNING, {})
+        self.compression = pd.get(C.COMPRESSION_TRAINING, {})
+        self.data_efficiency = pd.get(C.DATA_EFFICIENCY, {})
+        self.curriculum_learning_legacy = pd.get(C.CURRICULUM_LEARNING_LEGACY, {})
+        self.progressive_layer_drop = pd.get(C.PROGRESSIVE_LAYER_DROP, {})
+        self.pipeline = pd.get(C.PIPELINE, {})
+        self.monitor_config_enabled = (self.tensorboard.enabled or self.wandb.enabled
+                                       or self.csv_monitor.enabled)
+
+        self._do_sanity_check()
+
+    # -- batch triangle solver; mirrors reference semantics (config.py:872-980)
+    def _configure_train_batch_size(self):
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        dp = self.data_parallel_size
+
+        if all(v is not None for v in (train, micro, gas)):
+            if train != micro * gas * dp:
+                raise ConfigError(
+                    f"Check batch related parameters. train_batch_size is not equal to "
+                    f"micro_batch_per_gpu * gradient_acc_step * world_size "
+                    f"{train} != {micro} * {gas} * {dp}")
+        elif train is not None and micro is not None:
+            gas = train // (micro * dp)
+            if train % (micro * dp) != 0:
+                raise ConfigError(
+                    f"train_batch_size {train} not divisible by micro_batch*dp {micro * dp}")
+        elif train is not None and gas is not None:
+            micro = train // (gas * dp)
+            if train % (gas * dp) != 0:
+                raise ConfigError(
+                    f"train_batch_size {train} not divisible by gas*dp {gas * dp}")
+        elif micro is not None and gas is not None:
+            train = micro * gas * dp
+        elif train is not None:
+            gas = 1
+            micro = train // dp
+            if train % dp != 0:
+                raise ConfigError(f"train_batch_size {train} not divisible by dp {dp}")
+        elif micro is not None:
+            gas = 1
+            train = micro * dp
+        else:
+            raise ConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu needs to be provided")
+
+        if train <= 0 or micro <= 0 or gas <= 0:
+            raise ConfigError(
+                f"batch sizes must be positive: train={train} micro={micro} gas={gas}")
+        self.train_batch_size = int(train)
+        self.train_micro_batch_size_per_gpu = int(micro)
+        self.gradient_accumulation_steps = int(gas)
+
+    def _do_sanity_check(self):
+        if self.zero_config.stage >= 2 and self.pipeline_parallel_size > 1:
+            raise ConfigError(
+                "ZeRO stage >= 2 is incompatible with pipeline parallelism "
+                "(reference: engine.py:1414-1417)")
+
+    # -- convenience mirrors of reference engine properties
+    @property
+    def zero_enabled(self):
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self):
+        return self.zero_config.stage
+
+    def print_config(self):
+        logger.info(f"DeepSpeedConfig: {json.dumps(self._param_dict, indent=2, default=str)}")

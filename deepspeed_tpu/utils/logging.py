@@ -1,0 +1,62 @@
+"""Rank-aware logging.
+
+TPU-native analogue of the reference logging utilities
+(deepspeed/utils/logging.py): a package-level ``logger`` plus ``log_dist``
+which filters emission by process index so multi-host runs don't spam.
+"""
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name="DeepSpeedTPU", level=logging.INFO):
+    logger_ = logging.getLogger(name)
+    logger_.setLevel(level)
+    logger_.propagate = False
+    if not logger_.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"))
+        logger_.addHandler(handler)
+    return logger_
+
+
+logger = _create_logger(
+    level=LOG_LEVELS.get(os.environ.get("DSTPU_LOG_LEVEL", "info"), logging.INFO))
+
+
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the listed process indices (-1 = all)."""
+    my_rank = _process_index()
+    if ranks is None:
+        ranks = [0]
+    if -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message):
+    _warned = getattr(warning_once, "_warned", set())
+    if message not in _warned:
+        logger.warning(message)
+        _warned.add(message)
+        warning_once._warned = _warned

@@ -1,0 +1,156 @@
+"""Wall-clock + throughput timers.
+
+Re-design of deepspeed/utils/timer.py (SynchronizedWallClockTimer :21,
+ThroughputTimer :137). CUDA-event timing becomes block-until-ready wall
+timing: under XLA async dispatch a timer stop must synchronize to be
+meaningful, so `stop(sync=True)` blocks on outstanding work.
+"""
+
+import time
+from typing import Dict, List, Optional
+
+from .logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+BACKWARD_INNER_MICRO_TIMER = "bwd_inner_microstep"
+BACKWARD_INNER_GLOBAL_TIMER = "bwd_inner"
+BACKWARD_REDUCE_MICRO_TIMER = "bwd_allreduce_microstep"
+BACKWARD_REDUCE_GLOBAL_TIMER = "bwd_allreduce"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+
+def _sync():
+    try:
+        import jax
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class _Timer:
+    def __init__(self, name):
+        self.name = name
+        self.started = False
+        self.start_time = 0.0
+        self.elapsed_ = 0.0
+        self.count = 0
+
+    def start(self):
+        assert not self.started, f"timer {self.name} already started"
+        self.start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, sync=False, record=True):
+        assert self.started, f"timer {self.name} not started"
+        if sync:
+            _sync()
+        delta = time.perf_counter() - self.start_time
+        if record:
+            self.elapsed_ += delta
+            self.count += 1
+        self.started = False
+
+    def elapsed(self, reset=True):
+        val = self.elapsed_
+        if self.started:
+            val += time.perf_counter() - self.start_time
+        if reset:
+            self.elapsed_ = 0.0
+            self.count = 0
+        return val
+
+    def mean(self):
+        return self.elapsed_ / max(self.count, 1)
+
+    def reset(self):
+        self.started = False
+        self.elapsed_ = 0.0
+        self.count = 0
+
+
+class SynchronizedWallClockTimer:
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def get_timers(self):
+        return self.timers
+
+    def log(self, names: List[str], normalizer=1.0, reset=True, ranks=None):
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {elapsed:.2f}")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=ranks)
+
+    def get_mean(self, names, normalizer=1.0, reset=True):
+        out = {}
+        for name in names:
+            if name in self.timers:
+                out[name] = self.timers[name].mean() * 1000.0 / normalizer
+                if reset:
+                    self.timers[name].reset()
+        return out
+
+
+class ThroughputTimer:
+    """samples/sec + TFLOPs tracking (reference utils/timer.py:137)."""
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=50,
+                 monitor_memory=False, logging_fn=None):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.started = False
+        self.start_time = 0.0
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def start(self):
+        self.started = True
+        self.start_time = time.perf_counter()
+
+    def stop(self, global_step=False, report_speed=True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        duration = time.perf_counter() - self.start_time
+        self.step_elapsed_time += duration
+        if global_step and self.global_step_count >= self.start_step:
+            self.total_elapsed_time += self.step_elapsed_time
+            if report_speed and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                    f"global_step={self.global_step_count}, "
+                    f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
+                    f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.2f}")
+        if global_step:
+            self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self):
+        if self.total_elapsed_time > 0:
+            steps = self.global_step_count - self.start_step + 1
+            return self.batch_size * steps / self.total_elapsed_time
+        return 0.0

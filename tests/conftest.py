@@ -1,0 +1,32 @@
+"""Test harness configuration.
+
+The TPU answer to the reference DistributedTest harness
+(tests/unit/common.py:277): instead of forking N processes over NCCL, we run
+single-process with N virtual CPU devices
+(XLA_FLAGS=--xla_force_host_platform_device_count=8) and build real
+jax.sharding.Meshes over them — multi-chip semantics without hardware.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    yield
+    from deepspeed_tpu.parallel import topology
+    topology.reset_mesh()
+
+
+@pytest.fixture
+def mesh8():
+    from deepspeed_tpu.parallel import initialize_mesh
+    return initialize_mesh(dp=8)

@@ -1,0 +1,107 @@
+"""Config system tests — mirrors the batch-triangle and subsystem-config
+behavior of reference runtime/config.py (tests modeled on
+tests/unit/runtime/test_ds_config_dict.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.config_utils import ConfigError
+
+
+def test_batch_triangle_full():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2,
+         "gradient_accumulation_steps": 2}, world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.data_parallel_size == 8
+
+
+def test_batch_triangle_solve_gas():
+    cfg = DeepSpeedConfig({"train_batch_size": 32,
+                           "train_micro_batch_size_per_gpu": 2}, world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triangle_solve_micro():
+    cfg = DeepSpeedConfig({"train_batch_size": 32,
+                           "gradient_accumulation_steps": 2}, world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_batch_triangle_solve_train():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2,
+                           "gradient_accumulation_steps": 4}, world_size=8)
+    assert cfg.train_batch_size == 64
+
+
+def test_batch_triangle_mismatch_raises():
+    with pytest.raises(ConfigError):
+        DeepSpeedConfig({"train_batch_size": 33, "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 2}, world_size=8)
+
+
+def test_batch_triangle_missing_raises():
+    with pytest.raises(ConfigError):
+        DeepSpeedConfig({}, world_size=8)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, world_size=8)
+
+
+def test_zero_config_parsing():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 8,
+         "zero_optimization": {"stage": 2, "reduce_bucket_size": 1000,
+                               "offload_optimizer": {"device": "cpu"}}},
+        world_size=8)
+    assert cfg.zero_config.stage == 2
+    assert cfg.zero_config.reduce_bucket_size == 1000
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+    assert cfg.zero_enabled
+
+
+def test_zero_invalid_stage():
+    with pytest.raises(ConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "zero_optimization": {"stage": 5}}, world_size=8)
+
+
+def test_zero_legacy_cpu_offload_flag():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "zero_optimization": {"stage": 2, "cpu_offload": True}},
+                          world_size=8)
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+
+
+def test_parallel_sizes_reduce_dp():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "tensor_parallel_size": 2},
+                          world_size=8)
+    assert cfg.data_parallel_size == 4
+
+
+def test_zero23_pp_incompatible():
+    with pytest.raises(ConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "pipeline_parallel_size": 2,
+                         "zero_optimization": {"stage": 2}}, world_size=8)
+
+
+def test_optimizer_scheduler_sections():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 8,
+         "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+         "scheduler": {"type": "WarmupLR",
+                       "params": {"warmup_num_steps": 10}}}, world_size=8)
+    assert cfg.optimizer.type == "adamw"
+    assert cfg.scheduler.type == "WarmupLR"
+
+
+def test_unknown_zero_key_raises():
+    with pytest.raises(ConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "zero_optimization": {"stage": 1, "bogus_key": 1}},
+                        world_size=8)

@@ -1,0 +1,55 @@
+"""Topology/mesh tests — modeled on reference tests for ProcessTopology
+(tests/unit/runtime/pipe/test_topology.py)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel import (ProcessTopology, initialize_mesh,
+                                    DeviceMeshManager)
+
+
+def test_process_topology_coords():
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 4])
+    assert topo.world_size() == 8
+    assert topo.get_rank(pipe=0, data=0) == 0
+    assert topo.get_rank(pipe=1, data=3) == 7
+    assert topo.get_coord(5) == {"pipe": 1, "data": 1}
+    assert topo.get_dim("data") == 4
+
+
+def test_axis_comm_lists():
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 4])
+    data_lists = topo.get_axis_comm_lists("data")
+    assert data_lists == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert [0, 4] in pipe_lists
+
+
+def test_filter_match():
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 4])
+    assert topo.filter_match(pipe=1) == [4, 5, 6, 7]
+
+
+def test_mesh_manager_shapes():
+    mm = initialize_mesh(dp=4, tp=2)
+    assert mm.dp == 4 and mm.tp == 2
+    assert mm.dp_world_size == 4
+    assert mm.mesh.shape["model"] == 2
+    assert mm.mesh.shape["data"] == 4
+
+
+def test_mesh_manager_infer_dp():
+    mm = DeviceMeshManager(tp=2)
+    assert mm.dp * mm.tp == 8
+
+
+def test_mesh_bad_shape_raises():
+    with pytest.raises(ValueError):
+        DeviceMeshManager(tp=3)
+
+
+def test_batch_sharding_spec():
+    mm = initialize_mesh(dp=4, sp=2)
+    spec = mm.batch_spec()
+    assert spec[0] == ("data", "expert")
+    assert spec[1] == "seq"
