@@ -1,0 +1,3 @@
+from .api import ModelSpec, FunctionalModel, from_flax
+from .gpt2 import (GPT2Config, GPT2Model, GPT2_125M, GPT2_350M, GPT2_760M,
+                   GPT2_1_3B)

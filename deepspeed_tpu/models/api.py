@@ -1,0 +1,98 @@
+"""Model specification protocol.
+
+The reference wraps ``torch.nn.Module``; the TPU-native equivalent is a
+functional spec: parameters are a pytree, the model is (init, apply). The
+engine consumes anything satisfying:
+
+    init(rng) -> params                              (pure; shape-deducible)
+    apply(params, batch, rng=None, train=True) -> loss | (loss, aux)
+    partition_rules() -> [(path_regex, PartitionSpec-like tuple), ...]
+        logical TP/SP sharding rules; ZeRO sharding is layered on top by
+        runtime/zero/partition.py. Optional (default: fully replicated).
+
+``ModelSpec`` is a convenience base. Flax linen modules can be adapted via
+``from_flax``.
+"""
+
+import re
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+
+
+class ModelSpec:
+    """Base class for deepspeed_tpu model specs."""
+
+    def init(self, rng) -> Any:
+        raise NotImplementedError
+
+    def apply(self, params, batch, rng=None, train=True):
+        raise NotImplementedError
+
+    def partition_rules(self) -> List[Tuple[str, Tuple]]:
+        return []
+
+    def num_params(self, params) -> int:
+        return sum(x.size for x in jax.tree.leaves(params))
+
+    def flops_per_token(self) -> Optional[float]:
+        """Approximate training FLOPs per token (6N rule unless overridden)."""
+        return None
+
+
+class FunctionalModel(ModelSpec):
+    def __init__(self, init_fn: Callable, apply_fn: Callable,
+                 rules: Optional[Sequence[Tuple[str, Tuple]]] = None):
+        self._init = init_fn
+        self._apply = apply_fn
+        self._rules = list(rules or [])
+
+    def init(self, rng):
+        return self._init(rng)
+
+    def apply(self, params, batch, rng=None, train=True):
+        return self._apply(params, batch, rng=rng, train=train)
+
+    def partition_rules(self):
+        return self._rules
+
+
+def from_flax(module, example_batch, loss_fn, rules=None):
+    """Adapt a flax.linen module: loss_fn(logits_or_out, batch) -> scalar."""
+
+    def init_fn(rng):
+        return module.init(rng, example_batch)
+
+    def apply_fn(params, batch, rng=None, train=True):
+        rngs = {"dropout": rng} if rng is not None else None
+        out = module.apply(params, batch, rngs=rngs)
+        return loss_fn(out, batch)
+
+    return FunctionalModel(init_fn, apply_fn, rules)
+
+
+def match_rule(path: str, rules: Sequence[Tuple[str, Tuple]]):
+    """First rule whose regex matches the '/'-joined param path wins."""
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    return None
+
+
+def param_path_tree(params):
+    """Pytree of '/'-joined key paths, same structure as params."""
+    paths = []
+    leaves, treedef = jax.tree.flatten_with_path(params)
+
+    def path_str(kp):
+        parts = []
+        for k in kp:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        return "/".join(parts)
+
+    return jax.tree.unflatten(treedef, [path_str(kp) for kp, _ in leaves])

@@ -1,0 +1,217 @@
+"""GPT-2 family — the flagship training model.
+
+TPU-native design (not a port of any torch modeling file): parameters are a
+flat pytree with **stacked** per-layer leaves ([L, ...] leading layer dim) so
+the decoder runs as one ``lax.scan`` over layers. That gives O(1) compile time
+in depth, makes ``jax.checkpoint`` (activation checkpointing, reference
+runtime/activation_checkpointing/checkpointing.py) a one-line policy, and is
+the shape ZeRO-3 wants: leaves sharded over the dp axes are gathered
+layer-by-layer inside the scan, which XLA overlaps with compute — replacing
+the reference's entire fetch/prefetch coordinator
+(runtime/zero/partitioned_param_coordinator.py).
+
+Attention dispatches to the flash-attention op (Pallas on TPU).
+"""
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .api import ModelSpec
+from ..ops.flash_attention import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    remat: bool = False            # activation checkpointing over the layer scan
+    attn_backend: str = "auto"     # auto | pallas | xla
+    dtype: str = "float32"         # compute dtype; params always fp32 masters
+    pad_vocab_to_multiple: int = 128
+
+    @property
+    def padded_vocab(self):
+        m = self.pad_vocab_to_multiple
+        return ((self.vocab_size + m - 1) // m) * m
+
+    @property
+    def head_dim(self):
+        return self.n_embd // self.n_head
+
+
+# presets matching BASELINE.md configs
+GPT2_125M = GPT2Config(n_embd=768, n_layer=12, n_head=12)
+GPT2_350M = GPT2Config(n_embd=1024, n_layer=24, n_head=16)
+GPT2_760M = GPT2Config(n_embd=1536, n_layer=24, n_head=16)
+GPT2_1_3B = GPT2Config(n_embd=2048, n_layer=24, n_head=32)
+
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+class GPT2Model(ModelSpec):
+
+    def __init__(self, config: GPT2Config = GPT2_125M):
+        self.config = config
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng):
+        cfg = self.config
+        d, l, v = cfg.n_embd, cfg.n_layer, cfg.padded_vocab
+        std = cfg.initializer_range
+        proj_std = std / math.sqrt(2 * l)
+        keys = jax.random.split(rng, 8)
+
+        def norm(key, shape, s):
+            return (jax.random.normal(key, shape, jnp.float32) * s)
+
+        blocks = {
+            "ln1_scale": jnp.ones((l, d)),
+            "ln1_bias": jnp.zeros((l, d)),
+            "qkv_w": norm(keys[0], (l, d, 3 * d), std),
+            "qkv_b": jnp.zeros((l, 3 * d)),
+            "attn_proj_w": norm(keys[1], (l, d, d), proj_std),
+            "attn_proj_b": jnp.zeros((l, d)),
+            "ln2_scale": jnp.ones((l, d)),
+            "ln2_bias": jnp.zeros((l, d)),
+            "mlp_fc_w": norm(keys[2], (l, d, 4 * d), std),
+            "mlp_fc_b": jnp.zeros((l, 4 * d)),
+            "mlp_proj_w": norm(keys[3], (l, 4 * d, d), proj_std),
+            "mlp_proj_b": jnp.zeros((l, d)),
+        }
+        return {
+            "wte": norm(keys[4], (v, d), std),
+            "wpe": norm(keys[5], (cfg.n_positions, d), std),
+            "blocks": blocks,
+            "ln_f_scale": jnp.ones((d,)),
+            "ln_f_bias": jnp.zeros((d,)),
+        }
+
+    # ----------------------------------------------------------------- block
+    def _block(self, x, layer_params, rng, train):
+        cfg = self.config
+        b, t, d = x.shape
+        h, hd = cfg.n_head, cfg.head_dim
+        p = layer_params
+
+        ln1 = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_epsilon)
+        qkv = ln1 @ p["qkv_w"].astype(ln1.dtype) + p["qkv_b"].astype(ln1.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+        drop_rng = None
+        if train and cfg.dropout > 0 and rng is not None:
+            rng, drop_rng = jax.random.split(rng)
+        attn = flash_attention(q, k, v, causal=True,
+                               dropout_rate=cfg.dropout if train else 0.0,
+                               dropout_rng=drop_rng, backend=cfg.attn_backend)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
+        attn = attn @ p["attn_proj_w"].astype(attn.dtype) + p["attn_proj_b"].astype(attn.dtype)
+        x = x + self._dropout(attn, rng, train, 0)
+
+        ln2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_epsilon)
+        hmid = ln2 @ p["mlp_fc_w"].astype(ln2.dtype) + p["mlp_fc_b"].astype(ln2.dtype)
+        hmid = jax.nn.gelu(hmid, approximate=True)
+        out = hmid @ p["mlp_proj_w"].astype(hmid.dtype) + p["mlp_proj_b"].astype(hmid.dtype)
+        x = x + self._dropout(out, rng, train, 1)
+        return x
+
+    def _dropout(self, x, rng, train, salt):
+        cfg = self.config
+        if not train or cfg.dropout == 0.0 or rng is None:
+            return x
+        key = jax.random.fold_in(rng, salt)
+        keep = jax.random.bernoulli(key, 1.0 - cfg.dropout, x.shape)
+        return x * keep / (1.0 - cfg.dropout)
+
+    # --------------------------------------------------------------- forward
+    def logits(self, params, input_ids, rng=None, train=True):
+        cfg = self.config
+        # compute dtype follows the param dtype: the engine casts fp32 masters
+        # to bf16/fp16 before apply (mixed-precision contract); cfg.dtype is
+        # the fallback for direct use.
+        wte_dtype = params["wte"].dtype
+        compute_dtype = (wte_dtype if jnp.issubdtype(wte_dtype, jnp.floating)
+                         else jnp.dtype(cfg.dtype))
+        b, t = input_ids.shape
+        wte = params["wte"].astype(compute_dtype)
+        x = wte[input_ids] + params["wpe"][:t].astype(compute_dtype)
+        x = self._dropout(x, rng, train, 2)
+
+        def body(carry, layer_params):
+            h, i = carry
+            layer_rng = None if rng is None else jax.random.fold_in(rng, i)
+            h = self._block(h, layer_params, layer_rng, train)
+            return (h, i + 1), None
+
+        body_fn = body
+        if cfg.remat:
+            body_fn = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+        (x, _), _ = lax.scan(body_fn, (x, 0), params["blocks"])
+
+        x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                        cfg.layer_norm_epsilon)
+        logits = x @ wte.T
+        return logits
+
+    def apply(self, params, batch, rng=None, train=True):
+        """Next-token LM loss. batch: {'input_ids': [B,T]} (+ optional
+        'labels' [B,T] with -100 = ignore, HF convention)."""
+        cfg = self.config
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        logits = self.logits(params, input_ids, rng=rng, train=train)
+        if isinstance(batch, dict) and "labels" in batch:
+            labels = batch["labels"]
+            shift_logits = logits[:, :-1]
+            shift_labels = labels[:, 1:]
+        else:
+            shift_logits = logits[:, :-1]
+            shift_labels = input_ids[:, 1:]
+        valid = (shift_labels >= 0) & (shift_labels < cfg.vocab_size)
+        safe_labels = jnp.where(valid, shift_labels, 0)
+        logp = jax.nn.log_softmax(shift_logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        nll = jnp.where(valid, nll, 0.0)
+        return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+    # ------------------------------------------------------------- sharding
+    def partition_rules(self):
+        """TP (megatron-style) + SP logical rules; ZeRO layering happens in
+        runtime/zero/partition.py. Stacked leaves: axis 0 is the layer axis."""
+        return [
+            (r"wte$", ("model", None)),
+            (r"wpe$", (None, None)),
+            (r"blocks/qkv_w$", (None, None, "model")),
+            (r"blocks/qkv_b$", (None, "model")),
+            (r"blocks/attn_proj_w$", (None, "model", None)),
+            (r"blocks/mlp_fc_w$", (None, None, "model")),
+            (r"blocks/mlp_fc_b$", (None, "model")),
+            (r"blocks/mlp_proj_w$", (None, "model", None)),
+        ]
+
+    def flops_per_token(self, seq_len: Optional[int] = None):
+        """Training FLOPs/token: 6N + attention term (12·L·D·T)."""
+        cfg = self.config
+        d, l = cfg.n_embd, cfg.n_layer
+        n_params = 12 * l * d * d + cfg.padded_vocab * d + cfg.n_positions * d
+        flops = 6 * n_params
+        if seq_len:
+            flops += 12 * l * d * seq_len  # attention matmuls (fwd+bwd)
+        return flops

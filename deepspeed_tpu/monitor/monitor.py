@@ -1,0 +1,119 @@
+"""Monitoring fan-out.
+
+Re-implementation of deepspeed/monitor/monitor.py:29 ``MonitorMaster``:
+an event sink `write_events([(tag, value, step)])` fanning out to
+TensorBoard / W&B / CSV sinks, each config-gated. Only the data-parallel-
+coordinating process writes (reference: rank-0 guard in each monitor).
+"""
+
+import csv
+import os
+from typing import List, Tuple
+
+import jax
+
+from ..utils.logging import logger
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = bool(config.enabled)
+
+    def write_events(self, event_list):
+        raise NotImplementedError
+
+
+class CsvMonitor(Monitor):
+    """reference monitor/csv_monitor.py"""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.output_path = config.output_path or "csv_monitor_output"
+        self.job_name = config.job_name
+        self._files = {}
+        if self.enabled and jax.process_index() == 0:
+            os.makedirs(os.path.join(self.output_path, self.job_name),
+                        exist_ok=True)
+
+    def _file_for(self, tag):
+        if tag not in self._files:
+            safe = tag.replace("/", "_")
+            path = os.path.join(self.output_path, self.job_name, f"{safe}.csv")
+            f = open(path, "a", newline="")
+            self._files[tag] = (f, csv.writer(f))
+        return self._files[tag]
+
+    def write_events(self, event_list):
+        if not self.enabled or jax.process_index() != 0:
+            return
+        for tag, value, step in event_list:
+            f, writer = self._file_for(tag)
+            writer.writerow([step, value])
+            f.flush()
+
+
+class TensorBoardMonitor(Monitor):
+    """reference monitor/tensorboard.py — uses torch's SummaryWriter if
+    importable, else degrades to disabled with a warning."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if self.enabled and jax.process_index() == 0:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                os.makedirs(config.output_path or "./runs", exist_ok=True)
+                self.summary_writer = SummaryWriter(
+                    log_dir=os.path.join(config.output_path or "./runs",
+                                         config.job_name))
+            except Exception as e:
+                logger.warning(f"tensorboard unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if self.summary_writer is None:
+            return
+        for tag, value, step in event_list:
+            self.summary_writer.add_scalar(tag, value, step)
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    """reference monitor/wandb.py"""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._wandb = None
+        if self.enabled and jax.process_index() == 0:
+            try:
+                import wandb
+                wandb.init(project=config.project, group=config.group,
+                           entity=config.team)
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning(f"wandb unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if self._wandb is None:
+            return
+        for tag, value, step in event_list:
+            self._wandb.log({tag: value}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """reference monitor/monitor.py:29 — owns all sinks."""
+
+    def __init__(self, ds_config):
+        self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(ds_config.wandb)
+        self.csv_monitor = CsvMonitor(ds_config.csv_monitor)
+        self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
+                        or self.csv_monitor.enabled)
+
+    def write_events(self, event_list: List[Tuple[str, float, int]]):
+        if not self.enabled:
+            return
+        for sink in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if sink.enabled:
+                sink.write_events(event_list)

@@ -1,0 +1,75 @@
+"""Attention op with backend dispatch.
+
+The TPU equivalent of the reference's fused attention kernels
+(csrc/transformer/softmax_kernels.cu, csrc/transformer/inference softmax/
+softmax_context): a Pallas flash-attention kernel on TPU (ops/pallas/
+flash_attention.py), and an XLA reference path used on CPU (tests) and as the
+numerics oracle. Loaded via FlashAttentionBuilder through the accelerator
+op-builder seam.
+"""
+
+import functools
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+
+
+def reference_attention(q, k, v, causal=True, mask=None, softmax_scale=None,
+                        dropout_rate=0.0, dropout_rng=None):
+    """Plain XLA attention. q,k,v: [B, H, T, D] (q may have Tq != Tk for
+    decode). Numerics oracle for the Pallas kernel."""
+    *_, t_q, d = q.shape
+    t_k = k.shape[-2]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / jnp.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if causal:
+        # offset so the last query attends to all keys (decode-friendly)
+        q_pos = jnp.arange(t_q)[:, None] + (t_k - t_q)
+        k_pos = jnp.arange(t_k)[None, :]
+        causal_mask = q_pos >= k_pos
+        logits = jnp.where(causal_mask[None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+@functools.lru_cache(None)
+def _get_pallas_flash():
+    from .pallas.flash_attention import flash_attention
+    return flash_attention
+
+
+def flash_attention(q, k, v, causal=True, mask=None, softmax_scale=None,
+                    dropout_rate=0.0, dropout_rng=None, backend="auto"):
+    """Dispatch: Pallas on TPU, XLA reference elsewhere."""
+    use_pallas = False
+    if backend == "pallas":
+        use_pallas = True
+    elif backend == "auto":
+        try:
+            use_pallas = (dropout_rate == 0.0 and mask is None
+                          and jax.default_backend() == "tpu"
+                          and q.shape[-2] >= 128 and q.shape[-2] == k.shape[-2]
+                          and q.shape[-1] in (64, 128, 256))
+        except Exception:
+            use_pallas = False
+    if use_pallas:
+        try:
+            return _get_pallas_flash()(q, k, v, causal=causal,
+                                       softmax_scale=softmax_scale)
+        except Exception:
+            pass
+    return reference_attention(q, k, v, causal=causal, mask=mask,
+                               softmax_scale=softmax_scale,
+                               dropout_rate=dropout_rate, dropout_rng=dropout_rng)
+
+
+def get_ops(backend: str):
+    return SimpleNamespace(flash_attention=flash_attention,
+                           reference_attention=reference_attention)

@@ -1,0 +1,100 @@
+"""Data loading.
+
+Analogue of deepspeed/runtime/dataloader.py (DeepSpeedDataLoader built by
+engine.deepspeed_io, engine.py:1542). TPU-native twist: every process loads
+the *global* batch layout it owns; batches are numpy pytrees handed to the
+jitted step, which shards them over the dp axes of the mesh via the batch
+sharding. Works with dict-of-arrays, sequence datasets (torch-style
+__getitem__/__len__), or any iterable.
+"""
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration
+    (reference runtime/dataloader.py RepeatingLoader)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+def _default_collate(samples):
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(np.stack([s[i] for s in samples])
+                           for i in range(len(first)))
+    return np.stack(samples)
+
+
+class DeepSpeedDataLoader:
+
+    def __init__(self, dataset, batch_size, collate_fn=None, shuffle=False,
+                 drop_last=True, seed=0, num_local_io_workers=None,
+                 data_sampler=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.data_sampler = data_sampler
+        self._rng = np.random.default_rng(seed)
+        self.epoch = 0
+
+    def _indices(self):
+        n = len(self.dataset)
+        idx = np.arange(n)
+        if self.data_sampler is not None:
+            return np.asarray(list(iter(self.data_sampler)))
+        if self.shuffle:
+            self._rng.shuffle(idx)
+        return idx
+
+    def __len__(self):
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        if isinstance(self.dataset, dict):
+            yield from self._iter_dict()
+            return
+        idx = self._indices()
+        n_batches = len(self)
+        for b in range(n_batches):
+            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            samples = [self.dataset[int(i)] for i in sel]
+            yield self.collate_fn(samples)
+        self.epoch += 1
+
+    def _iter_dict(self):
+        keys = list(self.dataset.keys())
+        n = len(self.dataset[keys[0]])
+        idx = np.arange(n)
+        if self.shuffle:
+            self._rng.shuffle(idx)
+        n_batches = (n // self.batch_size if self.drop_last
+                     else (n + self.batch_size - 1) // self.batch_size)
+        for b in range(n_batches):
+            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            yield {k: np.asarray(v)[sel] for k, v in self.dataset.items()}
+        self.epoch += 1

@@ -1,0 +1,2 @@
+from .loss_scaler import (LossScaleState, init_loss_scale_state, grads_finite,
+                          update_loss_scale)

@@ -1,0 +1,68 @@
+"""Loss scaling for fp16 training.
+
+Functional re-design of the reference loss scalers
+(deepspeed/runtime/fp16/loss_scaler.py:265 — LossScaler/DynamicLossScaler).
+The scaler state lives *inside* the jitted train step as a small pytree, and
+the overflow check + scale update are pure ops (lax.cond), so skipped steps
+compile into the same program rather than branching in Python.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray          # f32 scalar
+    good_steps: jnp.ndarray     # i32 scalar, consecutive overflow-free steps
+    hysteresis: jnp.ndarray     # i32 scalar, remaining tolerance
+
+
+def init_loss_scale_state(fp16_config=None, static_scale=None) -> LossScaleState:
+    if static_scale is not None:
+        scale = float(static_scale)
+    elif fp16_config is not None and not fp16_config.dynamic_loss_scale:
+        scale = float(fp16_config.loss_scale)
+    elif fp16_config is not None:
+        scale = float(2 ** fp16_config.initial_scale_power)
+    else:
+        scale = 1.0
+    hysteresis = fp16_config.hysteresis if fp16_config else 2
+    return LossScaleState(scale=jnp.float32(scale),
+                          good_steps=jnp.int32(0),
+                          hysteresis=jnp.int32(hysteresis))
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    leaves = jax.tree.leaves(grads)
+    return jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves]))
+
+
+def update_loss_scale(state: LossScaleState, finite: jnp.ndarray,
+                      dynamic: bool, scale_window: int = 1000,
+                      scale_factor: float = 2.0, min_scale: float = 1.0,
+                      max_hysteresis: int = 2) -> LossScaleState:
+    """Mirrors DynamicLossScaler.update_scale semantics
+    (loss_scaler.py: backoff on overflow w/ hysteresis, growth after
+    `scale_window` clean steps)."""
+    if not dynamic:
+        return state
+
+    def on_overflow(s):
+        new_hyst = s.hysteresis - 1
+        do_backoff = new_hyst <= 0
+        new_scale = jnp.where(do_backoff,
+                              jnp.maximum(s.scale / scale_factor, min_scale),
+                              s.scale)
+        new_hyst = jnp.where(do_backoff, jnp.int32(max_hysteresis), new_hyst)
+        return LossScaleState(scale=new_scale, good_steps=jnp.int32(0),
+                              hysteresis=new_hyst)
+
+    def on_clean(s):
+        grow = (s.good_steps + 1) % scale_window == 0
+        new_scale = jnp.where(grow, s.scale * scale_factor, s.scale)
+        return LossScaleState(scale=new_scale, good_steps=s.good_steps + 1,
+                              hysteresis=s.hysteresis)
+
+    return jax.lax.cond(finite, on_clean, on_overflow, state)

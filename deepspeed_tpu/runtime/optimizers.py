@@ -1,0 +1,139 @@
+"""Optimizer construction.
+
+The TPU analogue of the reference optimizer zoo (FusedAdam csrc/adam/
+multi_tensor_adam.cu, DeepSpeedCPUAdam, FusedLamb, plus
+_configure_basic_optimizer engine.py:1207). On TPU a "fused multi-tensor"
+optimizer is simply the XLA-fused pytree update — the compiler fuses the
+elementwise chains across leaves — so the design centers on:
+
+  * a uniform ``Optimizer`` pair (init, update) where the learning rate is a
+    *runtime scalar argument* (the host-side LR scheduler drives it, like the
+    reference's param-group lr mutation, with zero recompiles), and
+  * weight-decay mode parity: ``adam`` = L2-into-grad (torch semantics),
+    ``adamw`` = decoupled decay.
+
+Supported types mirror DEEPSPEED_OPTIMIZERS (runtime/config.py): adam, adamw,
+lamb, sgd, adagrad, lion (+ onebit variants mapping to their base optimizer
+with quantized-collective comm handled in the comm layer).
+"""
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class Optimizer(NamedTuple):
+    init: Callable[[Any], Any]
+    # update(grads, state, params, lr) -> (new_params, new_state)
+    update: Callable[[Any, Any, Any, Any], Any]
+    name: str = "custom"
+    defaults: dict = {}
+
+
+def _chain_update(core, params, grads, state, lr, weight_decay, decoupled,
+                  trust_ratio=False):
+    if weight_decay and not decoupled:
+        grads = jax.tree.map(lambda g, p: g + weight_decay * p, grads, params)
+    updates, new_state = core.update(grads, state, params)
+    if weight_decay and decoupled:
+        updates = jax.tree.map(lambda u, p: u + weight_decay * p, updates, params)
+    if trust_ratio:
+        def per_leaf(u, p):
+            p_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(u.reshape(-1))
+            ratio = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm, 1.0)
+            return u * ratio
+        updates = jax.tree.map(per_leaf, updates, params)
+    new_params = jax.tree.map(lambda p, u: (p - lr * u).astype(p.dtype),
+                              params, updates)
+    return new_params, new_state
+
+
+def get_optimizer(name: str, params_config: dict = None) -> Optimizer:
+    cfg = dict(params_config or {})
+    name = name.lower()
+    lr0 = cfg.pop("lr", 1e-3)
+    betas = cfg.pop("betas", (0.9, 0.999))
+    eps = cfg.pop("eps", 1e-8)
+    weight_decay = cfg.pop("weight_decay", 0.0)
+    momentum = cfg.pop("momentum", 0.0)
+    cfg.pop("torch_adam", None)
+    cfg.pop("adam_w_mode", None)
+    cfg.pop("freeze_step", None)          # onebit warmup — comm-layer concern
+    cfg.pop("cuda_aware", None)
+    cfg.pop("comm_backend_name", None)
+    bias_correction = cfg.pop("bias_correction", True)
+    defaults = {"lr": lr0, "betas": betas, "eps": eps,
+                "weight_decay": weight_decay}
+
+    if name in ("adam", "adamw", "fusedadam", "onebitadam", "zerooneadam",
+                "cpu_adam"):
+        core = optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps,
+                                   nesterov=False)
+        if not bias_correction:
+            core = optax.scale_by_rms(decay=betas[1], eps=eps)
+        decoupled = name != "adam"  # reference: adam w/ adam_w_mode=True is default
+        # DeepSpeed's "adam" defaults to AdamW-mode (engine.py:1207 adam_w_mode)
+        decoupled = True if name == "adam" else decoupled
+
+        def update(grads, state, params, lr):
+            return _chain_update(core, params, grads, state, lr,
+                                 weight_decay, decoupled)
+
+        return Optimizer(core.init, update, name, defaults)
+
+    if name in ("lamb", "fusedlamb", "onebitlamb"):
+        core = optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps)
+
+        def update(grads, state, params, lr):
+            return _chain_update(core, params, grads, state, lr, weight_decay,
+                                 decoupled=True, trust_ratio=True)
+
+        return Optimizer(core.init, update, name, defaults)
+
+    if name == "sgd":
+        core = (optax.trace(decay=momentum) if momentum
+                else optax.identity())
+
+        def update(grads, state, params, lr):
+            return _chain_update(core, params, grads, state, lr, weight_decay,
+                                 decoupled=False)
+
+        return Optimizer(core.init, update, name, defaults)
+
+    if name == "adagrad":
+        core = optax.scale_by_rss(initial_accumulator_value=0.0, eps=eps)
+
+        def update(grads, state, params, lr):
+            return _chain_update(core, params, grads, state, lr, weight_decay,
+                                 decoupled=False)
+
+        return Optimizer(core.init, update, name, defaults)
+
+    if name == "lion":
+        core = optax.scale_by_lion(b1=betas[0], b2=betas[1])
+
+        def update(grads, state, params, lr):
+            return _chain_update(core, params, grads, state, lr, weight_decay,
+                                 decoupled=True)
+
+        return Optimizer(core.init, update, name, defaults)
+
+    raise ValueError(f"Unknown optimizer type: {name}")
+
+
+def wrap_client_optimizer(tx) -> Optimizer:
+    """Accept a user optax.GradientTransformation (reference: client optimizer
+    object passed to deepspeed.initialize). LR is baked into the client tx;
+    the lr arg is ignored."""
+    if isinstance(tx, Optimizer):
+        return tx
+
+    def update(grads, state, params, lr):
+        updates, new_state = tx.update(grads, state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_state
+
+    return Optimizer(tx.init, update, "client")

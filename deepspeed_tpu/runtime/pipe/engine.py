@@ -1,0 +1,16 @@
+"""Pipeline engine (1F1B over the 'pipe' mesh axis).
+
+Implemented in the pipeline-parallelism milestone; see schedule.py for the
+instruction streams. Placeholder raising until then so top-level initialize()
+can dispatch.
+"""
+
+from ..engine import DeepSpeedEngine
+
+
+class PipelineEngine(DeepSpeedEngine):
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "PipelineEngine lands with the pipeline-parallelism milestone; "
+            "use pipeline_parallel_size=1 for now")

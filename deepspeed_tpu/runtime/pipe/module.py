@@ -1,0 +1,153 @@
+"""Pipeline model front-end.
+
+Re-design of the reference PipelineModule (runtime/pipe/module.py:85,
+LayerSpec/TiedLayerSpec :29,76): a model expressed as a list of layer specs,
+partitioned into contiguous stage ranges. TPU-native difference: a "layer" is
+a functional (init, apply) pair over activations, stages map to slices of the
+'pipe' mesh axis, and tied layers share a single param leaf (pytree aliasing)
+instead of replication + allreduce.
+"""
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ...models.api import ModelSpec
+from ...utils.logging import logger
+
+
+class LayerSpec:
+    """Deferred layer constructor (reference module.py:29)."""
+
+    def __init__(self, typename: Callable, *args, **kwargs):
+        self.typename = typename
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.typename(*self.args, **self.kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer sharing params with all other layers of the same key
+    (reference module.py:76)."""
+
+    def __init__(self, key: str, typename: Callable, *args,
+                 forward_fn: Optional[Callable] = None, **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+class PipelineModule(ModelSpec):
+    """Layer-list model, partitioned across pipeline stages.
+
+    Each built layer must provide:
+        init(rng) -> params          (possibly empty dict for stateless)
+        apply(params, x, rng=None, train=True) -> x
+    The final loss_fn(last_activation, batch) -> scalar is supplied by the
+    caller (reference: loss_fn argument to PipelineModule).
+    """
+
+    def __init__(self, layers: Sequence[LayerSpec], num_stages: int = 1,
+                 loss_fn: Optional[Callable] = None,
+                 partition_method: str = "uniform",
+                 activation_checkpoint_interval: int = 0,
+                 batch_fn: Optional[Callable] = None):
+        self.layer_specs = list(layers)
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.batch_fn = batch_fn
+        self._layers = [spec.build() if isinstance(spec, LayerSpec) else spec
+                        for spec in self.layer_specs]
+        self.parts = self._partition_layers()
+        # tied keys → list of layer indices
+        self.tied_groups = {}
+        for i, spec in enumerate(self.layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                self.tied_groups.setdefault(spec.key, []).append(i)
+
+    # -- partitioning (reference module.py:353 uniform/parameters methods)
+    def _partition_layers(self) -> List[int]:
+        n = len(self._layers)
+        method = self.partition_method.lower()
+        if method in ("uniform", "type:regex_placeholder"):
+            return list(np.linspace(0, n, self.num_stages + 1, dtype=int))
+        if method == "parameters":
+            weights = []
+            for layer in self._layers:
+                try:
+                    shapes = jax.eval_shape(layer.init, jax.random.PRNGKey(0))
+                    weights.append(sum(int(np.prod(s.shape))
+                                       for s in jax.tree.leaves(shapes)))
+                except Exception:
+                    weights.append(1)
+            weights = np.asarray(weights, dtype=np.float64) + 1e-6
+            cum = np.concatenate([[0.0], np.cumsum(weights)])
+            targets = np.linspace(0, cum[-1], self.num_stages + 1)
+            parts = [int(np.searchsorted(cum, t)) for t in targets]
+            parts[0], parts[-1] = 0, n
+            return parts
+        raise ValueError(f"Unknown partition_method {self.partition_method}")
+
+    def stage_layer_range(self, stage_id: int):
+        return self.parts[stage_id], self.parts[stage_id + 1]
+
+    # -- ModelSpec interface (whole-model view; the pipeline engine uses the
+    #    per-stage slices)
+    def init(self, rng):
+        params = []
+        tied_cache = {}
+        keys = jax.random.split(rng, max(len(self._layers), 1))
+        for i, (spec, layer) in enumerate(zip(self.layer_specs, self._layers)):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key in tied_cache:
+                    params.append({"__tied__": spec.key})
+                    continue
+                p = layer.init(keys[i])
+                tied_cache[spec.key] = p
+                params.append(p)
+            else:
+                params.append(layer.init(keys[i]))
+        return params
+
+    def resolve_tied(self, params):
+        """Replace {'__tied__': key} placeholders with the owning leaf."""
+        tied = {}
+        for i, spec in enumerate(self.layer_specs):
+            if isinstance(spec, TiedLayerSpec) and not (
+                    isinstance(params[i], dict) and "__tied__" in params[i]):
+                tied[spec.key] = params[i]
+        out = []
+        for i, p in enumerate(params):
+            if isinstance(p, dict) and "__tied__" in p:
+                out.append(tied[p["__tied__"]])
+            else:
+                out.append(p)
+        return out
+
+    def apply(self, params, batch, rng=None, train=True):
+        """Sequential (single-stage) execution; loss from loss_fn."""
+        resolved = self.resolve_tied(params)
+        x = batch["inputs"] if isinstance(batch, dict) and "inputs" in batch else batch
+        if self.batch_fn is not None:
+            x = self.batch_fn(x)
+        for i, layer in enumerate(self._layers):
+            layer_rng = None if rng is None else jax.random.fold_in(rng, i)
+            fn = layer.apply
+            if self.activation_checkpoint_interval and \
+                    i % self.activation_checkpoint_interval == 0:
+                fn = jax.checkpoint(fn)
+            x = fn(resolved[i], x, rng=layer_rng, train=train)
+        if self.loss_fn is not None:
+            return self.loss_fn(x, batch)
+        return x
+
+    def num_layers(self):
+        return len(self._layers)
+
+    def partition_rules(self):
+        return []

@@ -1,0 +1,139 @@
+"""ZeRO as sharding rules.
+
+This module is the TPU-native collapse of the reference's ZeRO machinery
+(runtime/zero/stage_1_and_2.py, stage3.py, partition_parameters.py,
+partitioned_param_coordinator.py — ~7k LoC of hooks/buckets/streams): each
+stage is expressed as *where each pytree leaf lives on the mesh*, and
+pjit/GSPMD materializes the gathers/reduce-scatters the reference did by hand:
+
+  stage 0: params/grads/opt replicated; grad sync = psum (DDP allreduce,
+           engine.py:2215).
+  stage 1: optimizer state sharded over dp (stage_1_and_2.py partitioning).
+  stage 2: + grads reduce-scattered: the jitted step emits grads with a
+           dp-sharded out_sharding, so XLA lowers the grad sum to
+           reduce-scatter (the average_tensor path, stage_1_and_2.py:894).
+  stage 3: + params sharded over dp; XLA inserts per-layer all-gathers inside
+           the layer scan and overlaps them with compute (replacing the
+           prefetch coordinator).
+
+Leaves smaller than ``stage3_param_persistence_threshold`` stay replicated —
+the same knob as the reference (zero/config.py stage3_param_persistence_
+threshold): tiny leaves (biases, layernorms) aren't worth a gather.
+"""
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...models.api import match_rule, param_path_tree
+from ...parallel.topology import DeviceMeshManager, DP_AXES
+
+
+def _tp_spec(path: str, rules, ndim: int) -> list:
+    spec = match_rule(path, rules or [])
+    if spec is None:
+        return [None] * ndim
+    spec = list(spec)
+    assert len(spec) == ndim, f"rule for {path} has wrong rank {spec} vs {ndim}"
+    return spec
+
+
+def _add_dp_axis(spec: list, shape: Tuple[int, ...], dp_world: int,
+                 min_size: int) -> list:
+    """Shard the largest still-free, dp-divisible dim over the dp axes."""
+    if int(np.prod(shape or (1,))) < max(min_size, dp_world):
+        return spec
+    best = None
+    for i, (s, dim) in enumerate(zip(spec, shape)):
+        if s is None and dim % dp_world == 0:
+            if best is None or dim > shape[best]:
+                best = i
+    if best is not None:
+        spec[best] = DP_AXES
+    return spec
+
+
+class ZeroShardingPlanner:
+    """Computes NamedShardings for params / grads / optimizer state."""
+
+    def __init__(self, mesh_manager: DeviceMeshManager, stage: int,
+                 rules: Optional[Sequence] = None,
+                 persistence_threshold: int = 0):
+        self.mm = mesh_manager
+        self.stage = stage
+        self.rules = list(rules or [])
+        self.persistence_threshold = persistence_threshold
+        # drop TP rules if there is no model axis
+        if self.mm.tp == 1:
+            self.rules = []
+
+    # -- per-leaf specs ---------------------------------------------------
+    def _leaf_spec(self, path: str, shape, dp_sharded: bool) -> P:
+        spec = _tp_spec(path, self.rules, len(shape))
+        if dp_sharded and self.mm.dp_world_size > 1:
+            spec = _add_dp_axis(spec, shape, self.mm.dp_world_size,
+                                self.persistence_threshold)
+        return P(*spec)
+
+    def param_spec(self, path: str, shape) -> P:
+        return self._leaf_spec(path, shape, dp_sharded=self.stage >= 3)
+
+    def grad_spec(self, path: str, shape) -> P:
+        return self._leaf_spec(path, shape, dp_sharded=self.stage >= 2)
+
+    def opt_spec(self, path: str, shape) -> P:
+        return self._leaf_spec(path, shape, dp_sharded=self.stage >= 1)
+
+    # -- pytree-level shardings ------------------------------------------
+    def _tree_shardings(self, params_like, spec_fn):
+        paths = param_path_tree(params_like)
+        mesh = self.mm.mesh
+
+        def leaf(path, x):
+            shape = getattr(x, "shape", ())
+            if len(shape) == 0:
+                return NamedSharding(mesh, P())
+            return NamedSharding(mesh, spec_fn(path, shape))
+
+        return jax.tree.map(leaf, paths, params_like)
+
+    def param_shardings(self, params_like):
+        return self._tree_shardings(params_like, self.param_spec)
+
+    def grad_shardings(self, params_like):
+        return self._tree_shardings(params_like, self.grad_spec)
+
+    def opt_state_shardings(self, opt_state_like, params_like):
+        """Optimizer state leaves that mirror a param shape get the
+        opt-sharded spec; scalars/counters stay replicated.
+
+        Keyed by shape match per-leaf (optax states like ScaleByAdamState hold
+        mu/nu pytrees with the params' structure plus scalar counts)."""
+        mesh = self.mm.mesh
+        paths = param_path_tree(params_like)
+        shape_to_spec = {}
+        leaves_paths = jax.tree.leaves(paths)
+        leaves_params = jax.tree.leaves(params_like)
+        for path, x in zip(leaves_paths, leaves_params):
+            shape = tuple(getattr(x, "shape", ()))
+            if shape and shape not in shape_to_spec:
+                shape_to_spec[shape] = self.opt_spec(path, shape)
+
+        def leaf(x):
+            shape = tuple(getattr(x, "shape", ()))
+            spec = shape_to_spec.get(shape)
+            if not shape or spec is None:
+                return NamedSharding(mesh, P())
+            return NamedSharding(mesh, spec)
+
+        return jax.tree.map(leaf, opt_state_like)
+
+    def describe(self, params_like):
+        """Debug: path → spec table (analogue of ds_summary dumps)."""
+        paths = jax.tree.leaves(param_path_tree(params_like))
+        out = []
+        for path, x in zip(paths, jax.tree.leaves(params_like)):
+            out.append((path, tuple(x.shape), str(self.param_spec(path, x.shape))))
+        return out

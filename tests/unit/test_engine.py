@@ -1,0 +1,139 @@
+"""End-to-end engine tests: tiny GPT-2 on an 8-device CPU mesh, across ZeRO
+stages and precisions — the "few steps, assert loss decreases / parity with
+baseline" pattern of reference tests/unit/runtime/zero/test_zero.py:57-190."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+TINY = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                  n_head=4, pad_vocab_to_multiple=8)
+
+
+def make_batch(rng, gas, global_micro, seqlen=16):
+    return {"input_ids": rng.integers(0, 255, size=(gas, global_micro, seqlen),
+                                      dtype=np.int32)}
+
+
+def base_config(stage=0, **over):
+    cfg = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "steps_per_print": 0,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def run_steps(config, n_steps=5, seed=0):
+    model = GPT2Model(TINY)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rng = np.random.default_rng(seed)
+    losses = []
+    for _ in range(n_steps):
+        batch = make_batch(rng, engine.gradient_accumulation_steps,
+                           engine.train_micro_batch_size_per_gpu * engine.dp_world_size)
+        loss = engine.train_batch(batch=batch)
+        losses.append(float(loss))
+    return engine, losses
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stage_trains(stage):
+    engine, losses = run_steps(base_config(stage=stage))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+
+
+def test_zero_stages_parity():
+    """All ZeRO stages must produce the SAME loss trajectory (they are
+    rearrangements of the same math) — the core ZeRO correctness property."""
+    _, base = run_steps(base_config(stage=0))
+    for stage in (1, 2, 3):
+        _, losses = run_steps(base_config(stage=stage))
+        np.testing.assert_allclose(losses, base, rtol=2e-4,
+                                   err_msg=f"stage {stage} diverges from stage 0")
+
+
+def test_bf16_trains():
+    engine, losses = run_steps(base_config(stage=2, bf16={"enabled": True}))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_fp16_loss_scaling():
+    cfg = base_config(stage=1, fp16={"enabled": True, "initial_scale_power": 8})
+    engine, losses = run_steps(cfg)
+    assert np.isfinite(losses).all()
+    assert engine.cur_scale > 0
+
+
+def test_forward_backward_step_api():
+    """Reference-style user loop (engine.py:1634/1775/1971)."""
+    model = GPT2Model(TINY)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=base_config(stage=2))
+    rng = np.random.default_rng(0)
+    global_micro = engine.train_micro_batch_size_per_gpu * engine.dp_world_size
+    losses = []
+    for step in range(3):
+        for _ in range(engine.gradient_accumulation_steps):
+            batch = {"input_ids": rng.integers(0, 255, (global_micro, 16),
+                                               dtype=np.int32)}
+            loss = engine.forward(batch)
+            engine.backward(loss)
+            losses.append(float(loss))
+        assert engine.is_gradient_accumulation_boundary()
+        engine.step()
+    assert engine.global_steps == 3
+    assert np.isfinite(losses).all()
+
+
+def test_api_path_matches_fused_path():
+    """forward/backward/step must compute the same update as train_batch."""
+    rng = np.random.default_rng(7)
+    batches = [make_batch(rng, 2, 8) for _ in range(3)]
+
+    model = GPT2Model(TINY)
+    e1, _, _, _ = deepspeed_tpu.initialize(model=model, config=base_config(stage=1))
+    for b in batches:
+        e1.train_batch(batch=b)
+
+    e2, _, _, _ = deepspeed_tpu.initialize(model=model, config=base_config(stage=1))
+    for b in batches:
+        for g in range(2):
+            micro = {k: v[g] for k, v in b.items()}
+            loss = e2.forward(micro)
+            e2.backward(loss)
+        e2.step()
+
+    p1 = jax.tree.leaves(e1.get_fp32_params())
+    p2 = jax.tree.leaves(e2.get_fp32_params())
+    for a, b_ in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-5)
+
+
+def test_eval_batch():
+    engine, _ = run_steps(base_config(stage=0), n_steps=1)
+    rng = np.random.default_rng(1)
+    batch = {"input_ids": rng.integers(0, 255, (8, 16), dtype=np.int32)}
+    loss = engine.eval_batch(batch)
+    assert np.isfinite(float(loss))
+
+
+def test_lr_scheduler_integration():
+    cfg = base_config(
+        stage=0,
+        scheduler={"type": "WarmupLR",
+                   "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-3,
+                              "warmup_num_steps": 10}})
+    engine, _ = run_steps(cfg, n_steps=3)
+    assert engine.get_lr()[0] > 0
+    assert engine.lr_scheduler.last_batch_iteration == 2
