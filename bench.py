@@ -1,0 +1,104 @@
+"""Headline benchmark: GPT-2 125M training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline compares our MFU against the reference's headline training
+efficiency (BERT-Large 64 TFLOPS on a 125-TFLOPS V100 = 0.512 MFU,
+docs/_posts/2020-05-28-fastest-bert-training.md:36-38).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+REFERENCE_MFU = 64.0 / 125.0  # reference headline: BERT-Large on V100
+
+# bf16 peak TFLOP/s per chip by TPU generation
+PEAK_TFLOPS = {
+    "v5e": 197.0, "v5litepod": 197.0, "v5p": 459.0,
+    "v4": 275.0, "v6e": 918.0,
+}
+
+
+def detect_peak():
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for key, val in PEAK_TFLOPS.items():
+        if key in gen:
+            return val * 1e12
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for key, val in PEAK_TFLOPS.items():
+        if key in kind.replace(" ", ""):
+            return val * 1e12
+    return 197.0e12
+
+
+def main():
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Model, GPT2_125M
+    import dataclasses
+
+    seq = int(os.environ.get("BENCH_SEQ", 1024))
+    micro_bs = int(os.environ.get("BENCH_BS", 8))
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    warmup = 3
+
+    cfg = dataclasses.replace(GPT2_125M, n_positions=seq, remat=True,
+                              attn_backend="auto")
+    model = GPT2Model(cfg)
+    n_dev = len(deepspeed_tpu.parallel.topology.default_devices())
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_batch_size": micro_bs * n_dev,
+            "train_micro_batch_size_per_gpu": micro_bs,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 0},
+            "steps_per_print": 0,
+        })
+
+    rng = np.random.default_rng(0)
+    global_bs = micro_bs * engine.dp_world_size
+
+    def batch():
+        return {"input_ids": rng.integers(0, 50256, (1, global_bs, seq),
+                                          dtype=np.int32)}
+
+    for _ in range(warmup):
+        engine.train_batch(batch=batch())
+    jax.effects_barrier()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=batch())
+    jax.effects_barrier()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * global_bs * seq / dt
+    flops_per_token = model.flops_per_token(seq)
+    achieved = tokens_per_sec * flops_per_token
+    peak = detect_peak() * engine.dp_world_size
+    mfu = achieved / peak
+
+    print(json.dumps({
+        "metric": "gpt2_125m_bf16_train_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(mfu / REFERENCE_MFU, 4),
+        "detail": {
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "achieved_tflops": round(achieved / 1e12, 2),
+            "seq": seq, "micro_bs": micro_bs, "steps": steps,
+            "final_loss": round(float(loss), 4),
+            "devices": engine.dp_world_size,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

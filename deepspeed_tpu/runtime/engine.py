@@ -85,7 +85,10 @@ class DeepSpeedEngine:
         assert model is not None, "deepspeed_tpu.initialize requires a model"
         dist.init_distributed()
 
-        devices = default_devices()
+        if mesh_manager is not None:
+            devices = list(mesh_manager.mesh.devices.flat)
+        else:
+            devices = default_devices()
         self._config = DeepSpeedConfig(config, mpu=mpu, world_size=len(devices))
         cfg = self._config
 
