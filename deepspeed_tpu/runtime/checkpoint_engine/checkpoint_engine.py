@@ -1,0 +1,82 @@
+"""Pluggable checkpoint persistence backend.
+
+Mirrors the reference CheckpointEngine ABC
+(runtime/checkpoint_engine/checkpoint_engine.py:9: create/save/load/commit).
+Default backend serializes pytrees with flax msgpack (handles bf16); an
+orbax-based engine provides async + multi-host sharded saves (the Nebula
+analogue, nebula_checkpoint_engine.py).
+"""
+
+import os
+from typing import Any
+
+from ...utils.logging import logger
+
+
+class CheckpointEngine:
+
+    def __init__(self, config_params=None):
+        pass
+
+    def create(self, tag):
+        """Notify start of a new checkpoint `tag` (reference :15)."""
+
+    def makedirs(self, path, exist_ok=False):
+        os.makedirs(path, exist_ok=exist_ok)
+
+    def save(self, state_dict: Any, path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, map_location=None) -> Any:
+        raise NotImplementedError
+
+    def commit(self, tag):
+        """Flush/seal all files of `tag` (reference :26)."""
+        return True
+
+
+class MsgpackCheckpointEngine(CheckpointEngine):
+    """Default: flax msgpack bytes per state file."""
+
+    def save(self, state_dict, path):
+        from flax import serialization
+        data = serialization.msgpack_serialize(state_dict)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def load(self, path, map_location=None):
+        from flax import serialization
+        with open(path, "rb") as f:
+            return serialization.msgpack_restore(f.read())
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Sharded/async saves via orbax (multi-host path)."""
+
+    def __init__(self, config_params=None, use_async=False):
+        super().__init__(config_params)
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def save(self, state_dict, path):
+        self._ckptr.save(os.path.abspath(path), state_dict, force=True)
+
+    def load(self, path, map_location=None):
+        return self._ckptr.restore(os.path.abspath(path))
+
+    def commit(self, tag):
+        self._ckptr.wait_until_finished()
+        return True
+
+
+def get_checkpoint_engine(config) -> CheckpointEngine:
+    if getattr(config, "checkpoint_config", None) and \
+            getattr(config.checkpoint_config, "async_save", False):
+        try:
+            return OrbaxCheckpointEngine(use_async=True)
+        except Exception as e:
+            logger.warning(f"orbax engine unavailable ({e}); using msgpack")
+    return MsgpackCheckpointEngine()

@@ -1,0 +1,179 @@
+"""Engine checkpoint save/load.
+
+Re-design of the reference checkpoint path (engine.py:2493-3239:
+save_checkpoint/_save_zero_checkpoint/load_checkpoint + `latest` tag file +
+zero_to_fp32 offline merge + universal checkpoint).
+
+TPU-native simplification that *adds* capability: checkpoints store GLOBAL
+logical arrays (msgpack/orbax), not per-rank shards — so every checkpoint is
+already a "universal checkpoint" (reference checkpoint/universal_checkpoint.py):
+it loads under ANY mesh shape / ZeRO stage / dp degree; resharding happens in
+device_put against the target sharding. The reference's zero_to_fp32 merge
+script, elastic-checkpoint reshaping (checkpoint/zero_checkpoint.py) and
+mp-resharding (state_dict_factory.py) collapse into this property.
+
+Layout (reference layout kept recognizable):
+    <dir>/latest                          — tag file
+    <dir>/<tag>/model_states.msgpack      — fp32 master params (global)
+    <dir>/<tag>/optim_states.msgpack      — optimizer + loss-scale state
+    <dir>/<tag>/engine_state.json         — counters, lr sched, client state
+    <dir>/<tag>/ds_config.json            — config snapshot
+"""
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..utils.logging import logger, log_dist
+from .checkpoint_engine.checkpoint_engine import get_checkpoint_engine
+from .fp16.loss_scaler import LossScaleState
+
+import jax.numpy as jnp
+
+
+def _to_numpy_tree(tree):
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def save_checkpoint(engine, save_dir, tag=None, client_state=None,
+                    save_latest=True):
+    if tag is None:
+        tag = f"global_step{engine.global_steps}"
+    ckpt_engine = get_checkpoint_engine(engine._config)
+    ckpt_dir = os.path.join(save_dir, str(tag))
+    is_writer = jax.process_index() == 0
+
+    ckpt_engine.create(tag)
+    if is_writer:
+        os.makedirs(ckpt_dir, exist_ok=True)
+        ckpt_engine.save(_to_numpy_tree(engine.params),
+                         os.path.join(ckpt_dir, "model_states.msgpack"))
+        from flax import serialization
+        optim_state = {
+            "opt_state": serialization.to_state_dict(
+                _to_numpy_tree(engine.opt_state))
+            if engine.opt_state is not None else None,
+            "scaler": {
+                "scale": float(engine.scaler_state.scale),
+                "good_steps": int(engine.scaler_state.good_steps),
+                "hysteresis": int(engine.scaler_state.hysteresis),
+            },
+        }
+        ckpt_engine.save(optim_state,
+                         os.path.join(ckpt_dir, "optim_states.msgpack"))
+        engine_state = {
+            "global_steps": engine.global_steps,
+            "global_samples": engine.global_samples,
+            "micro_steps": engine.micro_steps,
+            "skipped_steps": engine.skipped_steps,
+            "zero_stage": engine.zero_stage,
+            "lr_scheduler": (engine.lr_scheduler.state_dict()
+                             if engine.lr_scheduler is not None and
+                             hasattr(engine.lr_scheduler, "state_dict") else None),
+            "client_state": client_state or {},
+            "dp_world_size": engine.dp_world_size,
+        }
+        with open(os.path.join(ckpt_dir, "engine_state.json"), "w") as f:
+            json.dump(engine_state, f, indent=2, default=str)
+        with open(os.path.join(ckpt_dir, "ds_config.json"), "w") as f:
+            json.dump(engine._config._param_dict, f, indent=2, default=str)
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(str(tag))
+    ckpt_engine.commit(tag)
+    from .. import comm as dist
+    dist.barrier()
+    log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+    return ckpt_dir
+
+
+def _restore_like(template_shardings, tree):
+    """device_put each leaf against the engine's target sharding — this IS
+    the universal-checkpoint reshard."""
+    return jax.tree.map(
+        lambda sh, x: jax.device_put(jnp.asarray(x), sh),
+        template_shardings, tree)
+
+
+def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
+                    load_lr_scheduler_states=True, load_module_only=False):
+    if tag is None:
+        latest_path = os.path.join(load_dir, "latest")
+        if not os.path.isfile(latest_path):
+            logger.warning(f"no 'latest' file at {load_dir}; nothing loaded")
+            return None, {}
+        with open(latest_path) as f:
+            tag = f.read().strip()
+    ckpt_dir = os.path.join(load_dir, str(tag))
+    if not os.path.isdir(ckpt_dir):
+        logger.warning(f"checkpoint dir {ckpt_dir} missing; nothing loaded")
+        return None, {}
+
+    ckpt_engine = get_checkpoint_engine(engine._config)
+    params = ckpt_engine.load(os.path.join(ckpt_dir, "model_states.msgpack"))
+    with engine.mesh:
+        engine.params = _restore_like(engine.param_shardings, params)
+
+    client_state: Dict[str, Any] = {}
+    state_path = os.path.join(ckpt_dir, "engine_state.json")
+    if os.path.isfile(state_path):
+        with open(state_path) as f:
+            engine_state = json.load(f)
+        if not load_module_only:
+            engine.global_steps = engine_state.get("global_steps", 0)
+            engine.global_samples = engine_state.get("global_samples", 0)
+            engine.micro_steps = engine_state.get("micro_steps", 0)
+            engine.skipped_steps = engine_state.get("skipped_steps", 0)
+            if (load_lr_scheduler_states and engine.lr_scheduler is not None
+                    and engine_state.get("lr_scheduler") is not None):
+                engine.lr_scheduler.load_state_dict(engine_state["lr_scheduler"])
+        client_state = engine_state.get("client_state", {})
+
+    if load_optimizer_states and not load_module_only and \
+            engine.opt_state is not None:
+        optim = ckpt_engine.load(os.path.join(ckpt_dir, "optim_states.msgpack"))
+        if optim.get("opt_state") is not None:
+            # msgpack restores namedtuples as nested containers; rebuild
+            # against the engine's live structure.
+            from flax import serialization
+            engine.opt_state = serialization.from_state_dict(
+                engine.opt_state, optim["opt_state"])
+            with engine.mesh:
+                engine.opt_state = _restore_like(engine.opt_state_shardings,
+                                                 engine.opt_state)
+        sc = optim.get("scaler", {})
+        engine.scaler_state = LossScaleState(
+            scale=jnp.float32(sc.get("scale", 1.0)),
+            good_steps=jnp.int32(sc.get("good_steps", 0)),
+            hysteresis=jnp.int32(sc.get("hysteresis", 2)))
+    log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
+    return ckpt_dir, client_state
+
+
+def save_16bit_model(engine, save_dir, save_filename="pytorch_model.msgpack"):
+    """Consolidated 16-bit export (reference engine.save_16bit_model
+    :3194 / _zero3_consolidated_16bit_state_dict :3127): gather everything,
+    cast to the compute dtype, single file."""
+    params = engine.get_fp32_params()
+    dtype = engine._compute_dtype or jnp.float32
+    params16 = jax.tree.map(
+        lambda x: np.asarray(x.astype(dtype))
+        if jnp.issubdtype(x.dtype, jnp.floating) else np.asarray(x), params)
+    if jax.process_index() == 0:
+        os.makedirs(save_dir, exist_ok=True)
+        get_checkpoint_engine(engine._config).save(
+            params16, os.path.join(save_dir, save_filename))
+    return os.path.join(save_dir, save_filename)
+
+
+def get_fp32_state_dict_from_checkpoint(ckpt_dir):
+    """Offline reader (the zero_to_fp32.py equivalent,
+    utils/zero_to_fp32.py:158): returns the fp32 param pytree from a
+    checkpoint directory without building an engine."""
+    from .checkpoint_engine.checkpoint_engine import MsgpackCheckpointEngine
+    path = os.path.join(ckpt_dir, "model_states.msgpack")
+    return MsgpackCheckpointEngine().load(path)
