@@ -104,12 +104,11 @@ class GPT2Model(ModelSpec):
         }
 
     # ----------------------------------------------------------------- block
-    def _block(self, x, layer_params, rng, train):
+    def _attn_sublayer(self, x, p, rng, train):
+        """ln1 → qkv → flash attention → proj → residual (+dropout)."""
         cfg = self.config
         b, t, d = x.shape
         h, hd = cfg.n_head, cfg.head_dim
-        p = layer_params
-
         ln1 = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_epsilon)
         qkv = ln1 @ p["qkv_w"].astype(ln1.dtype) + p["qkv_b"].astype(ln1.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -118,20 +117,28 @@ class GPT2Model(ModelSpec):
         v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         drop_rng = None
         if train and cfg.dropout > 0 and rng is not None:
-            rng, drop_rng = jax.random.split(rng)
+            drop_rng = jax.random.fold_in(rng, 3)
         attn = flash_attention(q, k, v, causal=True,
                                dropout_rate=cfg.dropout if train else 0.0,
                                dropout_rng=drop_rng, backend=cfg.attn_backend)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
         attn = attn @ p["attn_proj_w"].astype(attn.dtype) + p["attn_proj_b"].astype(attn.dtype)
-        x = x + self._dropout(attn, rng, train, 0)
+        return x + self._dropout(attn, rng, train, 0)
 
+    def _mlp_sublayer(self, x, p, rng, train):
+        """ln2 → fc → gelu → proj → residual (+dropout). Returns (x, aux)."""
+        cfg = self.config
         ln2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_epsilon)
         hmid = ln2 @ p["mlp_fc_w"].astype(ln2.dtype) + p["mlp_fc_b"].astype(ln2.dtype)
         hmid = jax.nn.gelu(hmid, approximate=True)
         out = hmid @ p["mlp_proj_w"].astype(hmid.dtype) + p["mlp_proj_b"].astype(hmid.dtype)
-        x = x + self._dropout(out, rng, train, 1)
-        return x
+        return x + self._dropout(out, rng, train, 1), jnp.float32(0.0)
+
+    def _block(self, x, layer_params, rng, train):
+        """One decoder block. Returns (x, aux_loss) — aux is nonzero only for
+        MoE variants."""
+        x = self._attn_sublayer(x, layer_params, rng, train)
+        return self._mlp_sublayer(x, layer_params, rng, train)
 
     def _dropout(self, x, rng, train, salt):
         cfg = self.config
@@ -142,7 +149,8 @@ class GPT2Model(ModelSpec):
         return x * keep / (1.0 - cfg.dropout)
 
     # --------------------------------------------------------------- forward
-    def logits(self, params, input_ids, rng=None, train=True):
+    def logits(self, params, input_ids, rng=None, train=True,
+               return_aux_loss=False):
         cfg = self.config
         # compute dtype follows the param dtype: the engine casts fp32 masters
         # to bf16/fp16 before apply (mixed-precision contract); cfg.dtype is
@@ -156,27 +164,34 @@ class GPT2Model(ModelSpec):
         x = self._dropout(x, rng, train, 2)
 
         def body(carry, layer_params):
-            h, i = carry
+            h, i, aux = carry
             layer_rng = None if rng is None else jax.random.fold_in(rng, i)
-            h = self._block(h, layer_params, layer_rng, train)
-            return (h, i + 1), None
+            h, l_aux = self._block(h, layer_params, layer_rng, train)
+            return (h, i + 1, aux + l_aux), None
 
         body_fn = body
         if cfg.remat:
             body_fn = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
-        (x, _), _ = lax.scan(body_fn, (x, 0), params["blocks"])
+        (x, _, aux_total), _ = lax.scan(body_fn, (x, 0, jnp.float32(0.0)),
+                                        params["blocks"])
 
         x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                         cfg.layer_norm_epsilon)
         logits = x @ wte.T
+        if return_aux_loss:
+            return logits, aux_total / cfg.n_layer
         return logits
+
+    def aux_loss_weight(self) -> float:
+        return 0.0
 
     def apply(self, params, batch, rng=None, train=True):
         """Next-token LM loss. batch: {'input_ids': [B,T]} (+ optional
         'labels' [B,T] with -100 = ignore, HF convention)."""
         cfg = self.config
         input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
-        logits = self.logits(params, input_ids, rng=rng, train=train)
+        logits, aux = self.logits(params, input_ids, rng=rng, train=train,
+                                  return_aux_loss=True)
         if isinstance(batch, dict) and "labels" in batch:
             labels = batch["labels"]
             shift_logits = logits[:, :-1]
@@ -189,7 +204,9 @@ class GPT2Model(ModelSpec):
         logp = jax.nn.log_softmax(shift_logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
         nll = jnp.where(valid, nll, 0.0)
-        return nll.sum() / jnp.maximum(valid.sum(), 1)
+        loss = nll.sum() / jnp.maximum(valid.sum(), 1)
+        w = self.aux_loss_weight()
+        return loss + w * aux if w else loss
 
     # ------------------------------------------------------------- sharding
     def partition_rules(self):

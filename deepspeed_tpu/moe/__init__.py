@@ -1,0 +1,7 @@
+"""Mixture-of-Experts (reference: deepspeed/moe/)."""
+
+from .layer import MoE
+from .experts import ExpertFFN
+from .sharded_moe import MOELayer, TopKGate, topk_gating
+from .utils import (has_moe_layers, is_moe_param_path,
+                    split_params_into_moe_and_dense)

@@ -1,0 +1,45 @@
+"""Local experts.
+
+Reference: deepspeed/moe/experts.py:10 ``Experts`` — a ModuleList of per-rank
+expert FFNs run in a Python loop over chunks. TPU-native design: expert
+parameters are stacked along a leading [E] axis (sharded over the ``expert``
+mesh axis) and all experts run as ONE batched einsum — the MXU sees a single
+large batched matmul instead of E small ones.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class ExpertFFN:
+    """Stacked per-expert 2-layer MLP: [E, M] → [E, F] → [E, M]."""
+
+    def __init__(self, model_dim: int, ffn_dim: int, num_experts: int,
+                 activation=None, initializer_range: float = 0.02):
+        self.model_dim = model_dim
+        self.ffn_dim = ffn_dim
+        self.num_experts = num_experts
+        self.activation = activation or (lambda x: jax.nn.gelu(x, approximate=True))
+        self.initializer_range = initializer_range
+
+    def init(self, rng):
+        e, m, f = self.num_experts, self.model_dim, self.ffn_dim
+        k1, k2 = jax.random.split(rng)
+        std = self.initializer_range
+        return {
+            "wi": jax.random.normal(k1, (e, m, f), jnp.float32) * std,
+            "bi": jnp.zeros((e, f)),
+            "wo": jax.random.normal(k2, (e, f, m), jnp.float32) * std / math.sqrt(2),
+            "bo": jnp.zeros((e, m)),
+        }
+
+    def apply(self, params, x, rng=None, train=True):
+        """x: [E, C, M] expert-major tokens → [E, C, M]."""
+        dt = x.dtype
+        h = jnp.einsum("ecm,emf->ecf", x, params["wi"].astype(dt))
+        h = h + params["bi"][:, None, :].astype(dt)
+        h = self.activation(h)
+        y = jnp.einsum("ecf,efm->ecm", h, params["wo"].astype(dt))
+        return y + params["bo"][:, None, :].astype(dt)

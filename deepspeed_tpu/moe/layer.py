@@ -1,0 +1,61 @@
+"""The user-facing MoE layer.
+
+Reference: deepspeed/moe/layer.py:16 ``MoE`` — wraps gate + experts + expert
+parallelism setup (EP process groups via deepspeed.utils.groups). Here EP
+groups are the ``expert`` mesh axis (parallel/topology.py); the layer just
+composes TopKGate + ExpertFFN into a functional init/apply pair.
+"""
+
+from typing import Optional
+
+import jax
+
+from .experts import ExpertFFN
+from .sharded_moe import MOELayer, TopKGate
+
+
+class MoE:
+    """Mixture of experts. apply() returns (output, l_aux, exp_counts) like
+    the reference MoE.forward (deepspeed/moe/layer.py:115)."""
+
+    def __init__(self,
+                 hidden_size: int,
+                 ffn_dim: Optional[int] = None,
+                 num_experts: int = 1,
+                 ep_size: int = 1,
+                 k: int = 1,
+                 capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0,
+                 min_capacity: int = 4,
+                 noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True,
+                 use_rts: bool = True,
+                 activation=None):
+        assert num_experts % max(ep_size, 1) == 0, \
+            f"num_experts={num_experts} must divide by ep_size={ep_size}"
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        self.ep_size = ep_size
+        self.gate = TopKGate(hidden_size, num_experts, k,
+                             capacity_factor, eval_capacity_factor,
+                             min_capacity, noisy_gate_policy, drop_tokens,
+                             use_rts)
+        self.experts = ExpertFFN(hidden_size, ffn_dim or 4 * hidden_size,
+                                 num_experts, activation=activation)
+        self.moe_layer = MOELayer(self.gate, self.experts)
+
+    def init(self, rng):
+        return self.moe_layer.init(rng)
+
+    def apply(self, params, x, rng=None, train=True):
+        return self.moe_layer.apply(params, x, rng=rng, train=train)
+
+    def partition_rules(self, prefix: str = ""):
+        """Expert leaves: leading E dim over the 'expert' axis; gate
+        replicated."""
+        return [
+            (prefix + r"experts/wi$", ("expert", None, None)),
+            (prefix + r"experts/bi$", ("expert", None)),
+            (prefix + r"experts/wo$", ("expert", None, None)),
+            (prefix + r"experts/bo$", ("expert", None)),
+        ]

@@ -1,0 +1,218 @@
+"""Sharded MoE: gating + dispatch/combine.
+
+TPU-native re-design of the reference gating/dispatch layer
+(deepspeed/moe/sharded_moe.py:179 ``top1gating``, :277 ``top2gating``, :420
+``MOELayer`` with the ``_AllToAll`` autograd function at :90). The reference
+dispatches tokens with an explicit NCCL all-to-all inside an autograd.Function;
+here dispatch/combine are einsums against a one-hot dispatch tensor with
+sharding constraints — expert tensors are sharded over the ``expert`` mesh
+axis, token tensors over the data axes, and GSPMD lowers the resharding between
+them to an ICI all-to-all (differentiable for free, no custom autograd).
+
+Gating semantics follow the reference (which follows GShard):
+  - top-1 / top-2 (generalized to top-k) with static per-expert capacity
+    ``ceil(k * S / E * capacity_factor)`` clamped to ``min_capacity``
+  - load-balance aux loss  l_aux = E * sum_e mean_s(gates[s,e]) * mean_s(mask[s,e])
+  - noisy gating: 'Jitter' (input multiplied by uniform noise) and 'RSample'
+    (logits + gaussian) policies
+  - token dropping by intra-expert position (cumsum order), or
+    ``drop_tokens=False`` → capacity = S (nothing dropped, more padding)
+  - optional random token selection (``use_rts``) for drop fairness
+"""
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.constraints import maybe_constraint
+from ..parallel.topology import DATA_AXIS, EXPERT_AXIS
+
+
+def _capacity(num_tokens: int, num_experts: int, k: int,
+              capacity_factor: float, min_capacity: int,
+              drop_tokens: bool) -> int:
+    if not drop_tokens:
+        return num_tokens
+    cap = int(math.ceil(k * num_tokens / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def _one_hot(x, n, dtype=jnp.float32):
+    return jax.nn.one_hot(x, n, dtype=dtype)
+
+
+def topk_gating(logits: jnp.ndarray,
+                k: int,
+                capacity_factor: float,
+                min_capacity: int = 4,
+                drop_tokens: bool = True,
+                use_rts: bool = True,
+                rng: Optional[jax.Array] = None,
+                train: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                             jnp.ndarray, jnp.ndarray]:
+    """Compute combine/dispatch tensors for top-k routing.
+
+    logits: [S, E] raw gate logits.
+    Returns (l_aux, combine [S,E,C] f32, dispatch [S,E,C] bool,
+    exp_counts [E] i32 — tokens routed per expert before capacity drop).
+    """
+    s, e = logits.shape
+    c = _capacity(s, e, k, capacity_factor if train else capacity_factor,
+                  min_capacity, drop_tokens)
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    combine = jnp.zeros((s, e, c), jnp.float32)
+    dispatch = jnp.zeros((s, e, c), jnp.bool_)
+    # running per-expert fill count, so choice 2 slots come after choice 1
+    fill = jnp.zeros((e,), jnp.int32)
+    # -inf-mask chosen experts on the LOGITS so later choices can never
+    # re-select them (reference top2gating: logits_except1 masked_fill -inf;
+    # zeroing softmax gates instead re-picks index 0 once gates underflow)
+    masked_logits = logits.astype(jnp.float32)
+    l_aux = jnp.float32(0.0)
+    exp_counts = jnp.zeros((e,), jnp.int32)
+    gate_sum = jnp.zeros((s,), jnp.float32)
+    picks = []
+
+    for choice in range(k):
+        idx = jnp.argmax(masked_logits, axis=-1)                   # [S]
+        mask = _one_hot(idx, e)                                    # [S, E]
+        if choice == 0:
+            # aux loss uses the FIRST-choice assignment (reference
+            # top2gating computes it from mask1 only, sharded_moe.py:294)
+            me = jnp.mean(gates, axis=0)
+            ce = jnp.mean(mask, axis=0)
+            l_aux = jnp.sum(me * ce) * e
+        exp_counts = exp_counts + jnp.sum(mask, axis=0).astype(jnp.int32)
+
+        if use_rts and train and rng is not None and drop_tokens:
+            # random-token-selection: randomize drop priority instead of
+            # favoring early positions (reference use_rts, sharded_moe.py:208);
+            # salt offset keeps this stream disjoint from layer dropout keys
+            prio = jax.random.uniform(jax.random.fold_in(rng, 1000 + choice), (s,))
+            order = jnp.argsort(prio)
+            inv = jnp.argsort(order)
+            mask_sorted = mask[order]
+            loc_sorted = jnp.cumsum(mask_sorted, axis=0) - mask_sorted
+            locations = loc_sorted[inv]
+        else:
+            locations = jnp.cumsum(mask, axis=0) - mask            # [S, E]
+        locations = locations + fill[None, :]
+        fill = fill + jnp.sum(mask, axis=0).astype(jnp.int32)
+
+        pos = jnp.sum(locations * mask, axis=-1).astype(jnp.int32)  # [S]
+        keep = pos < c
+        mask = mask * keep[:, None]
+        gate_val = jnp.sum(gates * mask, axis=-1)                   # [S]
+        picks.append((mask, pos, gate_val))
+        gate_sum = gate_sum + gate_val
+        # exclude chosen expert from the next round
+        masked_logits = jnp.where(_one_hot(idx, e) > 0, -jnp.inf, masked_logits)
+
+    # top-1 uses the raw gate probability as combine weight (reference
+    # top1gating); for k>=2 the picked gates renormalize to sum to 1
+    # (reference top2gating denom, sharded_moe.py:323)
+    if k == 1:
+        denom = jnp.ones_like(gate_sum)
+    else:
+        denom = jnp.maximum(gate_sum, jnp.finfo(jnp.float32).eps)
+    for mask, pos, gate_val in picks:
+        w = gate_val / denom                                        # [S]
+        oh_pos = _one_hot(jnp.where(pos < c, pos, 0), c)            # [S, C]
+        contrib = (w[:, None] * mask)[:, :, None] * oh_pos[:, None, :]
+        combine = combine + contrib
+        dispatch = dispatch | (contrib > 0)
+
+    return l_aux, combine, dispatch, exp_counts
+
+
+class TopKGate:
+    """Linear gate + top-k routing (reference ``TopKGate``,
+    sharded_moe.py:377): holds the [M, E] projection and the routing
+    hyperparameters. Functional: init/apply."""
+
+    def __init__(self, model_dim: int, num_experts: int, k: int = 1,
+                 capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0,
+                 min_capacity: int = 4,
+                 noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True,
+                 use_rts: bool = True):
+        assert k >= 1
+        self.model_dim = model_dim
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.noisy_gate_policy = noisy_gate_policy
+        self.drop_tokens = drop_tokens
+        self.use_rts = use_rts
+
+    def init(self, rng):
+        scale = 1.0 / math.sqrt(self.model_dim)
+        return {"wg": jax.random.uniform(rng, (self.model_dim, self.num_experts),
+                                         jnp.float32, -scale, scale)}
+
+    def apply(self, params, x, rng=None, train=True):
+        """x: [S, M] → (l_aux, combine [S,E,C], dispatch [S,E,C], counts)."""
+        inp = x.astype(jnp.float32)
+        if train and self.noisy_gate_policy == "Jitter" and rng is not None:
+            noise = jax.random.uniform(jax.random.fold_in(rng, 17),
+                                       inp.shape, jnp.float32, 0.99, 1.01)
+            inp = inp * noise
+        logits = inp @ params["wg"]
+        if train and self.noisy_gate_policy == "RSample" and rng is not None:
+            logits = logits + jax.random.normal(
+                jax.random.fold_in(rng, 19), logits.shape)
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        return topk_gating(logits, self.k, cf,
+                           min_capacity=self.min_capacity,
+                           drop_tokens=self.drop_tokens,
+                           use_rts=self.use_rts, rng=rng, train=train)
+
+
+class MOELayer:
+    """Dispatch → experts → combine (reference ``MOELayer``,
+    sharded_moe.py:420).
+
+    expert params carry a leading [E] dim sharded over the ``expert`` mesh
+    axis; dispatch/combine einsums reshard tokens [S, ...] ↔ expert-major
+    [E, C, ...] and GSPMD emits the all-to-all the reference performs
+    explicitly (``_AllToAll.apply``, sharded_moe.py:90)."""
+
+    def __init__(self, gate: TopKGate, experts, use_sharding_constraints=True):
+        self.gate = gate
+        self.experts = experts
+        self.use_sharding_constraints = use_sharding_constraints
+
+    def init(self, rng):
+        gate_rng, exp_rng = jax.random.split(rng)
+        return {"gate": self.gate.init(gate_rng),
+                "experts": self.experts.init(exp_rng)}
+
+    def apply(self, params, x, rng=None, train=True):
+        """x: [..., M] (any leading dims) → (y [..., M], l_aux, exp_counts)."""
+        lead = x.shape[:-1]
+        m = x.shape[-1]
+        xs = x.reshape(-1, m)                                      # [S, M]
+        l_aux, combine, dispatch, exp_counts = self.gate.apply(
+            params["gate"], xs, rng=rng, train=train)
+
+        # tokens → expert-major [E, C, M]; this einsum's output sharding
+        # (expert axis) vs input sharding (data axes) is the all-to-all.
+        expert_in = jnp.einsum("sec,sm->ecm",
+                               dispatch.astype(x.dtype), xs)
+        if self.use_sharding_constraints:
+            expert_in = maybe_constraint(expert_in, EXPERT_AXIS, None, None)
+        expert_out = self.experts.apply(params["experts"], expert_in,
+                                        rng=rng, train=train)      # [E, C, M]
+        if self.use_sharding_constraints:
+            expert_out = maybe_constraint(expert_out, EXPERT_AXIS, None, None)
+        y = jnp.einsum("sec,ecm->sm", combine.astype(x.dtype), expert_out)
+        if self.use_sharding_constraints:
+            y = maybe_constraint(y, (DATA_AXIS, EXPERT_AXIS), None)
+        return y.reshape(*lead, m), l_aux, exp_counts

@@ -1,0 +1,37 @@
+"""MoE parameter utilities.
+
+Reference: deepspeed/moe/utils.py ``split_params_into_different_moe_groups_
+for_optimizer`` — splits optimizer param groups into expert vs non-expert so
+ZeRO can shard them over the right process groups. TPU-native version: paths
+are classified by regex over the pytree key path; the ZeRO planner uses the
+classification to shard expert leaves over 'data' only (expert-dp = dp/ep,
+reference deepspeed/utils/groups.py:108).
+"""
+
+import re
+from typing import Any, Dict, Tuple
+
+import jax
+
+from ..models.api import param_path_tree
+
+EXPERT_PATH_PATTERN = r"(^|/)experts(/|$)"
+
+
+def is_moe_param_path(path: str) -> bool:
+    return re.search(EXPERT_PATH_PATTERN, path) is not None
+
+
+def split_params_into_moe_and_dense(params) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Flat {path: leaf} maps for expert and non-expert params."""
+    paths = jax.tree.leaves(param_path_tree(params))
+    leaves = jax.tree.leaves(params)
+    moe, dense = {}, {}
+    for p, leaf in zip(paths, leaves):
+        (moe if is_moe_param_path(p) else dense)[p] = leaf
+    return moe, dense
+
+
+def has_moe_layers(params) -> bool:
+    return any(is_moe_param_path(p)
+               for p in jax.tree.leaves(param_path_tree(params)))

@@ -15,6 +15,10 @@ from ...utils.logging import logger
 
 class CheckpointEngine:
 
+    #: True if save() is a cross-process collective that must be invoked on
+    #: every process (orbax); False if only the writer process calls save().
+    collective = False
+
     def __init__(self, config_params=None):
         pass
 
@@ -53,16 +57,23 @@ class MsgpackCheckpointEngine(CheckpointEngine):
 
 
 class OrbaxCheckpointEngine(CheckpointEngine):
-    """Sharded/async saves via orbax (multi-host path)."""
+    """Sharded/async saves via orbax (multi-host path). save() must be
+    called on every process (orbax serializes global arrays collectively)."""
+
+    collective = True
 
     def __init__(self, config_params=None, use_async=False):
         super().__init__(config_params)
         import orbax.checkpoint as ocp
         self._ocp = ocp
-        self._ckptr = ocp.StandardCheckpointer()
+        if use_async:
+            self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        else:
+            self._ckptr = ocp.StandardCheckpointer()
 
     def save(self, state_dict, path):
-        self._ckptr.save(os.path.abspath(path), state_dict, force=True)
+        self._ckptr.save(os.path.abspath(path), args=self._ocp.args.StandardSave(
+            state_dict), force=True)
 
     def load(self, path, map_location=None):
         return self._ckptr.restore(os.path.abspath(path))

@@ -35,8 +35,19 @@ from .fp16.loss_scaler import LossScaleState
 import jax.numpy as jnp
 
 
-def _to_numpy_tree(tree):
-    return jax.tree.map(lambda x: np.asarray(x), tree)
+def _gather_to_host(engine, tree):
+    """Gather sharded global arrays to replicated and pull to host numpy.
+
+    Runs a collective (jit with replicated out_shardings), so it MUST be
+    called on every process — np.asarray on a dp-sharded array would raise
+    (non-addressable shards) in multi-host runs."""
+    if tree is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = jax.tree.map(lambda _: NamedSharding(engine.mesh, P()), tree)
+    with engine.mesh:
+        gathered = jax.jit(lambda t: t, out_shardings=rep)(tree)
+    return jax.tree.map(lambda x: np.asarray(x.addressable_data(0)), gathered)
 
 
 def save_checkpoint(engine, save_dir, tag=None, client_state=None,
@@ -48,23 +59,28 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     is_writer = jax.process_index() == 0
 
     ckpt_engine.create(tag)
+    # gather on ALL processes (collective); write on the writer — or on all
+    # processes for collective engines (orbax)
+    params_host = _gather_to_host(engine, engine.params)
+    from flax import serialization
+    optim_state = {
+        "opt_state": serialization.to_state_dict(
+            _gather_to_host(engine, engine.opt_state))
+        if engine.opt_state is not None else None,
+        "scaler": {
+            "scale": float(engine.scaler_state.scale),
+            "good_steps": int(engine.scaler_state.good_steps),
+            "hysteresis": int(engine.scaler_state.hysteresis),
+        },
+    }
     if is_writer:
         os.makedirs(ckpt_dir, exist_ok=True)
-        ckpt_engine.save(_to_numpy_tree(engine.params),
+    if is_writer or ckpt_engine.collective:
+        ckpt_engine.save(params_host,
                          os.path.join(ckpt_dir, "model_states.msgpack"))
-        from flax import serialization
-        optim_state = {
-            "opt_state": serialization.to_state_dict(
-                _to_numpy_tree(engine.opt_state))
-            if engine.opt_state is not None else None,
-            "scaler": {
-                "scale": float(engine.scaler_state.scale),
-                "good_steps": int(engine.scaler_state.good_steps),
-                "hysteresis": int(engine.scaler_state.hysteresis),
-            },
-        }
         ckpt_engine.save(optim_state,
                          os.path.join(ckpt_dir, "optim_states.msgpack"))
+    if is_writer:
         engine_state = {
             "global_steps": engine.global_steps,
             "global_samples": engine.global_samples,
@@ -91,6 +107,19 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     return ckpt_dir
 
 
+def _engine_for_layout(config, model_states_path):
+    """Pick the engine that matches what's on disk (an orbax checkpoint is a
+    directory, msgpack a file), falling back to the configured engine — so a
+    checkpoint written with async_save loads fine without it, and vice versa."""
+    from .checkpoint_engine.checkpoint_engine import (
+        MsgpackCheckpointEngine, OrbaxCheckpointEngine)
+    if os.path.isdir(model_states_path):
+        return OrbaxCheckpointEngine()
+    if os.path.isfile(model_states_path):
+        return MsgpackCheckpointEngine()
+    return get_checkpoint_engine(config)
+
+
 def _restore_like(template_shardings, tree):
     """device_put each leaf against the engine's target sharding — this IS
     the universal-checkpoint reshard."""
@@ -113,7 +142,9 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
         logger.warning(f"checkpoint dir {ckpt_dir} missing; nothing loaded")
         return None, {}
 
-    ckpt_engine = get_checkpoint_engine(engine._config)
+    ckpt_engine = _engine_for_layout(engine._config,
+                                     os.path.join(ckpt_dir,
+                                                  "model_states.msgpack"))
     params = ckpt_engine.load(os.path.join(ckpt_dir, "model_states.msgpack"))
     with engine.mesh:
         engine.params = _restore_like(engine.param_shardings, params)
@@ -158,22 +189,28 @@ def save_16bit_model(engine, save_dir, save_filename="pytorch_model.msgpack"):
     """Consolidated 16-bit export (reference engine.save_16bit_model
     :3194 / _zero3_consolidated_16bit_state_dict :3127): gather everything,
     cast to the compute dtype, single file."""
-    params = engine.get_fp32_params()
     dtype = engine._compute_dtype or jnp.float32
+    params_host = _gather_to_host(engine, engine.params)
     params16 = jax.tree.map(
-        lambda x: np.asarray(x.astype(dtype))
-        if jnp.issubdtype(x.dtype, jnp.floating) else np.asarray(x), params)
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, params_host)
+    ckpt_engine = get_checkpoint_engine(engine._config)
     if jax.process_index() == 0:
         os.makedirs(save_dir, exist_ok=True)
-        get_checkpoint_engine(engine._config).save(
-            params16, os.path.join(save_dir, save_filename))
+    if jax.process_index() == 0 or ckpt_engine.collective:
+        ckpt_engine.save(params16, os.path.join(save_dir, save_filename))
     return os.path.join(save_dir, save_filename)
 
 
 def get_fp32_state_dict_from_checkpoint(ckpt_dir):
     """Offline reader (the zero_to_fp32.py equivalent,
     utils/zero_to_fp32.py:158): returns the fp32 param pytree from a
-    checkpoint directory without building an engine."""
-    from .checkpoint_engine.checkpoint_engine import MsgpackCheckpointEngine
+    checkpoint directory without building an engine. Detects the engine by
+    layout: a directory at the model_states path means orbax, a file means
+    msgpack."""
+    from .checkpoint_engine.checkpoint_engine import (
+        MsgpackCheckpointEngine, OrbaxCheckpointEngine)
     path = os.path.join(ckpt_dir, "model_states.msgpack")
+    if os.path.isdir(path):
+        return OrbaxCheckpointEngine().load(path)
     return MsgpackCheckpointEngine().load(path)

@@ -28,7 +28,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...models.api import match_rule, param_path_tree
-from ...parallel.topology import DeviceMeshManager, DP_AXES
+from ...parallel.topology import DeviceMeshManager, DP_AXES, DATA_AXIS, EXPERT_AXIS
 
 
 def _tp_spec(path: str, rules, ndim: int) -> list:
@@ -40,7 +40,14 @@ def _tp_spec(path: str, rules, ndim: int) -> list:
     return spec
 
 
-def _add_dp_axis(spec: list, shape: Tuple[int, ...], dp_world: int,
+def _uses_axis(spec: list, axis: str) -> bool:
+    for s in spec:
+        if s == axis or (isinstance(s, (tuple, list)) and axis in s):
+            return True
+    return False
+
+
+def _add_dp_axis(spec: list, shape: Tuple[int, ...], dp_axes, dp_world: int,
                  min_size: int) -> list:
     """Shard the largest still-free, dp-divisible dim over the dp axes."""
     if int(np.prod(shape or (1,))) < max(min_size, dp_world):
@@ -51,7 +58,7 @@ def _add_dp_axis(spec: list, shape: Tuple[int, ...], dp_world: int,
             if best is None or dim > shape[best]:
                 best = i
     if best is not None:
-        spec[best] = DP_AXES
+        spec[best] = dp_axes
     return spec
 
 
@@ -65,16 +72,34 @@ class ZeroShardingPlanner:
         self.stage = stage
         self.rules = list(rules or [])
         self.persistence_threshold = persistence_threshold
-        # drop TP rules if there is no model axis
-        if self.mm.tp == 1:
-            self.rules = []
+        # drop rules that touch any size-1 mesh axis: a no-op sharding hides
+        # intent and would block the ZeRO dp-axis assignment on that dim
+        def _rule_live(rule):
+            _, spec = rule
+            axes = set()
+            for s in spec:
+                if isinstance(s, (tuple, list)):
+                    axes.update(s)
+                elif s is not None:
+                    axes.add(s)
+            return all(self.mm.axis_size(a) > 1 for a in axes)
+
+        self.rules = [r for r in self.rules if _rule_live(r)]
 
     # -- per-leaf specs ---------------------------------------------------
     def _leaf_spec(self, path: str, shape, dp_sharded: bool) -> P:
         spec = _tp_spec(path, self.rules, len(shape))
-        if dp_sharded and self.mm.dp_world_size > 1:
-            spec = _add_dp_axis(spec, shape, self.mm.dp_world_size,
-                                self.persistence_threshold)
+        if dp_sharded:
+            # expert leaves are already sharded over 'expert': their ZeRO
+            # sharding runs over 'data' only — the reference's expert-dp
+            # groups of size dp/ep (deepspeed/utils/groups.py:108)
+            if _uses_axis(spec, EXPERT_AXIS):
+                dp_axes, dp_world = DATA_AXIS, self.mm.dp
+            else:
+                dp_axes, dp_world = DP_AXES, self.mm.dp_world_size
+            if dp_world > 1:
+                spec = _add_dp_axis(spec, shape, dp_axes, dp_world,
+                                    self.persistence_threshold)
         return P(*spec)
 
     def param_spec(self, path: str, shape) -> P:

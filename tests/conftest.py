@@ -18,6 +18,14 @@ os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
 
 import pytest  # noqa: E402
 
+# The axon TPU plugin ignores JAX_PLATFORMS=cpu (the platform still
+# initializes and stays the default backend), so pin the default device to
+# CPU explicitly — otherwise un-sharded test computations silently run on
+# the real TPU chip with bf16 matmul precision.
+import jax  # noqa: E402
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
 
 @pytest.fixture(autouse=True)
 def _reset_global_state():

@@ -38,7 +38,9 @@ def batches(n, seed=0):
 
 
 def assert_trees_equal(a, b, atol=0):
-    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb), f"leaf count {len(la)} != {len(lb)}"
+    for x, y in zip(la, lb):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=atol)
 
 
