@@ -59,7 +59,18 @@ def initialize(args=None,
     if config is None:
         raise ValueError("DeepSpeed requires --deepspeed_config or the config kwarg")
 
-    if isinstance(model, PipelineModule):
+    def _wants_pipeline(cfg):
+        if isinstance(cfg, str):
+            import json
+            try:
+                with open(cfg) as f:
+                    cfg = json.load(f)
+            except Exception:
+                return False
+        return isinstance(cfg, dict) and \
+            int(cfg.get("pipeline_parallel_size", 1)) > 1
+
+    if isinstance(model, PipelineModule) or _wants_pipeline(config):
         engine = PipelineEngine(args=args,
                                 model=model,
                                 optimizer=optimizer,

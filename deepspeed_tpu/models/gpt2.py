@@ -185,43 +185,79 @@ class GPT2Model(ModelSpec):
     def aux_loss_weight(self) -> float:
         return 0.0
 
-    def apply(self, params, batch, rng=None, train=True):
-        """Next-token LM loss. batch: {'input_ids': [B,T]} (+ optional
-        'labels' [B,T] with -100 = ignore, HF convention)."""
+    def _lm_loss(self, logits, batch):
+        """Shifted next-token NLL; labels with -100 = ignore (HF convention)."""
         cfg = self.config
         input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
-        logits, aux = self.logits(params, input_ids, rng=rng, train=train,
-                                  return_aux_loss=True)
         if isinstance(batch, dict) and "labels" in batch:
-            labels = batch["labels"]
-            shift_logits = logits[:, :-1]
-            shift_labels = labels[:, 1:]
+            shift_logits, shift_labels = logits[:, :-1], batch["labels"][:, 1:]
         else:
-            shift_logits = logits[:, :-1]
-            shift_labels = input_ids[:, 1:]
+            shift_logits, shift_labels = logits[:, :-1], input_ids[:, 1:]
         valid = (shift_labels >= 0) & (shift_labels < cfg.vocab_size)
         safe_labels = jnp.where(valid, shift_labels, 0)
         logp = jax.nn.log_softmax(shift_logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
         nll = jnp.where(valid, nll, 0.0)
-        loss = nll.sum() / jnp.maximum(valid.sum(), 1)
+        return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+    def apply(self, params, batch, rng=None, train=True):
+        """Next-token LM loss. batch: {'input_ids': [B,T]} (+ optional
+        'labels' [B,T])."""
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        logits, aux = self.logits(params, input_ids, rng=rng, train=train,
+                                  return_aux_loss=True)
+        loss = self._lm_loss(logits, batch)
         w = self.aux_loss_weight()
         return loss + w * aux if w else loss
 
     # ------------------------------------------------------------- sharding
     def partition_rules(self):
-        """TP (megatron-style) + SP logical rules; ZeRO layering happens in
-        runtime/zero/partition.py. Stacked leaves: axis 0 is the layer axis."""
+        """TP (megatron-style) + PP logical rules; ZeRO layering happens in
+        runtime/zero/partition.py. Stacked leaves: axis 0 is the layer axis —
+        sharded over 'pipe' when pp>1 (the planner drops size-1 axes)."""
         return [
             (r"wte$", ("model", None)),
             (r"wpe$", (None, None)),
-            (r"blocks/qkv_w$", (None, None, "model")),
-            (r"blocks/qkv_b$", (None, "model")),
-            (r"blocks/attn_proj_w$", (None, "model", None)),
-            (r"blocks/mlp_fc_w$", (None, None, "model")),
-            (r"blocks/mlp_fc_b$", (None, "model")),
-            (r"blocks/mlp_proj_w$", (None, "model", None)),
+            (r"blocks/qkv_w$", ("pipe", None, "model")),
+            (r"blocks/qkv_b$", ("pipe", "model")),
+            (r"blocks/attn_proj_w$", ("pipe", "model", None)),
+            (r"blocks/mlp_fc_w$", ("pipe", None, "model")),
+            (r"blocks/mlp_fc_b$", ("pipe", "model")),
+            (r"blocks/mlp_proj_w$", ("pipe", "model", None)),
+            (r"blocks/", ("pipe",)),       # remaining stacked leaves (LNs, biases)
         ]
+
+    # ------------------------------------------------------- pipeline protocol
+    def pipeline_spec(self):
+        """Hooks for the compiled ppermute pipeline (runtime/pipe/engine.py):
+        embed → per-layer block over the stacked 'blocks' subtree → head
+        loss. The layer axis (dim 0 of every blocks leaf) is what the engine
+        slices across pipeline stages."""
+
+        def embed(params, batch, rng, train):
+            cfg = self.config
+            input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+            wte_dtype = params["wte"].dtype
+            compute_dtype = (wte_dtype if jnp.issubdtype(wte_dtype, jnp.floating)
+                             else jnp.dtype(cfg.dtype))
+            t = input_ids.shape[-1]
+            x = params["wte"].astype(compute_dtype)[input_ids] + \
+                params["wpe"][:t].astype(compute_dtype)
+            return self._dropout(x, rng, train, 2)
+
+        def block(block_params, x, rng, train):
+            return self._block(x, block_params, rng, train)  # (x, aux)
+
+        def head_loss(params, x, batch):
+            cfg = self.config
+            x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                            cfg.layer_norm_epsilon)
+            logits = x @ params["wte"].astype(x.dtype).T
+            return self._lm_loss(logits, batch)
+
+        return {"blocks_key": "blocks", "embed": embed, "block": block,
+                "head_loss": head_loss,
+                "aux_loss_weight": self.aux_loss_weight()}
 
     def flops_per_token(self, seq_len: Optional[int] = None):
         """Training FLOPs/token: 6N + attention term (12·L·D·T)."""

@@ -71,15 +71,20 @@ class GPT2MoEModel(GPT2Model):
 
     # ------------------------------------------------------------- sharding
     def partition_rules(self):
-        rules = [r for r in super().partition_rules() if "mlp" not in r[0]]
-        # stacked [L, E, ...]: layer axis scans, expert axis shards
-        rules += [
-            (r"blocks/moe/experts/wi$", (None, "expert", None, None)),
-            (r"blocks/moe/experts/bi$", (None, "expert", None)),
-            (r"blocks/moe/experts/wo$", (None, "expert", None, None)),
-            (r"blocks/moe/experts/bo$", (None, "expert", None)),
+        """Expert rules must precede the base class's first-match-wins
+        'blocks/' catch-all, so specific rules are inserted and the
+        catch-all stays last. Stacked [L, E, ...]: layer axis ('pipe')
+        scans, expert axis shards."""
+        base = [r for r in super().partition_rules() if "mlp" not in r[0]]
+        catchall = [r for r in base if r[0] == r"blocks/"]
+        specific = [r for r in base if r[0] != r"blocks/"]
+        moe_rules = [
+            (r"blocks/moe/experts/wi$", ("pipe", "expert", None, None)),
+            (r"blocks/moe/experts/bi$", ("pipe", "expert", None)),
+            (r"blocks/moe/experts/wo$", ("pipe", "expert", None, None)),
+            (r"blocks/moe/experts/bo$", ("pipe", "expert", None)),
         ]
-        return rules
+        return specific + moe_rules + catchall
 
     def flops_per_token(self, seq_len=None):
         """Active-params FLOPs: dense attention + top_k experts."""

@@ -60,8 +60,7 @@ def topk_gating(logits: jnp.ndarray,
     exp_counts [E] i32 — tokens routed per expert before capacity drop).
     """
     s, e = logits.shape
-    c = _capacity(s, e, k, capacity_factor if train else capacity_factor,
-                  min_capacity, drop_tokens)
+    c = _capacity(s, e, k, capacity_factor, min_capacity, drop_tokens)
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
     combine = jnp.zeros((s, e, c), jnp.float32)

@@ -36,18 +36,28 @@ import jax.numpy as jnp
 
 
 def _gather_to_host(engine, tree):
-    """Gather sharded global arrays to replicated and pull to host numpy.
+    """Gather sharded global arrays to replicated and pull to host numpy,
+    LEAF BY LEAF: replicating the whole ZeRO-sharded tree at once would
+    materialize full params+optimizer state on every device and OOM exactly
+    the models ZeRO exists for.
 
-    Runs a collective (jit with replicated out_shardings), so it MUST be
+    Runs collectives (jit with replicated out_shardings), so it MUST be
     called on every process — np.asarray on a dp-sharded array would raise
     (non-addressable shards) in multi-host runs."""
     if tree is None:
         return None
     from jax.sharding import NamedSharding, PartitionSpec as P
-    rep = jax.tree.map(lambda _: NamedSharding(engine.mesh, P()), tree)
-    with engine.mesh:
-        gathered = jax.jit(lambda t: t, out_shardings=rep)(tree)
-    return jax.tree.map(lambda x: np.asarray(x.addressable_data(0)), gathered)
+    rep = NamedSharding(engine.mesh, P())
+    replicate = jax.jit(lambda x: x, out_shardings=rep)
+
+    def leaf(x):
+        with engine.mesh:
+            g = replicate(x)
+        out = np.asarray(g.addressable_data(0))
+        g.delete()
+        return out
+
+    return jax.tree.map(leaf, tree)
 
 
 def save_checkpoint(engine, save_dir, tag=None, client_state=None,

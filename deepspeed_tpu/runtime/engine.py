@@ -152,6 +152,7 @@ class DeepSpeedEngine:
         rng = jax.random.PRNGKey(cfg.seed)
         param_shapes = jax.eval_shape(model.init, rng)
         self.param_shapes = param_shapes
+        self._pre_init_validate()
         self.param_shardings = self.planner.param_shardings(param_shapes)
         with self.mesh:
             self.params = jax.jit(model.init,
@@ -203,6 +204,10 @@ class DeepSpeedEngine:
             f"dtype={self._compute_dtype or 'float32'} "
             f"batch={cfg.train_batch_size} (micro={cfg.train_micro_batch_size_per_gpu} "
             f"gas={cfg.gradient_accumulation_steps})", ranks=[0])
+
+    def _pre_init_validate(self):
+        """Hook for subclasses to validate model/mesh compatibility after
+        param shapes are known but before params materialize."""
 
     # ------------------------------------------------------------------
     # compiled step functions

@@ -4,11 +4,13 @@ Re-design of the reference PipelineModule (runtime/pipe/module.py:85,
 LayerSpec/TiedLayerSpec :29,76): a model expressed as a list of layer specs,
 partitioned into contiguous stage ranges. TPU-native difference: a "layer" is
 a functional (init, apply) pair over activations, stages map to slices of the
-'pipe' mesh axis, and tied layers share a single param leaf (pytree aliasing)
-instead of replication + allreduce.
+'pipe' mesh axis, and tied layers read ONE shared param subtree (params =
+{"layers": [per-layer], "tied": {key: subtree}}) — autodiff sums the tied
+gradients where the reference replicates weights and allreduces
+(module.py:406-427 ReduceTiedGrads).
 """
 
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -48,6 +50,9 @@ class PipelineModule(ModelSpec):
         apply(params, x, rng=None, train=True) -> x
     The final loss_fn(last_activation, batch) -> scalar is supplied by the
     caller (reference: loss_fn argument to PipelineModule).
+
+    Params pytree: {"layers": [p0, p1, ...], "tied": {key: subtree}} — slots
+    of tied layers hold an empty dict, their params live under "tied".
     """
 
     def __init__(self, layers: Sequence[LayerSpec], num_stages: int = 1,
@@ -65,7 +70,7 @@ class PipelineModule(ModelSpec):
                         for spec in self.layer_specs]
         self.parts = self._partition_layers()
         # tied keys → list of layer indices
-        self.tied_groups = {}
+        self.tied_groups: Dict[str, List[int]] = {}
         for i, spec in enumerate(self.layer_specs):
             if isinstance(spec, TiedLayerSpec):
                 self.tied_groups.setdefault(spec.key, []).append(i)
@@ -74,7 +79,7 @@ class PipelineModule(ModelSpec):
     def _partition_layers(self) -> List[int]:
         n = len(self._layers)
         method = self.partition_method.lower()
-        if method in ("uniform", "type:regex_placeholder"):
+        if method == "uniform":
             return list(np.linspace(0, n, self.num_stages + 1, dtype=int))
         if method == "parameters":
             weights = []
@@ -96,52 +101,42 @@ class PipelineModule(ModelSpec):
     def stage_layer_range(self, stage_id: int):
         return self.parts[stage_id], self.parts[stage_id + 1]
 
-    # -- ModelSpec interface (whole-model view; the pipeline engine uses the
-    #    per-stage slices)
+    # -- params --------------------------------------------------------------
     def init(self, rng):
-        params = []
-        tied_cache = {}
+        layers: List[Any] = []
+        tied: Dict[str, Any] = {}
         keys = jax.random.split(rng, max(len(self._layers), 1))
         for i, (spec, layer) in enumerate(zip(self.layer_specs, self._layers)):
             if isinstance(spec, TiedLayerSpec):
-                if spec.key in tied_cache:
-                    params.append({"__tied__": spec.key})
-                    continue
-                p = layer.init(keys[i])
-                tied_cache[spec.key] = p
-                params.append(p)
+                if spec.key not in tied:
+                    tied[spec.key] = layer.init(keys[i])
+                layers.append({})
             else:
-                params.append(layer.init(keys[i]))
-        return params
+                layers.append(layer.init(keys[i]))
+        return {"layers": layers, "tied": tied}
 
-    def resolve_tied(self, params):
-        """Replace {'__tied__': key} placeholders with the owning leaf."""
-        tied = {}
-        for i, spec in enumerate(self.layer_specs):
-            if isinstance(spec, TiedLayerSpec) and not (
-                    isinstance(params[i], dict) and "__tied__" in params[i]):
-                tied[spec.key] = params[i]
-        out = []
-        for i, p in enumerate(params):
-            if isinstance(p, dict) and "__tied__" in p:
-                out.append(tied[p["__tied__"]])
-            else:
-                out.append(p)
-        return out
+    def layer_params(self, slot_params, tied, layer_idx: int):
+        """The effective params of layer `layer_idx` (slot or tied subtree)."""
+        spec = self.layer_specs[layer_idx]
+        if isinstance(spec, TiedLayerSpec):
+            return tied[spec.key]
+        return slot_params
 
     def apply(self, params, batch, rng=None, train=True):
-        """Sequential (single-stage) execution; loss from loss_fn."""
-        resolved = self.resolve_tied(params)
+        """Sequential (single-stage) execution; loss from loss_fn. Tied
+        layers read the shared subtree, so their grads sum automatically."""
+        layers, tied = params["layers"], params["tied"]
         x = batch["inputs"] if isinstance(batch, dict) and "inputs" in batch else batch
         if self.batch_fn is not None:
             x = self.batch_fn(x)
         for i, layer in enumerate(self._layers):
+            p = self.layer_params(layers[i], tied, i)
             layer_rng = None if rng is None else jax.random.fold_in(rng, i)
             fn = layer.apply
             if self.activation_checkpoint_interval and \
                     i % self.activation_checkpoint_interval == 0:
                 fn = jax.checkpoint(fn)
-            x = fn(resolved[i], x, rng=layer_rng, train=train)
+            x = fn(p, x, rng=layer_rng, train=train)
         if self.loss_fn is not None:
             return self.loss_fn(x, batch)
         return x

@@ -1,0 +1,204 @@
+"""Declarative pipeline schedules.
+
+Re-design of the reference schedule layer (runtime/pipe/schedule.py:11
+``PipeSchedule``, :189 ``TrainSchedule`` (1F1B), :135 ``InferenceSchedule``,
+:301 ``DataParallelSchedule``; instruction taxonomy :327-487). A schedule is a
+generator of per-step instruction lists; each instruction names a micro-batch
+``buffer_id``. Two consumers:
+
+  1. The host-driven interpreter (pipe/engine.py ``exec_schedule``) — exact
+     reference semantics, works for heterogeneous layer lists.
+  2. Validation of the compiled ppermute path: the compiled 1F1B
+     kernel executes the same dependency order the TrainSchedule emits; tests
+     assert the stream's invariants.
+
+On TPU the Send/Recv pairs lower to ``lax.ppermute`` steps over the 'pipe'
+mesh axis rather than NCCL p2p.
+"""
+
+from typing import Iterator, List
+
+
+# ---------------------------------------------------------------------------
+# instruction taxonomy (reference schedule.py:327-487)
+# ---------------------------------------------------------------------------
+class PipeInstruction:
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{type(self).__name__}({args})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class BufferOpInstruction(PipeInstruction):
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# schedules
+# ---------------------------------------------------------------------------
+class PipeSchedule:
+    """Base: yields lists of PipeInstruction per step (reference :11)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def __iter__(self):
+        return self.steps()
+
+    @property
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference :135)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            micro = step_id - self.stage_id
+            cmds: List[PipeInstruction] = []
+            if 0 <= micro < self.micro_batches:
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=micro))
+                else:
+                    cmds.append(RecvActivation(buffer_id=micro))
+                cmds.append(ForwardPass(buffer_id=micro))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=micro))
+            yield cmds
+
+    @property
+    def num_pipe_buffers(self):
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (reference :189): warmup forwards, steady-state alternating
+    1-forward-1-backward, cooldown backwards, then grad reduce + step."""
+
+    def steps(self):
+        m, s, sid = self.micro_batches, self.stages, self.stage_id
+        warmup = min(s - sid - 1, m)
+
+        fwd_next = 0
+        bwd_next = 0
+        # warmup forwards
+        for _ in range(warmup):
+            yield self._fwd_cmds(fwd_next)
+            fwd_next += 1
+        # steady state: 1F1B
+        while fwd_next < m:
+            yield self._fwd_cmds(fwd_next)
+            fwd_next += 1
+            yield self._bwd_cmds(bwd_next)
+            bwd_next += 1
+        # cooldown backwards
+        while bwd_next < m:
+            yield self._bwd_cmds(bwd_next)
+            bwd_next += 1
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+    def _fwd_cmds(self, micro):
+        cmds: List[PipeInstruction] = []
+        if self.is_first_stage:
+            cmds.append(LoadMicroBatch(buffer_id=micro))
+        else:
+            cmds.append(RecvActivation(buffer_id=micro))
+        cmds.append(ForwardPass(buffer_id=micro))
+        if not self.is_last_stage:
+            cmds.append(SendActivation(buffer_id=micro))
+        return cmds
+
+    def _bwd_cmds(self, micro):
+        cmds: List[PipeInstruction] = []
+        if not self.is_last_stage:
+            cmds.append(RecvGrad(buffer_id=micro))
+        cmds.append(BackwardPass(buffer_id=micro))
+        if not self.is_first_stage:
+            cmds.append(SendGrad(buffer_id=micro))
+        return cmds
+
+    @property
+    def num_pipe_buffers(self):
+        # in-flight forwards at steady state (reference :199)
+        return max(min(self.stages - self.stage_id, self.micro_batches), 2)
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference :301)."""
+
+    def steps(self):
+        for micro in range(self.micro_batches):
+            yield [LoadMicroBatch(buffer_id=micro),
+                   ForwardPass(buffer_id=micro),
+                   BackwardPass(buffer_id=micro)]
+        yield [ReduceGrads(), OptimizerStep()]
+
+    @property
+    def num_pipe_buffers(self):
+        return 1

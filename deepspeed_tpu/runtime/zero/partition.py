@@ -32,12 +32,13 @@ from ...parallel.topology import DeviceMeshManager, DP_AXES, DATA_AXIS, EXPERT_A
 
 
 def _tp_spec(path: str, rules, ndim: int) -> list:
+    """Match a rule; prefix specs pad with None on the right."""
     spec = match_rule(path, rules or [])
     if spec is None:
         return [None] * ndim
     spec = list(spec)
-    assert len(spec) == ndim, f"rule for {path} has wrong rank {spec} vs {ndim}"
-    return spec
+    assert len(spec) <= ndim, f"rule for {path} has rank {len(spec)} > {ndim}"
+    return spec + [None] * (ndim - len(spec))
 
 
 def _uses_axis(spec: list, axis: str) -> bool:
@@ -72,19 +73,19 @@ class ZeroShardingPlanner:
         self.stage = stage
         self.rules = list(rules or [])
         self.persistence_threshold = persistence_threshold
-        # drop rules that touch any size-1 mesh axis: a no-op sharding hides
-        # intent and would block the ZeRO dp-axis assignment on that dim
-        def _rule_live(rule):
-            _, spec = rule
-            axes = set()
-            for s in spec:
-                if isinstance(s, (tuple, list)):
-                    axes.update(s)
-                elif s is not None:
-                    axes.add(s)
-            return all(self.mm.axis_size(a) > 1 for a in axes)
+        # sanitize per-axis: entries naming a size-1 mesh axis become None so
+        # the dim stays free for the ZeRO dp assignment (models declare
+        # pipe/model/expert/seq axes unconditionally; only live axes stick)
+        def _live(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if self.mm.axis_size(a) > 1)
+                return kept if kept else None
+            return entry if self.mm.axis_size(entry) > 1 else None
 
-        self.rules = [r for r in self.rules if _rule_live(r)]
+        self.rules = [(pat, tuple(_live(e) for e in spec))
+                      for pat, spec in self.rules]
 
     # -- per-leaf specs ---------------------------------------------------
     def _leaf_spec(self, path: str, shape, dp_sharded: bool) -> P:

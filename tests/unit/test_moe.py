@@ -114,6 +114,17 @@ def test_moe_layer_ep_sharded_matches_single_device():
     np.testing.assert_allclose(float(aux_sh), float(aux_ref), rtol=1e-4)
 
 
+def test_moe_partition_rules_not_shadowed():
+    """Expert rules must win over the base 'blocks/' catch-all (regression:
+    first-match-wins ordering silently disabled expert sharding)."""
+    from deepspeed_tpu.models.api import match_rule
+    from deepspeed_tpu.models.gpt2_moe import GPT2MoEModel
+    rules = GPT2MoEModel().partition_rules()
+    assert match_rule("blocks/moe/experts/wi", rules) == \
+        ("pipe", "expert", None, None)
+    assert match_rule("blocks/ln1_scale", rules) == ("pipe",)
+
+
 def test_moe_gpt2_trains_and_loss_decreases():
     from deepspeed_tpu.models.gpt2_moe import GPT2MoEConfig, GPT2MoEModel
     import deepspeed_tpu

@@ -1,0 +1,175 @@
+"""Pipeline parallelism tests (reference tests/unit/pipe/): schedule
+invariants, compiled ppermute 1F1B vs single-stage parity, interpreted
+PipelineModule schedule execution, tied weights."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.runtime.pipe import schedule as sched
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               TiedLayerSpec)
+
+TINY = GPT2Config(vocab_size=128, n_positions=32, n_embd=32, n_layer=4,
+                  n_head=2, pad_vocab_to_multiple=32)
+
+
+# ---------------------------------------------------------------- schedules
+def test_train_schedule_1f1b_invariants():
+    m, s = 6, 3
+    for sid in range(s):
+        steps = list(sched.TrainSchedule(m, s, sid))
+        fwd_order, bwd_order = [], []
+        for cmds in steps:
+            for c in cmds:
+                if isinstance(c, sched.ForwardPass):
+                    fwd_order.append(c.buffer_id)
+                if isinstance(c, sched.BackwardPass):
+                    bwd_order.append(c.buffer_id)
+        assert fwd_order == list(range(m))
+        assert bwd_order == list(range(m))
+        # last step is reduce + optimizer
+        kinds = [type(c) for c in steps[-1]]
+        assert kinds == [sched.ReduceTiedGrads, sched.ReduceGrads,
+                         sched.OptimizerStep]
+        # warmup depth: stage 0 runs s-1 forwards before its first backward
+        first_bwd = next(i for i, cmds in enumerate(steps)
+                         for c in cmds if isinstance(c, sched.BackwardPass))
+        n_fwd_before = sum(1 for cmds in steps[:first_bwd]
+                           for c in cmds if isinstance(c, sched.ForwardPass))
+        assert n_fwd_before == min(s - sid, m)
+
+    # cross-stage pairing: every SendActivation at stage s has a matching
+    # RecvActivation at stage s+1
+    for sid in range(s - 1):
+        sends = [c.buffer_id for cmds in sched.TrainSchedule(m, s, sid)
+                 for c in cmds if isinstance(c, sched.SendActivation)]
+        recvs = [c.buffer_id for cmds in sched.TrainSchedule(m, s, sid + 1)
+                 for c in cmds if isinstance(c, sched.RecvActivation)]
+        assert sends == recvs == list(range(m))
+
+
+def test_inference_schedule():
+    steps = list(sched.InferenceSchedule(4, 2, 0))
+    fwd = [c.buffer_id for cmds in steps for c in cmds
+           if isinstance(c, sched.ForwardPass)]
+    assert fwd == [0, 1, 2, 3]
+
+
+# ------------------------------------------------------- compiled pipeline
+def _train_engine(pp, stage=0):
+    model = GPT2Model(TINY)
+    # same global batch (32 = 8-row micro x gas 4) at every pp; micro is
+    # per-device so it scales with dp = 8/pp
+    cfg = {
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": pp,
+        "gradient_accumulation_steps": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "pipeline_parallel_size": pp,
+        "steps_per_print": 0,
+    }
+    return deepspeed_tpu.initialize(model=model, config=cfg)[0]
+
+
+def _batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"input_ids": rng.integers(0, 127, (4, 8, 16), dtype=np.int32)}
+            for _ in range(n)]
+
+
+def test_compiled_pipeline_matches_single_stage():
+    """pp=4 loss trajectory == pp=1 (same data, same init)."""
+    e1 = _train_engine(pp=1)
+    losses1 = [float(e1.train_batch(batch=b)) for b in _batches(3)]
+
+    from deepspeed_tpu.parallel import topology
+    topology.reset_mesh()
+    e4 = _train_engine(pp=4)
+    losses4 = [float(e4.train_batch(batch=b)) for b in _batches(3)]
+    np.testing.assert_allclose(losses1, losses4, rtol=2e-4)
+
+
+def test_pipeline_engine_rejects_forward():
+    e = _train_engine(pp=2)
+    with pytest.raises(RuntimeError):
+        e.forward({"input_ids": np.zeros((4, 16), np.int32)})
+
+
+def test_pipeline_layer_divisibility_error():
+    model = GPT2Model(GPT2Config(vocab_size=64, n_positions=16, n_embd=16,
+                                 n_layer=3, n_head=2, pad_vocab_to_multiple=16))
+    with pytest.raises(ValueError, match="divide"):
+        deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "pipeline_parallel_size": 2})
+
+
+# ------------------------------------------------- interpreted PipelineModule
+class Linear:
+    def __init__(self, din, dout):
+        self.din, self.dout = din, dout
+
+    def init(self, rng):
+        return {"w": jax.random.normal(rng, (self.din, self.dout)) * 0.1,
+                "b": jnp.zeros((self.dout,))}
+
+    def apply(self, p, x, rng=None, train=True):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _mse(x, batch):
+    return jnp.mean((x - batch["targets"]) ** 2)
+
+
+def test_interpreted_schedule_matches_sequential():
+    """Interpreting the 1F1B instruction stream gives the same loss/params
+    as the plain sequential engine step."""
+    specs = [LayerSpec(Linear, 8, 16), LayerSpec(Linear, 16, 16),
+             LayerSpec(Linear, 16, 16), LayerSpec(Linear, 16, 8)]
+
+    def make(module):
+        return deepspeed_tpu.initialize(model=module, config={
+            "train_batch_size": 32,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "SGD", "params": {"lr": 0.1}},
+            "steps_per_print": 0})[0]
+
+    rng = np.random.default_rng(0)
+    batch = {"inputs": rng.normal(size=(4, 8, 8)).astype(np.float32),
+             "targets": rng.normal(size=(4, 8, 8)).astype(np.float32)}
+
+    m1 = PipelineModule(specs, loss_fn=_mse)
+    e1 = make(m1)
+    l_seq = float(e1.train_batch(batch=batch))
+
+    from deepspeed_tpu.parallel import topology
+    topology.reset_mesh()
+    m2 = PipelineModule(specs, loss_fn=_mse)
+    e2 = make(m2)
+    l_int = float(e2.train_batch_interpreted(batch, num_stages=2))
+    np.testing.assert_allclose(l_seq, l_int, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(e1.params), jax.tree.leaves(e2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_tied_layers_share_and_sum_grads():
+    """Tied first/last layers: one param subtree, grads sum from both uses."""
+    specs = [TiedLayerSpec("emb", Linear, 8, 8),
+             LayerSpec(Linear, 8, 8),
+             TiedLayerSpec("emb", Linear, 8, 8)]
+    m = PipelineModule(specs, loss_fn=_mse)
+    params = m.init(jax.random.PRNGKey(0))
+    assert list(params["tied"].keys()) == ["emb"]
+    assert params["layers"][0] == {} and params["layers"][2] == {}
+
+    batch = {"inputs": jnp.ones((2, 8)), "targets": jnp.zeros((2, 8))}
+    g = jax.grad(lambda p: m.apply(p, batch))(params)
+    # tied grad is nonzero (sum of both uses)
+    assert float(jnp.abs(g["tied"]["emb"]["w"]).sum()) > 0
