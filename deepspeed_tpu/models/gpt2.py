@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .api import ModelSpec
-from ..ops.flash_attention import flash_attention
+from ..ops.seq_parallel import sp_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,6 +37,7 @@ class GPT2Config:
     initializer_range: float = 0.02
     remat: bool = False            # activation checkpointing over the layer scan
     attn_backend: str = "auto"     # auto | pallas | xla
+    sp_attention: str = "ulysses"  # ulysses | ring (when the 'seq' axis is live)
     dtype: str = "float32"         # compute dtype; params always fp32 masters
     pad_vocab_to_multiple: int = 128
 
@@ -118,9 +119,10 @@ class GPT2Model(ModelSpec):
         drop_rng = None
         if train and cfg.dropout > 0 and rng is not None:
             drop_rng = jax.random.fold_in(rng, 3)
-        attn = flash_attention(q, k, v, causal=True,
-                               dropout_rate=cfg.dropout if train else 0.0,
-                               dropout_rng=drop_rng, backend=cfg.attn_backend)
+        attn = sp_attention(q, k, v, causal=True,
+                            dropout_rate=cfg.dropout if train else 0.0,
+                            dropout_rng=drop_rng, impl=cfg.sp_attention,
+                            backend=cfg.attn_backend)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
         attn = attn @ p["attn_proj_w"].astype(attn.dtype) + p["attn_proj_b"].astype(attn.dtype)
         return x + self._dropout(attn, rng, train, 0)

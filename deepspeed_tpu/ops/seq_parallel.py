@@ -1,0 +1,140 @@
+"""Sequence-parallel attention: ring attention + Ulysses all-to-all.
+
+ABSENT in the reference (SURVEY.md §5: v0.9.1 has no SP/ring/Ulysses —
+its long-sequence story is block-sparse attention + activation partitioning);
+this module is the TPU-native long-context answer the north-star metric
+requires. Two strategies over the ``seq`` mesh axis:
+
+- **Ulysses** (DeepSpeed-Ulysses style head↔sequence all-to-all): attention
+  needs full sequence per head, so reshard [B, H, T/sp, D] → [B, H/sp, T, D],
+  run ordinary flash attention on full-length sequences of a head subset,
+  reshard back. Implemented as sharding CONSTRAINTS — GSPMD lowers the
+  reshard to the all-to-all the reference would issue over NCCL. Composes
+  with pp/tp/ZeRO because nothing is manual.
+- **Ring attention**: K/V blocks rotate around the ``seq`` ring
+  (lax.ppermute) while each device keeps its Q shard; online-softmax
+  accumulators (m, l, o) merge per block — attention memory stays
+  O(T/sp) per device, enabling sequences that don't fit any single chip.
+  shard_map manual over 'seq' only; differentiable through the scan.
+
+Both match the dense reference_attention numerics (tests/unit/test_seq_parallel.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.constraints import active_mesh, maybe_constraint
+from ..parallel.topology import DP_AXES as _BATCH_AXES, SEQ_AXIS
+from .flash_attention import flash_attention
+
+
+def seq_axis_size() -> int:
+    mesh = active_mesh()
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(SEQ_AXIS, 1))
+
+
+def ulysses_attention(q, k, v, causal=True, softmax_scale=None,
+                      dropout_rate=0.0, dropout_rng=None, backend="auto"):
+    """q,k,v: [B, H, T, D] with T sharded over 'seq'. Reshard heads↔sequence
+    around a full-sequence attention (DeepSpeed-Ulysses; the reference has
+    no equivalent — see module docstring)."""
+    # all-to-all #1: gather sequence, scatter heads
+    spec_heads = (_BATCH_AXES, SEQ_AXIS, None, None)
+    q = maybe_constraint(q, *spec_heads)
+    k = maybe_constraint(k, *spec_heads)
+    v = maybe_constraint(v, *spec_heads)
+    out = flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale,
+                          dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+                          backend=backend)
+    # all-to-all #2: back to sequence-sharded, full heads
+    return maybe_constraint(out, _BATCH_AXES, None, SEQ_AXIS, None)
+
+
+def _ring_attention_local(q, k, v, causal, scale, axis_name, sp):
+    """Per-device body: q,k,v [B, H, Tl, D] local shards; returns [B,H,Tl,D].
+    K/V rotate sp times around the ring; online softmax merges blocks."""
+    b, h, tl, d = q.shape
+    sid = lax.axis_index(axis_name)
+    q32 = q.astype(jnp.float32) * scale
+    neg = jnp.float32(-1e30)
+
+    def step(carry, i):
+        k_blk, v_blk, m, l, o = carry
+        # k_blk arrived from device (sid - i) % sp → its global block index
+        src = (sid - i) % sp
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                            k_blk.astype(jnp.float32))
+        if causal:
+            q_pos = sid * tl + jnp.arange(tl)[:, None]
+            k_pos = src * tl + jnp.arange(tl)[None, :]
+            logits = jnp.where((q_pos >= k_pos)[None, None], logits, neg)
+        blk_max = jnp.max(logits, axis=-1)                       # [B,H,Tl]
+        new_m = jnp.maximum(m, blk_max)
+        # renormalize old accumulators, accumulate this block
+        alpha = jnp.exp(m - new_m)
+        p = jnp.exp(logits - new_m[..., None])                   # [B,H,Tl,Tk]
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (k_next, v_next, new_m, l_new, o_new), None
+
+    m0 = jnp.full((b, h, tl), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, tl), jnp.float32)
+    o0 = jnp.zeros((b, h, tl, d), jnp.float32)
+    (k_last, v_last, m, l, o), _ = lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(sp))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, causal=True, softmax_scale=None):
+    """q,k,v: [B, H, T, D] with T sharded over 'seq'. O(T/sp) attention
+    memory per device; K/V blocks ride the ICI ring (ppermute)."""
+    mesh = active_mesh()
+    sp = seq_axis_size()
+    if mesh is None or sp == 1:
+        return flash_attention(q, k, v, causal=causal,
+                               softmax_scale=softmax_scale)
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    # manual over 'seq' only: specs name just the manual axis, the batch
+    # dims stay under auto/GSPMD (dp sharding untouched)
+    spec = P(None, None, SEQ_AXIS, None)
+    body = functools.partial(_ring_attention_local, causal=causal,
+                             scale=scale, axis_name=SEQ_AXIS, sp=sp)
+    return jax.shard_map(
+        lambda a, b_, c: body(a, b_, c),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={SEQ_AXIS}, check_vma=False)(q, k, v)
+
+
+def sp_attention(q, k, v, causal=True, softmax_scale=None, dropout_rate=0.0,
+                 dropout_rng=None, impl="ulysses", backend="auto"):
+    """Dispatch by impl when the 'seq' axis is live; plain flash otherwise."""
+    if impl not in ("ulysses", "ring"):
+        raise ValueError(f"sp_attention impl must be 'ulysses' or 'ring', "
+                         f"got {impl!r}")
+    if seq_axis_size() == 1:
+        return flash_attention(q, k, v, causal=causal,
+                               softmax_scale=softmax_scale,
+                               dropout_rate=dropout_rate,
+                               dropout_rng=dropout_rng, backend=backend)
+    if impl == "ring":
+        if dropout_rate > 0.0:
+            raise NotImplementedError(
+                "ring attention does not support attention dropout; use "
+                "sp_attention='ulysses' or dropout=0")
+        return ring_attention(q, k, v, causal=causal,
+                              softmax_scale=softmax_scale)
+    return ulysses_attention(q, k, v, causal=causal,
+                             softmax_scale=softmax_scale,
+                             dropout_rate=dropout_rate,
+                             dropout_rng=dropout_rng, backend=backend)

@@ -35,7 +35,7 @@ from .. import comm as dist
 from ..accelerator import get_accelerator
 from ..comm.logging import configure_comms_logger
 from ..models.api import ModelSpec
-from ..parallel.topology import initialize_mesh, DP_AXES, default_devices
+from ..parallel.topology import initialize_mesh, default_devices
 from ..utils.logging import logger, log_dist
 from ..utils.timer import (SynchronizedWallClockTimer, ThroughputTimer,
                            FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
@@ -213,7 +213,10 @@ class DeepSpeedEngine:
     # compiled step functions
     # ------------------------------------------------------------------
     def _batch_sharding(self, leading_gas: bool):
-        spec = (P(None, DP_AXES) if leading_gas else P(DP_AXES))
+        """Batch dim over dp axes; token dim over 'seq' when sp>1 (the
+        sequence-parallel input sharding — tokens enter already split)."""
+        base = self.mesh_manager.batch_spec(shard_seq=True)
+        spec = P(None, *base) if leading_gas else base
         return NamedSharding(self.mesh, spec)
 
     def _micro_loss(self, params, mb, rng, train=True):

@@ -71,6 +71,12 @@ class PipelineEngine(DeepSpeedEngine):
         if n_layer % pp != 0:
             raise ValueError(f"n_layer={n_layer} must divide by "
                              f"pipeline_parallel_size={pp}")
+        if self.mesh_manager.sp > 1 and \
+                getattr(getattr(self.module, "config", None),
+                        "sp_attention", "ulysses") == "ring":
+            raise ValueError(
+                "ring attention nests a shard_map inside the pipeline's "
+                "manual region; use sp_attention='ulysses' with pp>1")
 
     # ------------------------------------------------------------------
     # compiled 1F1B
