@@ -8,7 +8,6 @@ numerics oracle. Loaded via FlashAttentionBuilder through the accelerator
 op-builder seam.
 """
 
-import functools
 from types import SimpleNamespace
 
 import jax
@@ -39,32 +38,52 @@ def reference_attention(q, k, v, causal=True, mask=None, softmax_scale=None,
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-@functools.lru_cache(None)
-def _get_pallas_flash():
-    from .pallas.flash_attention import flash_attention
-    return flash_attention
+def _on_tpu() -> bool:
+    """Will this computation run on a real TPU? jax.default_backend() is NOT
+    trustworthy here — the axon plugin reports 'tpu' even under
+    JAX_PLATFORMS=cpu — so prefer the active mesh's devices, then the pinned
+    default device."""
+    from ..parallel.constraints import active_mesh
+    mesh = active_mesh()
+    if mesh is not None and getattr(mesh, "devices", None) is not None:
+        return mesh.devices.flat[0].platform == "tpu"
+    dev = jax.config.jax_default_device
+    if dev is not None:
+        return getattr(dev, "platform", None) == "tpu"
+    return jax.default_backend() == "tpu"
 
 
 def flash_attention(q, k, v, causal=True, mask=None, softmax_scale=None,
-                    dropout_rate=0.0, dropout_rng=None, backend="auto"):
-    """Dispatch: Pallas on TPU, XLA reference elsewhere."""
-    use_pallas = False
+                    dropout_rate=0.0, dropout_rng=None, backend="auto",
+                    interpret=None):
+    """Dispatch: Pallas kernel on TPU, XLA reference elsewhere.
+
+    backend="pallas" runs the Pallas kernel unconditionally and RAISES if the
+    shape/features are unsupported — no silent degradation on the hot path.
+    backend="xla" forces the reference path. "auto" picks Pallas only when
+    running on TPU with a supported shape. ``interpret=None`` auto-enables
+    interpreter mode off-TPU (CPU tests of the real kernel)."""
+    from .pallas import flash_attention as pallas_fa
+
     if backend == "pallas":
-        use_pallas = True
-    elif backend == "auto":
-        try:
-            use_pallas = (dropout_rate == 0.0 and mask is None
-                          and jax.default_backend() == "tpu"
-                          and q.shape[-2] >= 128 and q.shape[-2] == k.shape[-2]
-                          and q.shape[-1] in (64, 128, 256))
-        except Exception:
-            use_pallas = False
-    if use_pallas:
-        try:
-            return _get_pallas_flash()(q, k, v, causal=causal,
-                                       softmax_scale=softmax_scale)
-        except Exception:
-            pass
+        if not pallas_fa.supported(q, k, causal=causal, mask=mask,
+                                   dropout_rate=dropout_rate):
+            raise ValueError(
+                f"pallas flash attention does not support this call "
+                f"(q={q.shape} k={k.shape} causal={causal} "
+                f"mask={'yes' if mask is not None else 'no'} "
+                f"dropout={dropout_rate}); pass backend='xla' explicitly")
+        if interpret is None:
+            interpret = not _on_tpu()
+        return pallas_fa.flash_attention(q, k, v, causal, softmax_scale,
+                                         None, None, interpret)
+    if backend == "auto" and _on_tpu() and \
+            pallas_fa.supported(q, k, causal=causal, mask=mask,
+                                dropout_rate=dropout_rate):
+        return pallas_fa.flash_attention(q, k, v, causal, softmax_scale,
+                                         None, None, False)
+    if backend not in ("auto", "xla"):
+        raise ValueError(f"unknown attention backend {backend!r}")
     return reference_attention(q, k, v, causal=causal, mask=mask,
                                softmax_scale=softmax_scale,
                                dropout_rate=dropout_rate, dropout_rng=dropout_rng)

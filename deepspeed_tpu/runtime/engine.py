@@ -268,28 +268,40 @@ class DeepSpeedEngine:
         # --- fused train_batch step: scan over gas micro-batches ---
         def train_step(params, opt_state, scaler_state, batch, lr, rng):
             gas = jax.tree.leaves(batch)[0].shape[0]
-            zeros = jax.tree.map(
-                lambda s: jnp.zeros(s.shape, jnp.float32), self.param_shapes)
             scale = scaler_state.scale
 
             def scaled_loss(p, mb, r):
                 return self._micro_loss(p, mb, r) * scale
 
             grad_fn = jax.value_and_grad(scaled_loss)
+            grad_specs = jax.tree.map(lambda s: s.spec, self.grad_shardings)
 
-            def body(carry, xs):
-                gacc, lacc = carry
-                mb, i = xs
-                loss, g = grad_fn(params, mb, jax.random.fold_in(rng, i))
-                g = jax.tree.map(lambda a, b: a + b.astype(jnp.float32), gacc, g)
-                # pin ZeRO-2/3 reduce-scatter per micro-step
-                g = lax.with_sharding_constraint(
-                    g, jax.tree.map(lambda s: s.spec, self.grad_shardings))
-                return (g, lacc + loss), None
+            if gas == 1:
+                # fast path: no accumulation buffer round-trip through HBM
+                lsum, gsum = grad_fn(params,
+                                     jax.tree.map(lambda x: x[0], batch),
+                                     jax.random.fold_in(rng, 0))
+                gsum = lax.with_sharding_constraint(
+                    jax.tree.map(lambda g: g.astype(jnp.float32), gsum),
+                    grad_specs)
+            else:
+                zeros = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, jnp.float32),
+                    self.param_shapes)
 
-            (gsum, lsum), _ = lax.scan(
-                body, (zeros, jnp.float32(0.0)),
-                (batch, jnp.arange(gas)))
+                def body(carry, xs):
+                    gacc, lacc = carry
+                    mb, i = xs
+                    loss, g = grad_fn(params, mb, jax.random.fold_in(rng, i))
+                    g = jax.tree.map(lambda a, b: a + b.astype(jnp.float32),
+                                     gacc, g)
+                    # pin ZeRO-2/3 reduce-scatter per micro-step
+                    g = lax.with_sharding_constraint(g, grad_specs)
+                    return (g, lacc + loss), None
+
+                (gsum, lsum), _ = lax.scan(
+                    body, (zeros, jnp.float32(0.0)),
+                    (batch, jnp.arange(gas)))
             new_params, new_opt, new_scaler, finite, grad_norm = \
                 self._apply_update(params, opt_state, scaler_state, gsum, lr,
                                    denom=jnp.float32(gas))
