@@ -1,0 +1,4 @@
+"""Inference runtime (reference deepspeed/inference/)."""
+
+from .config import DeepSpeedInferenceConfig
+from .engine import InferenceEngine

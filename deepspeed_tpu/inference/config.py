@@ -1,0 +1,107 @@
+"""Inference config.
+
+TPU-native counterpart of the reference ``DeepSpeedInferenceConfig``
+(reference deepspeed/inference/config.py): same JSON surface (dtype,
+tensor_parallel.tp_size, max_out_tokens, replace_with_kernel_inject, ...) on
+the dataclass config base. CUDA-graph and quantization knobs are accepted for
+config compatibility; cuda-graph is meaningless under XLA (everything is a
+compiled program already) and warns.
+"""
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+from ..runtime.config_utils import ConfigError, DeepSpeedConfigModel
+from ..utils.logging import logger
+
+_DTYPES = {
+    "float32": jnp.float32, "fp32": jnp.float32,
+    "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "int8": jnp.int8,
+}
+
+
+@dataclasses.dataclass
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """reference inference/config.py DeepSpeedTPConfig."""
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Any = None
+    tp_group: Any = None
+
+    def validate(self):
+        if self.tp_size < 1:
+            raise ConfigError(f"tp_size must be >= 1, got {self.tp_size}")
+
+
+@dataclasses.dataclass
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    ep_size: int = 1
+    moe_experts: Any = dataclasses.field(default_factory=lambda: [1])
+    type: str = "standard"
+
+
+@dataclasses.dataclass
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    bits: int = 8
+    group_size: int = 64
+
+
+@dataclasses.dataclass
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """reference inference/config.py:70 DeepSpeedInferenceConfig."""
+    kernel_inject: bool = False            # replace_with_kernel_inject
+    dtype: Any = "bfloat16"
+    tensor_parallel: Any = None            # dict -> DeepSpeedTPConfig
+    injection_policy: Any = None
+    replace_method: str = "auto"
+    moe: Any = None
+    quant: Any = None
+    checkpoint: Optional[str] = None       # checkpoint dir / json path
+    base_dir: str = ""
+    max_tokens: int = 1024                 # alias: max_out_tokens
+    min_out_tokens: int = 1
+    max_batch_size: Optional[int] = None
+    enable_cuda_graph: bool = False        # accepted; warns (XLA == compiled)
+    triangular_masking: bool = True
+    return_tuple: bool = True
+    training_mp_size: int = 1
+    replace_with_kernel_inject: bool = False
+    mp_size: int = 1                       # deprecated alias for tp_size
+    seed: int = 0
+
+    ALIASES = {"max_out_tokens": "max_tokens"}
+
+    def validate(self):
+        if isinstance(self.dtype, str):
+            key = self.dtype.lower().replace("torch.", "")
+            if key not in _DTYPES:
+                raise ConfigError(f"unknown inference dtype {self.dtype!r}; "
+                                  f"one of {sorted(_DTYPES)}")
+            self.dtype = _DTYPES[key]
+        if self.tensor_parallel is None:
+            self.tensor_parallel = DeepSpeedTPConfig(
+                tp_size=max(self.mp_size, 1))
+        elif isinstance(self.tensor_parallel, dict):
+            self.tensor_parallel = DeepSpeedTPConfig.from_dict(
+                self.tensor_parallel)
+        if self.mp_size > 1 and self.tensor_parallel.tp_size == 1:
+            self.tensor_parallel.tp_size = self.mp_size
+        if isinstance(self.moe, dict):
+            self.moe = DeepSpeedMoEConfig.from_dict(self.moe)
+        elif isinstance(self.moe, bool):
+            self.moe = DeepSpeedMoEConfig(enabled=self.moe)
+        if isinstance(self.quant, dict):
+            self.quant = QuantizationConfig.from_dict(self.quant)
+        if self.enable_cuda_graph:
+            logger.warning("enable_cuda_graph is a no-op on TPU: XLA programs "
+                           "are already captured/replayed whole")
+        if self.kernel_inject or self.replace_with_kernel_inject:
+            self.kernel_inject = self.replace_with_kernel_inject = True
+        if self.max_tokens < 1:
+            raise ConfigError("max_tokens must be >= 1")

@@ -1,0 +1,264 @@
+"""InferenceEngine — TP-sharded serving with a compiled KV-cache decode loop.
+
+TPU-native re-design of the reference inference engine
+(reference deepspeed/inference/engine.py:89 ``InferenceEngine``). The torch
+engine mutates the module in place (kernel injection, CUDA graphs); here the
+engine owns a params pytree sharded over the 'model' mesh axis and three
+compiled programs:
+
+  prefill:  [B, T_prompt] -> (logits, cache)     (cache write 0..T)
+  decode:   one token through the cache          (reference softmax_context,
+            csrc/transformer/inference/csrc/pt_binding.cpp:1747)
+  generate: prefill + lax.scan over decode steps + sampling, ONE dispatch
+            per generate() call — the XLA answer to CUDA-graph capture
+            (reference inference/engine.py:500 _capture_graph).
+
+TP serving reuses the model's training partition rules through the stage-0
+sharding planner (reference auto-TP, module_inject/auto_tp.py:13, falls out
+of the same rules). Sampling: greedy / temperature / top-k, with EOS
+short-circuit semantics matching HF generate defaults.
+"""
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.api import ModelSpec
+from ..parallel.topology import (DeviceMeshManager, default_devices,
+                                 initialize_mesh, get_mesh_manager)
+from ..runtime.zero.partition import ZeroShardingPlanner
+from ..utils.logging import log_dist, logger
+from .config import DeepSpeedInferenceConfig
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+class InferenceEngine:
+    """Callable engine: ``engine(input_ids)`` -> logits;
+    ``engine.generate(...)`` -> token ids."""
+
+    def __init__(self, model, config: DeepSpeedInferenceConfig = None,
+                 params=None, mesh_manager: Optional[DeviceMeshManager] = None):
+        if config is None:
+            config = DeepSpeedInferenceConfig()
+        self._config = config
+        self.dtype = config.dtype
+
+        # HF torch modules (the reference's primary input) are converted by
+        # the injection layer into a deepspeed_tpu model spec + params.
+        if not isinstance(model, ModelSpec):
+            from ..module_inject import replace_transformer_layer
+            model, params = replace_transformer_layer(model, config)
+        self.module = model
+
+        tp = config.tensor_parallel.tp_size
+        if mesh_manager is not None:
+            self.mesh_manager = mesh_manager
+        else:
+            devices = default_devices()
+            if len(devices) % tp != 0:
+                raise ValueError(
+                    f"tp_size={tp} does not divide device count {len(devices)}")
+            self.mesh_manager = initialize_mesh(
+                dp=len(devices) // tp, tp=tp, devices=devices)
+        self.mesh = self.mesh_manager.mesh
+
+        rules = model.partition_rules() if hasattr(model, "partition_rules") \
+            else []
+        self.planner = ZeroShardingPlanner(self.mesh_manager, stage=0,
+                                           rules=rules)
+
+        rng = jax.random.PRNGKey(config.seed)
+        param_shapes = jax.eval_shape(model.init, rng)
+        self.param_shardings = self.planner.param_shardings(param_shapes)
+        with self.mesh:
+            if params is not None:
+                cast = jax.jit(
+                    lambda p: jax.tree.map(self._cast_leaf, p),
+                    out_shardings=self.param_shardings)
+                self.params = cast(params)
+            else:
+                self.params = jax.jit(
+                    lambda r: jax.tree.map(self._cast_leaf, model.init(r)),
+                    out_shardings=self.param_shardings)(rng)
+        if config.checkpoint:
+            self.load_checkpoint(config.checkpoint)
+
+        self._cache_rules = (model.cache_partition_rules()
+                             if hasattr(model, "cache_partition_rules") else [])
+        self._fns: Dict[Any, Any] = {}
+        n_params = sum(int(np.prod(s.shape))
+                       for s in jax.tree.leaves(param_shapes))
+        log_dist(f"InferenceEngine initialized: params={n_params/1e6:.1f}M "
+                 f"tp={tp} dtype={jnp.dtype(self.dtype).name} "
+                 f"max_tokens={config.max_tokens}", ranks=[0])
+
+    # ------------------------------------------------------------------ utils
+    def _cast_leaf(self, x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(self.dtype)
+        return x
+
+    def _batch_sharding(self, batch_size: int):
+        """Serving batches can be any size: shard over the dp axes only when
+        divisible, else replicate (small-batch decode)."""
+        if batch_size % self.mesh_manager.dp_world_size == 0:
+            return self.mesh_manager.batch_sharding(False)
+        return NamedSharding(self.mesh, P())
+
+    def _cache_shardings(self, cache_shapes):
+        planner = ZeroShardingPlanner(self.mesh_manager, stage=0,
+                                      rules=self._cache_rules)
+        return planner.param_shardings(cache_shapes)
+
+    def load_checkpoint(self, load_dir, tag=None):
+        """Load a deepspeed_tpu training checkpoint (any source mp/dp layout
+        — universal reshard-on-load) into the serving shardings."""
+        from ..runtime.checkpointing import load_params_for_inference
+        with self.mesh:
+            self.params = load_params_for_inference(
+                load_dir, tag=tag, like=self.params,
+                shardings=self.param_shardings, cast=self._cast_leaf)
+        return load_dir
+
+    # ---------------------------------------------------------------- forward
+    def forward(self, input_ids, **kwargs):
+        """Full-sequence logits (scoring path, no cache)."""
+        input_ids = jnp.asarray(input_ids)
+        key = ("fwd", input_ids.shape)
+        if key not in self._fns:
+            def fwd(params, ids):
+                logits, _ = self.module.logits(params, ids, train=False,
+                                               return_aux_loss=True)
+                return logits
+            self._fns[key] = jax.jit(
+                fwd, in_shardings=(self.param_shardings,
+                                   self._batch_sharding(input_ids.shape[0])))
+        with self.mesh:
+            return self._fns[key](self.params, input_ids)
+
+    __call__ = forward
+
+    # --------------------------------------------------------------- generate
+    def generate(self, input_ids, max_new_tokens: int = 64,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 max_length: Optional[int] = None):
+        """Autoregressive generation, one compiled program per
+        (prompt_shape, max_new_tokens) bucket. Returns [B, T+max_new_tokens]
+        (prompt + generated; positions after EOS hold eos_token_id)."""
+        input_ids = jnp.asarray(input_ids)
+        if input_ids.ndim == 1:
+            input_ids = input_ids[None]
+        b, t = input_ids.shape
+        if max_length is not None:
+            max_new_tokens = max(0, max_length - t)
+        n_pos = getattr(getattr(self.module, "config", None),
+                        "n_positions", None)
+        if n_pos is not None and t + max_new_tokens > n_pos:
+            raise ValueError(
+                f"generate: prompt {t} + max_new_tokens {max_new_tokens} "
+                f"exceeds the model's context length n_positions={n_pos}")
+        cache_len = min(_next_pow2(t + max_new_tokens),
+                        max(self._config.max_tokens, t + max_new_tokens))
+        if t + max_new_tokens > self._config.max_tokens:
+            logger.warning(
+                f"generate: {t}+{max_new_tokens} tokens exceeds config "
+                f"max_tokens={self._config.max_tokens} "
+                f"(reference inference/engine.py:588 guard); growing cache")
+
+        key = ("gen", b, t, max_new_tokens, float(temperature), top_k,
+               eos_token_id)
+        if key not in self._fns:
+            self._fns[key] = self._build_generate(
+                b, t, cache_len, max_new_tokens, temperature, top_k,
+                eos_token_id)
+        with self.mesh:
+            return self._fns[key](self.params, input_ids,
+                                  jax.random.PRNGKey(seed))
+
+    def _build_generate(self, b, t, cache_len, max_new_tokens, temperature,
+                        top_k, eos_token_id):
+        model = self.module
+        vocab = model.config.vocab_size
+
+        def sample(logits, key):
+            # logits [B, V_padded]; restrict to the real vocab
+            logits = logits[:, :vocab].astype(jnp.float32)
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits = logits / temperature
+            if top_k:
+                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            return jax.random.categorical(key, logits, axis=-1).astype(
+                jnp.int32)
+
+        cache_shapes = jax.eval_shape(
+            lambda: model.init_kv_cache(b, cache_len, dtype=self.dtype))
+        cache_specs = jax.tree.map(
+            lambda sh: sh.spec, self._cache_shardings(cache_shapes))
+
+        def constrain(cache):
+            return lax.with_sharding_constraint(cache, cache_specs)
+
+        def run(params, prompt, key):
+            cache = constrain(
+                model.init_kv_cache(b, cache_len, dtype=self.dtype))
+            logits, cache = model.apply_with_cache(params, prompt, cache,
+                                                   jnp.int32(0))
+            tok = sample(logits[:, -1], key)
+            finished = (jnp.zeros((b,), jnp.bool_) if eos_token_id is None
+                        else tok == eos_token_id)
+
+            def step(carry, i):
+                cache, tok, finished, key = carry
+                key, sub = jax.random.split(key)
+                # tok was sampled for position t+i-1; write its K/V there
+                logits, cache = model.apply_with_cache(
+                    params, tok[:, None], cache, t + i - 1)
+                cache = constrain(cache)
+                nxt = sample(logits[:, -1], sub)
+                if eos_token_id is not None:
+                    nxt = jnp.where(finished, eos_token_id, nxt)
+                    finished = finished | (nxt == eos_token_id)
+                return (cache, nxt, finished, key), tok
+
+            if max_new_tokens > 1:
+                (_, last, _, _), toks = lax.scan(
+                    step, (cache, tok, finished, key),
+                    jnp.arange(1, max_new_tokens, dtype=jnp.int32))
+                toks = jnp.concatenate([toks.T, last[:, None]], axis=-1)
+            else:
+                toks = tok[:, None]
+            return jnp.concatenate([prompt, toks], axis=-1)
+
+        return jax.jit(run, in_shardings=(
+            self.param_shardings, self._batch_sharding(b), None))
+
+    # ------------------------------------------------------------- properties
+    @property
+    def config(self):
+        return self._config
+
+    @property
+    def mp_world_size(self):
+        return self.mesh_manager.tp
+
+    def eval(self):
+        return self
+
+    def half(self):
+        """Reference API: cast to fp16 (here: the configured low dtype)."""
+        with self.mesh:
+            self.params = jax.jit(lambda p: jax.tree.map(self._cast_leaf, p),
+                                  out_shardings=self.param_shardings)(
+                self.params)
+        return self

@@ -105,8 +105,12 @@ class GPT2Model(ModelSpec):
         }
 
     # ----------------------------------------------------------------- block
-    def _attn_sublayer(self, x, p, rng, train):
-        """ln1 → qkv → flash attention → proj → residual (+dropout)."""
+    def _attn_sublayer(self, x, p, rng, train, attn_fn=None):
+        """ln1 → qkv → flash attention → proj → residual (+dropout).
+
+        ``attn_fn(q, k, v) -> attn`` overrides the attention inner — the
+        decode path injects its KV-cache attention here so train and serve
+        share one block implementation."""
         cfg = self.config
         b, t, d = x.shape
         h, hd = cfg.n_head, cfg.head_dim
@@ -116,13 +120,16 @@ class GPT2Model(ModelSpec):
         q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
-        drop_rng = None
-        if train and cfg.dropout > 0 and rng is not None:
-            drop_rng = jax.random.fold_in(rng, 3)
-        attn = sp_attention(q, k, v, causal=True,
-                            dropout_rate=cfg.dropout if train else 0.0,
-                            dropout_rng=drop_rng, impl=cfg.sp_attention,
-                            backend=cfg.attn_backend)
+        if attn_fn is not None:
+            attn = attn_fn(q, k, v)
+        else:
+            drop_rng = None
+            if train and cfg.dropout > 0 and rng is not None:
+                drop_rng = jax.random.fold_in(rng, 3)
+            attn = sp_attention(q, k, v, causal=True,
+                                dropout_rate=cfg.dropout if train else 0.0,
+                                dropout_rng=drop_rng, impl=cfg.sp_attention,
+                                backend=cfg.attn_backend)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
         attn = attn @ p["attn_proj_w"].astype(attn.dtype) + p["attn_proj_b"].astype(attn.dtype)
         return x + self._dropout(attn, rng, train, 0)
@@ -260,6 +267,72 @@ class GPT2Model(ModelSpec):
         return {"blocks_key": "blocks", "embed": embed, "block": block,
                 "head_loss": head_loss,
                 "aux_loss_weight": self.aux_loss_weight()}
+
+    # ------------------------------------------------------- decode protocol
+    # The inference engine's counterpart to the reference's fused inference
+    # modules (reference model_implementations/transformers/ds_transformer.py,
+    # csrc/transformer/inference/csrc/pt_binding.cpp:1747 softmax_context —
+    # attention with KV-cache append). Functional: the cache is a pytree the
+    # caller threads through compiled prefill/decode steps.
+    def init_kv_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+        cfg = self.config
+        shape = (cfg.n_layer, batch_size, cfg.n_head, max_len, cfg.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def apply_with_cache(self, params, input_ids, cache, start_pos):
+        """Forward with KV cache. input_ids: [B, T] (prompt for prefill,
+        [B, 1] for decode); start_pos: traced scalar — tokens occupy
+        positions [start_pos, start_pos+T). Returns (logits [B,T,V],
+        new_cache)."""
+        cfg = self.config
+        b, t = input_ids.shape
+        h, hd = cfg.n_head, cfg.head_dim
+        max_len = cache["k"].shape[-2]
+        wte_dtype = params["wte"].dtype
+        compute_dtype = (wte_dtype if jnp.issubdtype(wte_dtype, jnp.floating)
+                         else jnp.dtype(cfg.dtype))
+        wte = params["wte"].astype(compute_dtype)
+        wpe = lax.dynamic_slice(params["wpe"], (start_pos, 0),
+                                (t, cfg.n_embd)).astype(compute_dtype)
+        x = wte[input_ids] + wpe
+
+        # attention mask over the cache: key position <= query position
+        q_pos = start_pos + jnp.arange(t)[:, None]
+        k_pos = jnp.arange(max_len)[None, :]
+        mask = (k_pos <= q_pos)[None, None]          # [1, 1, T, max_len]
+
+        from ..ops.flash_attention import reference_attention
+
+        def body(x, xs):
+            layer_params, k_cache, v_cache = xs
+            new_kv = {}
+
+            def cached_attn(q, k, v):
+                kc = lax.dynamic_update_slice(
+                    k_cache, k.astype(k_cache.dtype), (0, 0, start_pos, 0))
+                vc = lax.dynamic_update_slice(
+                    v_cache, v.astype(v_cache.dtype), (0, 0, start_pos, 0))
+                new_kv["k"], new_kv["v"] = kc, vc
+                return reference_attention(q, kc.astype(q.dtype),
+                                           vc.astype(q.dtype),
+                                           causal=False, mask=mask)
+
+            x = self._attn_sublayer(x, layer_params, None, False,
+                                    attn_fn=cached_attn)
+            x, _ = self._mlp_sublayer(x, layer_params, None, False)
+            return x, (new_kv["k"], new_kv["v"])
+
+        x, (new_k, new_v) = lax.scan(
+            body, x, (params["blocks"], cache["k"], cache["v"]))
+        x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                        cfg.layer_norm_epsilon)
+        logits = x @ wte.T
+        return logits, {"k": new_k, "v": new_v}
+
+    def cache_partition_rules(self):
+        """Sharding for the KV cache: heads over 'model' (TP), batch over the
+        dp axes."""
+        return [(r"(k|v)$", (None, ("data", "expert"), "model", None, None))]
 
     def flops_per_token(self, seq_len: Optional[int] = None):
         """Training FLOPs/token: 6N + attention term (12·L·D·T)."""

@@ -1,0 +1,7 @@
+"""Module injection: HF → deepspeed_tpu conversion + AutoTP.
+
+Reference: deepspeed/module_inject/ (replace_module.py, policy.py,
+auto_tp.py)."""
+
+from .policy import replace_transformer_layer, register_policy, policy_for
+from .auto_tp import auto_tp_rules

@@ -1,0 +1,104 @@
+"""Injection policies: HF torch modules → deepspeed_tpu model + params.
+
+TPU-native counterpart of the reference's kernel-injection layer
+(reference module_inject/replace_module.py:276 ``replace_transformer_layer``,
+module_inject/policy.py ``TransformerPolicy``, containers/gpt2.py). The torch
+version swaps nn.Modules for fused-CUDA modules in place; on TPU "injection"
+means: read the architecture + weights out of the HF module ONCE, emit
+
+    (deepspeed_tpu ModelSpec, params pytree)
+
+and let the inference engine compile/shard it. Per-architecture policies
+register themselves by HF class name, exactly like reference
+replace_policy.py's ``replace_policies`` list.
+"""
+
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+from ..utils.logging import logger
+
+_POLICIES: Dict[str, Callable] = {}
+
+
+def register_policy(*hf_class_names):
+    def deco(fn):
+        for name in hf_class_names:
+            _POLICIES[name] = fn
+        return fn
+    return deco
+
+
+def policy_for(model) -> Callable:
+    for klass in type(model).__mro__:
+        if klass.__name__ in _POLICIES:
+            return _POLICIES[klass.__name__]
+    raise ValueError(
+        f"no injection policy for {type(model).__name__}; known: "
+        f"{sorted(_POLICIES)} (reference replace_policy.py registry)")
+
+
+def _np(t):
+    return np.asarray(t.detach().cpu().numpy(), dtype=np.float32)
+
+
+@register_policy("GPT2LMHeadModel", "GPT2Model")
+def gpt2_policy(model) -> Tuple[Any, Any]:
+    """HF GPT-2 → stacked-layer GPT2Model params.
+
+    HF Conv1D stores weights [in, out] — our convention (x @ w) directly, no
+    transpose (reference containers/gpt2.py HFGPT2LayerPolicy notes the same
+    Conv1D quirk)."""
+    import jax.numpy as jnp
+    from ..models.gpt2 import GPT2Config, GPT2Model
+
+    hf = model.transformer if hasattr(model, "transformer") else model
+    hf_cfg = model.config
+    cfg = GPT2Config(
+        vocab_size=hf_cfg.vocab_size,
+        n_positions=hf_cfg.n_positions,
+        n_embd=hf_cfg.n_embd,
+        n_layer=hf_cfg.n_layer,
+        n_head=hf_cfg.n_head,
+        layer_norm_epsilon=hf_cfg.layer_norm_epsilon,
+        pad_vocab_to_multiple=1,
+    )
+    spec = GPT2Model(cfg)
+
+    stack = lambda field: np.stack([field(h) for h in hf.h])
+    blocks = {
+        "ln1_scale": stack(lambda h: _np(h.ln_1.weight)),
+        "ln1_bias": stack(lambda h: _np(h.ln_1.bias)),
+        "qkv_w": stack(lambda h: _np(h.attn.c_attn.weight)),
+        "qkv_b": stack(lambda h: _np(h.attn.c_attn.bias)),
+        "attn_proj_w": stack(lambda h: _np(h.attn.c_proj.weight)),
+        "attn_proj_b": stack(lambda h: _np(h.attn.c_proj.bias)),
+        "ln2_scale": stack(lambda h: _np(h.ln_2.weight)),
+        "ln2_bias": stack(lambda h: _np(h.ln_2.bias)),
+        "mlp_fc_w": stack(lambda h: _np(h.mlp.c_fc.weight)),
+        "mlp_fc_b": stack(lambda h: _np(h.mlp.c_fc.bias)),
+        "mlp_proj_w": stack(lambda h: _np(h.mlp.c_proj.weight)),
+        "mlp_proj_b": stack(lambda h: _np(h.mlp.c_proj.bias)),
+    }
+    params = {
+        "wte": _np(hf.wte.weight),
+        "wpe": _np(hf.wpe.weight),
+        "blocks": {k: jnp.asarray(v) for k, v in blocks.items()},
+        "ln_f_scale": _np(hf.ln_f.weight),
+        "ln_f_bias": _np(hf.ln_f.bias),
+    }
+    params = {k: (jnp.asarray(v) if not isinstance(v, dict) else v)
+              for k, v in params.items()}
+    return spec, params
+
+
+def replace_transformer_layer(model, config=None) -> Tuple[Any, Any]:
+    """Entry point (reference module_inject/replace_module.py:276). Dispatch
+    by policy; unknown architectures fall back to AutoTP-style generic
+    handling only if a policy exists — otherwise raise (no silent wrap)."""
+    policy = policy_for(model)
+    spec, params = policy(model)
+    logger.info(f"injected {type(model).__name__} -> "
+                f"{type(spec).__name__} ({policy.__name__})")
+    return spec, params

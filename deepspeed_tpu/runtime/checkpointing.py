@@ -212,6 +212,34 @@ def save_16bit_model(engine, save_dir, save_filename="pytorch_model.msgpack"):
     return os.path.join(save_dir, save_filename)
 
 
+def load_params_for_inference(load_dir, tag=None, like=None, shardings=None,
+                              cast=None):
+    """Load params from a training checkpoint dir into serving shardings
+    (the reference's checkpoint-loading path of InferenceEngine,
+    inference/engine.py:338,419 — here any mp/dp layout reshards on load)."""
+    if tag is None:
+        latest_path = os.path.join(load_dir, "latest")
+        if os.path.isfile(latest_path):
+            with open(latest_path) as f:
+                tag = f.read().strip()
+    ckpt_dir = os.path.join(load_dir, str(tag)) if tag else load_dir
+    params = get_fp32_state_dict_from_checkpoint(ckpt_dir)
+    if like is not None:
+        want = jax.tree.structure(like)
+        got = jax.tree.structure(params)
+        if want != got:
+            raise ValueError(
+                f"checkpoint at {ckpt_dir} does not match the serving "
+                f"model's parameter structure:\n  model: {want}\n  "
+                f"checkpoint: {got}")
+    if cast is not None:
+        params = jax.tree.map(lambda x: cast(jnp.asarray(x)), params)
+    if shardings is not None:
+        params = _restore_like(shardings, params)
+    log_dist(f"loaded inference params from {ckpt_dir}", ranks=[0])
+    return params
+
+
 def get_fp32_state_dict_from_checkpoint(ckpt_dir):
     """Offline reader (the zero_to_fp32.py equivalent,
     utils/zero_to_fp32.py:158): returns the fp32 param pytree from a
