@@ -160,6 +160,8 @@ class InferenceEngine:
         b, t = input_ids.shape
         if max_length is not None:
             max_new_tokens = max(0, max_length - t)
+        if max_new_tokens <= 0:
+            return input_ids  # prompt already at/over max_length
         n_pos = getattr(getattr(self.module, "config", None),
                         "n_positions", None)
         if n_pos is not None and t + max_new_tokens > n_pos:

@@ -1,0 +1,9 @@
+"""Host Adagrad (reference csrc/adagrad/cpu_adagrad.cpp:243) — shares the
+cpu_adam native library; separate builder name kept for reference parity
+(op_builder/cpu_adagrad.py)."""
+
+from .cpu_adam_ops import get_ops as _get  # same .so, same namespace
+
+
+def get_ops(backend: str = "cpu"):
+    return _get(backend)

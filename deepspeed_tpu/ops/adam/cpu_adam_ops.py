@@ -1,0 +1,207 @@
+"""Host (CPU) Adam — the optimizer for offloaded ZeRO partitions.
+
+Capability match for the reference's DeepSpeedCPUAdam
+(csrc/adam/cpu_adam.cpp:303-308, ops/adam/cpu_adam.py): fp32 master weights +
+moments live in host RAM; the step runs on host SIMD cores via the C++
+extension (ops/csrc/cpu_adam.cpp) while the TPU computes the next micro-batch.
+A numpy fallback keeps the op functional where no C++ toolchain exists (the
+reference hard-fails there; we degrade with a warning since the math is
+identical, just slower).
+
+Loaded through CPUAdamBuilder (ops/op_builder.py) / the accelerator seam.
+"""
+
+import ctypes
+from types import SimpleNamespace
+
+import numpy as np
+
+from ..native_build import NativeBuildError, load_library
+from ...utils.logging import logger
+
+try:
+    import ml_dtypes
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+_u16p = ctypes.POINTER(ctypes.c_uint16)
+
+
+def _ptr(a: np.ndarray, typ=_f32p):
+    return a.ctypes.data_as(typ)
+
+
+def _lib():
+    lib = load_library("cpu_adam")
+    lib.ds_adam_step.restype = ctypes.c_int
+    lib.ds_adam_step.argtypes = [
+        _f32p, _f32p, _f32p, _f32p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float, ctypes.c_int, ctypes.c_int]
+    lib.ds_adam_step_copy_bf16.restype = ctypes.c_int
+    lib.ds_adam_step_copy_bf16.argtypes = [
+        _f32p, _f32p, _f32p, _f32p, _u16p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float, ctypes.c_int, ctypes.c_int]
+    lib.ds_adagrad_step.restype = ctypes.c_int
+    lib.ds_adagrad_step.argtypes = [
+        _f32p, _f32p, _f32p, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float]
+    lib.ds_lion_step.restype = ctypes.c_int
+    lib.ds_lion_step.argtypes = [
+        _f32p, _f32p, _f32p, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float, ctypes.c_float]
+    lib.ds_norm_sq.restype = ctypes.c_double
+    lib.ds_norm_sq.argtypes = [_f32p, ctypes.c_int64]
+    lib.ds_has_nonfinite.restype = ctypes.c_int
+    lib.ds_has_nonfinite.argtypes = [_f32p, ctypes.c_int64]
+    lib.ds_scale.restype = ctypes.c_int
+    lib.ds_scale.argtypes = [_f32p, ctypes.c_int64, ctypes.c_float]
+    lib.ds_fp32_to_bf16.restype = ctypes.c_int
+    lib.ds_fp32_to_bf16.argtypes = [_f32p, _u16p, ctypes.c_int64]
+    return lib
+
+
+def _check(a, dtype=np.float32):
+    assert isinstance(a, np.ndarray) and a.dtype == dtype and \
+        a.flags["C_CONTIGUOUS"], f"need contiguous {dtype} array, got {a.dtype}"
+
+
+class NativeHostOps:
+    """ctypes surface over libcpu_adam."""
+
+    def __init__(self):
+        self.lib = _lib()
+        self.native = True
+
+    def adam_step(self, w, g, m, v, step, lr, beta1, beta2, eps,
+                  weight_decay=0.0, decoupled=True, bias_correction=True,
+                  w16=None):
+        for a in (w, g, m, v):
+            _check(a)
+        if w16 is not None:
+            assert _BF16 is not None and w16.dtype == _BF16
+            self.lib.ds_adam_step_copy_bf16(
+                _ptr(w), _ptr(g), _ptr(m), _ptr(v),
+                w16.ctypes.data_as(_u16p), w.size, step, lr, beta1, beta2,
+                eps, weight_decay, int(decoupled), int(bias_correction))
+        else:
+            self.lib.ds_adam_step(
+                _ptr(w), _ptr(g), _ptr(m), _ptr(v), w.size, step, lr, beta1,
+                beta2, eps, weight_decay, int(decoupled), int(bias_correction))
+
+    def adagrad_step(self, w, g, acc, lr, eps, weight_decay=0.0):
+        for a in (w, g, acc):
+            _check(a)
+        self.lib.ds_adagrad_step(_ptr(w), _ptr(g), _ptr(acc), w.size, lr, eps,
+                                 weight_decay)
+
+    def lion_step(self, w, g, m, lr, beta1, beta2, weight_decay=0.0):
+        for a in (w, g, m):
+            _check(a)
+        self.lib.ds_lion_step(_ptr(w), _ptr(g), _ptr(m), w.size, lr, beta1,
+                              beta2, weight_decay)
+
+    def norm_sq(self, x) -> float:
+        _check(x)
+        return float(self.lib.ds_norm_sq(_ptr(x), x.size))
+
+    def has_nonfinite(self, x) -> bool:
+        _check(x)
+        return bool(self.lib.ds_has_nonfinite(_ptr(x), x.size))
+
+    def scale_(self, x, a):
+        _check(x)
+        self.lib.ds_scale(_ptr(x), x.size, a)
+
+    def fp32_to_bf16(self, src, dst):
+        _check(src)
+        assert _BF16 is not None and dst.dtype == _BF16
+        self.lib.ds_fp32_to_bf16(_ptr(src), dst.ctypes.data_as(_u16p),
+                                 src.size)
+
+
+class NumpyHostOps:
+    """Pure-numpy fallback with identical semantics (slower)."""
+
+    native = False
+
+    def adam_step(self, w, g, m, v, step, lr, beta1, beta2, eps,
+                  weight_decay=0.0, decoupled=True, bias_correction=True,
+                  w16=None):
+        grad = g if (decoupled or weight_decay == 0.0) else g + weight_decay * w
+        m *= beta1
+        m += (1 - beta1) * grad
+        v *= beta2
+        v += (1 - beta2) * np.square(grad)
+        if bias_correction:
+            bc1 = 1 - beta1 ** step
+            bc2 = 1 - beta2 ** step
+        else:
+            bc1 = bc2 = 1.0
+        update = (m / bc1) / (np.sqrt(v / bc2) + eps)
+        if decoupled and weight_decay != 0.0:
+            update = update + weight_decay * w
+        w -= lr * update
+        if w16 is not None:
+            w16[...] = w.astype(w16.dtype)
+
+    def adagrad_step(self, w, g, acc, lr, eps, weight_decay=0.0):
+        grad = g if weight_decay == 0.0 else g + weight_decay * w
+        acc += np.square(grad)
+        w -= lr * grad / (np.sqrt(acc) + eps)
+
+    def lion_step(self, w, g, m, lr, beta1, beta2, weight_decay=0.0):
+        update = np.sign(beta1 * m + (1 - beta1) * g)
+        if weight_decay != 0.0:
+            update = update + weight_decay * w
+        w -= lr * update
+        m *= beta2
+        m += (1 - beta2) * g
+
+    def norm_sq(self, x) -> float:
+        return float(np.sum(np.square(x, dtype=np.float64)))
+
+    def has_nonfinite(self, x) -> bool:
+        return not bool(np.all(np.isfinite(x)))
+
+    def scale_(self, x, a):
+        x *= a
+
+    def fp32_to_bf16(self, src, dst):
+        dst[...] = src.astype(dst.dtype)
+
+
+_cached = None
+
+
+def get_ops(backend: str = "cpu"):
+    """Builder entry. backend is advisory; host ops always run on the host."""
+    global _cached
+    if _cached is None:
+        try:
+            _cached = NativeHostOps()
+        except (NativeBuildError, OSError) as e:
+            logger.warning(f"cpu_adam native build unavailable ({e}); "
+                           f"falling back to numpy host ops")
+            _cached = NumpyHostOps()
+    return _cached
+
+
+def bf16_dtype():
+    return _BF16
+
+
+def get_host_ops():
+    return get_ops()
+
+
+def ops_namespace(backend: str = "cpu"):
+    ops = get_ops(backend)
+    return SimpleNamespace(
+        adam_step=ops.adam_step, adagrad_step=ops.adagrad_step,
+        lion_step=ops.lion_step, norm_sq=ops.norm_sq,
+        has_nonfinite=ops.has_nonfinite, scale_=ops.scale_,
+        fp32_to_bf16=ops.fp32_to_bf16, native=ops.native)

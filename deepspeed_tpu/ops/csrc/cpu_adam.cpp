@@ -1,0 +1,186 @@
+// Host-side SIMD optimizers for offloaded ZeRO partitions.
+//
+// TPU-native equivalent of the reference's CPU Adam/Adagrad
+// (csrc/adam/cpu_adam.cpp:303-308, csrc/adagrad/cpu_adagrad.cpp:243): when
+// optimizer state is offloaded to host RAM / NVMe, the optimizer step runs on
+// the host CPU over fp32 master buffers while the TPU works on the next
+// micro-batch. Design differences from the reference: no global optimizer
+// registry keyed by id (the Python side owns per-leaf state as numpy views and
+// passes raw pointers), and bf16 (not fp16) is the device dtype, so the
+// fused "step + copy back" variant emits round-to-nearest-even bfloat16.
+//
+// Vectorization: plain loops with #pragma omp simd — autovectorizes to
+// AVX2/AVX-512 at -O3 -march=native, replacing the reference's hand-written
+// AVX intrinsics (csrc/includes/simd.h) with something the compiler owns.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+namespace {
+
+// fp32 -> bf16 with round-to-nearest-even (matches XLA's convert semantics).
+static inline uint16_t float_to_bf16(float f) {
+    uint32_t bits;
+    std::memcpy(&bits, &f, sizeof(bits));
+    if ((bits & 0x7fffffffu) > 0x7f800000u) {  // NaN: quiet, keep payload bit
+        return static_cast<uint16_t>((bits >> 16) | 0x0040u);
+    }
+    uint32_t lsb = (bits >> 16) & 1u;
+    bits += 0x7fffu + lsb;
+    return static_cast<uint16_t>(bits >> 16);
+}
+
+}  // namespace
+
+extern "C" {
+
+// One Adam step over a flat fp32 buffer.
+//   decoupled=1 -> AdamW (decay applied to weights, not grads)
+//   bias_correction=1 -> standard Adam bias correction with `step` (1-based)
+// Returns 0.
+int ds_adam_step(float* w, const float* g, float* m, float* v, int64_t n,
+                 int64_t step, float lr, float beta1, float beta2, float eps,
+                 float weight_decay, int decoupled, int bias_correction) {
+    float bc1 = 1.0f, bc2 = 1.0f;
+    if (bias_correction) {
+        bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+        bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
+    }
+    const float inv_bc1 = 1.0f / bc1;
+    const float inv_bc2_sqrt = 1.0f / std::sqrt(bc2);
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float grad = g[i];
+        if (weight_decay != 0.0f && !decoupled) grad += weight_decay * w[i];
+        float mi = beta1 * m[i] + (1.0f - beta1) * grad;
+        float vi = beta2 * v[i] + (1.0f - beta2) * grad * grad;
+        m[i] = mi;
+        v[i] = vi;
+        float update = (mi * inv_bc1) / (std::sqrt(vi) * inv_bc2_sqrt + eps);
+        if (weight_decay != 0.0f && decoupled) update += weight_decay * w[i];
+        w[i] -= lr * update;
+    }
+    return 0;
+}
+
+// Adam step fused with the device-copy cast: also writes the updated weights
+// as bf16 into `w16` (the buffer that gets device_put back to the TPU).
+int ds_adam_step_copy_bf16(float* w, const float* g, float* m, float* v,
+                           uint16_t* w16, int64_t n, int64_t step, float lr,
+                           float beta1, float beta2, float eps,
+                           float weight_decay, int decoupled,
+                           int bias_correction) {
+    float bc1 = 1.0f, bc2 = 1.0f;
+    if (bias_correction) {
+        bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+        bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
+    }
+    const float inv_bc1 = 1.0f / bc1;
+    const float inv_bc2_sqrt = 1.0f / std::sqrt(bc2);
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float grad = g[i];
+        if (weight_decay != 0.0f && !decoupled) grad += weight_decay * w[i];
+        float mi = beta1 * m[i] + (1.0f - beta1) * grad;
+        float vi = beta2 * v[i] + (1.0f - beta2) * grad * grad;
+        m[i] = mi;
+        v[i] = vi;
+        float update = (mi * inv_bc1) / (std::sqrt(vi) * inv_bc2_sqrt + eps);
+        if (weight_decay != 0.0f && decoupled) update += weight_decay * w[i];
+        float wi = w[i] - lr * update;
+        w[i] = wi;
+        w16[i] = float_to_bf16(wi);
+    }
+    return 0;
+}
+
+// Adagrad step (reference csrc/adagrad/cpu_adagrad.cpp behavior).
+int ds_adagrad_step(float* w, const float* g, float* acc, int64_t n, float lr,
+                    float eps, float weight_decay) {
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float grad = g[i];
+        if (weight_decay != 0.0f) grad += weight_decay * w[i];
+        float a = acc[i] + grad * grad;
+        acc[i] = a;
+        w[i] -= lr * grad / (std::sqrt(a) + eps);
+    }
+    return 0;
+}
+
+// Lion step (sign of interpolated momentum; used by the host offload path
+// when the configured optimizer is lion).
+int ds_lion_step(float* w, const float* g, float* m, int64_t n, float lr,
+                 float beta1, float beta2, float weight_decay) {
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float grad = g[i];
+        float c = beta1 * m[i] + (1.0f - beta1) * grad;
+        float update = (c > 0.0f) ? 1.0f : ((c < 0.0f) ? -1.0f : 0.0f);
+        if (weight_decay != 0.0f) update += weight_decay * w[i];
+        w[i] -= lr * update;
+        m[i] = beta2 * m[i] + (1.0f - beta2) * grad;
+    }
+    return 0;
+}
+
+// Utilities for the host grad path ---------------------------------------
+
+// sum of squares (for host-side global grad norm)
+double ds_norm_sq(const float* x, int64_t n) {
+    double acc = 0.0;
+#pragma omp parallel for reduction(+ : acc) schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        acc += static_cast<double>(x[i]) * static_cast<double>(x[i]);
+    }
+    return acc;
+}
+
+// any non-finite? (host overflow check for the fp16 loss-scaler path)
+int ds_has_nonfinite(const float* x, int64_t n) {
+    int bad = 0;
+#pragma omp parallel for reduction(| : bad) schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        bad |= !std::isfinite(x[i]);
+    }
+    return bad;
+}
+
+// x *= a  (grad unscale / averaging)
+int ds_scale(float* x, int64_t n, float a) {
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) x[i] *= a;
+    return 0;
+}
+
+int ds_fp32_to_bf16(const float* src, uint16_t* dst, int64_t n) {
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) dst[i] = float_to_bf16(src[i]);
+    return 0;
+}
+
+int ds_bf16_to_fp32(const uint16_t* src, float* dst, int64_t n) {
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t bits = static_cast<uint32_t>(src[i]) << 16;
+        float f;
+        std::memcpy(&f, &bits, sizeof(f));
+        dst[i] = f;
+    }
+    return 0;
+}
+
+int ds_num_threads() {
+#if defined(_OPENMP)
+    return omp_get_max_threads();
+#else
+    return 1;
+#endif
+}
+
+}  // extern "C"

@@ -1,0 +1,81 @@
+"""JIT build + cache for the C++ host extensions.
+
+The TPU analogue of the reference's op_builder JIT path
+(op_builder/builder.py:94 ``OpBuilder.load`` → torch cpp_extension): here the
+host-side native code (CPU SIMD optimizers, async NVMe I/O) compiles once with
+g++ into a shared library keyed by a source hash, loaded via ctypes. No
+torch/pybind dependency — the ABI is a C API over raw pointers, and numpy
+arrays supply the memory.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+from ..utils.logging import logger
+
+_CSRC = os.path.join(os.path.dirname(__file__), "csrc")
+_CACHE = os.environ.get(
+    "DSTPU_NATIVE_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu", "lib"))
+
+_lock = threading.Lock()
+_loaded = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _source_path(name: str) -> str:
+    return os.path.join(_CSRC, f"{name}.cpp")
+
+
+def _flags(openmp: bool):
+    flags = ["-O3", "-std=c++17", "-fPIC", "-shared", "-march=native"]
+    if openmp:
+        flags.append("-fopenmp")
+    return flags
+
+
+def build(name: str, openmp: bool = True) -> str:
+    """Compile csrc/<name>.cpp → cached .so; returns the library path."""
+    src = _source_path(name)
+    if not os.path.isfile(src):
+        raise NativeBuildError(f"no native source {src}")
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    lib = os.path.join(_CACHE, f"lib{name}_{digest}.so")
+    if os.path.isfile(lib):
+        return lib
+    os.makedirs(_CACHE, exist_ok=True)
+    tmp = lib + f".tmp{os.getpid()}"
+    cmd = ["g++", *_flags(openmp), src, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise NativeBuildError(f"g++ unavailable or timed out: {e}") from e
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"native build of {name} failed:\n{proc.stderr[-2000:]}")
+    os.replace(tmp, lib)  # atomic under concurrent builders
+    logger.info(f"built native op {name} -> {lib}")
+    return lib
+
+
+def load_library(name: str, openmp: bool = True) -> ctypes.CDLL:
+    """Build (if needed) and dlopen the named native library, cached."""
+    with _lock:
+        if name not in _loaded:
+            _loaded[name] = ctypes.CDLL(build(name, openmp=openmp))
+        return _loaded[name]
+
+
+def available(name: str) -> bool:
+    try:
+        load_library(name)
+        return True
+    except NativeBuildError:
+        return False

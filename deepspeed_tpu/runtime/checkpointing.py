@@ -71,12 +71,21 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     ckpt_engine.create(tag)
     # gather on ALL processes (collective); write on the writer — or on all
     # processes for collective engines (orbax)
-    params_host = _gather_to_host(engine, engine.params)
     from flax import serialization
+    offload = getattr(engine, "_offload", None)
+    if offload is not None:
+        # ZeRO-Offload: the fp32 masters + moments ARE the optimizer state,
+        # already on the host (runtime/zero/offload.py)
+        params_host = offload.masters_tree()
+        offload_sd = serialization.to_state_dict(offload.state_dict())
+    else:
+        params_host = _gather_to_host(engine, engine.params)
+        offload_sd = None
     optim_state = {
         "opt_state": serialization.to_state_dict(
             _gather_to_host(engine, engine.opt_state))
         if engine.opt_state is not None else None,
+        "offload": offload_sd,
         "scaler": {
             "scale": float(engine.scaler_state.scale),
             "good_steps": int(engine.scaler_state.good_steps),
@@ -156,8 +165,16 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
                                      os.path.join(ckpt_dir,
                                                   "model_states.msgpack"))
     params = ckpt_engine.load(os.path.join(ckpt_dir, "model_states.msgpack"))
-    with engine.mesh:
-        engine.params = _restore_like(engine.param_shardings, params)
+    offload = getattr(engine, "_offload", None)
+    if offload is not None:
+        # checkpoint holds fp32 masters; host offload owns them — the
+        # device-param refresh happens ONCE at the end (after optimizer
+        # state may also have been restored)
+        for i, w in enumerate(jax.tree.leaves(params)):
+            offload.masters[i][...] = np.asarray(w, np.float32).reshape(-1)
+    else:
+        with engine.mesh:
+            engine.params = _restore_like(engine.param_shardings, params)
 
     client_state: Dict[str, Any] = {}
     state_path = os.path.join(ckpt_dir, "engine_state.json")
@@ -175,9 +192,12 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
         client_state = engine_state.get("client_state", {})
 
     if load_optimizer_states and not load_module_only and \
-            engine.opt_state is not None:
+            (engine.opt_state is not None or offload is not None):
         optim = ckpt_engine.load(os.path.join(ckpt_dir, "optim_states.msgpack"))
-        if optim.get("opt_state") is not None:
+        if offload is not None and optim.get("offload") is not None:
+            offload.load_state_dict(optim["offload"])
+        if engine.opt_state is not None and \
+                optim.get("opt_state") is not None:
             # msgpack restores namedtuples as nested containers; rebuild
             # against the engine's live structure.
             from flax import serialization
@@ -191,6 +211,8 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
             scale=jnp.float32(sc.get("scale", 1.0)),
             good_steps=jnp.int32(sc.get("good_steps", 0)),
             hysteresis=jnp.int32(sc.get("hysteresis", 2)))
+    if offload is not None:
+        engine.params = offload.device_params()
     log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
     return ckpt_dir, client_state
 

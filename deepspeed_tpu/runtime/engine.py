@@ -154,19 +154,44 @@ class DeepSpeedEngine:
         self.param_shapes = param_shapes
         self._pre_init_validate()
         self.param_shardings = self.planner.param_shardings(param_shapes)
+        zoff = zcfg.offload_optimizer
+        self._offload = None
+        offload_active = (zoff is not None and
+                          getattr(zoff, "device", "none") != "none" and
+                          self.optimizer is not None)
         with self.mesh:
-            self.params = jax.jit(model.init,
-                                  out_shardings=self.param_shardings)(rng)
-            if self.optimizer is not None:
-                opt_shapes = jax.eval_shape(self.optimizer.init, param_shapes)
-                self.opt_state_shardings = self.planner.opt_state_shardings(
-                    opt_shapes, param_shapes)
-                self.opt_state = jax.jit(
-                    self.optimizer.init,
-                    out_shardings=self.opt_state_shardings)(self.params)
-            else:
+            params_f32 = jax.jit(model.init,
+                                 out_shardings=self.param_shardings)(rng)
+            if offload_active:
+                # ZeRO-Offload: fp32 masters + moments leave the device
+                # (runtime/zero/offload.py); the device keeps only the
+                # compute-dtype copy.
+                from .zero.offload import HostOffloadOptimizer
+                self._offload = HostOffloadOptimizer(
+                    self.optimizer.name, self.optimizer.defaults, params_f32,
+                    self.param_shardings, self._compute_dtype, zoff)
+                if self._compute_dtype is not None:
+                    cast = jax.jit(
+                        lambda p: _cast_tree(p, self._compute_dtype),
+                        out_shardings=self.param_shardings, donate_argnums=0)
+                    self.params = cast(params_f32)
+                else:
+                    self.params = params_f32
                 self.opt_state = None
                 self.opt_state_shardings = None
+            else:
+                self.params = params_f32
+                if self.optimizer is not None:
+                    opt_shapes = jax.eval_shape(self.optimizer.init,
+                                                param_shapes)
+                    self.opt_state_shardings = self.planner.opt_state_shardings(
+                        opt_shapes, param_shapes)
+                    self.opt_state = jax.jit(
+                        self.optimizer.init,
+                        out_shardings=self.opt_state_shardings)(self.params)
+                else:
+                    self.opt_state = None
+                    self.opt_state_shardings = None
         self.grad_shardings = self.planner.grad_shardings(param_shapes)
         self.scaler_state = init_loss_scale_state(cfg.fp16 if cfg.fp16.enabled else None)
         self._base_rng = jax.random.PRNGKey(cfg.seed + 1)
@@ -265,8 +290,8 @@ class DeepSpeedEngine:
         mesh = self.mesh
         rep = NamedSharding(mesh, P())
 
-        # --- fused train_batch step: scan over gas micro-batches ---
-        def train_step(params, opt_state, scaler_state, batch, lr, rng):
+        # --- shared gradient-accumulation body (scan over gas micros) ---
+        def accum_grads(params, scaler_state, batch, rng):
             gas = jax.tree.leaves(batch)[0].shape[0]
             scale = scaler_state.scale
 
@@ -302,11 +327,16 @@ class DeepSpeedEngine:
                 (gsum, lsum), _ = lax.scan(
                     body, (zeros, jnp.float32(0.0)),
                     (batch, jnp.arange(gas)))
+            return lsum, gsum, gas
+
+        # --- fused train_batch step: accumulate + in-jit optimizer update ---
+        def train_step(params, opt_state, scaler_state, batch, lr, rng):
+            lsum, gsum, gas = accum_grads(params, scaler_state, batch, rng)
             new_params, new_opt, new_scaler, finite, grad_norm = \
                 self._apply_update(params, opt_state, scaler_state, gsum, lr,
                                    denom=jnp.float32(gas))
             metrics = {
-                "loss": lsum / (gas * scale),
+                "loss": lsum / (gas * scaler_state.scale),
                 "grad_norm": grad_norm,
                 "loss_scale": scaler_state.scale,
                 "overflow": ~finite,
@@ -319,7 +349,20 @@ class DeepSpeedEngine:
                           None, self._batch_sharding(True), None, None),
             out_shardings=(self.param_shardings, self.opt_state_shardings,
                            None, None),
-            donate_argnums=(0, 1, 2)) if self.optimizer is not None else None
+            donate_argnums=(0, 1, 2)) \
+            if self.optimizer is not None and self._offload is None else None
+
+        # --- offload path: grads-only step; host SIMD Adam applies them ---
+        def grad_step(params, scaler_state, batch, rng):
+            lsum, gsum, gas = accum_grads(params, scaler_state, batch, rng)
+            return lsum / (gas * scaler_state.scale), gsum
+
+        self._grad_step_fn = jax.jit(
+            grad_step,
+            in_shardings=(self.param_shardings, None,
+                          self._batch_sharding(True), None),
+            out_shardings=(rep, self.grad_shardings)) \
+            if self._offload is not None else None
 
         # --- micro grad (forward/backward API path) ---
         def micro_grad(params, mb, rng, scale):
@@ -360,7 +403,8 @@ class DeepSpeedEngine:
                           None, self.grad_shardings, None, None),
             out_shardings=(self.param_shardings, self.opt_state_shardings,
                            None, None),
-            donate_argnums=(0, 1, 2, 3)) if self.optimizer is not None else None
+            donate_argnums=(0, 1, 2, 3)) \
+            if self.optimizer is not None and self._offload is None else None
 
         # --- eval ---
         def eval_loss(params, mb):
@@ -427,18 +471,44 @@ class DeepSpeedEngine:
         assert self.optimizer is not None, "step() requires an optimizer"
         assert self._grad_acc_buffer is not None, "step() without backward()"
         self.timers(STEP_GLOBAL_TIMER).start()
-        lr = jnp.float32(self.get_lr()[0])
-        with self.mesh:
-            (self.params, self.opt_state, self.scaler_state,
-             metrics) = self._apply_fn(self.params, self.opt_state,
-                                       self.scaler_state,
-                                       self._grad_acc_buffer, lr,
-                                       jnp.float32(self._grad_acc_count))
+        if self._offload is not None:
+            metrics = self._offload_apply(self._grad_acc_buffer,
+                                          denom=float(self._grad_acc_count))
+        else:
+            lr = jnp.float32(self.get_lr()[0])
+            with self.mesh:
+                (self.params, self.opt_state, self.scaler_state,
+                 metrics) = self._apply_fn(self.params, self.opt_state,
+                                           self.scaler_state,
+                                           self._grad_acc_buffer, lr,
+                                           jnp.float32(self._grad_acc_count))
         self._grad_acc_buffer = None
         self._grad_acc_count = 0
         self._post_step(metrics)
         self.timers(STEP_GLOBAL_TIMER).stop()
         return metrics
+
+    def _offload_apply(self, grads, denom):
+        """Host-side optimizer step (ZeRO-Offload): unscale/clip/step on the
+        CPU SIMD path, refresh the device's compute-dtype params."""
+        cfg = self._config
+        scale = float(self.scaler_state.scale)
+        lr = float(self.get_lr()[0])
+        new_params, info = self._offload.step(
+            grads, lr, unscale=1.0 / (denom * scale),
+            clip=float(cfg.gradient_clipping or 0.0),
+            check_finite=cfg.fp16.enabled)
+        finite = not info["overflow"]
+        if finite:
+            self.params = new_params
+        self.scaler_state = update_loss_scale(
+            self.scaler_state, jnp.bool_(finite), dynamic=self._dynamic_scale,
+            scale_window=cfg.fp16.loss_scale_window,
+            min_scale=cfg.fp16.min_loss_scale,
+            max_hysteresis=cfg.fp16.hysteresis)
+        self._last_grad_norm = info["grad_norm"]
+        return {"grad_norm": info["grad_norm"], "overflow": not finite,
+                "loss_scale": scale}
 
     # ------------------------------------------------------------------
     # fused path: train_batch (the PipelineEngine-compatible entrypoint)
@@ -451,12 +521,23 @@ class DeepSpeedEngine:
             batch = self._next_gas_batch(data_iter)
         batch = self._to_device_batch(batch)
         self.tput_timer.start()
-        lr = jnp.float32(self.get_lr()[0])
         rng = jax.random.fold_in(self._base_rng, self.global_steps)
-        with self.mesh:
-            (self.params, self.opt_state, self.scaler_state,
-             metrics) = self._train_step_fn(self.params, self.opt_state,
-                                            self.scaler_state, batch, lr, rng)
+        if self._offload is not None:
+            # denom = the batch's ACTUAL gas dim (accum_grads derives gas the
+            # same way), not the config value — they can legitimately differ
+            gas = jax.tree.leaves(batch)[0].shape[0]
+            with self.mesh:
+                loss, gsum = self._grad_step_fn(self.params, self.scaler_state,
+                                                batch, rng)
+            metrics = self._offload_apply(gsum, denom=float(gas))
+            metrics["loss"] = loss
+        else:
+            lr = jnp.float32(self.get_lr()[0])
+            with self.mesh:
+                (self.params, self.opt_state, self.scaler_state,
+                 metrics) = self._train_step_fn(self.params, self.opt_state,
+                                                self.scaler_state, batch, lr,
+                                                rng)
         self.micro_steps += cfg.gradient_accumulation_steps
         self._post_step(metrics)
         self.tput_timer.stop(global_step=True)
@@ -577,7 +658,10 @@ class DeepSpeedEngine:
 
     def get_fp32_params(self):
         """Gathered, fully-replicated fp32 params (the zero_to_fp32 path,
-        utils/zero_to_fp32.py, as a live call)."""
+        utils/zero_to_fp32.py, as a live call). Under ZeRO-Offload the fp32
+        masters live on the host — return those (device params are bf16)."""
+        if self._offload is not None:
+            return self._offload.masters_tree()
         rep = jax.tree.map(lambda _: NamedSharding(self.mesh, P()),
                            self.param_shardings)
         with self.mesh:
