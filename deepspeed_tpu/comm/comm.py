@@ -75,12 +75,19 @@ def init_distributed(dist_backend: str = "xla",
     proc_id = rank if rank >= 0 else int(
         env.get("RANK", env.get("OMPI_COMM_WORLD_RANK", env.get("SLURM_PROCID", "0"))))
 
-    if nprocs > 1 and jax.process_count() == 1:
+    # do NOT touch jax.devices()/process_count() before initialize — that
+    # would initialize the XLA backend and make jax.distributed.initialize
+    # raise (it must run first in the process)
+    if nprocs > 1 and not jax.distributed.is_initialized():
         coordinator = init_method
         if coordinator is None:
             addr = env.get("MASTER_ADDR", "127.0.0.1")
             port = env.get("MASTER_PORT", str(distributed_port))
             coordinator = f"{addr}:{port}"
+        if env.get("JAX_PLATFORMS", "").startswith("cpu") or \
+                env.get("DSTPU_ACCELERATOR") == "cpu":
+            # multi-process CPU backend needs cross-host collectives
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         if verbose:
             logger.info(
                 f"Initializing jax.distributed: coordinator={coordinator} "
@@ -91,35 +98,85 @@ def init_distributed(dist_backend: str = "xla",
     _INITIALIZED = True
 
 
+def _dist_state():
+    """The jax.distributed global state (None outside multi-process runs).
+    The control plane below reads it directly — backend-independent, so it
+    works even when a device plugin shadows the default backend."""
+    try:
+        from jax._src import distributed
+        if distributed.global_state.client is not None:
+            return distributed.global_state
+    except Exception:
+        pass
+    return None
+
+
 def is_initialized():
-    return _INITIALIZED or jax.process_count() > 1
+    return _INITIALIZED or _dist_state() is not None
 
 
 def get_rank(group=None) -> int:
-    return jax.process_index()
+    gs = _dist_state()
+    return gs.process_id if gs is not None else jax.process_index()
 
 
 def get_world_size(group=None) -> int:
-    return jax.process_count()
+    gs = _dist_state()
+    return gs.num_processes if gs is not None else jax.process_count()
 
 
 def get_local_rank() -> int:
     return int(os.environ.get("LOCAL_RANK", 0))
 
 
-def barrier(group=None):
-    """Cross-process barrier via a tiny psum on every device."""
-    if jax.process_count() > 1:
+_barrier_count = 0
+
+
+def barrier(group=None, timeout_ms: int = 600_000):
+    """Cross-process barrier over the coordination service (GRPC) — no
+    device collective, so it works on any backend mix. Falls back to the
+    device-collective sync when the runtime is multi-process without a
+    jax.distributed client (e.g. an externally-bootstrapped TPU pod)."""
+    global _barrier_count
+    gs = _dist_state()
+    if gs is not None and gs.num_processes > 1:
+        _barrier_count += 1
+        gs.client.wait_at_barrier(f"dstpu_barrier_{_barrier_count}",
+                                  timeout_ms)
+    elif jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
 
 
 def broadcast_object(obj, src: int = 0):
-    """Host-level object broadcast (reference p2p pickled-object sends)."""
-    if jax.process_count() == 1:
+    """Host-level object broadcast via the coordination service key-value
+    store (reference p2p pickled-object sends, pipe/p2p.py:100). The entry
+    is deleted after every rank has read it (no coordinator KV leak)."""
+    global _barrier_count
+    gs = _dist_state()
+    if gs is None or gs.num_processes <= 1:
+        if gs is None and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            return multihost_utils.broadcast_one_to_all(obj)
         return obj
-    from jax.experimental import multihost_utils
-    return multihost_utils.broadcast_one_to_all(obj)
+    import base64
+    import pickle
+    _barrier_count += 1
+    key = f"dstpu_bcast_{_barrier_count}"
+    if gs.process_id == src:
+        payload = base64.b64encode(pickle.dumps(obj)).decode("ascii")
+        gs.client.key_value_set(key, payload)
+        out = obj
+    else:
+        payload = gs.client.blocking_key_value_get(key, 600_000)
+        out = pickle.loads(base64.b64decode(payload))
+    gs.client.wait_at_barrier(f"{key}_done", 600_000)
+    if gs.process_id == src:
+        try:
+            gs.client.key_value_delete(key)
+        except Exception:
+            pass  # older jaxlib without delete: entry persists, job still OK
+    return out
 
 
 def destroy_process_group():

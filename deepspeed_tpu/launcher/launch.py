@@ -1,0 +1,116 @@
+"""Per-node process spawner.
+
+TPU-native re-design of the reference per-node launcher
+(deepspeed/launcher/launch.py:216): spawns the worker processes for THIS
+node, wires the rendezvous env (RANK / WORLD_SIZE / MASTER_ADDR / MASTER_PORT
+→ consumed by comm.init_distributed → jax.distributed.initialize), forwards
+signals, and tears the whole tree down if any child dies.
+
+A JAX SPMD job runs ONE process per host (the process drives all local TPU
+chips), so the default --nproc_per_node is 1 — unlike the reference's
+process-per-GPU model. >1 is supported for the CPU-backend test rig, where N
+single-device processes emulate N hosts on one machine.
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ..utils.logging import logger
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="deepspeed_tpu per-node launcher")
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--master_addr", default="127.0.0.1")
+    p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument("--module", action="store_true",
+                   help="run the script as 'python -m <script>'")
+    p.add_argument("--no_python", action="store_true",
+                   help="exec the script directly (not via the interpreter)")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def build_cmd(args):
+    if args.no_python:
+        cmd = [args.training_script]
+    elif args.module:
+        cmd = [sys.executable, "-m", args.training_script]
+    else:
+        cmd = [sys.executable, args.training_script]
+    return cmd + list(args.training_script_args)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    world_size = args.nnodes * args.nproc_per_node
+    procs = []
+
+    def terminate(sig=signal.SIGTERM):
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), sig)
+                except ProcessLookupError:
+                    pass
+
+    def handler(signum, frame):
+        logger.info(f"launch: forwarding signal {signum} to workers")
+        terminate(signum)
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+
+    for local_rank in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + local_rank
+        env = dict(os.environ)
+        env.update({
+            "RANK": str(rank),
+            "LOCAL_RANK": str(local_rank),
+            "WORLD_SIZE": str(world_size),
+            "MASTER_ADDR": args.master_addr,
+            "MASTER_PORT": str(args.master_port),
+            "DSTPU_NUM_PROCESSES": str(world_size),
+            "NODE_RANK": str(args.node_rank),
+        })
+        cmd = build_cmd(args)
+        logger.info(f"launch: rank {rank} -> {' '.join(cmd)}")
+        procs.append(subprocess.Popen(cmd, env=env, start_new_session=True))
+
+    # babysit: if one worker dies, kill the rest (reference launch.py:119
+    # sigkill-the-tree behavior)
+    exit_code = 0
+    alive = set(range(len(procs)))
+    while alive:
+        for i in sorted(alive):
+            rc = procs[i].poll()
+            if rc is None:
+                continue
+            alive.discard(i)
+            if rc != 0:
+                logger.error(f"launch: worker {i} exited rc={rc}; "
+                             f"terminating remaining workers")
+                exit_code = rc
+                terminate(signal.SIGTERM)
+                deadline = time.time() + 10
+                for p in procs:
+                    try:
+                        p.wait(timeout=max(0.1, deadline - time.time()))
+                    except subprocess.TimeoutExpired:
+                        terminate(signal.SIGKILL)
+                alive.clear()
+                break
+        time.sleep(0.2)
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,188 @@
+"""Job runner — the `deepspeed_tpu` CLI entrypoint.
+
+TPU-native re-design of the reference runner (deepspeed/launcher/
+runner.py:376 + multinode_runner.py): parses a hostfile, applies
+--include/--exclude filters, and starts the per-node launcher
+(launcher/launch.py) on every selected host — locally for single-node, over
+ssh for multinode (the PDSH role; pdsh itself is optional and shelled out to
+when requested and present).
+
+Hostfile format (reference compatible):
+    worker-1 slots=4
+    worker-2 slots=4
+
+On TPU "slots" is informational (one SPMD process drives all local chips);
+process count = node count, except in --backend=cpu test mode where
+--nproc_per_node emulates multiple hosts on one machine.
+"""
+
+import argparse
+import os
+import shlex
+import shutil
+import subprocess
+import sys
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher",
+        usage="deepspeed_tpu [options] <user script> [script args]")
+    p.add_argument("-H", "--hostfile", default=DLTS_HOSTFILE,
+                   help="hostfile of 'host slots=N' lines")
+    p.add_argument("-i", "--include", default="",
+                   help="subset of hosts, e.g. 'worker-1@worker-2'")
+    p.add_argument("-e", "--exclude", default="",
+                   help="hosts to drop, same syntax as --include")
+    p.add_argument("--num_nodes", type=int, default=-1,
+                   help="cap on node count from the hostfile")
+    p.add_argument("--master_addr", default=None)
+    p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument("--launcher", default="ssh", choices=["ssh", "pdsh"],
+                   help="multinode transport")
+    p.add_argument("--launcher_args", default="",
+                   help="extra args for ssh/pdsh")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per node (CPU-backend testing; TPU uses 1)")
+    p.add_argument("--force_multi", action="store_true",
+                   help="multinode codepath even for one node")
+    p.add_argument("--module", action="store_true")
+    p.add_argument("--no_python", action="store_true")
+    p.add_argument("--ds_report", action="store_true",
+                   help="print the environment report and exit")
+    p.add_argument("user_script", nargs="?")
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def fetch_hostfile(path):
+    """Parse 'host slots=N' lines; returns ordered {host: slots}."""
+    if not os.path.isfile(path):
+        return {}
+    resources = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for tok in parts[1:]:
+                if tok.startswith("slots="):
+                    slots = int(tok.split("=")[1])
+            if host in resources:
+                raise ValueError(f"duplicate host {host} in hostfile")
+            resources[host] = slots
+    return resources
+
+
+def _parse_filter(spec):
+    """'host1@host2' or 'host1:0,1@host2' → {host: [slot,...] or None}."""
+    out = {}
+    if not spec:
+        return out
+    for item in spec.split("@"):
+        if ":" in item:
+            host, slots = item.split(":", 1)
+            out[host] = [int(s) for s in slots.split(",")]
+        else:
+            out[item] = None
+    return out
+
+
+def filter_resources(resources, include, exclude):
+    inc = _parse_filter(include)
+    exc = _parse_filter(exclude)
+    if inc and exc:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    hosts = dict(resources)
+    if inc:
+        for h in inc:
+            if h not in hosts:
+                raise ValueError(f"--include host {h} not in hostfile")
+        hosts = {h: hosts[h] for h in resources if h in inc}
+        for h, slots in inc.items():
+            if slots is not None:
+                logger.warning(
+                    f"--include slot list for {h} ignored: a TPU host runs "
+                    f"one SPMD process for all its chips")
+    for h, slots in exc.items():
+        if slots is not None:
+            logger.warning(f"--exclude slot list for {h} ignored")
+            continue
+        hosts.pop(h, None)
+    return hosts
+
+
+def _launch_cmd(args, node_rank, nnodes, master_addr):
+    cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+           f"--node_rank={node_rank}", f"--nnodes={nnodes}",
+           f"--nproc_per_node={args.nproc_per_node}",
+           f"--master_addr={master_addr}",
+           f"--master_port={args.master_port}"]
+    if args.module:
+        cmd.append("--module")
+    if args.no_python:
+        cmd.append("--no_python")
+    return cmd + [args.user_script] + list(args.user_args)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.ds_report:
+        from ..env_report import main as report
+        report()
+        return 0
+    if not args.user_script:
+        logger.error("no user script given (see --help)")
+        return 2
+
+    resources = fetch_hostfile(args.hostfile)
+    resources = filter_resources(resources, args.include, args.exclude)
+    if args.num_nodes > 0:
+        resources = dict(list(resources.items())[:args.num_nodes])
+
+    multinode = bool(resources) and (len(resources) > 1 or args.force_multi)
+    if not multinode:
+        # single node: run the per-node launcher in-process
+        master = args.master_addr or "127.0.0.1"
+        cmd = _launch_cmd(args, node_rank=0, nnodes=1, master_addr=master)
+        logger.info(f"cmd = {' '.join(map(shlex.quote, cmd))}")
+        return subprocess.call(cmd)
+
+    hosts = list(resources)
+    master = args.master_addr or hosts[0]
+    env_fwd = {k: v for k, v in os.environ.items()
+               if k.startswith(("DSTPU_", "JAX_", "XLA_", "TPU_",
+                                "PYTHON", "LIBTPU"))}
+    env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env_fwd.items())
+    procs = []
+    if args.launcher == "pdsh" and shutil.which("pdsh") is None:
+        logger.warning("pdsh not found; falling back to ssh")
+        args.launcher = "ssh"
+    for rank, host in enumerate(hosts):
+        node_cmd = _launch_cmd(args, node_rank=rank, nnodes=len(hosts),
+                               master_addr=master)
+        remote = (f"cd {shlex.quote(os.getcwd())} && {env_str} "
+                  + " ".join(map(shlex.quote, node_cmd)))
+        if args.launcher == "pdsh":
+            full = ["pdsh", "-w", host] + shlex.split(args.launcher_args) + \
+                [remote]
+        else:
+            full = ["ssh"] + shlex.split(args.launcher_args) + \
+                [host, remote]
+        logger.info(f"{host}: {' '.join(map(shlex.quote, full))}")
+        procs.append(subprocess.Popen(full))
+    rc = 0
+    for proc in procs:
+        rc = proc.wait() or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

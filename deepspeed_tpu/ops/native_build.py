@@ -40,13 +40,37 @@ def _flags(openmp: bool):
     return flags
 
 
+_toolchain_id = None
+
+
+def _toolchain():
+    """g++ version + host arch — part of the cache key because -march=native
+    makes the .so host-specific (NFS-shared caches across heterogeneous
+    nodes must not collide)."""
+    global _toolchain_id
+    if _toolchain_id is None:
+        import platform
+        try:
+            ver = subprocess.run(["g++", "-dumpfullversion", "-dumpversion"],
+                                 capture_output=True, text=True,
+                                 timeout=30).stdout.strip()
+        except (OSError, subprocess.TimeoutExpired):
+            ver = "unknown"
+        _toolchain_id = f"{ver}|{platform.machine()}|{platform.processor()}"
+    return _toolchain_id
+
+
 def build(name: str, openmp: bool = True) -> str:
     """Compile csrc/<name>.cpp → cached .so; returns the library path."""
     src = _source_path(name)
     if not os.path.isfile(src):
         raise NativeBuildError(f"no native source {src}")
+    h = hashlib.sha256()
     with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        h.update(f.read())
+    h.update(" ".join(_flags(openmp)).encode())
+    h.update(_toolchain().encode())
+    digest = h.hexdigest()[:16]
     lib = os.path.join(_CACHE, f"lib{name}_{digest}.so")
     if os.path.isfile(lib):
         return lib

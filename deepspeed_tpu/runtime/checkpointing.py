@@ -76,7 +76,7 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     if offload is not None:
         # ZeRO-Offload: the fp32 masters + moments ARE the optimizer state,
         # already on the host (runtime/zero/offload.py)
-        params_host = offload.masters_tree()
+        params_host = offload.masters_tree(copy=False)  # serialized below
         offload_sd = serialization.to_state_dict(offload.state_dict())
     else:
         params_host = _gather_to_host(engine, engine.params)

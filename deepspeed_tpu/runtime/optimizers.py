@@ -66,7 +66,8 @@ def get_optimizer(name: str, params_config: dict = None) -> Optimizer:
     cfg.pop("comm_backend_name", None)
     bias_correction = cfg.pop("bias_correction", True)
     defaults = {"lr": lr0, "betas": betas, "eps": eps,
-                "weight_decay": weight_decay}
+                "weight_decay": weight_decay,
+                "bias_correction": bias_correction}
 
     if name in ("adam", "adamw", "fusedadam", "onebitadam", "zerooneadam",
                 "cpu_adam"):

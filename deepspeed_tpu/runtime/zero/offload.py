@@ -189,6 +189,7 @@ class HostOffloadOptimizer:
         self.beta1, self.beta2 = float(betas[0]), float(betas[1])
         self.eps = float(defaults.get("eps", 1e-8))
         self.weight_decay = float(defaults.get("weight_decay", 0.0))
+        self.bias_correction = bool(defaults.get("bias_correction", True))
         # reference "adam" defaults to adam_w_mode=True (engine.py:1207)
         self.decoupled = True
         self.step_count = 0
@@ -245,7 +246,8 @@ class HostOffloadOptimizer:
             self.ops.adam_step(w, grad_flat, m, v, self.step_count, lr,
                                self.beta1, self.beta2, self.eps,
                                weight_decay=self.weight_decay,
-                               decoupled=self.decoupled, w16=w16)
+                               decoupled=self.decoupled,
+                               bias_correction=self.bias_correction, w16=w16)
         elif self.name == "adagrad":
             self.ops.adagrad_step(w, grad_flat, v, lr, self.eps,
                                   self.weight_decay)
@@ -305,11 +307,15 @@ class HostOffloadOptimizer:
     # ------------------------------------------------------------------
     # checkpoint surface (consumed by runtime/checkpointing.py)
     # ------------------------------------------------------------------
-    def masters_tree(self):
-        """fp32 master params as a pytree (the zero_to_fp32 source)."""
+    def masters_tree(self, copy: bool = True):
+        """fp32 master params as a pytree (the zero_to_fp32 source).
+        copy=True (the public default) snapshots — the optimizer mutates the
+        underlying buffers every step. copy=False is for internal read-only
+        serialization to avoid doubling host RAM transiently."""
         return jax.tree.unflatten(
             self.treedef,
-            [w.reshape(s) for w, s in zip(self.masters, self.shapes)])
+            [(w.reshape(s).copy() if copy else w.reshape(s))
+             for w, s in zip(self.masters, self.shapes)])
 
     def state_dict(self):
         # NOTE: no "masters" here — the checkpoint's model_states already
