@@ -157,7 +157,8 @@ def broadcast_object(obj, src: int = 0):
     if gs is None or gs.num_processes <= 1:
         if gs is None and jax.process_count() > 1:
             from jax.experimental import multihost_utils
-            return multihost_utils.broadcast_one_to_all(obj)
+            return multihost_utils.broadcast_one_to_all(
+                obj, is_source=jax.process_index() == src)
         return obj
     import base64
     import pickle

@@ -1,0 +1,101 @@
+"""Grouped quantization kernels.
+
+Capability match for the reference quantization ops
+(csrc/quantization/pt_binding.cpp:141-160 ``ds_quantize_*``/``quantize``/
+``dequantize``; quantize.cu, fake_quantizer.cu): per-group symmetric or
+asymmetric integer quantization with optional stochastic rounding, plus the
+"fake quant" (quantize→dequantize in one op) used by QAT/MoQ. All shapes are
+static and the math is elementwise + per-group reductions, so XLA fuses it
+into a handful of kernels — a handwritten Pallas kernel would buy nothing
+here (the op is bandwidth-bound and already minimal).
+
+Layout: x is reshaped to [groups, -1]; scales (and zero points for
+asymmetric) are per-group fp32. int8/int4 target widths supported; int4
+values live in an int8 carrier in [-8, 7] (packing is a storage concern the
+caller owns, as in the reference's quantization_utils.h).
+"""
+
+from functools import partial
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+
+
+def _qrange(bits, symmetric):
+    if symmetric:
+        qmax = float(2 ** (bits - 1) - 1)
+        return -qmax, qmax          # symmetric keeps zero exact
+    return 0.0, float(2 ** bits - 1)
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def quantize(x, groups: int = 1, bits: int = 8, symmetric: bool = True,
+             stochastic: bool = False, rng=None):
+    """x: any shape, size divisible by groups.
+    Returns (q int8, scale f32[groups]) for symmetric or
+            (q int8/uint8, scale, zero_point) for asymmetric."""
+    orig_shape = x.shape
+    xg = x.reshape(groups, -1).astype(jnp.float32)
+    qmin, qmax = _qrange(bits, symmetric)
+    if symmetric:
+        absmax = jnp.max(jnp.abs(xg), axis=1, keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+        scaled = xg / scale
+    else:
+        lo = jnp.min(xg, axis=1, keepdims=True)
+        hi = jnp.max(xg, axis=1, keepdims=True)
+        scale = jnp.where(hi > lo, (hi - lo) / (qmax - qmin), 1.0)
+        zero = qmin - lo / scale
+        scaled = xg / scale + zero
+    if stochastic:
+        if rng is None:
+            raise ValueError(
+                "stochastic=True requires an rng key — a fixed key would "
+                "add the SAME noise every call, biasing the rounding")
+        noise = jax.random.uniform(rng, scaled.shape) - 0.5
+        q = jnp.floor(scaled + 0.5 + noise)
+    else:
+        q = jnp.rint(scaled)
+    carrier = jnp.int8 if symmetric else jnp.uint8  # asym range is [0, 2^b-1]
+    q = jnp.clip(q, qmin, qmax).astype(carrier)
+    q = q.reshape(orig_shape)
+    if symmetric:
+        return q, scale.reshape(groups)
+    return q, scale.reshape(groups), zero.reshape(groups)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def dequantize(q, scale, zero_point=None, groups: int = 1):
+    orig_shape = q.shape
+    qg = q.reshape(groups, -1).astype(jnp.float32)
+    scale = scale.reshape(groups, 1)
+    if zero_point is not None:
+        qg = qg - zero_point.reshape(groups, 1)
+    return (qg * scale).reshape(orig_shape)
+
+
+def fake_quantize(x, groups: int = 1, bits: int = 8, symmetric: bool = True,
+                  stochastic: bool = False, rng=None):
+    """quantize→dequantize (the reference ds_quantize_fp32/fp16 semantics:
+    returns the quantization-error-injected tensor in the input dtype) —
+    the QAT/MoQ primitive."""
+    out = quantize(x, groups, bits, symmetric, stochastic, rng)
+    if symmetric:
+        q, scale = out
+        return dequantize(q, scale, groups=groups).astype(x.dtype)
+    q, scale, zero = out
+    return dequantize(q, scale, zero, groups=groups).astype(x.dtype)
+
+
+def quantization_error(x, groups=1, bits=8, symmetric=True):
+    """Mean-squared quantization error (MoQ precision-switch diagnostics)."""
+    return jnp.mean(jnp.square(
+        x.astype(jnp.float32) -
+        fake_quantize(x, groups, bits, symmetric).astype(jnp.float32)))
+
+
+def get_ops(backend: str = "tpu"):
+    return SimpleNamespace(quantize=quantize, dequantize=dequantize,
+                           fake_quantize=fake_quantize,
+                           quantization_error=quantization_error)

@@ -1,0 +1,49 @@
+"""Random-LTD token ops: sample / gather / scatter.
+
+Capability match for the reference random-ltd kernels
+(csrc/random_ltd/pt_binding.cpp:211-215 ``token_sort_``/``token_gather``/
+``token_scatter_``; ops/random_ltd/dropping_utils.py): random layer-token-drop
+subsamples a per-layer token subset, runs the layer on the kept tokens, and
+scatters outputs back into the full sequence. The CUDA sort/gather/scatter
+kernels map to argsort/take_along_axis/scatter — native XLA ops the compiler
+tiles well; indices are SORTED so kept tokens preserve causal order (the
+reference's token_sort_ post-pass).
+"""
+
+from functools import partial
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def sample_token_indices(rng, keep: int, batch: int, seqlen: int):
+    """[B, keep] sorted indices of kept tokens per sequence
+    (gpt_sample_tokens semantics: random subset, order preserving)."""
+    def per_seq(r):
+        perm = jax.random.permutation(r, seqlen)
+        return jnp.sort(perm[:keep])
+    return jax.vmap(per_seq)(jax.random.split(rng, batch))
+
+
+@jax.jit
+def token_gather(x, indices):
+    """x: [B, T, ...]; indices: [B, K] → [B, K, ...]."""
+    idx = indices.reshape(indices.shape + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(x, idx, axis=1)
+
+
+@jax.jit
+def token_scatter(base, values, indices):
+    """Inverse of token_gather: place values[B,K,...] at indices into
+    base[B,T,...] (kept tokens updated, dropped tokens keep base)."""
+    idx = indices.reshape(indices.shape + (1,) * (base.ndim - 2))
+    idx = jnp.broadcast_to(idx, values.shape)
+    return jnp.put_along_axis(base, idx, values, axis=1, inplace=False)
+
+
+def get_ops(backend: str = "tpu"):
+    return SimpleNamespace(sample_token_indices=sample_token_indices,
+                           token_gather=token_gather,
+                           token_scatter=token_scatter)

@@ -1,0 +1,137 @@
+"""Fused transformer training ops.
+
+Capability match for the reference fused training layer
+(csrc/transformer/ds_transformer_cuda.cpp:1037-1052 forward/backward;
+normalize_kernels.cu, gelu_kernels.cu, softmax_kernels.cu,
+dropout_kernels.cu): the building blocks of a fused encoder block —
+layer-norm, bias-GELU, masked softmax, dropout-add — plus a whole fused
+block (attention + MLP with pre/post-LN). On TPU these are jnp compositions
+that XLA fuses into the surrounding matmuls; the attention core dispatches
+to the Pallas flash kernel (ops/pallas/flash_attention.py) through the same
+seam the model uses. Backward comes from jax.grad — no hand-written bwd
+kernels to maintain (the reference's backward_fp16 et al).
+"""
+
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+
+
+def layer_norm(x, scale, bias, eps: float = 1e-5):
+    """Fused LN (normalize_kernels.cu): stats in fp32, output in x.dtype."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def bias_gelu(x, bias=None, approximate: bool = True):
+    """Fused bias + GELU (gelu_kernels.cu; tanh approximation like the
+    reference's gelu(sqrt(2/pi)(x+0.044715x^3)) form)."""
+    if bias is not None:
+        x = x + bias
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def bias_relu(x, bias=None):
+    if bias is not None:
+        x = x + bias
+    return jax.nn.relu(x)
+
+
+def bias_dropout_add(x, bias, residual, rate: float, rng, train: bool):
+    """Fused bias + dropout + residual add (dropout_kernels.cu
+    bias_add_dropout_residual)."""
+    if bias is not None:
+        x = x + bias
+    if train and rate > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+        x = x * keep / (1.0 - rate)
+    return x + residual
+
+
+def masked_softmax(logits, mask=None, causal: bool = False):
+    """Attention softmax in fp32 with additive masking
+    (softmax_kernels.cu attn_softmax)."""
+    lf = logits.astype(jnp.float32)
+    t_q, t_k = lf.shape[-2], lf.shape[-1]
+    if causal:
+        cm = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        lf = jnp.where(cm, lf, -1e30)
+    if mask is not None:
+        lf = jnp.where(mask, lf, -1e30)
+    return jax.nn.softmax(lf, axis=-1).astype(logits.dtype)
+
+
+def transformer_layer(x, p, n_head: int, rng=None, train: bool = True,
+                      dropout: float = 0.0, pre_layer_norm: bool = True,
+                      causal: bool = True, attn_backend: str = "auto"):
+    """A whole fused transformer block (the DeepSpeedTransformerLayer
+    contract, ops/transformer/transformer.py): params dict p holds
+    ln1/ln2 {scale,bias}, attn {wqkv, bqkv, wo, bo}, mlp {wi, bi, wo, bo}.
+    x: [B, T, D]."""
+    from ..flash_attention import flash_attention
+
+    d = x.shape[-1]
+    hd = d // n_head
+
+    def rngs(i):
+        return None if rng is None else jax.random.fold_in(rng, i)
+
+    h = layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"]) \
+        if pre_layer_norm else x
+    qkv = h @ p["attn"]["wqkv"] + p["attn"]["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        b, tl, _ = t.shape
+        return t.reshape(b, tl, n_head, hd).transpose(0, 2, 1, 3)
+
+    ctx = flash_attention(heads(q), heads(k), heads(v), causal=causal,
+                          backend=attn_backend)
+    b, _, tl, _ = ctx.shape
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, tl, d)
+    attn_out = ctx @ p["attn"]["wo"]
+    x = bias_dropout_add(attn_out, p["attn"]["bo"], x, dropout, rngs(0),
+                         train)
+    if not pre_layer_norm:
+        x = layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+
+    h = layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"]) \
+        if pre_layer_norm else x
+    h = bias_gelu(h @ p["mlp"]["wi"], p["mlp"]["bi"])
+    mlp_out = h @ p["mlp"]["wo"]
+    x = bias_dropout_add(mlp_out, p["mlp"]["bo"], x, dropout, rngs(1), train)
+    if not pre_layer_norm:
+        x = layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+    return x
+
+
+def init_layer_params(rng, d: int, d_ff: int = None, dtype=jnp.float32):
+    """Initializer for transformer_layer's param dict."""
+    d_ff = d_ff or 4 * d
+    ks = jax.random.split(rng, 4)
+    init = jax.nn.initializers.normal(0.02)
+    return {
+        "ln1": {"scale": jnp.ones((d,), dtype), "bias": jnp.zeros((d,), dtype)},
+        "ln2": {"scale": jnp.ones((d,), dtype), "bias": jnp.zeros((d,), dtype)},
+        "attn": {"wqkv": init(ks[0], (d, 3 * d), dtype),
+                 "bqkv": jnp.zeros((3 * d,), dtype),
+                 "wo": init(ks[1], (d, d), dtype),
+                 "bo": jnp.zeros((d,), dtype)},
+        "mlp": {"wi": init(ks[2], (d, d_ff), dtype),
+                "bi": jnp.zeros((d_ff,), dtype),
+                "wo": init(ks[3], (d_ff, d), dtype),
+                "bo": jnp.zeros((d,), dtype)},
+    }
+
+
+def get_ops(backend: str = "tpu"):
+    return SimpleNamespace(layer_norm=layer_norm, bias_gelu=bias_gelu,
+                           bias_relu=bias_relu,
+                           bias_dropout_add=bias_dropout_add,
+                           masked_softmax=masked_softmax,
+                           transformer_layer=transformer_layer,
+                           init_layer_params=init_layer_params)

@@ -1,0 +1,87 @@
+"""Transformer inference ops: KV-cache attention + fused decode helpers.
+
+Capability match for the reference inference kernels
+(csrc/transformer/inference/csrc/pt_binding.cpp:1747-1811 —
+``softmax_context`` (attention + KV-cache append), ``residual_add_bias``,
+``apply_rotary_pos_emb``; inference_context.h workspace). The KV cache here
+is an explicit pytree the caller threads through jit (functional — no global
+workspace), and cache append is a dynamic_update_slice the compiler keeps
+in-place under donation. The inference engine (inference/engine.py) builds
+its decode loop out of these pieces via the model's apply_with_cache.
+"""
+
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def update_kv_cache(k_cache, v_cache, k_new, v_new, start_pos):
+    """Append [B, H, T_new, D] at start_pos (softmax_context's cache
+    append). Caches: [B, H, T_max, D]."""
+    k_cache = lax.dynamic_update_slice(
+        k_cache, k_new.astype(k_cache.dtype), (0, 0, start_pos, 0))
+    v_cache = lax.dynamic_update_slice(
+        v_cache, v_new.astype(v_cache.dtype), (0, 0, start_pos, 0))
+    return k_cache, v_cache
+
+
+def cached_attention(q, k_cache, v_cache, cur_len, softmax_scale=None):
+    """Attention of q [B, H, T_q, D] against the first cur_len cache
+    entries, causal within the query block (the softmax_context compute).
+    cur_len = start_pos + T_q (a traced scalar is fine)."""
+    *_, t_q, d = q.shape
+    t_max = k_cache.shape[-2]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / jnp.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(k_cache.dtype),
+                        k_cache) * scale
+    logits = logits.astype(jnp.float32)
+    q_pos = cur_len - t_q + jnp.arange(t_q)[:, None]
+    k_pos = jnp.arange(t_max)[None, :]
+    visible = k_pos <= q_pos
+    logits = jnp.where(visible[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v_cache.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache).astype(q.dtype)
+
+
+def residual_add_bias(hidden, residual, bias=None):
+    """Fused residual+bias (pt_binding residual_add_bias)."""
+    out = hidden + residual
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def apply_rotary_pos_emb(q, k, positions, base: float = 10000.0):
+    """RoPE over the last dim (apply_rotary_pos_emb.cu). q/k: [B,H,T,D],
+    positions: [T] absolute positions."""
+    d = q.shape[-1]
+    half = d // 2
+    freq = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freq[None, :]  # [T,half]
+    cos = jnp.cos(angles)[None, None]
+    sin = jnp.sin(angles)[None, None]
+
+    def rot(x):
+        x1, x2 = x[..., :half], x[..., half:]
+        xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+        return jnp.concatenate(
+            [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin],
+            axis=-1).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def vector_matmul(x, w, transpose_w: bool = False):
+    """The reference's vector_matmul decode GEMV — on TPU just a matmul the
+    MXU handles; kept as an API point for op parity."""
+    return x @ (w.T if transpose_w else w)
+
+
+def get_ops(backend: str = "tpu"):
+    return SimpleNamespace(update_kv_cache=update_kv_cache,
+                           cached_attention=cached_attention,
+                           residual_add_bias=residual_add_bias,
+                           apply_rotary_pos_emb=apply_rotary_pos_emb,
+                           vector_matmul=vector_matmul)

@@ -1,0 +1,79 @@
+"""Flatten/unflatten utilities.
+
+Capability match for the reference utils op (csrc/utils/
+flatten_unflatten.cpp ``flatten``/``unflatten``, loaded at engine.py:377):
+pack a pytree of arrays into one flat fp32 host buffer and back. On TPU the
+in-jit equivalent is free (pytrees + donation), so this surface exists for
+HOST-side consumers: checkpoint packing, NVMe swap staging, comm payloads.
+"""
+
+from types import SimpleNamespace
+from typing import Any, List, Tuple
+
+import jax
+import numpy as np
+
+
+def flatten(tree, dtype=np.float32) -> Tuple[np.ndarray, Any]:
+    """Pytree of FLOATING arrays → (flat 1-D buffer in `dtype`, spec).
+    Raises on non-float leaves — casting ints through float32 would silently
+    corrupt values outside its exact range; use flatten_bytes for mixed
+    trees."""
+    leaves, treedef = jax.tree.flatten(tree)
+    arrs = [np.asarray(x) for x in leaves]
+    for a in arrs:
+        if not np.issubdtype(a.dtype, np.floating):
+            raise TypeError(
+                f"flatten: non-float leaf dtype {a.dtype}; use "
+                f"flatten_bytes for exact mixed-dtype packing")
+    spec = (treedef, [(a.shape, a.dtype.str) for a in arrs])
+    if not arrs:
+        return np.zeros(0, dtype), spec
+    flat = np.concatenate([a.reshape(-1).astype(dtype, copy=False)
+                           for a in arrs])
+    return np.ascontiguousarray(flat, dtype), spec
+
+
+def unflatten(flat: np.ndarray, spec) -> Any:
+    treedef, metas = spec
+    out: List[np.ndarray] = []
+    off = 0
+    for shape, dtype_str in metas:
+        n = int(np.prod(shape or (1,)))
+        out.append(flat[off:off + n].astype(np.dtype(dtype_str),
+                                            copy=False).reshape(shape))
+        off += n
+    if off != flat.size:
+        raise ValueError(f"flat buffer size {flat.size} != spec total {off}")
+    return jax.tree.unflatten(treedef, out)
+
+
+def flatten_bytes(tree) -> Tuple[np.ndarray, Any]:
+    """Exact packing of ANY pytree: each leaf at its native dtype as raw
+    bytes (uint8 buffer). Use for checkpoint/comm payloads with int leaves."""
+    leaves, treedef = jax.tree.flatten(tree)
+    arrs = [np.ascontiguousarray(np.asarray(x)) for x in leaves]
+    spec = (treedef, [(a.shape, a.dtype.str) for a in arrs])
+    if not arrs:
+        return np.zeros(0, np.uint8), spec
+    return np.concatenate([a.reshape(-1).view(np.uint8) for a in arrs]), spec
+
+
+def unflatten_bytes(flat: np.ndarray, spec) -> Any:
+    treedef, metas = spec
+    out: List[np.ndarray] = []
+    off = 0
+    for shape, dtype_str in metas:
+        dt = np.dtype(dtype_str)
+        nbytes = int(np.prod(shape or (1,))) * dt.itemsize
+        out.append(flat[off:off + nbytes].view(dt).reshape(shape))
+        off += nbytes
+    if off != flat.size:
+        raise ValueError(f"flat buffer size {flat.size} != spec total {off}")
+    return jax.tree.unflatten(treedef, out)
+
+
+def get_ops(backend: str = "cpu"):
+    return SimpleNamespace(flatten=flatten, unflatten=unflatten,
+                           flatten_bytes=flatten_bytes,
+                           unflatten_bytes=unflatten_bytes)

@@ -51,6 +51,26 @@ def _chain_update(core, params, grads, state, lr, weight_decay, decoupled,
     return new_params, new_state
 
 
+def _scale_by_adam_no_bias_correction(b1, b2, eps):
+    """Adam moments without the 1-beta^t correction."""
+
+    def init(params):
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32),
+                                      mu=zeros,
+                                      nu=jax.tree.map(jnp.copy, zeros))
+
+    def update(grads, state, params=None):
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g),
+                          state.nu, grads)
+        updates = jax.tree.map(lambda m, v: m / (jnp.sqrt(v) + eps), mu, nu)
+        return updates, optax.ScaleByAdamState(count=state.count + 1,
+                                               mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)
+
+
 def get_optimizer(name: str, params_config: dict = None) -> Optimizer:
     cfg = dict(params_config or {})
     name = name.lower()
@@ -74,7 +94,10 @@ def get_optimizer(name: str, params_config: dict = None) -> Optimizer:
         core = optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps,
                                    nesterov=False)
         if not bias_correction:
-            core = optax.scale_by_rms(decay=betas[1], eps=eps)
+            # Adam WITHOUT the 1-beta^t correction (reference FusedAdam
+            # bias_correction=False keeps both moments) — matches the host
+            # offload path (ops/csrc/cpu_adam.cpp bias_correction=0)
+            core = _scale_by_adam_no_bias_correction(betas[0], betas[1], eps)
         decoupled = name != "adam"  # reference: adam w/ adam_w_mode=True is default
         # DeepSpeed's "adam" defaults to AdamW-mode (engine.py:1207 adam_w_mode)
         decoupled = True if name == "adam" else decoupled

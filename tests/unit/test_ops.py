@@ -1,0 +1,236 @@
+"""Op-builder honesty tests: EVERY registered builder loads working ops,
+and each op's numerics check out against an oracle — the reference's
+tests/unit/ops pattern (kernel parity vs torch) with jnp/numpy oracles."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.op_builder import builder_names, get_builder_class
+
+
+def test_every_builder_loads():
+    for name in builder_names():
+        cls = get_builder_class(name, backend="cpu")
+        builder = cls()
+        assert builder.is_compatible(verbose=True), f"{name} not compatible"
+        ops = builder.load()
+        assert ops is not None, f"{name} loaded nothing"
+        public = [a for a in dir(ops) if not a.startswith("_")]
+        assert public, f"{name} namespace is empty"
+
+
+# ---------------------------------------------------------------- fused adam
+def test_fused_adam_matches_optax():
+    import optax
+    from deepspeed_tpu.ops.adam import fused_adam_ops
+    ops = fused_adam_ops.get_ops()
+    rng = jax.random.PRNGKey(0)
+    params = {"a": jax.random.normal(rng, (37,)),
+              "b": jax.random.normal(jax.random.fold_in(rng, 1), (5, 7))}
+    grads = jax.tree.map(lambda x: x * 0.1 + 0.01, params)
+    m, v = ops.init_state(params)
+
+    tx = optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    state = tx.init(params)
+    p_ref = params
+    p_mine = params
+    for step in range(1, 4):
+        p_mine, m, v = ops.fused_adam(p_mine, grads, m, v, step, 1e-2,
+                                      weight_decay=0.01)
+        updates, state = tx.update(grads, state, p_ref)
+        p_ref = optax.apply_updates(p_ref, updates)
+    for k in params:
+        np.testing.assert_allclose(p_mine[k], p_ref[k], rtol=1e-5, atol=1e-6)
+
+
+def test_fused_lamb_trust_ratio():
+    from deepspeed_tpu.ops import lamb_ops
+    ops = lamb_ops.get_ops()
+    params = {"w": jnp.ones((64,)) * 2.0}
+    grads = {"w": jnp.ones((64,)) * 0.5}
+    m, v = ops.init_state(params)
+    p2, m, v = ops.fused_lamb(params, grads, m, v, 1, 1e-2)
+    assert np.all(np.isfinite(p2["w"]))
+    assert float(jnp.max(jnp.abs(p2["w"] - params["w"]))) > 0
+
+
+# ---------------------------------------------------------------- quantizer
+@pytest.mark.parametrize("symmetric", [True, False])
+@pytest.mark.parametrize("bits", [8, 4])
+def test_quantize_roundtrip_error(symmetric, bits):
+    from deepspeed_tpu.ops import quantizer_ops
+    ops = quantizer_ops.get_ops()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 256)).astype(np.float32))
+    out = ops.fake_quantize(x, groups=4, bits=bits, symmetric=symmetric)
+    scale = float(jnp.max(jnp.abs(x)))
+    err = float(jnp.max(jnp.abs(out - x)))
+    # max error bounded by ~1 quantization step of the worst group
+    step = 2 * scale / (2 ** bits - 2)
+    assert err <= step, (err, step)
+
+
+def test_quantize_int8_range():
+    from deepspeed_tpu.ops import quantizer_ops
+    ops = quantizer_ops.get_ops()
+    x = jnp.linspace(-3, 3, 512).reshape(2, 256)
+    q, scale = ops.quantize(x, groups=2, bits=8, symmetric=True)
+    assert q.dtype == jnp.int8
+    assert int(jnp.max(q)) <= 127 and int(jnp.min(q)) >= -127
+
+
+# ---------------------------------------------------------------- random-ltd
+def test_random_ltd_gather_scatter_roundtrip():
+    from deepspeed_tpu.ops import random_ltd_ops
+    ops = random_ltd_ops.get_ops()
+    rng = jax.random.PRNGKey(3)
+    x = jnp.arange(2 * 16 * 4, dtype=jnp.float32).reshape(2, 16, 4)
+    idx = ops.sample_token_indices(rng, 8, 2, 16)
+    assert idx.shape == (2, 8)
+    assert np.all(np.diff(np.asarray(idx), axis=1) > 0), "indices not sorted"
+    sub = ops.token_gather(x, idx)
+    assert sub.shape == (2, 8, 4)
+    back = ops.token_scatter(x, sub * 2, idx)
+    # kept tokens doubled, dropped tokens unchanged
+    kept_mask = np.zeros((2, 16), bool)
+    for b in range(2):
+        kept_mask[b, np.asarray(idx)[b]] = True
+    np.testing.assert_allclose(np.asarray(back)[kept_mask],
+                               np.asarray(x)[kept_mask] * 2)
+    np.testing.assert_allclose(np.asarray(back)[~kept_mask],
+                               np.asarray(x)[~kept_mask])
+
+
+# ------------------------------------------------------------- sparse attn
+def test_sparsity_layouts():
+    from deepspeed_tpu.ops import sparse_attention_ops as sa
+    for cfg in [sa.FixedSparsityConfig(4, block=8, num_local_blocks=2),
+                sa.BigBirdSparsityConfig(4, block=8),
+                sa.BSLongformerSparsityConfig(4, block=8),
+                sa.VariableSparsityConfig(4, block=8,
+                                          local_window_blocks=[1, 2])]:
+        layout = cfg.make_layout(64)
+        assert layout.shape == (4, 8, 8)
+        assert layout.any(), type(cfg).__name__
+        assert not layout.all() or isinstance(cfg, sa.SparsityConfig)
+    causal = sa.FixedSparsityConfig(2, block=8, num_local_blocks=2,
+                                    attention="unidirectional")
+    lay = causal.make_layout(64)
+    assert not np.triu(lay[0], k=1).any(), "causal layout leaks future"
+
+
+def test_sparse_attention_matches_dense_on_full_layout():
+    from deepspeed_tpu.ops import sparse_attention_ops as sa
+    from deepspeed_tpu.ops.flash_attention import reference_attention
+    rng = np.random.default_rng(1)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 2, 32, 8)),
+                           dtype=jnp.float32) for _ in range(3))
+    full = sa.SparsityConfig(2, block=8).make_layout(32)
+    out = sa.sparse_attention(q, k, v, full, block=8)
+    ref = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_sparse_attention_blocks_hidden():
+    from deepspeed_tpu.ops import sparse_attention_ops as sa
+    rng = np.random.default_rng(2)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 1, 16, 4)),
+                           dtype=jnp.float32) for _ in range(3))
+    layout = np.zeros((1, 2, 2), bool)
+    layout[:, 0, 0] = layout[:, 1, 1] = True  # block-diagonal
+    out = sa.sparse_attention(q, k, v, layout, block=8)
+    # queries in block 0 must not see keys in block 1: recompute with only
+    # the first 8 kv and compare
+    from deepspeed_tpu.ops.flash_attention import reference_attention
+    ref0 = reference_attention(q[:, :, :8], k[:, :, :8], v[:, :, :8],
+                               causal=False)
+    np.testing.assert_allclose(np.asarray(out)[:, :, :8], np.asarray(ref0),
+                               atol=1e-5)
+
+
+# ---------------------------------------------------------- transformer ops
+def test_layer_norm_matches_reference_formula():
+    from deepspeed_tpu.ops.transformer import fused_ops
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((2, 5, 16)), dtype=jnp.float32)
+    scale = jnp.asarray(rng.standard_normal(16), dtype=jnp.float32)
+    bias = jnp.asarray(rng.standard_normal(16), dtype=jnp.float32)
+    out = fused_ops.layer_norm(x, scale, bias)
+    mu = np.mean(np.asarray(x), -1, keepdims=True)
+    sd = np.std(np.asarray(x), -1, keepdims=True)
+    ref = (np.asarray(x) - mu) / np.sqrt(sd ** 2 + 1e-5) * \
+        np.asarray(scale) + np.asarray(bias)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+
+def test_transformer_layer_runs_and_grads():
+    from deepspeed_tpu.ops.transformer import fused_ops
+    rng = jax.random.PRNGKey(0)
+    p = fused_ops.init_layer_params(rng, d=32)
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (2, 8, 32))
+
+    def loss(p):
+        return jnp.sum(fused_ops.transformer_layer(x, p, n_head=4,
+                                                   train=False) ** 2)
+
+    val, grads = jax.value_and_grad(loss)(p)
+    assert np.isfinite(float(val))
+    assert all(np.all(np.isfinite(g)) for g in jax.tree.leaves(grads))
+
+
+# ---------------------------------------------------------- inference ops
+def test_cached_attention_matches_reference():
+    from deepspeed_tpu.ops.transformer import inference_ops as iops
+    from deepspeed_tpu.ops.flash_attention import reference_attention
+    rng = np.random.default_rng(5)
+    b, h, t, d, t_max = 1, 2, 6, 4, 8
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), dtype=jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, t, d)), dtype=jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, t, d)), dtype=jnp.float32)
+    kc = jnp.zeros((b, h, t_max, d))
+    vc = jnp.zeros((b, h, t_max, d))
+    kc, vc = iops.update_kv_cache(kc, vc, k, v, 0)
+    out = iops.cached_attention(q, kc, vc, cur_len=t)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_rotary_pos_emb_norm_preserving():
+    from deepspeed_tpu.ops.transformer import inference_ops as iops
+    rng = np.random.default_rng(6)
+    q = jnp.asarray(rng.standard_normal((1, 2, 4, 8)), dtype=jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, 4, 8)), dtype=jnp.float32)
+    q2, k2 = iops.apply_rotary_pos_emb(q, k, jnp.arange(4))
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(q2), axis=-1),
+                               np.linalg.norm(np.asarray(q), axis=-1),
+                               rtol=1e-5)
+    # position 0 is identity
+    np.testing.assert_allclose(np.asarray(q2)[:, :, 0], np.asarray(q)[:, :, 0],
+                               atol=1e-6)
+
+
+# --------------------------------------------------------------- utils ops
+def test_flatten_unflatten_roundtrip():
+    from deepspeed_tpu.ops import utils_ops
+    ops = utils_ops.get_ops()
+    tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": {"c": np.ones(4, dtype=np.float32)}}
+    flat, spec = ops.flatten(tree)
+    assert flat.shape == (10,)
+    back = ops.unflatten(flat, spec)
+    np.testing.assert_array_equal(back["a"], tree["a"])
+    np.testing.assert_array_equal(back["b"]["c"], tree["b"]["c"])
+
+
+def test_flatten_rejects_int_leaves_and_bytes_roundtrip():
+    from deepspeed_tpu.ops import utils_ops
+    ops = utils_ops.get_ops()
+    tree = {"w": np.ones(3, np.float32), "step": np.array([2 ** 25 + 1])}
+    with pytest.raises(TypeError):
+        ops.flatten(tree)
+    flat, spec = ops.flatten_bytes(tree)
+    back = ops.unflatten_bytes(flat, spec)
+    assert back["step"][0] == 2 ** 25 + 1  # exact (float32 could not)
+    np.testing.assert_array_equal(back["w"], tree["w"])
