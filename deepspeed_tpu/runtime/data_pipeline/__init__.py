@@ -1,0 +1,13 @@
+"""Data-efficiency pipeline (reference runtime/data_pipeline/): curriculum
+scheduling, curriculum-aware sampling, mmap indexed datasets, random-LTD."""
+
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import (DataAnalyzer, DeepSpeedDataSampler, seqlen_metric)
+from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder)
+from .random_ltd import RandomLTDScheduler, random_ltd_layer
+
+__all__ = [
+    "CurriculumScheduler", "DataAnalyzer", "DeepSpeedDataSampler",
+    "seqlen_metric", "MMapIndexedDataset", "MMapIndexedDatasetBuilder",
+    "RandomLTDScheduler", "random_ltd_layer",
+]

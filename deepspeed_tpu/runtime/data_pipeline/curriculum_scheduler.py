@@ -1,0 +1,86 @@
+"""Curriculum difficulty scheduler.
+
+Capability match for the reference curriculum scheduler
+(runtime/data_pipeline/curriculum_scheduler.py — schedules at :122-143:
+fixed_linear / fixed_root / fixed_discrete / custom). Difficulty is an
+integer knob (typically sequence length or a percentile of a data metric)
+that ramps with the global step; the engine consumes it to truncate batches
+(legacy `curriculum_learning` block) and the data sampler consumes it to
+filter samples (`data_efficiency.data_sampling.curriculum_learning`).
+"""
+
+import math
+from typing import Callable, Dict, Optional
+
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config: Dict,
+                 custom_get_difficulty: Optional[Callable] = None):
+        # NOTE: legacy `curriculum_type` is the METRIC (e.g. "seqlen"), not
+        # a schedule — only `schedule_type` selects the schedule here
+        self.schedule_type = config.get("schedule_type", FIXED_LINEAR)
+        self.min_difficulty = int(config.get("min_difficulty", 1))
+        self.max_difficulty = int(config.get("max_difficulty", 1))
+        sched = config.get("schedule_config", config)
+        self.total_steps = int(sched.get("total_curriculum_step",
+                                         sched.get("total_step", 1)))
+        self.difficulty_step = int(sched.get("difficulty_step", 1))
+        self.root_degree = int(sched.get("root_degree", 2))
+        self.difficulties = list(sched.get("difficulty", []))
+        self.max_steps = list(sched.get("max_step", []))
+        self._custom = custom_get_difficulty
+        if self.schedule_type == CUSTOM and self._custom is None:
+            raise ValueError("custom schedule needs custom_get_difficulty")
+        if self.schedule_type == FIXED_DISCRETE and \
+                len(self.difficulties) != len(self.max_steps) + 1:
+            raise ValueError(
+                "fixed_discrete: need len(difficulty) == len(max_step)+1")
+        self.current_difficulty = self.get_difficulty(0)
+
+    def _clip(self, d: float) -> int:
+        if d >= self.max_difficulty:
+            return self.max_difficulty  # always reachable, even when max is
+            #                             not a difficulty_step multiple
+        d = int(d)
+        d -= d % self.difficulty_step  # keep TPU-friendly multiples
+        return max(self.min_difficulty, d)
+
+    def get_difficulty(self, global_step: int) -> int:
+        s = max(0, global_step)
+        if self.schedule_type == CUSTOM:
+            return int(self._custom(s))
+        if self.schedule_type == FIXED_DISCRETE:
+            for diff, until in zip(self.difficulties, self.max_steps):
+                if s < until:
+                    return int(diff)
+            return int(self.difficulties[-1])
+        frac = min(1.0, s / max(1, self.total_steps))
+        if self.schedule_type == FIXED_ROOT:
+            frac = frac ** (1.0 / self.root_degree)
+        elif self.schedule_type != FIXED_LINEAR:
+            raise ValueError(f"unknown schedule {self.schedule_type}")
+        span = self.max_difficulty - self.min_difficulty
+        return self._clip(self.min_difficulty + frac * span)
+
+    def update_difficulty(self, global_step: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty
+
+    def get_current_difficulty(self) -> int:
+        return self.current_difficulty
+
+    def is_fully_ramped(self, global_step: int) -> bool:
+        return self.get_difficulty(global_step) >= self.max_difficulty
+
+    def state_dict(self):
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd):
+        self.current_difficulty = sd["current_difficulty"]

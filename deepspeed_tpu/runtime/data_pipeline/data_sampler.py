@@ -1,0 +1,115 @@
+"""Curriculum-aware distributed data sampler + offline data analyzer.
+
+Capability match for the reference data-sampling stack
+(runtime/data_pipeline/data_sampling/data_sampler.py:338
+``DeepSpeedDataSampler``; data_analyzer.py:417 ``DataAnalyzer``): an offline
+pass scores every sample on a difficulty metric (seqlen, vocab rarity, or a
+user metric); at train time the sampler draws each global batch only from
+samples whose metric ≤ the curriculum's current difficulty threshold, sliced
+deterministically across dp ranks. Difficulty can index metric VALUES
+(value-based) or PERCENTILES of the metric distribution (percentile-based),
+matching the reference's two curriculum_metric modes.
+"""
+
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class DataAnalyzer:
+    """Offline metric computation (reference data_analyzer.py, reduced to
+    the in-memory case: metric values per sample + percentile map)."""
+
+    def __init__(self, dataset, metric_fn: Callable = None):
+        self.dataset = dataset
+        self.metric_fn = metric_fn or (lambda sample: len(sample))
+
+    def run(self) -> np.ndarray:
+        return np.asarray([float(self.metric_fn(self.dataset[i]))
+                           for i in range(len(self.dataset))])
+
+
+def seqlen_metric(sample):
+    """Default difficulty metric: token count."""
+    if isinstance(sample, dict):
+        sample = next(iter(sample.values()))
+    return len(sample)
+
+
+class DeepSpeedDataSampler:
+    """Iterates GLOBAL batches of sample indices, curriculum-filtered and
+    dp-sharded. Each __iter__ pass is one epoch worth of steps; the engine's
+    global step drives the difficulty ramp."""
+
+    def __init__(self, dataset, batch_size: int, *,
+                 metric_values: Optional[Sequence[float]] = None,
+                 metric_fn: Optional[Callable] = None,
+                 curriculum_config: Optional[Dict] = None,
+                 difficulty_type: str = "percentile",
+                 dp_rank: int = 0, dp_world: int = 1,
+                 seed: int = 0, drop_last: bool = True):
+        assert batch_size % dp_world == 0, \
+            f"global batch {batch_size} not divisible by dp={dp_world}"
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.dp_rank = dp_rank
+        self.dp_world = dp_world
+        self.seed = seed
+        self.drop_last = drop_last
+        self.global_step = 0
+        if metric_values is None:
+            metric_values = DataAnalyzer(dataset,
+                                         metric_fn or seqlen_metric).run()
+        self.metric_values = np.asarray(metric_values, dtype=np.float64)
+        self.difficulty_type = difficulty_type
+        order = np.argsort(self.metric_values, kind="stable")
+        self._sorted_idx = order
+        self._sorted_vals = self.metric_values[order]
+        self.scheduler = (CurriculumScheduler(curriculum_config)
+                          if curriculum_config else None)
+
+    # -- curriculum pool --------------------------------------------------
+    def _eligible(self) -> np.ndarray:
+        if self.scheduler is None:
+            return self._sorted_idx
+        diff = self.scheduler.update_difficulty(self.global_step)
+        if self.difficulty_type == "value":
+            hi = np.searchsorted(self._sorted_vals, diff, side="right")
+        else:  # percentile: difficulty in [1, 100]
+            pct = min(100, max(1, diff))
+            hi = max(1, int(round(len(self._sorted_idx) * pct / 100.0)))
+        return self._sorted_idx[:max(1, hi)]
+
+    def set_step(self, global_step: int):
+        self.global_step = global_step
+
+    def __iter__(self):
+        """Unbounded step-driven iterator of [batch_size] GLOBAL index
+        arrays; THIS rank's slice is local_indices(batch). Every rank draws
+        from the same per-step rng, so the global batch is identical
+        everywhere without communication. The eligible pool is re-derived
+        every step as the curriculum ramps (the reference sampler likewise
+        yields for the training duration, data_sampler.py:338)."""
+        while True:
+            pool = self._eligible()
+            rng = np.random.default_rng(self.seed + self.global_step)
+            take = rng.choice(len(pool), size=self.batch_size,
+                              replace=len(pool) < self.batch_size)
+            yield pool[take]
+            self.global_step += 1
+
+    def local_indices(self, global_batch: np.ndarray) -> np.ndarray:
+        per = self.batch_size // self.dp_world
+        return global_batch[self.dp_rank * per:(self.dp_rank + 1) * per]
+
+    def state_dict(self):
+        return {"global_step": self.global_step,
+                "scheduler": (self.scheduler.state_dict()
+                              if self.scheduler else None)}
+
+    def load_state_dict(self, sd):
+        self.global_step = sd["global_step"]
+        if self.scheduler is not None and sd.get("scheduler"):
+            self.scheduler.load_state_dict(sd["scheduler"])

@@ -1,0 +1,115 @@
+"""Memory-mapped indexed dataset.
+
+Capability match for the reference mmap indexed dataset
+(runtime/data_pipeline/data_sampling/indexed_dataset.py:617
+``MMapIndexedDataset`` + builder): token sequences stored as one flat binary
+stream plus an index of per-document sizes, read back through np.memmap with
+zero copies. The on-disk format here is our own (simpler: one header, sizes
+and offsets as little-endian int64 arrays) — reading the reference's Megatron
+format is a non-goal; WRITING data for this framework is the use case.
+
+Files: <path>.bin (payload), <path>.idx (header + sizes + offsets).
+"""
+
+import os
+import struct
+from typing import Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+           6: np.float32, 7: np.float64, 8: np.uint16, 9: np.uint32}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix):
+    return prefix + ".bin"
+
+
+def index_file_path(prefix):
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+
+    def __init__(self, path_prefix: str, dtype=np.int32):
+        self.prefix = path_prefix
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        self._bin = open(data_file_path(path_prefix), "wb")
+        self.sizes = []
+
+    def add_item(self, tokens: Sequence):
+        arr = np.ascontiguousarray(np.asarray(tokens), dtype=self.dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self.sizes.append(arr.size)
+
+    def add_document(self, tokens):
+        self.add_item(tokens)
+
+    def finalize(self):
+        self._bin.close()
+        sizes = np.asarray(self.sizes, dtype=np.int64)
+        offsets = np.zeros(len(sizes) + 1, dtype=np.int64)
+        np.cumsum(sizes, out=offsets[1:])
+        with open(index_file_path(self.prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<HHI", _VERSION,
+                                _DTYPE_CODES[self.dtype], len(sizes)))
+            f.write(sizes.tobytes())
+            f.write(offsets.tobytes())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finalize()
+
+
+class MMapIndexedDataset:
+    """Zero-copy reads: ds[i] returns a numpy view into the mmap."""
+
+    def __init__(self, path_prefix: str):
+        self.prefix = path_prefix
+        with open(index_file_path(path_prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{index_file_path(path_prefix)}: bad magic")
+            version, code, n = struct.unpack("<HHI", f.read(8))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            self.dtype = np.dtype(_DTYPES[code])
+            self.sizes = np.frombuffer(f.read(8 * n), dtype=np.int64)
+            self.offsets = np.frombuffer(f.read(8 * (n + 1)), dtype=np.int64)
+        self._data = np.memmap(data_file_path(path_prefix), dtype=self.dtype,
+                               mode="r")
+
+    def __len__(self):
+        return len(self.sizes)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        if i < 0:
+            i += len(self)
+        return self._data[self.offsets[i]:self.offsets[i + 1]]
+
+    def get(self, i, offset=0, length=None):
+        """Sub-range of document i (reference .get with offset/length)."""
+        start = self.offsets[i] + offset
+        if length is None:
+            length = self.sizes[i] - offset
+        return self._data[start:start + length]
+
+    @property
+    def total_tokens(self):
+        return int(self.offsets[-1])
+
+    @staticmethod
+    def exists(path_prefix):
+        return (os.path.isfile(data_file_path(path_prefix)) and
+                os.path.isfile(index_file_path(path_prefix)))

@@ -1,0 +1,62 @@
+"""Random layer-token-drop (random-LTD).
+
+Capability match for the reference random-LTD subsystem
+(runtime/data_pipeline/data_routing/basic_layer.py:14
+``RandomLayerTokenDrop``, scheduler.py; ops/random_ltd/dropping_utils.py):
+selected layers run on a random, order-preserving subset of tokens, and the
+kept-token count ramps toward the full sequence on a schedule. The gather/
+scatter compute lives in ops/random_ltd_ops.py (XLA take/put_along_axis);
+this module is the schedule + the functional layer wrapper a model applies
+around its blocks (the reference mutates nn.Modules; here the model opts in
+by calling ``random_ltd_layer``).
+"""
+
+from typing import Callable, Dict
+
+import jax
+
+from ...ops.random_ltd_ops import (sample_token_indices, token_gather,
+                                   token_scatter)
+
+
+class RandomLTDScheduler:
+    """Ramp of kept tokens per step (reference data_routing/scheduler.py:
+    fixed_linear over require_steps in increments of seq_per_step)."""
+
+    def __init__(self, config: Dict):
+        sched = config.get("random_ltd_schedule", {})
+        self.min_value = int(sched.get("min_value",
+                                       config.get("min_value", 128)))
+        self.max_value = int(sched.get("max_value",
+                                       config.get("max_value", 1024)))
+        sc = sched.get("schedule_config", {})
+        self.seq_per_step = int(sc.get("seq_per_step", 16))
+        self.require_steps = int(sc.get("require_steps", 1000))
+        self.schedule_type = sched.get("schedule_type", "fixed_linear")
+        if self.schedule_type != "fixed_linear":
+            raise ValueError(f"unknown random-ltd schedule "
+                             f"{self.schedule_type}")
+
+    def get_current_seq(self, global_step: int) -> int:
+        frac = min(1.0, max(0, global_step) / max(1, self.require_steps))
+        val = self.min_value + frac * (self.max_value - self.min_value)
+        if val >= self.max_value:
+            return self.max_value  # reachable even if not a step multiple
+        val = int(val) - int(val) % self.seq_per_step
+        return max(self.min_value, val)
+
+    def is_fully_ramped(self, global_step: int) -> bool:
+        return self.get_current_seq(global_step) >= self.max_value
+
+
+def random_ltd_layer(layer_fn: Callable, x, rng, keep: int):
+    """Run layer_fn on `keep` randomly chosen (sorted) tokens of x[B,T,...];
+    dropped tokens pass through unchanged (the reference's residual
+    bypass)."""
+    b, t = x.shape[0], x.shape[1]
+    if keep >= t:
+        return layer_fn(x)
+    idx = sample_token_indices(rng, keep, b, t)
+    sub = token_gather(x, idx)
+    out = layer_fn(sub)
+    return token_scatter(x, out, idx)

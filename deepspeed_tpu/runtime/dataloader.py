@@ -62,11 +62,26 @@ class DeepSpeedDataLoader:
     def _indices(self):
         n = len(self.dataset)
         idx = np.arange(n)
-        if self.data_sampler is not None:
-            return np.asarray(list(iter(self.data_sampler)))
         if self.shuffle:
             self._rng.shuffle(idx)
         return idx
+
+    def _iter_sampler(self):
+        """Step-driven sampler (DeepSpeedDataSampler): an UNBOUNDED iterator
+        of global index batches; this loader yields this rank's local slice
+        lazily — never materialize it (it does not terminate). One epoch
+        here = len(dataset)//batch_size steps."""
+        global_bs = getattr(self.data_sampler, "batch_size", self.batch_size)
+        steps = max(1, len(self.dataset) // global_bs)
+        it = iter(self.data_sampler)
+        for _ in range(steps):
+            global_idx = np.asarray(next(it)).reshape(-1)
+            if hasattr(self.data_sampler, "local_indices"):
+                sel = self.data_sampler.local_indices(global_idx)
+            else:
+                sel = global_idx
+            yield self.collate_fn([self.dataset[int(i)] for i in sel])
+        self.epoch += 1
 
     def __len__(self):
         n = len(self.dataset)
@@ -75,6 +90,9 @@ class DeepSpeedDataLoader:
         return (n + self.batch_size - 1) // self.batch_size
 
     def __iter__(self):
+        if self.data_sampler is not None:
+            yield from self._iter_sampler()
+            return
         if isinstance(self.dataset, dict):
             yield from self._iter_dict()
             return

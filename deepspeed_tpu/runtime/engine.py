@@ -196,6 +196,32 @@ class DeepSpeedEngine:
         self.scaler_state = init_loss_scale_state(cfg.fp16 if cfg.fp16.enabled else None)
         self._base_rng = jax.random.PRNGKey(cfg.seed + 1)
 
+        # ---- curriculum learning (engine.py:1673-1676 seqlen truncation;
+        #      data_pipeline/curriculum_scheduler.py) ----
+        self.curriculum_scheduler = None
+        self.curriculum_seqlen = None
+        self._curriculum_metric = "seqlen"
+        cl = dict(cfg.curriculum_learning_legacy or {})
+        de = dict(cfg.data_efficiency or {})
+        if not cl.get("enabled"):
+            ds = de.get("data_sampling", {})
+            if de.get("enabled") and ds.get("enabled") and \
+                    ds.get("curriculum_learning", {}).get("enabled"):
+                cl = dict(ds["curriculum_learning"], enabled=True)
+        if cl.get("enabled"):
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(cl)
+            self._curriculum_metric = cl.get("curriculum_metric",
+                                             cl.get("curriculum_type",
+                                                    "seqlen"))
+            if self._curriculum_metric != "seqlen":
+                logger.warning(
+                    f"curriculum metric '{self._curriculum_metric}': the "
+                    f"engine only truncates seqlen; wire a "
+                    f"DeepSpeedDataSampler with metric_values through "
+                    f"deepspeed_io(data_sampler=...) to filter by this "
+                    f"metric")
+
         # ---- dataloader (engine.deepspeed_io, engine.py:1542) ----
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data)
@@ -437,6 +463,7 @@ class DeepSpeedEngine:
         """Compute the micro-batch loss. The grads for this batch are
         produced lazily in backward()."""
         self.timers(FORWARD_GLOBAL_TIMER).start()
+        batch = self._apply_curriculum(batch, min_ndim=2)
         self._pending_batch = self._to_device_batch(batch)
         rng = jax.random.fold_in(self._base_rng, self.micro_steps)
         scale = self.scaler_state.scale
@@ -519,6 +546,7 @@ class DeepSpeedEngine:
         cfg = self._config
         if batch is None:
             batch = self._next_gas_batch(data_iter)
+        batch = self._apply_curriculum(batch)
         batch = self._to_device_batch(batch)
         self.tput_timer.start()
         rng = jax.random.fold_in(self._base_rng, self.global_steps)
@@ -547,6 +575,34 @@ class DeepSpeedEngine:
         batch = self._to_device_batch(batch)
         with self.mesh:
             return self._eval_fn(self.params, batch)
+
+    def _apply_curriculum(self, batch, min_ndim: int = 3):
+        """Seqlen curriculum: truncate the token axis to the current
+        difficulty (reference engine.py:1673 curriculum_seqlen kwarg).
+        Sliced host-side, so each reached difficulty compiles once.
+        The token length comes from batch['input_ids'] (dict batches) and
+        only token-shaped leaves ([gas, B, T] here, [B, T] on the micro
+        path via min_ndim=2) are sliced — scalar-per-sample leaves like
+        doc ids are left alone."""
+        if self.curriculum_scheduler is None or \
+                self._curriculum_metric != "seqlen":
+            return batch
+        if isinstance(batch, dict) and "input_ids" in batch:
+            full = batch["input_ids"].shape[-1]
+        else:
+            cands = [x for x in jax.tree.leaves(batch)
+                     if getattr(x, "ndim", 0) >= min_ndim]
+            if not cands:
+                return batch
+            full = cands[0].shape[-1]
+        seqlen = self.curriculum_scheduler.update_difficulty(
+            self.global_steps + 1)
+        self.curriculum_seqlen = seqlen
+        if seqlen >= full:
+            return batch
+        return jax.tree.map(
+            lambda x: x[..., :seqlen] if getattr(x, "ndim", 0) >= min_ndim
+            and x.shape[-1] == full else x, batch)
 
     def _next_gas_batch(self, data_iter):
         """Stack gas micro-batches from an iterator into [gas, ...] leaves."""

@@ -1,0 +1,201 @@
+"""Data-efficiency pipeline tests: curriculum schedules, indexed dataset,
+curriculum sampler, random-LTD ramp, and the engine consuming
+curriculum_learning (seqlen ramps across steps) — reference pattern:
+tests/unit/runtime/test_data_efficiency.py."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler, DataAnalyzer, DeepSpeedDataSampler,
+    MMapIndexedDataset, MMapIndexedDatasetBuilder, RandomLTDScheduler,
+    random_ltd_layer)
+
+
+# ------------------------------------------------------------- scheduler
+def test_fixed_linear_schedule():
+    s = CurriculumScheduler({"schedule_type": "fixed_linear",
+                             "min_difficulty": 8, "max_difficulty": 64,
+                             "schedule_config": {"total_curriculum_step": 10,
+                                                 "difficulty_step": 8}})
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(10) == 64
+    assert s.get_difficulty(100) == 64
+    mid = s.get_difficulty(5)
+    assert 8 < mid < 64 and mid % 8 == 0
+
+
+def test_fixed_root_schedule_ramps_faster_early():
+    lin = CurriculumScheduler({"schedule_type": "fixed_linear",
+                               "min_difficulty": 0, "max_difficulty": 100,
+                               "schedule_config": {"total_curriculum_step": 100,
+                                                   "difficulty_step": 1},
+                               })
+    root = CurriculumScheduler({"schedule_type": "fixed_root",
+                                "min_difficulty": 0, "max_difficulty": 100,
+                                "schedule_config": {"total_curriculum_step": 100,
+                                                    "difficulty_step": 1,
+                                                    "root_degree": 2}})
+    assert root.get_difficulty(25) > lin.get_difficulty(25)
+
+
+def test_fixed_discrete_schedule():
+    s = CurriculumScheduler({"schedule_type": "fixed_discrete",
+                             "min_difficulty": 1, "max_difficulty": 100,
+                             "schedule_config": {"difficulty": [10, 50, 100],
+                                                 "max_step": [5, 10]}})
+    assert s.get_difficulty(0) == 10
+    assert s.get_difficulty(7) == 50
+    assert s.get_difficulty(11) == 100
+
+
+# --------------------------------------------------------- indexed dataset
+def test_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "corpus")
+    docs = [np.arange(n, dtype=np.int32) for n in (5, 1, 17, 3)]
+    with MMapIndexedDatasetBuilder(prefix, dtype=np.int32) as b:
+        for d in docs:
+            b.add_item(d)
+    assert MMapIndexedDataset.exists(prefix)
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 4
+    assert ds.total_tokens == 26
+    for i, d in enumerate(docs):
+        np.testing.assert_array_equal(ds[i], d)
+    np.testing.assert_array_equal(ds.get(2, offset=2, length=3),
+                                  np.array([2, 3, 4], np.int32))
+    np.testing.assert_array_equal(ds[-1], docs[-1])
+
+
+def test_indexed_dataset_bad_magic(tmp_path):
+    prefix = str(tmp_path / "bad")
+    (tmp_path / "bad.idx").write_bytes(b"NOTMAGIC" + b"\0" * 16)
+    (tmp_path / "bad.bin").write_bytes(b"")
+    with pytest.raises(ValueError):
+        MMapIndexedDataset(prefix)
+
+
+# ----------------------------------------------------------------- sampler
+def _toy_dataset():
+    rng = np.random.default_rng(0)
+    return [np.zeros(rng.integers(4, 64), np.int32) for _ in range(200)]
+
+
+def test_sampler_curriculum_filters_difficulty():
+    ds = _toy_dataset()
+    sampler = DeepSpeedDataSampler(
+        ds, batch_size=8,
+        curriculum_config={"schedule_type": "fixed_linear",
+                           "min_difficulty": 10, "max_difficulty": 100,
+                           "schedule_config": {"total_curriculum_step": 50,
+                                               "difficulty_step": 1}},
+        difficulty_type="percentile", seed=1)
+    lens = np.array([len(s) for s in ds])
+    it = iter(sampler)
+    first = next(it)
+    # at step 0, only the easiest ~10% of samples are eligible
+    thresh = np.quantile(lens, 0.12)
+    assert np.all(lens[first] <= max(thresh, lens.min() + 1))
+    for _ in range(60):
+        batch = next(it)
+    # fully ramped: hard samples now appear
+    assert lens[batch].max() > np.quantile(lens, 0.5)
+
+
+def test_sampler_dp_slicing_deterministic():
+    ds = _toy_dataset()
+    common = dict(batch_size=8, seed=7)
+    s0 = DeepSpeedDataSampler(ds, dp_rank=0, dp_world=2, **common)
+    s1 = DeepSpeedDataSampler(ds, dp_rank=1, dp_world=2, **common)
+    b0 = next(iter(s0))
+    b1 = next(iter(s1))
+    np.testing.assert_array_equal(b0, b1)  # same global batch on all ranks
+    l0, l1 = s0.local_indices(b0), s1.local_indices(b1)
+    assert len(l0) == len(l1) == 4
+    assert not np.intersect1d(l0, l1).size  # disjoint local slices
+
+
+def test_data_analyzer():
+    ds = _toy_dataset()
+    vals = DataAnalyzer(ds).run()
+    assert len(vals) == len(ds)
+    assert vals[3] == len(ds[3])
+
+
+# -------------------------------------------------------------- random-ltd
+def test_random_ltd_schedule_and_layer():
+    import jax
+    import jax.numpy as jnp
+    sched = RandomLTDScheduler({"random_ltd_schedule": {
+        "min_value": 4, "max_value": 16,
+        "schedule_config": {"seq_per_step": 4, "require_steps": 10}}})
+    assert sched.get_current_seq(0) == 4
+    assert sched.get_current_seq(10) == 16
+    assert sched.get_current_seq(5) in (8, 12)
+    x = jnp.ones((2, 16, 8))
+    out = random_ltd_layer(lambda t: t * 2, x, jax.random.PRNGKey(0), 4)
+    kept = int(jnp.sum(out == 2.0) // 8)
+    assert kept == 2 * 4  # exactly `keep` tokens per sequence transformed
+    # full keep: layer applies to everything
+    out_full = random_ltd_layer(lambda t: t * 2, x, jax.random.PRNGKey(0), 16)
+    assert bool(jnp.all(out_full == 2.0))
+
+
+# --------------------------------------------------- engine consumes config
+def test_engine_curriculum_seqlen_ramps():
+    model = GPT2Model(GPT2Config(vocab_size=256, n_positions=64, n_embd=64,
+                                 n_layer=2, n_head=4, pad_vocab_to_multiple=8))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 0,
+        "curriculum_learning": {
+            "enabled": True, "curriculum_type": "seqlen",
+            "min_difficulty": 8, "max_difficulty": 32,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 8}},
+    })
+    rng = np.random.default_rng(0)
+    seqlens = []
+    for _ in range(5):
+        batch = {"input_ids": rng.integers(0, 255, (1, 8, 32), np.int32)}
+        loss = engine.train_batch(batch=batch)
+        assert np.isfinite(float(loss))
+        seqlens.append(engine.curriculum_seqlen)
+    assert seqlens[0] < seqlens[-1], seqlens
+    assert seqlens[-1] == 32
+    assert all(s % 8 == 0 for s in seqlens)
+
+
+def test_dataloader_with_sampler_is_lazy():
+    """The loader must NOT materialize the unbounded sampler (code-review
+    regression): one epoch = len(dataset)//batch steps, local slicing."""
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+    ds = _toy_dataset()
+    sampler = DeepSpeedDataSampler(ds, batch_size=8, dp_rank=0, dp_world=2,
+                                   seed=3)
+    loader = DeepSpeedDataLoader(ds, batch_size=4, data_sampler=sampler,
+                                 collate_fn=lambda xs: [len(x) for x in xs])
+    batches = list(loader)
+    assert len(batches) == len(ds) // 8
+    assert all(len(b) == 4 for b in batches)  # local slice, dp=2
+
+
+def test_curriculum_reaches_nonmultiple_max():
+    s = CurriculumScheduler({"schedule_type": "fixed_linear",
+                             "min_difficulty": 8, "max_difficulty": 100,
+                             "schedule_config": {"total_curriculum_step": 10,
+                                                 "difficulty_step": 8}})
+    assert s.get_difficulty(10) == 100
+    assert s.is_fully_ramped(10)
+    ltd = RandomLTDScheduler({"random_ltd_schedule": {
+        "min_value": 128, "max_value": 1000,
+        "schedule_config": {"seq_per_step": 16, "require_steps": 10}}})
+    assert ltd.get_current_seq(10) == 1000
+    assert ltd.is_fully_ramped(10)
