@@ -36,6 +36,7 @@ class GPT2Config:
     layer_norm_epsilon: float = 1e-5
     initializer_range: float = 0.02
     remat: bool = False            # activation checkpointing over the layer scan
+    remat_policy: Optional[str] = None  # see runtime/activation_checkpointing
     attn_backend: str = "auto"     # auto | pallas | xla
     sp_attention: str = "ulysses"  # ulysses | ring (when the 'seq' axis is live)
     dtype: str = "float32"         # compute dtype; params always fp32 masters
@@ -180,7 +181,9 @@ class GPT2Model(ModelSpec):
 
         body_fn = body
         if cfg.remat:
-            body_fn = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+            from ..runtime.activation_checkpointing.checkpointing import \
+                get_policy
+            body_fn = jax.checkpoint(body, policy=get_policy(cfg.remat_policy))
         (x, _, aux_total), _ = lax.scan(body_fn, (x, 0, jnp.float32(0.0)),
                                         params["blocks"])
 

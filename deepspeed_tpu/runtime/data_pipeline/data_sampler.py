@@ -49,6 +49,7 @@ class DeepSpeedDataSampler:
                  curriculum_config: Optional[Dict] = None,
                  difficulty_type: str = "percentile",
                  dp_rank: int = 0, dp_world: int = 1,
+                 gradient_accumulation_steps: int = 1,
                  seed: int = 0, drop_last: bool = True):
         assert batch_size % dp_world == 0, \
             f"global batch {batch_size} not divisible by dp={dp_world}"
@@ -56,9 +57,13 @@ class DeepSpeedDataSampler:
         self.batch_size = batch_size
         self.dp_rank = dp_rank
         self.dp_world = dp_world
+        # the engine pulls gas micro-batches per OPTIMIZER step; the
+        # curriculum must ramp on optimizer steps, not micro draws
+        self.gas = max(1, gradient_accumulation_steps)
         self.seed = seed
         self.drop_last = drop_last
-        self.global_step = 0
+        self._base_step = 0
+        self._draws = 0
         if metric_values is None:
             metric_values = DataAnalyzer(dataset,
                                          metric_fn or seqlen_metric).run()
@@ -82,34 +87,42 @@ class DeepSpeedDataSampler:
             hi = max(1, int(round(len(self._sorted_idx) * pct / 100.0)))
         return self._sorted_idx[:max(1, hi)]
 
+    @property
+    def global_step(self) -> int:
+        return self._base_step + self._draws // self.gas
+
     def set_step(self, global_step: int):
-        self.global_step = global_step
+        self._base_step = global_step
+        self._draws = 0
 
     def __iter__(self):
         """Unbounded step-driven iterator of [batch_size] GLOBAL index
         arrays; THIS rank's slice is local_indices(batch). Every rank draws
-        from the same per-step rng, so the global batch is identical
+        from the same per-draw rng, so the global batch is identical
         everywhere without communication. The eligible pool is re-derived
-        every step as the curriculum ramps (the reference sampler likewise
-        yields for the training duration, data_sampler.py:338)."""
+        per OPTIMIZER step (draws//gas) as the curriculum ramps (the
+        reference sampler likewise yields for the training duration,
+        data_sampler.py:338)."""
         while True:
             pool = self._eligible()
-            rng = np.random.default_rng(self.seed + self.global_step)
+            rng = np.random.default_rng(self.seed + self._draws)
             take = rng.choice(len(pool), size=self.batch_size,
                               replace=len(pool) < self.batch_size)
             yield pool[take]
-            self.global_step += 1
+            self._draws += 1
 
     def local_indices(self, global_batch: np.ndarray) -> np.ndarray:
         per = self.batch_size // self.dp_world
         return global_batch[self.dp_rank * per:(self.dp_rank + 1) * per]
 
     def state_dict(self):
-        return {"global_step": self.global_step,
+        return {"global_step": self.global_step, "draws": self._draws,
+                "base_step": self._base_step,
                 "scheduler": (self.scheduler.state_dict()
                               if self.scheduler else None)}
 
     def load_state_dict(self, sd):
-        self.global_step = sd["global_step"]
+        self._base_step = sd.get("base_step", sd.get("global_step", 0))
+        self._draws = sd.get("draws", 0)
         if self.scheduler is not None and sd.get("scheduler"):
             self.scheduler.load_state_dict(sd["scheduler"])

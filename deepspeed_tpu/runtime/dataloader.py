@@ -59,9 +59,19 @@ class DeepSpeedDataLoader:
         self._rng = np.random.default_rng(seed)
         self.epoch = 0
 
+    def _batch_sampler(self):
+        """A step-driven batch sampler (DeepSpeedDataSampler) yields whole
+        global index batches and knows each rank's slice; a torch-style
+        sampler yields one index per next() and is finite."""
+        if self.data_sampler is not None and \
+                hasattr(self.data_sampler, "local_indices"):
+            return self.data_sampler
+        return None
+
     def _indices(self):
-        n = len(self.dataset)
-        idx = np.arange(n)
+        if self.data_sampler is not None:  # torch-style per-sample sampler
+            return np.asarray(list(iter(self.data_sampler))).reshape(-1)
+        idx = np.arange(len(self.dataset))
         if self.shuffle:
             self._rng.shuffle(idx)
         return idx
@@ -71,26 +81,26 @@ class DeepSpeedDataLoader:
         of global index batches; this loader yields this rank's local slice
         lazily — never materialize it (it does not terminate). One epoch
         here = len(dataset)//batch_size steps."""
-        global_bs = getattr(self.data_sampler, "batch_size", self.batch_size)
-        steps = max(1, len(self.dataset) // global_bs)
-        it = iter(self.data_sampler)
-        for _ in range(steps):
+        sampler = self._batch_sampler()
+        it = iter(sampler)
+        for _ in range(len(self)):
             global_idx = np.asarray(next(it)).reshape(-1)
-            if hasattr(self.data_sampler, "local_indices"):
-                sel = self.data_sampler.local_indices(global_idx)
-            else:
-                sel = global_idx
+            sel = sampler.local_indices(global_idx)
             yield self.collate_fn([self.dataset[int(i)] for i in sel])
         self.epoch += 1
 
     def __len__(self):
         n = len(self.dataset)
+        sampler = self._batch_sampler()
+        if sampler is not None:
+            # one epoch = dataset coverage at the sampler's GLOBAL batch
+            return max(1, n // sampler.batch_size)
         if self.drop_last:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
     def __iter__(self):
-        if self.data_sampler is not None:
+        if self._batch_sampler() is not None:
             yield from self._iter_sampler()
             return
         if isinstance(self.dataset, dict):

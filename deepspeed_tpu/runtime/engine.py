@@ -222,6 +222,23 @@ class DeepSpeedEngine:
                     f"deepspeed_io(data_sampler=...) to filter by this "
                     f"metric")
 
+        # ---- activation checkpointing: JSON block -> remat policy on the
+        #      model (reference checkpointing.py:789 configure()) ----
+        if (cfg._param_dict or {}).get("activation_checkpointing") is not None:
+            import dataclasses as _dc
+            from .activation_checkpointing.checkpointing import configure
+            pol = configure(deepspeed_config=cfg)
+            mcfg = getattr(self.module, "config", None)
+            if mcfg is not None and hasattr(mcfg, "remat"):
+                updates = {"remat": True}
+                if hasattr(mcfg, "remat_policy"):
+                    updates["remat_policy"] = pol
+                if _dc.is_dataclass(mcfg):  # model configs are frozen
+                    self.module.config = _dc.replace(mcfg, **updates)
+                else:
+                    for k, v in updates.items():
+                        setattr(mcfg, k, v)
+
         # ---- dataloader (engine.deepspeed_io, engine.py:1542) ----
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data)
@@ -550,6 +567,7 @@ class DeepSpeedEngine:
         batch = self._to_device_batch(batch)
         self.tput_timer.start()
         rng = jax.random.fold_in(self._base_rng, self.global_steps)
+        self._maybe_profile_flops(batch, rng)
         if self._offload is not None:
             # denom = the batch's ACTUAL gas dim (accum_grads derives gas the
             # same way), not the config value — they can legitimately differ
@@ -604,6 +622,53 @@ class DeepSpeedEngine:
             lambda x: x[..., :seqlen] if getattr(x, "ndim", 0) >= min_ndim
             and x.shape[-1] == full else x, batch)
 
+    def _maybe_profile_flops(self, batch, rng):
+        """FlopsProfilerConfig hook: at profile_step, cost-analyze the
+        compiled train step (reference engine wiring of FlopsProfiler,
+        engine.py:1646-1664). Analysis only — the step fn donates its
+        inputs, so the REAL step that follows provides the latency (the
+        report is emitted from _post_step)."""
+        fpcfg = self._config.flops_profiler
+        if not fpcfg.enabled or self.global_steps != fpcfg.profile_step:
+            return
+        from ..profiling.flops_profiler import FlopsProfiler
+        prof_fn = self._grad_step_fn if self._offload is not None \
+            else self._train_step_fn
+        if prof_fn is None:
+            return
+        lr = jnp.float32(self.get_lr()[0])
+        args = (self.params, self.scaler_state, batch, rng) \
+            if self._offload is not None else \
+            (self.params, self.opt_state, self.scaler_state, batch, lr, rng)
+        profiler = FlopsProfiler(fpcfg)
+        with self.mesh:
+            prof = profiler.profile(prof_fn, *args)
+        self._flops_profile = prof
+        self._flops_profile_t0 = time.perf_counter()
+
+    def _emit_flops_report(self, metrics):
+        """Finish the profile started by _maybe_profile_flops: the step has
+        run; block on its output for an honest latency, then report."""
+        prof = getattr(self, "_flops_profile", None)
+        t0 = getattr(self, "_flops_profile_t0", None)
+        if prof is None or t0 is None:
+            return
+        self._flops_profile_t0 = None
+        from ..profiling.flops_profiler import FlopsProfiler
+        fpcfg = self._config.flops_profiler
+        loss = metrics.get("loss")
+        if hasattr(loss, "block_until_ready"):
+            loss.block_until_ready()
+        latency = time.perf_counter() - t0
+        n_params = sum(int(np.prod(s.shape))
+                       for s in jax.tree.leaves(self.param_shapes))
+        report = FlopsProfiler(fpcfg).report(prof, params=n_params,
+                                             latency_s=latency)
+        log_dist("\n" + report, ranks=[0])
+        if fpcfg.output_file and jax.process_index() == 0:
+            with open(fpcfg.output_file, "w") as f:
+                f.write(report + "\n")
+
     def _next_gas_batch(self, data_iter):
         """Stack gas micro-batches from an iterator into [gas, ...] leaves."""
         gas = self._config.gradient_accumulation_steps
@@ -614,6 +679,7 @@ class DeepSpeedEngine:
         return jax.tree.map(jnp.asarray, batch)
 
     def _post_step(self, metrics):
+        self._emit_flops_report(metrics)
         self.global_steps += 1
         self.global_samples += self._config.train_batch_size
         overflow = bool(metrics.get("overflow", False))
