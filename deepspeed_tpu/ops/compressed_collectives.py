@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _sign_compress(x):
+def sign_compress(x):
     """x -> (int8 sign, f32 scale) with scale = mean(|x|) (the 1-bit
     compression of the reference's compressed_allreduce)."""
     scale = jnp.mean(jnp.abs(x))
@@ -34,8 +34,22 @@ def _sign_compress(x):
     return sign, scale
 
 
-def _sign_decompress(sign, scale):
+def sign_decompress(sign, scale):
     return sign.astype(jnp.float32) * scale
+
+
+def sign_compress_with_error(x, error):
+    """Error-feedback form, the 1-bit optimizers' primitive: returns
+    (compressed float values, new_error). ONE implementation — the
+    optimizers (runtime/fp16/onebit) and the collective share it."""
+    corrected = x + error
+    sign, scale = sign_compress(corrected)
+    compressed = sign_decompress(sign, scale)
+    return compressed, corrected - compressed
+
+
+_sign_compress = sign_compress
+_sign_decompress = sign_decompress
 
 
 def onebit_allreduce(x, worker_error, server_error,
@@ -76,10 +90,10 @@ def onebit_allreduce(x, worker_error, server_error,
     return avg, new_worker_error, new_server_error
 
 
-def int8_allreduce(x, axis_name: str = "data", groups: int = 1):
+def int8_allreduce(x, axis_name: str = "data"):
     """Quantized AVERAGE: int8 reduce-scatter + int8 allgather (the
     ZeRO++-style quantized gradient collective, zero_quantized_gradients).
-    Lossy but unbiased-ish per call; no error state."""
+    Per-tensor scales; lossy but unbiased-ish per call; no error state."""
     world = lax.axis_size(axis_name)
     n = x.shape[0]
     assert n % world == 0

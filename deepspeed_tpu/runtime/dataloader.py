@@ -90,11 +90,18 @@ class DeepSpeedDataLoader:
         self.epoch += 1
 
     def __len__(self):
-        n = len(self.dataset)
         sampler = self._batch_sampler()
         if sampler is not None:
             # one epoch = dataset coverage at the sampler's GLOBAL batch
-            return max(1, n // sampler.batch_size)
+            return max(1, len(self.dataset) // sampler.batch_size)
+        if self.data_sampler is not None:
+            # torch-style per-sample sampler: its index count rules
+            try:
+                n = len(self.data_sampler)
+            except TypeError:
+                n = len(self.dataset)
+        else:
+            n = len(self.dataset)
         if self.drop_last:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size

@@ -33,13 +33,8 @@ class OnebitAdamState(NamedTuple):
     error: object   # per-leaf error feedback (compression stage)
 
 
-def sign_compress_with_error(m, err):
-    """Error-feedback sign compression: the shared 1-bit primitive
-    (also used by 0/1 Adam). Returns (compressed, new_error)."""
-    corrected = m + err
-    scale = jnp.mean(jnp.abs(corrected))
-    compressed = jnp.where(corrected >= 0, scale, -scale)
-    return compressed, corrected - compressed
+# ONE shared implementation with the wire-level collective
+from ....ops.compressed_collectives import sign_compress_with_error  # noqa: E402
 
 
 def scale_by_onebit_adam(b1: float = 0.9, b2: float = 0.999,

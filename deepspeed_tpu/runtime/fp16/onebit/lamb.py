@@ -28,16 +28,8 @@ def scale_by_onebit_lamb(b1=0.9, b2=0.999, eps=1e-8, freeze_step=100,
 
     def update(grads, state, params=None):
         upd, inner = core.update(grads, state.inner, params)
-
-        def trust(u, p):
-            p_norm = jnp.linalg.norm(p.astype(jnp.float32).reshape(-1))
-            u_norm = jnp.linalg.norm(u.reshape(-1))
-            ratio = jnp.where((p_norm > 0) & (u_norm > 0),
-                              jnp.clip(p_norm / u_norm, min_coeff, max_coeff),
-                              1.0)
-            return u * ratio
-
-        upd = jax.tree.map(trust, upd, params)
+        from ...optimizers import apply_trust_ratio
+        upd = apply_trust_ratio(upd, params, min_coeff, max_coeff)
         return upd, OnebitLambState(inner=inner)
 
     return optax.GradientTransformation(init, update)

@@ -26,8 +26,7 @@ class ZeroOneAdamState(NamedTuple):
 
 
 def scale_by_zeroone_adam(b1=0.9, b2=0.999, eps=1e-8,
-                          var_freeze_step=100, var_update_scaler=16,
-                          local_step_scaler=32768, local_step_clipper=16):
+                          var_freeze_step=100, var_update_scaler=16):
     def init(params):
         zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
                              params)

@@ -32,6 +32,21 @@ class Optimizer(NamedTuple):
     defaults: dict = {}
 
 
+def apply_trust_ratio(updates, params, min_coeff=None, max_coeff=None):
+    """LAMB's per-tensor ||w||/||update|| scaling (shared by lamb,
+    fusedlamb, and the 1-bit lamb wrapper)."""
+    def per_leaf(u, p):
+        p_norm = jnp.linalg.norm(p.astype(jnp.float32).reshape(-1))
+        u_norm = jnp.linalg.norm(u.reshape(-1))
+        ratio = p_norm / jnp.maximum(u_norm, 1e-30)
+        if min_coeff is not None or max_coeff is not None:
+            ratio = jnp.clip(ratio, min_coeff, max_coeff)
+        ratio = jnp.where((p_norm > 0) & (u_norm > 0), ratio, 1.0)
+        return u * ratio
+
+    return jax.tree.map(per_leaf, updates, params)
+
+
 def _chain_update(core, params, grads, state, lr, weight_decay, decoupled,
                   trust_ratio=False):
     if weight_decay and not decoupled:
@@ -40,12 +55,7 @@ def _chain_update(core, params, grads, state, lr, weight_decay, decoupled,
     if weight_decay and decoupled:
         updates = jax.tree.map(lambda u, p: u + weight_decay * p, updates, params)
     if trust_ratio:
-        def per_leaf(u, p):
-            p_norm = jnp.linalg.norm(p.reshape(-1))
-            u_norm = jnp.linalg.norm(u.reshape(-1))
-            ratio = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm, 1.0)
-            return u * ratio
-        updates = jax.tree.map(per_leaf, updates, params)
+        updates = apply_trust_ratio(updates, params)
     new_params = jax.tree.map(lambda p, u: (p - lr * u).astype(p.dtype),
                               params, updates)
     return new_params, new_state
@@ -107,11 +117,15 @@ def get_optimizer(name: str, params_config: dict = None) -> Optimizer:
                 min_coeff=float(cfg.pop("min_coeff", 0.01)))
         else:
             from .fp16.onebit.zoadam import scale_by_zeroone_adam
+            for unsupported in ("local_step_scaler", "local_step_clipper"):
+                if cfg.pop(unsupported, None) is not None:
+                    from ..utils.logging import logger
+                    logger.warning(
+                        f"ZeroOneAdam: {unsupported} is not implemented "
+                        f"(momentum compresses every step, the k=1 policy)")
             core = scale_by_zeroone_adam(
                 betas[0], betas[1], eps, freeze,
-                var_update_scaler=int(cfg.pop("var_update_scaler", 16)),
-                local_step_scaler=int(cfg.pop("local_step_scaler", 32768)),
-                local_step_clipper=int(cfg.pop("local_step_clipper", 16)))
+                var_update_scaler=int(cfg.pop("var_update_scaler", 16)))
 
         def update(grads, state, params, lr):
             # reference onebit optimizers use torch-Adam L2 decay

@@ -56,12 +56,7 @@ class PipelineEngine(DeepSpeedEngine):
                                  "PipelineModule")
             self._pspec = model.pipeline_spec()
         super().__init__(*args, **kwargs)
-        if self.mesh_manager.pp > 1 and self._interpreted:
-            raise ValueError(
-                "PipelineModule (heterogeneous layer lists) runs in "
-                "interpreted mode, which supports pp=1 meshes (semantic "
-                "reference). For pp>1 use a model with pipeline_spec() "
-                "(e.g. GPT2Model) — the compiled ppermute path.")
+
     def _pre_init_validate(self):
         if self._interpreted:
             return
@@ -257,7 +252,59 @@ class PipelineEngine(DeepSpeedEngine):
     # interpreted mode: execute the declarative TrainSchedule with vjp
     # ------------------------------------------------------------------
     def _init_interpreter(self):
+        """Heterogeneous PipelineModule execution. On a pp>1 mesh each
+        stage's layers are PLACED on that stage's slice of the 'pipe' axis
+        (reference: one process group per stage, pipe/engine.py); the host
+        drives the TrainSchedule, and async dispatch overlaps stage s's
+        micro t with stage s+1's micro t-1 — real pipelining, arbitrary
+        per-layer shapes (no ppermute shape constraint)."""
         self._stage_cache: Dict[Any, Any] = {}
+        pp = self.mesh_manager.pp
+        self._stage_shardings = None
+        if pp > 1:
+            from jax.sharding import Mesh
+            axes = tuple(a for a in self.mesh.axis_names if a != PIPE_AXIS)
+            pipe_pos = self.mesh.axis_names.index(PIPE_AXIS)
+            self._stage_shardings = []
+            for s in range(pp):
+                devs = np.take(self.mesh.devices, s, axis=pipe_pos)
+                sub = Mesh(devs, axes)
+                self._stage_shardings.append(NamedSharding(sub, P()))
+            self._restage_params()
+
+    def _stage_for_layer(self, layer_idx: int, ranges) -> int:
+        for s, (a, b) in enumerate(ranges):
+            if a <= layer_idx < b:
+                return s
+        return len(ranges) - 1
+
+    def _restage_params(self):
+        """Move each layer's params onto its stage's devices; tied subtrees
+        are replicated per consuming stage lazily (cached per step)."""
+        if self._stage_shardings is None:
+            return
+        ranges = self._stage_ranges(self.mesh_manager.pp)
+        layers = list(self.params["layers"])
+        for i in range(len(layers)):
+            sh = self._stage_shardings[self._stage_for_layer(i, ranges)]
+            layers[i] = jax.device_put(layers[i], sh)
+        self.params = dict(self.params, layers=layers)
+
+    def _tied_for_stage(self, tied_p, s):
+        if self._stage_shardings is None:
+            return tied_p
+        key = ("tied", s, self.global_steps)
+        if key not in self._stage_cache:
+            self._stage_cache = {k: v for k, v in self._stage_cache.items()
+                                 if k[2] == self.global_steps}
+            self._stage_cache[key] = jax.device_put(
+                tied_p, self._stage_shardings[s])
+        return self._stage_cache[key]
+
+    def _to_stage(self, x, s):
+        if self._stage_shardings is None:
+            return x
+        return jax.device_put(x, self._stage_shardings[s])
 
     def _stage_ranges(self, stages: int):
         module: PipelineModule = self.module
@@ -287,13 +334,30 @@ class PipelineEngine(DeepSpeedEngine):
 
         return fn
 
-    def train_batch_interpreted(self, batch, num_stages: int = 2):
+    def train_batch(self, data_iter=None, batch=None):
+        if self._interpreted and self.mesh_manager.pp > 1:
+            if batch is None:
+                batch = self._next_gas_batch(data_iter)
+            # same pre-step hooks as the base path (curriculum, throughput)
+            batch = self._apply_curriculum(batch)
+            self.tput_timer.start()
+            loss = self.train_batch_interpreted(
+                batch, num_stages=self.mesh_manager.pp)
+            self.tput_timer.stop(global_step=True)
+            return loss
+        return super().train_batch(data_iter=data_iter, batch=batch)
+
+    def train_batch_interpreted(self, batch, num_stages: int = None):
         """Run one global step by interpreting TrainSchedule instruction
-        streams for `num_stages` virtual stages — the reference execution
-        model (_exec_schedule), for parity tests and heterogeneous models."""
+        streams — the reference execution model (_exec_schedule). On a
+        pp>1 mesh each stage computes on ITS devices (activations/grads
+        hop stage→stage via device_put, the p2p of pipe/p2p.py); on pp=1
+        the stages are virtual (semantic reference for parity tests)."""
         assert self._interpreted
         cfg = self._config
         module: PipelineModule = self.module
+        if num_stages is None:
+            num_stages = max(2, self.mesh_manager.pp)
         batch = self._to_device_batch(batch)
         micros = [jax.tree.map(lambda x: x[i], batch)
                   for i in range(jax.tree.leaves(batch)[0].shape[0])]
@@ -337,16 +401,21 @@ class PipelineEngine(DeepSpeedEngine):
                 for c in cmds:
                     m = getattr(c, "buffer_id", None)
                     if isinstance(c, sched.LoadMicroBatch):
-                        stage_inputs[(s, m)] = micros[m]
+                        stage_inputs[(s, m)] = self._to_stage(micros[m], s)
                     elif isinstance(c, sched.RecvActivation):
-                        stage_inputs[(s, m)] = act_mail.pop((s - 1, m))
+                        # the stage→stage activation hop (pipe/p2p.py recv)
+                        stage_inputs[(s, m)] = self._to_stage(
+                            act_mail.pop((s - 1, m)), s)
                     elif isinstance(c, sched.ForwardPass):
                         x = stage_inputs[(s, m)]
                         mrng = jax.random.fold_in(rng, m)
                         fn = self._stage_apply(a, b, last)
+                        tied_s = self._tied_for_stage(tied_p, s)
+                        mb_s = self._to_stage(micros[m], s) if last else \
+                            micros[m]
                         out, vjp = jax.vjp(
-                            lambda sp, tp, xx: fn(sp, tp, xx, micros[m], mrng),
-                            stage_p, tied_p, x)
+                            lambda sp, tp, xx: fn(sp, tp, xx, mb_s, mrng),
+                            stage_p, tied_s, x)
                         vjps[(s, m)] = vjp
                         if last:
                             losses.append(out)
@@ -355,16 +424,27 @@ class PipelineEngine(DeepSpeedEngine):
                     elif isinstance(c, sched.SendActivation):
                         act_mail[(s, m)] = stage_inputs.pop((s, m, "out"))
                     elif isinstance(c, sched.RecvGrad):
-                        stage_inputs[(s, m, "gin")] = grad_mail.pop((s + 1, m))
+                        # the grad hop back (pipe/p2p.py SendGrad/RecvGrad)
+                        stage_inputs[(s, m, "gin")] = self._to_stage(
+                            grad_mail.pop((s + 1, m)), s)
                     elif isinstance(c, sched.BackwardPass):
-                        # loss cotangent: mean over micros, scaled for fp16
-                        # (the _apply_fn unscales by scaler_state.scale)
-                        g = (jnp.float32(1.0 / M) * self.scaler_state.scale
+                        # loss cotangent: mean over micros, scaled for fp16 (the
+                        # _apply_fn unscales by scaler_state.scale). Placed
+                        # on the stage: scaler_state is committed to the
+                        # FULL mesh after a step, and a full-mesh cotangent
+                        # against stage-placed residuals is a device clash.
+                        g = (self._to_stage(
+                            jnp.float32(1.0 / M) * self.scaler_state.scale, s)
                              if last else stage_inputs.pop((s, m, "gin")))
                         dstage, dtied, dx = vjps.pop((s, m))(g)
                         for j, layer_idx in enumerate(range(a, b)):
                             grads_layers[layer_idx] = jax.tree.map(
                                 jnp.add, grads_layers[layer_idx], dstage[j])
+                        if self._stage_shardings is not None:
+                            # tied grads accumulate across STAGES — bring
+                            # them to a common placement first
+                            dtied = jax.device_put(
+                                dtied, NamedSharding(self.mesh, P()))
                         grads_tied_acc[0] = jax.tree.map(
                             jnp.add, grads_tied_acc[0], dtied)
                         stage_inputs[(s, m, "gout")] = dx
@@ -387,6 +467,7 @@ class PipelineEngine(DeepSpeedEngine):
              metrics) = self._apply_fn(self.params, self.opt_state,
                                        self.scaler_state, grads, lr,
                                        jnp.float32(1.0))
+        self._restage_params()  # updated layers back onto their stages
         self.micro_steps += M
         loss = jnp.mean(jnp.stack(losses))
         metrics = dict(metrics)
