@@ -219,7 +219,17 @@ def all_reduce(x, op: str = ReduceOp.SUM, axis_name="data"):
     if op == ReduceOp.MIN:
         return lax.pmin(x, axis_name)
     if op == ReduceOp.PRODUCT:
-        return jnp.exp(lax.psum(jnp.log(x), axis_name))
+        # sign/zero-safe product: exp(psum(log)) alone NaNs on x<=0
+        neg_parity = lax.psum((x < 0).astype(jnp.int32), axis_name) % 2
+        any_zero = lax.pmax((x == 0).astype(jnp.int32), axis_name)
+        log_mag = lax.psum(
+            jnp.log(jnp.maximum(jnp.abs(x), jnp.finfo(jnp.float32).tiny)),
+            axis_name)
+        signed = jnp.exp(log_mag) * jnp.where(neg_parity == 1, -1.0, 1.0)
+        out = jnp.where(any_zero == 1, 0.0, signed)
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            out = jnp.round(out)  # exp/log lands epsilon below the integer
+        return out.astype(x.dtype)
     raise ValueError(f"Unsupported reduce op {op}")
 
 
@@ -247,7 +257,13 @@ def all_to_all(x, axis_name="expert", split_axis: int = 0, concat_axis: int = 0)
 
 
 def broadcast(x, src: int = 0, axis_name="data"):
-    """Select src's shard on every member (psum of masked value)."""
+    """src's value on every member, as psum of the masked value.
+
+    XLA exposes no one-to-many collective inside SPMD programs (ppermute
+    requires unique sources), so broadcast = all-reduce of a one-hot
+    contribution. Cost: a ring all-reduce moves ~2·N per link regardless of
+    world size — about 2x an optimal broadcast and CONSTANT in world size,
+    which is why this is also how GSPMD itself materializes broadcasts."""
     _log("broadcast", x, axis_name)
     idx = lax.axis_index(axis_name)
     mask = (idx == src).astype(x.dtype)

@@ -48,8 +48,6 @@ def sign_compress_with_error(x, error):
     return compressed, corrected - compressed
 
 
-_sign_compress = sign_compress
-_sign_decompress = sign_decompress
 
 
 def onebit_allreduce(x, worker_error, server_error,
@@ -67,8 +65,8 @@ def onebit_allreduce(x, worker_error, server_error,
     chunk = n // world
 
     corrected = x + worker_error
-    sign, scale = _sign_compress(corrected)
-    new_worker_error = corrected - _sign_decompress(sign, scale)
+    sign, scale = sign_compress(corrected)
+    new_worker_error = corrected - sign_decompress(sign, scale)
 
     # every member sends chunk j to member j (int8 over the wire);
     # scales travel alongside (world f32 scalars)
@@ -80,8 +78,8 @@ def onebit_allreduce(x, worker_error, server_error,
                         scales[:, None], axis=0) / world
 
     corrected_chunk = chunk_sum + server_error
-    csign, cscale = _sign_compress(corrected_chunk)
-    new_server_error = corrected_chunk - _sign_decompress(csign, cscale)
+    csign, cscale = sign_compress(corrected_chunk)
+    new_server_error = corrected_chunk - sign_decompress(csign, cscale)
 
     gathered = lax.all_gather(csign, axis_name)                 # [world, chunk]
     cscales = lax.all_gather(cscale, axis_name)                 # [world]

@@ -1,0 +1,168 @@
+"""Tensor-fragment debugging API.
+
+Capability match for the reference tensor-fragment utilities
+(utils/tensor_fragment.py:91-124 — ``safe_get_full_fp32_param``,
+``safe_get_full_grad``, ``safe_get_full_optimizer_state`` and the set_
+variants): under ZeRO a torch param's fp32 master lives as a fragment of a
+flat partition, and the API reassembles it. In this framework params are
+GLOBAL logical arrays (shardings describe placement), so "get full" is a
+gather-to-host of the addressed leaf and "set full" a device_put against
+its sharding; the fragment mapping machinery disappears but the user-facing
+contract — read/write the full fp32 value of one named parameter regardless
+of ZeRO stage/offload — is identical.
+
+Params are addressed by their '/'-joined path (models/api.py
+param_path_tree), e.g. "blocks/attn_w" or "layers/3/w".
+"""
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.api import param_path_tree
+
+
+def _leaf_index(tree, path: str) -> int:
+    paths = jax.tree.leaves(param_path_tree(tree))
+    try:
+        return paths.index(path)
+    except ValueError:
+        matches = [i for i, p in enumerate(paths) if path in p]
+        if len(matches) == 1:
+            return matches[0]
+        raise KeyError(
+            f"param path {path!r} not found "
+            f"({'ambiguous' if matches else 'no match'}); available: "
+            f"{paths[:20]}{'...' if len(paths) > 20 else ''}")
+
+
+def list_param_paths(engine) -> List[str]:
+    return jax.tree.leaves(param_path_tree(engine.params))
+
+
+def _gather_leaf(engine, leaf):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(engine.mesh, P())
+    # device_put (not jit): leaves may be committed to a pipeline stage's
+    # SUB-mesh (pipe/engine.py _restage_params) and jit refuses
+    # cross-device-set inputs; device_put transfers across device sets
+    g = jax.device_put(leaf, rep)
+    return np.asarray(g.addressable_data(0))
+
+
+def safe_get_full_fp32_param(engine, path: str) -> np.ndarray:
+    """The full fp32 value of the addressed parameter (masters under
+    offload; gathered device value otherwise)."""
+    offload = getattr(engine, "_offload", None)
+    i = _leaf_index(engine.params, path)
+    if offload is not None:
+        return offload.masters[i].reshape(offload.shapes[i]).copy()
+    return _gather_leaf(engine, jax.tree.leaves(engine.params)[i]).astype(
+        np.float32)
+
+
+def safe_set_full_fp32_param(engine, path: str, value) -> None:
+    """Write the full fp32 value back, preserving sharding/dtype (and the
+    host masters + device copy under offload)."""
+    i = _leaf_index(engine.params, path)
+    leaves, treedef = jax.tree.flatten(engine.params)
+    offload = getattr(engine, "_offload", None)
+    value = np.asarray(value, dtype=np.float32)
+    if offload is not None:
+        assert value.shape == offload.shapes[i], \
+            f"shape {value.shape} != {offload.shapes[i]}"
+        offload.masters[i][...] = value.reshape(-1)
+        leaves[i] = jax.device_put(
+            value.astype(offload.dtypes[i], copy=False),
+            offload.shardings[i])
+    else:
+        old = leaves[i]
+        assert value.shape == old.shape, \
+            f"shape {value.shape} != {old.shape}"
+        leaves[i] = jax.device_put(value.astype(old.dtype), old.sharding)
+    engine.params = jax.tree.unflatten(treedef, leaves)
+
+
+def safe_get_full_grad(engine, path: str) -> Optional[np.ndarray]:
+    """The full accumulated gradient of the addressed parameter. Available
+    between backward() and step() on the micro API (reference contract:
+    grads exist only in that window; the fused train_batch consumes them
+    in-jit)."""
+    buf = getattr(engine, "_grad_acc_buffer", None)
+    if buf is None:
+        return None
+    i = _leaf_index(engine.params, path)
+    g = _gather_leaf(engine, jax.tree.leaves(buf)[i]).astype(np.float32)
+    # the buffer holds grads of scale*loss summed over micro-batches;
+    # return the TRUE accumulated gradient (reference contract)
+    return g / float(engine.scaler_state.scale)
+
+
+_STATE_ALIASES = {
+    "exp_avg": ("mu", "m"),
+    "exp_avg_sq": ("nu", "v"),
+    "momentum": ("mu", "m", "trace"),
+    "variance": ("nu", "v"),
+}
+
+
+def safe_get_full_optimizer_state(engine, path: str,
+                                  state_name: str) -> Optional[np.ndarray]:
+    """One optimizer-state tensor (e.g. 'exp_avg', 'exp_avg_sq') of the
+    addressed parameter."""
+    i = _leaf_index(engine.params, path)
+    offload = getattr(engine, "_offload", None)
+    if offload is not None:
+        names = _STATE_ALIASES.get(state_name, (state_name,))
+        if any(n in ("mu", "m") for n in names):
+            m, _ = (offload.store.get_ram(i) if not offload.store.nvme
+                    else _offload_moments(offload, i))
+            return m.reshape(offload.shapes[i]).copy()
+        if any(n in ("nu", "v") for n in names):
+            _, v = (offload.store.get_ram(i) if not offload.store.nvme
+                    else _offload_moments(offload, i))
+            return v.reshape(offload.shapes[i]).copy()
+        return None
+    if engine.opt_state is None:
+        return None
+    names = _STATE_ALIASES.get(state_name, (state_name,))
+    sub = _find_named_subtree(engine.opt_state, names)
+    if sub is None:
+        return None
+    return _gather_leaf(engine, jax.tree.leaves(sub)[i]).astype(np.float32)
+
+
+def _offload_moments(offload, i):
+    """One leaf's moments from the NVMe store — per-leaf reads, not the
+    whole store."""
+    store = offload.store
+    store.flush()
+    n = store.sizes[i]
+    m = np.empty(n, np.float32)
+    v = np.empty(n, np.float32)
+    store._ck(store.aio.read(store._path(i, "m"), m), f"read m[{i}]")
+    store._ck(store.aio.read(store._path(i, "v"), v), f"read v[{i}]")
+    return m, v
+
+
+def _find_named_subtree(state, names) -> Optional[Any]:
+    """Locate a moment subtree by field name in a (possibly nested) optax
+    state (ScaleByAdamState.mu etc.)."""
+    if state is None:
+        return None
+    for name in names:
+        if hasattr(state, name):
+            return getattr(state, name)
+    if hasattr(state, "_fields"):  # namedtuple: recurse fields
+        for f in state._fields:
+            found = _find_named_subtree(getattr(state, f), names)
+            if found is not None:
+                return found
+    elif isinstance(state, (tuple, list)):
+        for item in state:
+            found = _find_named_subtree(item, names)
+            if found is not None:
+                return found
+    return None
