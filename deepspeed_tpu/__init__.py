@@ -59,18 +59,43 @@ def initialize(args=None,
     if config is None:
         raise ValueError("DeepSpeed requires --deepspeed_config or the config kwarg")
 
-    def _wants_pipeline(cfg):
+    def _cfg_dict(cfg):
         if isinstance(cfg, str):
             import json
             try:
                 with open(cfg) as f:
-                    cfg = json.load(f)
+                    return json.load(f)
             except Exception:
-                return False
-        return isinstance(cfg, dict) and \
-            int(cfg.get("pipeline_parallel_size", 1)) > 1
+                return {}
+        return cfg if isinstance(cfg, dict) else {}
 
-    if isinstance(model, PipelineModule) or _wants_pipeline(config):
+    def _wants_pipeline(cfg):
+        return int(_cfg_dict(cfg).get("pipeline_parallel_size", 1)) > 1
+
+    def _wants_hybrid(cfg):
+        return bool(_cfg_dict(cfg).get("hybrid_engine", {}).get("enabled"))
+
+    if _wants_hybrid(config):
+        # reference dispatch: hybrid_engine.enabled → DeepSpeedHybridEngine
+        # (__init__.py:141-181)
+        if isinstance(model, PipelineModule) or _wants_pipeline(config):
+            raise ValueError(
+                "hybrid_engine is incompatible with pipeline parallelism "
+                "(generation needs the whole model per replica); drop "
+                "pipeline_parallel_size / the PipelineModule or disable "
+                "hybrid_engine")
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine
+        engine = DeepSpeedHybridEngine(args=args,
+                                       model=model,
+                                       optimizer=optimizer,
+                                       model_parameters=model_parameters,
+                                       training_data=training_data,
+                                       lr_scheduler=lr_scheduler,
+                                       mpu=mpu,
+                                       collate_fn=collate_fn,
+                                       config=config,
+                                       mesh_manager=mesh_manager)
+    elif isinstance(model, PipelineModule) or _wants_pipeline(config):
         engine = PipelineEngine(args=args,
                                 model=model,
                                 optimizer=optimizer,

@@ -219,17 +219,10 @@ def all_reduce(x, op: str = ReduceOp.SUM, axis_name="data"):
     if op == ReduceOp.MIN:
         return lax.pmin(x, axis_name)
     if op == ReduceOp.PRODUCT:
-        # sign/zero-safe product: exp(psum(log)) alone NaNs on x<=0
-        neg_parity = lax.psum((x < 0).astype(jnp.int32), axis_name) % 2
-        any_zero = lax.pmax((x == 0).astype(jnp.int32), axis_name)
-        log_mag = lax.psum(
-            jnp.log(jnp.maximum(jnp.abs(x), jnp.finfo(jnp.float32).tiny)),
-            axis_name)
-        signed = jnp.exp(log_mag) * jnp.where(neg_parity == 1, -1.0, 1.0)
-        out = jnp.where(any_zero == 1, 0.0, signed)
-        if jnp.issubdtype(x.dtype, jnp.integer):
-            out = jnp.round(out)  # exp/log lands epsilon below the integer
-        return out.astype(x.dtype)
+        # EXACT product via all_gather + prod (an exp(psum(log)) trick NaNs
+        # on x<=0 and loses integer precision past 2^24). PRODUCT reduces
+        # are rare and small; the O(world) gather is the honest primitive.
+        return jnp.prod(lax.all_gather(x, axis_name), axis=0)
     raise ValueError(f"Unsupported reduce op {op}")
 
 

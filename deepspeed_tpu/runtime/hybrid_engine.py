@@ -1,0 +1,137 @@
+"""Hybrid engine — RLHF train↔generate mode flipping.
+
+Capability match for the reference DeepSpeedHybridEngine
+(runtime/hybrid_engine.py:32): one engine that trains (actor update) AND
+generates (experience collection) from the same weights. The reference
+builds inference containers sharing training tensors (:272), flips modes
+via eval()/train(), and routes ZeRO-3 generation through per-layer gathers
+(:333). TPU-native translation:
+
+  - generation runs through the InferenceEngine's compiled
+    prefill + scan-decode programs (inference/engine.py), built ONCE per
+    (shape, sampling) bucket over the SAME mesh as training;
+  - the serving param copy is a jitted cast/re-shard of the live training
+    params (ZeRO-3 dp-sharded → serving layout in one all-gather — the
+    reference's gather-per-layer generation path collapsed into one
+    resharding program), refreshed lazily when the global step advances;
+  - train()/eval() flip a flag; generate() while training is an error in
+    train mode only if params changed mid-accumulation (matching the
+    reference's guard rails, inference/engine.py:588-style).
+
+LoRA fuse/unfuse (:120-146) is a torch-module mutation with no analogue
+here: a functional model bakes adapters into its apply, so there is
+nothing to fuse — documented divergence, not a missing path.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist, logger
+from .engine import DeepSpeedEngine
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    """DeepSpeedEngine + generate(). Enabled by config
+    ``hybrid_engine.enabled`` (reference config surface)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        hcfg = dict((self._config._param_dict or {}).get("hybrid_engine", {}))
+        self._he_max_tokens = int(hcfg.get("max_out_tokens", 512))
+        self._he_tp = int(hcfg.get("inference_tp_size",
+                                   self.mesh_manager.tp)) or 1
+        if self._he_tp != self.mesh_manager.tp:
+            logger.warning(
+                f"hybrid_engine.inference_tp_size={self._he_tp} differs from "
+                f"the training mesh tp={self.mesh_manager.tp}: generation "
+                f"shares the training mesh, so the training tp applies")
+            self._he_tp = self.mesh_manager.tp
+        self._gen_engine = None
+        self._gen_params_step = -1
+        self._gen_src = None         # the params tree the serving copy mirrors
+        self._gen_cast_fn = None
+        if not (hasattr(self.module, "init_kv_cache") and
+                hasattr(self.module, "apply_with_cache")):
+            raise ValueError(
+                "hybrid_engine requires a model with a KV-cache decode path "
+                "(init_kv_cache/apply_with_cache), e.g. GPT2Model")
+        log_dist(f"HybridEngine: generation tp={self._he_tp} "
+                 f"max_out_tokens={self._he_max_tokens}", ranks=[0])
+
+    # -- mode flips ------------------------------------------------------
+    def eval(self):
+        """Reference API shape (train()/eval() mode flip). Generation here
+        is allowed in either mode — the only real guard is the
+        mid-accumulation check in generate() — so these are no-ops kept
+        for call-site compatibility."""
+        return self
+
+    def train(self, mode: bool = True):
+        return self
+
+    # -- generation ------------------------------------------------------
+    def _serving_engine(self):
+        from ..inference.config import DeepSpeedInferenceConfig
+        from ..inference.engine import InferenceEngine
+        if self._gen_engine is None:
+            dtype = ("bfloat16" if self._compute_dtype == jnp.bfloat16 else
+                     "float16" if self._compute_dtype == jnp.float16 else
+                     "float32")
+            icfg = DeepSpeedInferenceConfig.from_dict({
+                "dtype": dtype,
+                "max_tokens": self._he_max_tokens,
+                "tensor_parallel": {"tp_size": self._he_tp},
+            })
+            self._gen_engine = InferenceEngine(
+                self.module, icfg, params=self._live_params(),
+                mesh_manager=self.mesh_manager)
+            self._mark_serving_fresh()
+        elif self._serving_stale():
+            self._refresh_serving_params()
+        return self._gen_engine
+
+    def _serving_stale(self) -> bool:
+        """Weights changed since the serving copy was made: an optimizer
+        step bumped global_steps, OR the params tree object was replaced
+        (load_checkpoint, safe_set_full_fp32_param — every mutation path
+        reassigns engine.params)."""
+        return (self._gen_params_step != self.global_steps or
+                self._gen_src is not self.params)
+
+    def _mark_serving_fresh(self):
+        self._gen_params_step = self.global_steps
+        self._gen_src = self.params
+
+    def _live_params(self):
+        """Current fp32-master view of the weights (offload-aware)."""
+        if self._offload is not None:
+            return self._offload.masters_tree(copy=False)
+        return self.params
+
+    def _refresh_serving_params(self):
+        """Re-shard/cast the live training params into the serving layout —
+        the reference's ZeRO-3 gather-for-generation (:333) as ONE jitted
+        resharding."""
+        eng = self._gen_engine
+        if self._gen_cast_fn is None:  # compile the resharding cast ONCE
+            self._gen_cast_fn = jax.jit(
+                lambda p: jax.tree.map(eng._cast_leaf, p),
+                out_shardings=eng.param_shardings)
+        with eng.mesh:
+            eng.params = self._gen_cast_fn(self._live_params())
+        self._mark_serving_fresh()
+
+    def generate(self, input_ids, **kwargs):
+        """Autoregressive generation from the CURRENT weights (the RLHF
+        experience-collection call). See InferenceEngine.generate."""
+        if self._grad_acc_count:
+            raise RuntimeError(
+                "generate() mid-accumulation: finish the optimizer step "
+                "first (pending grads would be stale after generation "
+                "refreshes the serving params)")
+        return self._serving_engine().generate(input_ids, **kwargs)
+
+    def forward_logits(self, input_ids):
+        """Full-sequence logits under the serving layout (reward/critic
+        scoring passes in RLHF loops)."""
+        return self._serving_engine().forward(input_ids)

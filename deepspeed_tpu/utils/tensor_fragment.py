@@ -18,7 +18,6 @@ param_path_tree), e.g. "blocks/attn_w" or "layers/3/w".
 from typing import Any, List, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..models.api import param_path_tree
@@ -95,9 +94,11 @@ def safe_get_full_grad(engine, path: str) -> Optional[np.ndarray]:
         return None
     i = _leaf_index(engine.params, path)
     g = _gather_leaf(engine, jax.tree.leaves(buf)[i]).astype(np.float32)
-    # the buffer holds grads of scale*loss summed over micro-batches;
-    # return the TRUE accumulated gradient (reference contract)
-    return g / float(engine.scaler_state.scale)
+    # the buffer holds grads of scale*loss SUMMED over micro-batches;
+    # return the effective gradient step() will apply: /(scale * count)
+    denom = float(engine.scaler_state.scale) * max(
+        1, getattr(engine, "_grad_acc_count", 1))
+    return g / denom
 
 
 _STATE_ALIASES = {

@@ -1,0 +1,110 @@
+"""Hybrid engine tests (reference tests/hybrid_engine): train↔generate on
+shared weights — generation reflects updated params after each step, guard
+rails, and the RLHF-ish loop of generate→train."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+TINY = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                  n_head=4, pad_vocab_to_multiple=8)
+
+
+def _engine(**over):
+    cfg = {
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "steps_per_print": 0,
+        "hybrid_engine": {"enabled": True, "max_out_tokens": 64},
+    }
+    cfg.update(over)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(TINY),
+                                               config=cfg)
+    return engine
+
+
+def test_dispatch_builds_hybrid_engine():
+    engine = _engine()
+    assert isinstance(engine, DeepSpeedHybridEngine)
+
+
+def test_generate_then_train_then_generate_differs():
+    engine = _engine()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 255, (2, 8)).astype(np.int32)
+    out1 = np.asarray(engine.generate(prompt, max_new_tokens=8,
+                                      temperature=0.0))
+    assert out1.shape == (2, 16)
+    np.testing.assert_array_equal(out1[:, :8], prompt)
+    # big LR steps move the weights; greedy generation must change with them
+    for _ in range(8):
+        engine.train_batch(batch={"input_ids": rng.integers(
+            0, 255, (1, 8, 16), np.int32)})
+    out2 = np.asarray(engine.generate(prompt, max_new_tokens=8,
+                                      temperature=0.0))
+    assert out2.shape == (2, 16)
+    assert not np.array_equal(out1, out2), \
+        "generation ignored the weight updates"
+
+
+def test_generate_mid_accumulation_raises():
+    engine = _engine(gradient_accumulation_steps=2, train_batch_size=16)
+    rng = np.random.default_rng(1)
+    engine.forward({"input_ids": rng.integers(0, 255, (8, 16), np.int32)})
+    engine.backward()
+    with pytest.raises(RuntimeError, match="mid-accumulation"):
+        engine.generate(rng.integers(0, 255, (1, 8)).astype(np.int32))
+
+
+def test_rlhf_style_loop_trains():
+    """generate (experience) → train on it → loss finite across rounds."""
+    engine = _engine()
+    rng = np.random.default_rng(2)
+    for _ in range(3):
+        prompt = rng.integers(0, 255, (8, 8)).astype(np.int32)
+        seqs = np.asarray(engine.generate(prompt, max_new_tokens=8,
+                                          temperature=1.0, top_k=50,
+                                          seed=int(rng.integers(1 << 30))))
+        loss = engine.train_batch(batch={"input_ids": seqs[None].astype(
+            np.int32)})
+        assert np.isfinite(float(loss))
+
+
+def test_eval_train_mode_flip():
+    """Reference call-site compatibility: both return the engine."""
+    engine = _engine()
+    assert engine.eval() is engine
+    assert engine.train() is engine
+
+
+def test_set_param_refreshes_generation():
+    """Weight writes outside optimizer steps must reach generation (the
+    serving copy is identity-tracked, not just step-tracked)."""
+    from deepspeed_tpu.utils.tensor_fragment import (
+        safe_get_full_fp32_param, safe_set_full_fp32_param)
+    engine = _engine()
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 255, (1, 8)).astype(np.int32)
+    out1 = np.asarray(engine.generate(prompt, max_new_tokens=8))
+    w = safe_get_full_fp32_param(engine, "wte")
+    safe_set_full_fp32_param(engine, "wte",
+                             rng.normal(size=w.shape).astype(np.float32))
+    out2 = np.asarray(engine.generate(prompt, max_new_tokens=8))
+    assert not np.array_equal(out1, out2), \
+        "generation served stale weights after safe_set_full_fp32_param"
+
+
+def test_requires_cache_capable_model():
+    from deepspeed_tpu.models.api import FunctionalModel
+    m = FunctionalModel(lambda rng: {"w": jnp.zeros((2,))},
+                        lambda p, b, rng=None, train=True: jnp.float32(0.0))
+    with pytest.raises(ValueError, match="KV-cache"):
+        deepspeed_tpu.initialize(model=m, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "hybrid_engine": {"enabled": True}})
