@@ -1,0 +1,9 @@
+"""Compression library (reference deepspeed/compression): QAT, pruning,
+layer reduction, scheduler."""
+
+from .compress import (CompressedModel, CompressionScheduler,
+                       init_compression, redundancy_clean)
+from .config import CompressionConfig
+
+__all__ = ["init_compression", "redundancy_clean", "CompressedModel",
+           "CompressionScheduler", "CompressionConfig"]

@@ -1,0 +1,220 @@
+"""Compression-aware training (QAT / pruning).
+
+Capability match for the reference compression library
+(compression/compress.py:95 ``init_compression``, basic_layer.py:121
+``LinearLayer_Compress``, scheduler.py ``compression_scheduler``): weight
+quantization-aware training, magnitude/structured pruning, and a step
+scheduler that switches techniques on after their offset.
+
+TPU-native design: the reference rewrites nn.Modules in place; here
+``init_compression(model, config)`` returns a WRAPPED ModelSpec whose apply
+transforms the param pytree — fake-quantizing / masking every leaf whose
+path matches a configured group — before the inner model runs. The
+transforms are pure jnp (ops/quantizer_ops fake_quantize + top-k masks), so
+they trace into the SAME compiled train step; flipping a technique on at
+its schedule_offset retraces once (the engine recompiles when the scheduler
+reports a flip)."""
+
+import re
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from ..models.api import ModelSpec, param_path_tree
+from ..ops.quantizer_ops import fake_quantize
+from ..utils.logging import log_dist
+from .config import CompressionConfig, TechniqueConfig
+
+
+def _match(path: str, patterns: List[str]) -> bool:
+    for pat in patterns:
+        if pat == "*" or re.search(pat, path):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------- transforms
+def quantize_leaf(w, params: Dict[str, Any]):
+    """QAT fake-quant (LinearLayer_Compress weight quantization)."""
+    bits = int(params.get("target_bits", params.get("bits", 8)))
+    groups = int(params.get("quantization_groups", params.get("groups", 1)))
+    sym = params.get("quantization_type", "symmetric") != "asymmetric"
+    if w.size % max(groups, 1) != 0:
+        groups = 1
+    return fake_quantize(w, groups=groups, bits=bits, symmetric=sym)
+
+
+def sparse_prune_leaf(w, params: Dict[str, Any]):
+    """Unstructured magnitude pruning at `dense_ratio` kept weights."""
+    ratio = float(params.get("dense_ratio", 0.5))
+    k = max(1, int(round(w.size * ratio)))
+    flat = jnp.abs(w.reshape(-1))
+    thresh = jnp.sort(flat)[w.size - k]
+    return jnp.where(jnp.abs(w) >= thresh, w, jnp.zeros_like(w))
+
+
+def row_prune_leaf(w, params: Dict[str, Any]):
+    """Structured row pruning: keep the highest-L1 rows (2D leaves)."""
+    if w.ndim < 2:
+        return w
+    ratio = float(params.get("dense_ratio", 0.5))
+    rows = w.shape[0]
+    k = max(1, int(round(rows * ratio)))
+    norms = jnp.sum(jnp.abs(w.reshape(rows, -1)), axis=1)
+    thresh = jnp.sort(norms)[rows - k]
+    mask = (norms >= thresh).astype(w.dtype)
+    return w * mask.reshape((rows,) + (1,) * (w.ndim - 1))
+
+
+def head_prune_leaf(w, params: Dict[str, Any]):
+    """Attention-head pruning: zero whole heads by output-column blocks of
+    an attention projection (num_heads from the group params)."""
+    heads = int(params.get("num_heads", 1))
+    if heads <= 1 or w.ndim < 2 or w.shape[-1] % heads != 0:
+        return w
+    ratio = float(params.get("dense_ratio", 0.5))
+    keep = max(1, int(round(heads * ratio)))
+    hd = w.shape[-1] // heads
+    blocks = w.reshape(w.shape[:-1] + (heads, hd))
+    norms = jnp.sum(jnp.abs(blocks.reshape(-1, heads, hd)), axis=(0, 2))
+    thresh = jnp.sort(norms)[heads - keep]
+    mask = (norms >= thresh).astype(w.dtype)
+    return (blocks * mask[:, None]).reshape(w.shape)
+
+
+_TRANSFORMS = [
+    ("sparse_pruning", sparse_prune_leaf),
+    ("row_pruning", row_prune_leaf),
+    ("head_pruning", head_prune_leaf),
+    ("weight_quantization", quantize_leaf),   # quant LAST (after masks)
+]
+
+
+class CompressionScheduler:
+    """Step scheduler (reference compression/scheduler.py): a technique is
+    LIVE once global_step >= its schedule_offset. step() returns True when
+    any liveness flips — the engine's cue to retrace."""
+
+    def __init__(self, config: CompressionConfig):
+        self.config = config
+        self.global_step = 0
+        self._live = {}
+        self._update()
+
+    def _update(self):
+        changed = False
+        for name, _ in _TRANSFORMS:
+            tc: TechniqueConfig = getattr(self.config, name)
+            live = bool(tc and tc.enabled and
+                        self.global_step >= tc.schedule_offset)
+            if self._live.get(name) != live:
+                self._live[name] = live
+                changed = True
+        return changed
+
+    def is_live(self, name: str) -> bool:
+        return self._live.get(name, False)
+
+    def step(self, global_step: int) -> bool:
+        self.global_step = global_step
+        return self._update()
+
+
+class CompressedModel(ModelSpec):
+    """ModelSpec wrapper applying the live transforms to matching leaves."""
+
+    def __init__(self, inner: ModelSpec, config: CompressionConfig):
+        self.inner = inner
+        self.compression_config = config
+        self.compression_scheduler = CompressionScheduler(config)
+        self.config = getattr(inner, "config", None)
+
+    def init(self, rng):
+        return self.inner.init(rng)
+
+    def compress_params(self, params, force_all: bool = False):
+        """Apply the live transforms (force_all: every ENABLED technique
+        regardless of schedule — the export/redundancy_clean path, which
+        may run in a fresh process whose scheduler sits at step 0)."""
+        paths = param_path_tree(params)
+        for name, fn in _TRANSFORMS:
+            tc: TechniqueConfig = getattr(self.compression_config, name)
+            live = (tc is not None and tc.enabled) if force_all else \
+                self.compression_scheduler.is_live(name)
+            if not live:
+                continue
+
+            def leaf(path, w):
+                if not hasattr(w, "ndim") or not jnp.issubdtype(
+                        w.dtype, jnp.floating):
+                    return w
+                for group in tc.groups:
+                    if _match(path, group.modules):
+                        return fn(w, group.params)
+                return w
+
+            params = jax.tree.map(leaf, paths, params)
+        return params
+
+    def apply(self, params, batch, rng=None, train=True):
+        return self.inner.apply(self.compress_params(params), batch,
+                                rng=rng, train=train)
+
+    # inference surfaces see the SAME compressed weights as training —
+    # otherwise serve/train behavior silently diverges
+    def logits(self, params, *args, **kwargs):
+        return self.inner.logits(self.compress_params(params), *args,
+                                 **kwargs)
+
+    def apply_with_cache(self, params, *args, **kwargs):
+        return self.inner.apply_with_cache(self.compress_params(params),
+                                           *args, **kwargs)
+
+    def partition_rules(self):
+        return self.inner.partition_rules()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def init_compression(model, deepspeed_config, mpu=None) -> CompressedModel:
+    """Reference entrypoint (compress.py:95): wrap the model per the
+    `compression_training` block; no-op wrap if nothing is enabled."""
+    if hasattr(deepspeed_config, "_param_dict"):
+        deepspeed_config = deepspeed_config._param_dict
+    if isinstance(deepspeed_config, str):
+        import json
+        with open(deepspeed_config) as f:
+            deepspeed_config = json.load(f)
+    config = CompressionConfig.parse(deepspeed_config)
+    # honesty about unimplemented blocks: accepted-and-ignored config is
+    # worse than an error
+    from ..utils.logging import logger
+    if config.activation_quantization and \
+            config.activation_quantization.enabled:
+        logger.warning(
+            "compression: activation_quantization is NOT implemented "
+            "(requires model-internal hooks); the block is ignored")
+    if config.layer_reduction.get("enabled"):
+        logger.warning("compression: layer_reduction is NOT implemented; "
+                       "the block is ignored")
+    implemented = [n for n, _ in _TRANSFORMS
+                   if getattr(config, n) and getattr(config, n).enabled]
+    if not implemented:
+        log_dist("init_compression: no implemented technique enabled; "
+                 "model unchanged", ranks=[0])
+        return model
+    wrapped = CompressedModel(model, config)
+    log_dist(f"init_compression: techniques={implemented}", ranks=[0])
+    return wrapped
+
+
+def redundancy_clean(model, deepspeed_config=None):
+    """Reference post-training cleanup (compress.py redundancy_clean):
+    bakes every ENABLED transform into the weights permanently (not just
+    the currently-live ones — export may run in a fresh process whose
+    scheduler is at step 0). Returns params -> cleaned params."""
+    if isinstance(model, CompressedModel):
+        return lambda p: model.compress_params(p, force_all=True)
+    return lambda p: p

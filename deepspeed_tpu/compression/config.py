@@ -1,0 +1,69 @@
+"""Compression config parsing (reference compression/config.py +
+constants.py schema): the `compression_training` block with
+weight_quantization / activation_quantization / sparse_pruning /
+row_pruning / head_pruning / layer_reduction groups. Each technique has
+shared_parameters (enabled, schedule_offset, ...) and different_groups
+({name: {params: {...}, modules: [patterns]}})."""
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class TechniqueGroup:
+    name: str
+    params: Dict[str, Any]
+    modules: List[str]          # regex/substring patterns over param paths
+    related_modules: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class TechniqueConfig:
+    enabled: bool = False
+    schedule_offset: int = 0
+    shared: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    groups: List[TechniqueGroup] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def parse(cls, block: Dict[str, Any]) -> "TechniqueConfig":
+        shared = dict(block.get("shared_parameters", {}))
+        groups = []
+        for name, g in (block.get("different_groups") or {}).items():
+            groups.append(TechniqueGroup(
+                name=name, params=dict(g.get("params", {})),
+                modules=list(g.get("modules", ["*"])),
+                related_modules=g.get("related_modules")))
+        return cls(enabled=bool(shared.get("enabled", False)),
+                   schedule_offset=int(shared.get("schedule_offset", 0)),
+                   shared=shared, groups=groups)
+
+
+@dataclasses.dataclass
+class CompressionConfig:
+    weight_quantization: TechniqueConfig = None
+    activation_quantization: TechniqueConfig = None
+    sparse_pruning: TechniqueConfig = None
+    row_pruning: TechniqueConfig = None
+    head_pruning: TechniqueConfig = None
+    layer_reduction: Dict[str, Any] = None
+
+    @classmethod
+    def parse(cls, ds_config: Dict[str, Any]) -> "CompressionConfig":
+        block = (ds_config or {}).get("compression_training", {}) or {}
+        return cls(
+            weight_quantization=TechniqueConfig.parse(
+                block.get("weight_quantization", {})),
+            activation_quantization=TechniqueConfig.parse(
+                block.get("activation_quantization", {})),
+            sparse_pruning=TechniqueConfig.parse(
+                block.get("sparse_pruning", {})),
+            row_pruning=TechniqueConfig.parse(
+                block.get("row_pruning", {})),
+            head_pruning=TechniqueConfig.parse(
+                block.get("head_pruning", {})),
+            layer_reduction=dict(block.get("layer_reduction", {}) or {}))
+
+    def any_enabled(self) -> bool:
+        return any(t is not None and t.enabled for t in (
+            self.weight_quantization, self.activation_quantization,
+            self.sparse_pruning, self.row_pruning, self.head_pruning))

@@ -78,12 +78,10 @@ class InferenceEngine:
         rng = jax.random.PRNGKey(config.seed)
         param_shapes = jax.eval_shape(model.init, rng)
         self.param_shardings = self.planner.param_shardings(param_shapes)
+        self._recast_fn = None
         with self.mesh:
             if params is not None:
-                cast = jax.jit(
-                    lambda p: jax.tree.map(self._cast_leaf, p),
-                    out_shardings=self.param_shardings)
-                self.params = cast(params)
+                self.params = self.recast(params)
             else:
                 self.params = jax.jit(
                     lambda r: jax.tree.map(self._cast_leaf, model.init(r)),
@@ -105,6 +103,17 @@ class InferenceEngine:
         if jnp.issubdtype(x.dtype, jnp.floating):
             return x.astype(self.dtype)
         return x
+
+    def recast(self, params):
+        """Cast/re-shard a params tree into the serving layout — compiled
+        ONCE; the hybrid engine refreshes through this after every
+        optimizer step."""
+        if self._recast_fn is None:
+            self._recast_fn = jax.jit(
+                lambda p: jax.tree.map(self._cast_leaf, p),
+                out_shardings=self.param_shardings)
+        with self.mesh:
+            return self._recast_fn(params)
 
     def _batch_sharding(self, batch_size: int):
         """Serving batches can be any size: shard over the dp axes only when
@@ -259,8 +268,5 @@ class InferenceEngine:
 
     def half(self):
         """Reference API: cast to fp16 (here: the configured low dtype)."""
-        with self.mesh:
-            self.params = jax.jit(lambda p: jax.tree.map(self._cast_leaf, p),
-                                  out_shardings=self.param_shardings)(
-                self.params)
+        self.params = self.recast(self.params)
         return self

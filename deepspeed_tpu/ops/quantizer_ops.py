@@ -79,13 +79,21 @@ def fake_quantize(x, groups: int = 1, bits: int = 8, symmetric: bool = True,
                   stochastic: bool = False, rng=None):
     """quantize→dequantize (the reference ds_quantize_fp32/fp16 semantics:
     returns the quantization-error-injected tensor in the input dtype) —
-    the QAT/MoQ primitive."""
+    the QAT/MoQ primitive.
+
+    Straight-through estimator: the VALUE is the quantized tensor but the
+    GRADIENT flows as identity (x + stop_grad(q(x) - x)) — without this,
+    round() kills the gradient and quantization-aware TRAINING never
+    trains (reference fake_quantizer.cu relies on torch's autograd-opaque
+    kernel for the same effect)."""
     out = quantize(x, groups, bits, symmetric, stochastic, rng)
     if symmetric:
         q, scale = out
-        return dequantize(q, scale, groups=groups).astype(x.dtype)
-    q, scale, zero = out
-    return dequantize(q, scale, zero, groups=groups).astype(x.dtype)
+        deq = dequantize(q, scale, groups=groups).astype(x.dtype)
+    else:
+        q, scale, zero = out
+        deq = dequantize(q, scale, zero, groups=groups).astype(x.dtype)
+    return x + jax.lax.stop_gradient(deq - x)
 
 
 def quantization_error(x, groups=1, bits=8, symmetric=True):

@@ -681,6 +681,13 @@ class DeepSpeedEngine:
     def _post_step(self, metrics):
         self._emit_flops_report(metrics)
         self.global_steps += 1
+        # compression scheduler (reference engine.py:1955): a technique
+        # going live changes the traced program — recompile once
+        sched = getattr(self.module, "compression_scheduler", None)
+        if sched is not None and sched.step(self.global_steps):
+            log_dist(f"compression schedule flipped at step "
+                     f"{self.global_steps}; recompiling", ranks=[0])
+            self._compile_fns()
         self.global_samples += self._config.train_batch_size
         overflow = bool(metrics.get("overflow", False))
         if overflow:

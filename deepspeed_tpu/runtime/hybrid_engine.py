@@ -49,7 +49,6 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._gen_engine = None
         self._gen_params_step = -1
         self._gen_src = None         # the params tree the serving copy mirrors
-        self._gen_cast_fn = None
         if not (hasattr(self.module, "init_kv_cache") and
                 hasattr(self.module, "apply_with_cache")):
             raise ValueError(
@@ -113,12 +112,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         the reference's ZeRO-3 gather-for-generation (:333) as ONE jitted
         resharding."""
         eng = self._gen_engine
-        if self._gen_cast_fn is None:  # compile the resharding cast ONCE
-            self._gen_cast_fn = jax.jit(
-                lambda p: jax.tree.map(eng._cast_leaf, p),
-                out_shardings=eng.param_shardings)
-        with eng.mesh:
-            eng.params = self._gen_cast_fn(self._live_params())
+        eng.params = eng.recast(self._live_params())
         self._mark_serving_fresh()
 
     def generate(self, input_ids, **kwargs):

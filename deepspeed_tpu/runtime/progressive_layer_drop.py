@@ -1,0 +1,51 @@
+"""Progressive layer drop (reference runtime/progressive_layer_drop.py:
+``ProgressiveLayerDrop``, 40 LoC; engine injects its theta into forward
+kwargs at engine.py:1667): layers are stochastically skipped with keep
+probability theta(t) that anneals from 1 toward `theta`; deeper layers drop
+more (the PLD paper's i/L scaling). Models opt in by calling
+``should_keep``/``apply_pld`` around their blocks."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self, global_step: int = None) -> float:
+        if global_step is not None:
+            self.update_state(global_step)
+        return self.current_theta
+
+    def update_state(self, global_step: int):
+        # reference schedule: (1 - theta) * exp(-gamma * t) + theta
+        self.current_theta = (1.0 - self.theta) * math.exp(
+            -self.gamma * global_step) + self.theta
+        return self.current_theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True,
+                "pld_theta": self.get_theta()}
+
+
+def keep_prob_for_layer(theta: float, layer_idx: int, n_layers: int) -> float:
+    """Per-layer keep probability: deeper layers drop more (1 - i/L*(1-θ))."""
+    return 1.0 - (layer_idx + 1) / max(1, n_layers) * (1.0 - theta)
+
+
+def apply_pld(layer_fn, x, rng, keep_prob):
+    """Stochastic depth around one residual block: run layer_fn with
+    probability keep_prob (output scaled 1/p at train time), else pass x
+    through. Traced-safe (lax.cond on a sampled bernoulli)."""
+    if rng is None or keep_prob >= 1.0:
+        return layer_fn(x)
+    keep = jax.random.bernoulli(rng, keep_prob)
+    return jax.lax.cond(keep,
+                        lambda v: layer_fn(v) / keep_prob,
+                        lambda v: v, x)

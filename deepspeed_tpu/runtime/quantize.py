@@ -1,0 +1,112 @@
+"""MoQ — Mixture-of-Quantization training-time weight quantizer.
+
+Capability match for the reference MoQ stack (runtime/quantize.py:180LoC
+``Quantizer`` + weight_quantizer.py:153 ``WeightQuantization``): weights are
+fake-quantized during training with a precision that RAMPS from start_bits
+to target_bits every `quantize_period` steps (period doubling), optionally
+gated by Hessian eigenvalues (runtime/eigenvalue.py) so sensitive layers
+keep precision longer. Config block: `quantize_training` (same keys)."""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.api import param_path_tree
+from ..ops.quantizer_ops import fake_quantize
+from ..utils.logging import log_dist
+
+
+class Quantizer:
+
+    def __init__(self, q_target_bits: int = 8, q_start_bits: int = 16,
+                 q_period: int = 100, q_offset: int = 100,
+                 q_groups: int = 1, q_mixed_fp16: bool = False,
+                 q_change_ratio: float = 0.001, q_type: str = "symmetric",
+                 q_rounding: str = "nearest", q_verbose: bool = False,
+                 use_quantizer_kernel: bool = True,
+                 layer_num: int = 0):
+        self.target_bits = q_target_bits
+        self.start_bits = q_start_bits
+        self.period = max(1, q_period)
+        self.offset = q_offset
+        self.groups = max(1, q_groups)
+        self.symmetric = q_type != "asymmetric"
+        self.stochastic = q_rounding == "stochastic"
+        self.verbose = q_verbose
+        self.current_bits = q_start_bits
+        self._next_switch = q_offset
+        self._cur_period = self.period
+
+    def update(self, global_step: int,
+               eigenvalues: Optional[Dict[str, float]] = None) -> bool:
+        """Advance the precision schedule; True if bits changed. With
+        eigenvalues, the switch is postponed while curvature is above the
+        median (the reference's eigenvalue-gated switching)."""
+        if self.current_bits <= self.target_bits or \
+                global_step < self._next_switch:
+            return False
+        if eigenvalues:
+            vals = sorted(eigenvalues.values())
+            median = vals[len(vals) // 2]
+            if max(vals) > 2.0 * max(median, 1e-12):
+                self._next_switch = global_step + self._cur_period
+                return False
+        self.current_bits = max(self.target_bits, self.current_bits // 2)
+        self._cur_period *= 2  # reference: doubling periods between drops
+        self._next_switch = global_step + self._cur_period
+        log_dist(f"MoQ: precision -> {self.current_bits} bits at step "
+                 f"{global_step}", ranks=[0])
+        return True
+
+    def quantize(self, params, modules=("",), rng=None):
+        """Fake-quantize matching leaves at the CURRENT precision
+        (>= 16 bits = identity)."""
+        if self.current_bits >= 16:
+            return params
+        paths = param_path_tree(params)
+        i = [0]
+
+        def leaf(path, w):
+            if not hasattr(w, "ndim") or w.ndim < 2 or \
+                    not jnp.issubdtype(w.dtype, jnp.floating):
+                return w
+            if not any(m in path for m in modules):
+                return w
+            groups = self.groups if w.size % self.groups == 0 else 1
+            key = None
+            if self.stochastic:
+                base = rng if rng is not None else jax.random.PRNGKey(0)
+                key = jax.random.fold_in(base, i[0])
+            i[0] += 1
+            return fake_quantize(w, groups=groups, bits=self.current_bits,
+                                 symmetric=self.symmetric,
+                                 stochastic=self.stochastic, rng=key)
+
+        return jax.tree.map(leaf, paths, params)
+
+
+class WeightQuantization:
+    """Offline export quantizer (reference weight_quantizer.py): quantize a
+    trained checkpoint's matching weights for serving."""
+
+    def __init__(self, mlp_extra_grouping: bool = False, mp_size: int = 1):
+        self.mlp_extra_grouping = mlp_extra_grouping
+
+    def quantize_tree(self, params, bits: int = 8, groups: int = 1,
+                      modules=("",)):
+        paths = param_path_tree(params)
+
+        def leaf(path, w):
+            if not hasattr(w, "ndim") or w.ndim < 2 or \
+                    not jnp.issubdtype(w.dtype, jnp.floating):
+                return w
+            if not any(m in path for m in modules):
+                return w
+            g = groups * (2 if self.mlp_extra_grouping and "mlp" in path
+                          else 1)
+            if w.size % g != 0:
+                g = 1
+            return fake_quantize(w, groups=g, bits=bits, symmetric=True)
+
+        return jax.tree.map(leaf, paths, params)

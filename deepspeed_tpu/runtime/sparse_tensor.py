@@ -1,0 +1,60 @@
+"""Sparse gradient representation.
+
+Capability match for the reference SparseTensor (runtime/sparse_tensor.py +
+engine.sparse_allreduce, engine.py:2283-2354: allgather-based reduction of
+sparse embedding grads). Under SPMD the gradient reduction happens inside
+the compiled program, so the torch-side "allgather indices+values then
+scatter" machinery has no wire role — what remains useful is the COO
+container itself (host-side sparse grads for offload/comm experiments) and
+the dense↔sparse conversions, which this module provides with the
+reference's API names."""
+
+from typing import Tuple
+
+import numpy as np
+
+
+class SparseTensor:
+    """COO over the FIRST axis (the embedding-row sparsity pattern)."""
+
+    def __init__(self, indices: np.ndarray, values: np.ndarray,
+                 dense_size: Tuple[int, ...]):
+        self.indices = np.asarray(indices, dtype=np.int64).reshape(-1)
+        self.values = np.asarray(values)
+        self.dense_size = tuple(dense_size)
+        assert self.values.shape[0] == self.indices.shape[0]
+        assert self.values.shape[1:] == self.dense_size[1:]
+
+    @classmethod
+    def from_dense(cls, dense) -> "SparseTensor":
+        dense = np.asarray(dense)
+        rows = np.nonzero(np.any(dense.reshape(dense.shape[0], -1) != 0,
+                                 axis=1))[0]
+        return cls(rows, dense[rows], dense.shape)
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros(self.dense_size, dtype=self.values.dtype)
+        np.add.at(out, self.indices, self.values)
+        return out
+
+    def add(self, other: "SparseTensor") -> "SparseTensor":
+        assert self.dense_size == other.dense_size
+        idx = np.concatenate([self.indices, other.indices])
+        vals = np.concatenate([self.values, other.values])
+        # coalesce duplicate rows
+        uniq, inv = np.unique(idx, return_inverse=True)
+        out = np.zeros((len(uniq),) + self.dense_size[1:],
+                       dtype=vals.dtype)
+        np.add.at(out, inv, vals)
+        return SparseTensor(uniq, out, self.dense_size)
+
+    def sparse_size(self) -> int:
+        return self.indices.size + self.values.size
+
+    @property
+    def nnz_rows(self) -> int:
+        return int(self.indices.size)
+
+    def __repr__(self):
+        return (f"SparseTensor(rows={self.nnz_rows}/{self.dense_size[0]}, "
+                f"dense_size={self.dense_size})")

@@ -1,0 +1,227 @@
+"""Compression / MoQ / eigenvalue / PLD / sparse-tensor tests (reference
+tests/unit/compression): transform numerics, scheduler flips retrace, QAT
+end-to-end through the engine."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (CompressionConfig, init_compression)
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+TINY = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                  n_head=4, pad_vocab_to_multiple=8)
+
+
+def _wq_config(offset=0, bits=8):
+    return {"compression_training": {"weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": offset},
+        "different_groups": {"wq1": {
+            "params": {"target_bits": bits, "quantization_groups": 1},
+            "modules": ["attn", "mlp"]}}}}}
+
+
+def test_init_compression_noop_without_config():
+    model = GPT2Model(TINY)
+    assert init_compression(model, {}) is model
+
+
+def test_weight_quantization_transforms_matching_leaves():
+    model = init_compression(GPT2Model(TINY), _wq_config(bits=4))
+    params = model.init(jax.random.PRNGKey(0))
+    cp = model.compress_params(params)
+    changed = unchanged = 0
+    from deepspeed_tpu.models.api import param_path_tree
+    paths = jax.tree.leaves(param_path_tree(params))
+    for path, a, b in zip(paths, jax.tree.leaves(params),
+                          jax.tree.leaves(cp)):
+        same = np.allclose(np.asarray(a), np.asarray(b))
+        if np.asarray(a).std() == 0:
+            continue  # zero-init biases land exactly on the grid
+        if ("attn" in path or "mlp" in path) and a.ndim >= 2:
+            assert not same, f"{path} not quantized"
+            # 4-bit symmetric: at most 15 distinct levels per tensor
+            assert len(np.unique(np.asarray(b))) <= 15 * a.shape[0]
+            changed += 1
+        elif "wte" in path:
+            assert same, f"{path} unexpectedly transformed"
+            unchanged += 1
+    assert changed > 0 and unchanged > 0
+
+
+def test_sparse_pruning_ratio():
+    from deepspeed_tpu.compression.compress import sparse_prune_leaf
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((64, 64)), dtype=jnp.float32)
+    out = sparse_prune_leaf(w, {"dense_ratio": 0.25})
+    nz = float(jnp.mean((out != 0).astype(jnp.float32)))
+    assert abs(nz - 0.25) < 0.02
+    # surviving weights unchanged
+    mask = np.asarray(out) != 0
+    np.testing.assert_array_equal(np.asarray(out)[mask], np.asarray(w)[mask])
+
+
+def test_row_and_head_pruning():
+    from deepspeed_tpu.compression.compress import (head_prune_leaf,
+                                                    row_prune_leaf)
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal((16, 8)), dtype=jnp.float32)
+    out = row_prune_leaf(w, {"dense_ratio": 0.5})
+    zero_rows = int(np.sum(~np.any(np.asarray(out) != 0, axis=1)))
+    assert zero_rows == 8
+    wh = jnp.asarray(rng.standard_normal((8, 16)), dtype=jnp.float32)
+    out = head_prune_leaf(wh, {"dense_ratio": 0.5, "num_heads": 4})
+    blocks = np.asarray(out).reshape(8, 4, 4)
+    dead = int(np.sum(~np.any(blocks != 0, axis=(0, 2))))
+    assert dead == 2
+
+
+def test_scheduler_offset_flips_and_engine_recompiles():
+    model = init_compression(GPT2Model(TINY), _wq_config(offset=2, bits=8))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "steps_per_print": 0})
+    sched = model.compression_scheduler
+    assert not sched.is_live("weight_quantization")
+    rng = np.random.default_rng(0)
+    for _ in range(4):
+        loss = engine.train_batch(batch={"input_ids": rng.integers(
+            0, 255, (1, 8, 16), np.int32)})
+        assert np.isfinite(float(loss))
+    assert sched.is_live("weight_quantization")
+
+
+# ----------------------------------------------------------------- MoQ
+def test_moq_precision_schedule():
+    from deepspeed_tpu.runtime.quantize import Quantizer
+    q = Quantizer(q_start_bits=16, q_target_bits=4, q_period=10, q_offset=5)
+    assert not q.update(3)
+    assert q.update(6)            # 16 -> 8
+    assert q.current_bits == 8
+    assert not q.update(10)       # period doubled: next at 6+20
+    assert q.update(40)
+    assert q.current_bits == 4
+    assert not q.update(1000)     # at target: no further drops
+
+
+def test_moq_eigenvalue_gating():
+    from deepspeed_tpu.runtime.quantize import Quantizer
+    q = Quantizer(q_start_bits=16, q_target_bits=8, q_period=10, q_offset=0)
+    # high-curvature outlier postpones the switch
+    assert not q.update(5, eigenvalues={"a": 100.0, "b": 1.0, "c": 1.0})
+    assert q.current_bits == 16
+    assert q.update(5 + 10, eigenvalues={"a": 1.0, "b": 1.0, "c": 1.0})
+    assert q.current_bits == 8
+
+
+def test_moq_quantize_tree():
+    from deepspeed_tpu.runtime.quantize import Quantizer
+    q = Quantizer(q_start_bits=8, q_target_bits=8)
+    params = {"mlp_w": jnp.linspace(-1, 1, 64).reshape(8, 8),
+              "bias": jnp.ones((8,))}
+    out = q.quantize(params, modules=("mlp",))
+    assert not np.allclose(np.asarray(out["mlp_w"]),
+                           np.asarray(params["mlp_w"]))
+    np.testing.assert_array_equal(np.asarray(out["bias"]),
+                                  np.asarray(params["bias"]))
+
+
+# ------------------------------------------------------------ eigenvalue
+def test_eigenvalue_power_iteration_quadratic():
+    """For loss = 0.5 x^T A x the Hessian is A: recover its top
+    eigenvalue."""
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+    rng = np.random.default_rng(2)
+    q, _ = np.linalg.qr(rng.standard_normal((6, 6)))
+    eigs = np.array([5.0, 2.0, 1.0, 0.5, 0.2, 0.1])
+    a = jnp.asarray(q @ np.diag(eigs) @ q.T, dtype=jnp.float32)
+
+    def loss(x):
+        return 0.5 * x @ a @ x
+
+    est = Eigenvalue(max_iter=200, tol=1e-4).compute_eigenvalue(
+        loss, jnp.ones((6,)))
+    assert abs(est - 5.0) < 0.05, est
+
+
+def test_eigenvalue_per_layer():
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+    def loss(p):
+        return jnp.sum(3.0 * p["a"] ** 2) + jnp.sum(0.5 * p["b"] ** 2)
+
+    vals = Eigenvalue(max_iter=100).compute_layer_eigenvalues(
+        loss, {"a": jnp.ones((4,)), "b": jnp.ones((4,))})
+    assert abs(vals["a"] - 6.0) < 0.1
+    assert abs(vals["b"] - 1.0) < 0.1
+
+
+# --------------------------------------------------------------- PLD
+def test_pld_theta_schedule_and_layer_scaling():
+    from deepspeed_tpu.runtime.progressive_layer_drop import (
+        ProgressiveLayerDrop, apply_pld, keep_prob_for_layer)
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta(0) == 1.0
+    mid = pld.get_theta(100)
+    assert 0.5 < mid < 1.0
+    assert abs(pld.get_theta(10_000) - 0.5) < 1e-3
+    assert keep_prob_for_layer(0.5, 0, 10) > keep_prob_for_layer(0.5, 9, 10)
+    # expectation preserved: E[apply_pld] ~ layer_fn at train time
+    x = jnp.ones((4,))
+    outs = [apply_pld(lambda v: v * 2, x, jax.random.PRNGKey(i), 0.5)
+            for i in range(200)]
+    mean = np.mean([float(o[0]) for o in outs])
+    # E[out] = p * f(x)/p + (1-p) * x = f(x) + (1-p) x = 2 + 0.5 = 2.5
+    assert abs(mean - 2.5) < 0.4
+
+
+def test_fake_quantize_straight_through_gradient():
+    """QAT regression: round() must NOT kill gradients — the STE makes
+    grad(fake_quantize) ~ identity."""
+    from deepspeed_tpu.ops.quantizer_ops import fake_quantize
+    w = jnp.linspace(-1, 1, 64)
+    g = jax.grad(lambda w: jnp.sum(fake_quantize(w, bits=4) * 2.0))(w)
+    np.testing.assert_allclose(np.asarray(g), 2.0, atol=1e-5)
+
+
+def test_qat_weights_keep_training():
+    """With weight_quantization live from step 0, matching weights must
+    still move (the STE end-to-end check)."""
+    model = init_compression(GPT2Model(TINY), _wq_config(offset=0, bits=8))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "steps_per_print": 0})
+    before = np.asarray(jax.tree.leaves(engine.params)[0]).copy()
+    from deepspeed_tpu.models.api import param_path_tree
+    paths = jax.tree.leaves(param_path_tree(engine.params))
+    i = next(i for i, p in enumerate(paths) if "mlp_fc_w" in p)
+    w0 = np.asarray(jax.tree.leaves(engine.params)[i]).copy()
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        engine.train_batch(batch={"input_ids": rng.integers(
+            0, 255, (1, 8, 16), np.int32)})
+    w1 = np.asarray(jax.tree.leaves(engine.params)[i])
+    assert np.abs(w1 - w0).max() > 1e-5, "quantized weights stopped training"
+
+
+# ------------------------------------------------------------ sparse tensor
+def test_sparse_tensor_roundtrip_and_add():
+    from deepspeed_tpu.runtime.sparse_tensor import SparseTensor
+    dense = np.zeros((10, 4), np.float32)
+    dense[2] = 1.0
+    dense[7] = 2.0
+    st = SparseTensor.from_dense(dense)
+    assert st.nnz_rows == 2
+    np.testing.assert_array_equal(st.to_dense(), dense)
+    other = np.zeros((10, 4), np.float32)
+    other[7] = 3.0
+    other[9] = 1.0
+    summed = st.add(SparseTensor.from_dense(other))
+    np.testing.assert_array_equal(summed.to_dense(), dense + other)
+    assert summed.sparse_size() < dense.size + other.size
