@@ -1,0 +1,5 @@
+"""Autotuning (reference deepspeed/autotuning)."""
+
+from .autotuner import Autotuner, Experiment
+
+__all__ = ["Autotuner", "Experiment"]

@@ -1,0 +1,169 @@
+"""Autotuner — find the best micro-batch / ZeRO-stage configuration.
+
+Capability match for the reference autotuner (autotuning/autotuner.py:42
+``Autotuner``, tune() :404: model-info profile run :664 → micro-batch sweep
+:741 → per-stage tuning space :524; tuner/ grid-and-model-based searchers;
+scheduler.py experiment runner). TPU-native translation: experiments run
+IN-PROCESS — each trial builds a real engine over the live mesh, times a
+few train_batch steps, and tears down (the reference shells out through the
+launcher because NCCL state can't be rebuilt in-process; a jax mesh can).
+OOM-style failures mark the trial infeasible and prune larger micro
+batches, exactly like the reference's memory-aware pruning.
+
+Config block (reference keys): `autotuning`: {enabled, metric
+("throughput"|"latency"), start_profile_step, end_profile_step,
+micro_batch_sizes, zero_stages, max_trials, results_dir}.
+"""
+
+import itertools
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+DEFAULT_MICRO_BATCHES = [1, 2, 4, 8, 16]
+DEFAULT_ZERO_STAGES = [0, 1, 2, 3]
+
+
+class Experiment:
+    def __init__(self, config: Dict[str, Any]):
+        self.config = config
+        self.metric_val: Optional[float] = None
+        self.error: Optional[str] = None
+
+    @property
+    def feasible(self):
+        return self.metric_val is not None
+
+    def summary(self):
+        return {"config": {"train_micro_batch_size_per_gpu":
+                           self.config["train_micro_batch_size_per_gpu"],
+                           "zero_stage":
+                           self.config["zero_optimization"]["stage"]},
+                "metric": self.metric_val, "error": self.error}
+
+
+class Autotuner:
+
+    def __init__(self, model_factory: Callable[[], Any], base_config: Dict,
+                 batch_factory: Callable[[int], Any] = None,
+                 runner: Callable[[Dict], float] = None,
+                 results_dir: Optional[str] = None):
+        """model_factory: () -> fresh ModelSpec per trial.
+        batch_factory: (micro_bs_global) -> one [gas, B, ...] batch.
+        runner: override trial execution (tests); default builds a real
+        engine and measures."""
+        self.model_factory = model_factory
+        self.base_config = dict(base_config)
+        at = dict(self.base_config.get("autotuning", {}))
+        self.metric = at.get("metric", "throughput")
+        self.micro_batches = list(at.get("micro_batch_sizes",
+                                         DEFAULT_MICRO_BATCHES))
+        self.zero_stages = list(at.get("zero_stages", DEFAULT_ZERO_STAGES))
+        self.warmup_steps = int(at.get("start_profile_step", 2))
+        self.profile_steps = max(
+            1, int(at.get("end_profile_step", 5)) - self.warmup_steps)
+        self.max_trials = int(at.get("max_trials", 50))
+        self.results_dir = results_dir or at.get("results_dir")
+        self.batch_factory = batch_factory
+        self.runner = runner or self._run_trial
+        self.experiments: List[Experiment] = []
+
+    # -- trial execution -------------------------------------------------
+    def _trial_config(self, micro_bs: int, stage: int) -> Dict:
+        import copy
+        cfg = copy.deepcopy(self.base_config)
+        cfg.pop("autotuning", None)
+        gas = int(cfg.get("gradient_accumulation_steps", 1))
+        cfg["train_micro_batch_size_per_gpu"] = micro_bs
+        cfg.pop("train_batch_size", None)  # re-derived from micro*gas*dp
+        cfg["gradient_accumulation_steps"] = gas
+        cfg.setdefault("zero_optimization", {})["stage"] = stage
+        cfg["steps_per_print"] = 0
+        return cfg
+
+    def _run_trial(self, cfg: Dict) -> float:
+        """Build a real engine, time train_batch; samples/sec (throughput)
+        or ms/step (latency)."""
+        import deepspeed_tpu
+        from ..parallel import topology
+        topology.reset_mesh()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=self.model_factory(), config=cfg)
+        micro = engine.train_micro_batch_size_per_gpu
+        gas = engine.gradient_accumulation_steps
+        global_bs = micro * engine.dp_world_size
+        make = self.batch_factory or (lambda b: None)
+        batch = make(global_bs)
+        if batch is None:
+            raise ValueError("autotuner needs batch_factory for real runs")
+        loss = None
+        for _ in range(self.warmup_steps):
+            loss = engine.train_batch(batch=batch)
+        if loss is not None:
+            float(loss)  # drain the warmup before timing
+        t0 = time.perf_counter()
+        for _ in range(self.profile_steps):
+            loss = engine.train_batch(batch=batch)
+        float(loss)
+        dt = time.perf_counter() - t0
+        if self.metric == "latency":
+            return -dt * 1e3 / self.profile_steps  # maximize => negate ms
+        return self.profile_steps * gas * global_bs / dt  # samples/sec
+
+    # -- search ----------------------------------------------------------
+    def tune(self) -> Dict:
+        """Sweep (stage × micro batch); prune larger micros after an
+        infeasible one per stage; return the best full config."""
+        best: Optional[Experiment] = None
+        trials = 0
+        for stage in self.zero_stages:
+            infeasible_floor = None
+            for micro in sorted(self.micro_batches):
+                if trials >= self.max_trials:
+                    break
+                if infeasible_floor is not None and micro >= infeasible_floor:
+                    continue
+                cfg = self._trial_config(micro, stage)
+                exp = Experiment(cfg)
+                trials += 1
+                try:
+                    exp.metric_val = float(self.runner(cfg))
+                except (MemoryError, RuntimeError, ValueError) as e:
+                    msg = str(e)
+                    exp.error = msg[:500]
+                    if "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower():
+                        infeasible_floor = micro  # prune larger micros
+                    logger.warning(
+                        f"autotuning trial stage={stage} micro={micro} "
+                        f"failed: {msg[:120]}")
+                self.experiments.append(exp)
+                if exp.feasible and (best is None or
+                                     exp.metric_val > best.metric_val):
+                    best = exp
+                log_dist(
+                    f"autotuning: stage={stage} micro={micro} "
+                    f"{self.metric}="
+                    f"{exp.metric_val if exp.feasible else 'FAIL'}",
+                    ranks=[0])
+        if best is None:
+            raise RuntimeError("autotuning: every trial failed")
+        if self.results_dir:
+            os.makedirs(self.results_dir, exist_ok=True)
+            with open(os.path.join(self.results_dir, "autotuning.json"),
+                      "w") as f:
+                json.dump({"metric": self.metric,
+                           "best": best.summary(),
+                           "experiments": [e.summary()
+                                           for e in self.experiments]},
+                          f, indent=2)
+        log_dist(f"autotuning: best = {best.summary()}", ranks=[0])
+        return best.config
+
+    def best_experiment(self) -> Optional[Experiment]:
+        feas = [e for e in self.experiments if e.feasible]
+        return max(feas, key=lambda e: e.metric_val) if feas else None

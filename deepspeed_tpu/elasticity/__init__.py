@@ -1,0 +1,9 @@
+"""Elastic training config math (reference deepspeed/elasticity)."""
+
+from .elasticity import (ElasticityConfig, ElasticityConfigError,
+                         ElasticityError, ElasticityIncompatibleWorldSize,
+                         compute_elastic_config, get_valid_gpus)
+
+__all__ = ["compute_elastic_config", "get_valid_gpus", "ElasticityConfig",
+           "ElasticityError", "ElasticityConfigError",
+           "ElasticityIncompatibleWorldSize"]

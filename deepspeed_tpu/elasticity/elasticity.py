@@ -1,0 +1,191 @@
+"""Elastic training configuration.
+
+Capability match for the reference elasticity module
+(elasticity/elasticity.py — v0.1 fixed-global-batch :83, v0.2
+variable-global-batch :126, ``compute_elastic_config`` :233): before launch,
+compute the set of (global batch, micro batch, chip count) combinations a
+job can run under, so scaling events pick a compatible world size instead
+of crashing on the batch triangle. TPU twist: chip counts can be restricted
+to the slice sizes the platform actually provisions (powers of two /
+multiples of a slice quantum) via `allowed_world_sizes`.
+
+The torch-elastic agent integration (elastic_agent.py DSElasticAgent) has
+no analogue — re-rendezvous is the platform's job on TPU (the launcher
+restarts ranks; jax.distributed re-initializes); what the framework owns is
+THIS math plus the engine-side guard (engine checks its batch config is
+elastic-compatible when elasticity.enabled).
+"""
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+ELASTICITY = "elasticity"
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+class ElasticityConfig:
+    """Parsed `elasticity` block (reference config surface)."""
+
+    def __init__(self, param_dict: Dict):
+        self.enabled = bool(param_dict.get("enabled", False))
+        self.max_train_batch_size = int(
+            param_dict.get("max_train_batch_size", 2000))
+        self.micro_batches = [int(m) for m in
+                              param_dict.get("micro_batch_sizes",
+                                             [2, 4, 6])]
+        self.min_gpus = int(param_dict.get("min_gpus", 1))
+        self.max_gpus = int(param_dict.get("max_gpus", 10000))
+        self.min_time = int(param_dict.get("min_time", 0))
+        self.version = float(param_dict.get("version", 0.1))
+        self.ignore_non_elastic_batch_info = bool(
+            param_dict.get("ignore_non_elastic_batch_info", False))
+        self.prefer_larger_batch_size = bool(
+            param_dict.get("prefer_larger_batch_size",      # reference key
+                           param_dict.get("prefer_larger_batch", True)))
+        self.allowed_world_sizes = [
+            int(x) for x in param_dict.get("allowed_world_sizes", [])]
+        if any(m <= 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be positive: {self.micro_batches}")
+        if self.min_gpus < 1 or self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError(
+                f"invalid gpu range [{self.min_gpus}, {self.max_gpus}]")
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int],
+                   min_gpus: int, max_gpus: int,
+                   allowed: Optional[List[int]] = None) -> List[int]:
+    """Chip counts that divide batch_size with SOME micro batch
+    (reference get_valid_gpus)."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        max_count = batch_size // mb
+        for g in range(min_gpus, min(max_gpus, max_count) + 1):
+            if max_count % g == 0:
+                valid.add(g)
+    if allowed:
+        valid &= set(allowed)
+    return sorted(valid)
+
+
+def _candidate_batches(max_batch: int, micro_batches: List[int]) -> List[int]:
+    """Batch sizes reachable as micro * k <= max (reference's candidate
+    enumeration, built around the lcm for maximal divisibility)."""
+    lcm = 1
+    for m in micro_batches:
+        lcm = lcm * m // math.gcd(lcm, m)
+    cands = set()
+    b = lcm
+    while b <= max_batch:
+        cands.add(b)
+        b += lcm
+    # also powers-of-two multiples of each micro batch (denser small end)
+    for m in micro_batches:
+        b = m
+        while b <= max_batch:
+            cands.add(b)
+            b *= 2
+    return sorted(cands)
+
+
+def _get_compatible_gpus_v01(micro_batches, max_batch, min_gpus, max_gpus,
+                             prefer_larger=True, allowed=None
+                             ) -> Tuple[int, List[int]]:
+    """v0.1: ONE fixed global batch valid across the widest gpu range."""
+    best = None
+    for batch in _candidate_batches(max_batch, micro_batches):
+        gpus = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus,
+                              allowed)
+        if not gpus:
+            continue
+        key = (len(gpus), batch if prefer_larger else -batch)
+        if best is None or key > best[0]:
+            best = (key, batch, gpus)
+    if best is None:
+        raise ElasticityError(
+            f"no compatible global batch for micro_batches={micro_batches} "
+            f"max={max_batch} gpus=[{min_gpus},{max_gpus}]")
+    return best[1], best[2]
+
+
+def _get_compatible_gpus_v02(micro_batches, max_batch, min_gpus, max_gpus,
+                             current_num_gpus, prefer_larger=True,
+                             allowed=None):
+    """v0.2: global batch VARIES with world size — pick the largest batch
+    this world size supports (reference :126)."""
+    if not (min_gpus <= current_num_gpus <= max_gpus):
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {current_num_gpus} outside the elastic range "
+            f"[{min_gpus}, {max_gpus}]")
+    if allowed and current_num_gpus not in allowed:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {current_num_gpus} not in allowed_world_sizes "
+            f"{sorted(allowed)}")
+    candidates = []
+    for mb in micro_batches:
+        batch = mb * current_num_gpus
+        while batch * 2 <= max_batch:
+            batch *= 2
+        if batch <= max_batch:
+            candidates.append((batch, mb))
+    if not candidates:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {current_num_gpus} incompatible with micro "
+            f"batches {micro_batches} under max {max_batch}")
+    candidates.sort(reverse=prefer_larger)
+    batch, mb = candidates[0]
+    return batch, [current_num_gpus], mb
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version=None,
+                           world_size: int = 0, return_microbatch: bool = False):
+    """Reference entrypoint (:233): returns (final_batch_size, valid_gpus
+    [, micro_batch]) and validates the current world size when given."""
+    block = ds_config.get(ELASTICITY) if isinstance(ds_config, dict) else None
+    if not block:
+        raise ElasticityConfigError("no 'elasticity' block in config")
+    cfg = ElasticityConfig(block)
+    if not cfg.enabled:
+        raise ElasticityConfigError("elasticity.enabled is false")
+
+    if cfg.version >= 0.2 and world_size <= 0:
+        raise ElasticityConfigError(
+            "elasticity v0.2 scales the batch WITH the world size — pass "
+            "world_size (a pre-launch v0.1-style fixed plan would not "
+            "match what v0.2 assigns at runtime)")
+    if cfg.version >= 0.2:
+        batch, gpus, micro = _get_compatible_gpus_v02(
+            cfg.micro_batches, cfg.max_train_batch_size, cfg.min_gpus,
+            cfg.max_gpus, world_size,
+            prefer_larger=cfg.prefer_larger_batch_size,
+            allowed=cfg.allowed_world_sizes or None)
+    else:
+        batch, gpus = _get_compatible_gpus_v01(
+            cfg.micro_batches, cfg.max_train_batch_size, cfg.min_gpus,
+            cfg.max_gpus, prefer_larger=cfg.prefer_larger_batch_size,
+            allowed=cfg.allowed_world_sizes or None)
+        micro = None
+        if world_size > 0:
+            if world_size not in gpus:
+                raise ElasticityIncompatibleWorldSize(
+                    f"world size {world_size} not in the elastic set "
+                    f"{gpus} for batch {batch}")
+            per = batch // world_size
+            micro = max(m for m in cfg.micro_batches if per % m == 0)
+    if return_microbatch or world_size > 0:
+        return batch, gpus, micro
+    return batch, gpus

@@ -247,7 +247,7 @@ class DeepSpeedEngine:
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=cfg.train_batch_size,
-            steps_per_output=cfg.steps_per_print or 10)
+            steps_per_output=cfg.steps_per_print)  # 0 = never print
         configure_comms_logger(cfg.comms_logger)
         self.monitor = None
         if MonitorMaster is not None:
@@ -711,7 +711,8 @@ class DeepSpeedEngine:
                      f"lr={self.get_lr()[0]:.3e} "
                      f"skipped={self.skipped_steps}", ranks=[0])
         if self._config.wall_clock_breakdown and \
-                self.global_steps % (self._config.steps_per_print or 10) == 0:
+                self._config.steps_per_print and \
+                self.global_steps % self._config.steps_per_print == 0:
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                              STEP_GLOBAL_TIMER])
 

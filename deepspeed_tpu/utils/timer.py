@@ -140,7 +140,8 @@ class ThroughputTimer:
         self.step_elapsed_time += duration
         if global_step and self.global_step_count >= self.start_step:
             self.total_elapsed_time += self.step_elapsed_time
-            if report_speed and self.global_step_count % self.steps_per_output == 0:
+            if report_speed and self.steps_per_output and \
+                    self.global_step_count % self.steps_per_output == 0:
                 self.logging(
                     f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
                     f"global_step={self.global_step_count}, "

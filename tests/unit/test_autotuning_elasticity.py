@@ -1,0 +1,145 @@
+"""Autotuning + elasticity tests (reference tests/unit/elasticity,
+tests/unit/autotuning)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityConfigError,
+                                      ElasticityIncompatibleWorldSize,
+                                      compute_elastic_config,
+                                      get_valid_gpus)
+
+
+# ---------------------------------------------------------------- elasticity
+def _cfg(**over):
+    block = {"enabled": True, "max_train_batch_size": 64,
+             "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 16,
+             "version": 0.1}
+    block.update(over)
+    return {"elasticity": block}
+
+
+def test_get_valid_gpus():
+    gpus = get_valid_gpus(batch_size=16, micro_batches=[2, 4],
+                          min_gpus=1, max_gpus=16)
+    # 16/2=8 micro-steps: g in divisors of 8; 16/4=4: divisors of 4
+    assert gpus == [1, 2, 4, 8]
+    assert get_valid_gpus(16, [2], 1, 16, allowed=[4, 8, 32]) == [4, 8]
+
+
+def test_compute_elastic_config_v01():
+    batch, gpus = compute_elastic_config(_cfg())
+    assert batch <= 64
+    for g in gpus:
+        per = batch // g
+        assert batch % g == 0
+        assert any(per % m == 0 for m in (2, 4))
+
+
+def test_world_size_validation_v01():
+    batch, gpus, micro = compute_elastic_config(_cfg(), world_size=gpusafe())
+    assert micro in (2, 4)
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(_cfg(max_train_batch_size=8,
+                                    micro_batch_sizes=[8]), world_size=3)
+
+
+def gpusafe():
+    batch, gpus = compute_elastic_config(_cfg())
+    return gpus[0]
+
+
+def test_compute_elastic_config_v02_scales_batch():
+    b4, g4, m4 = compute_elastic_config(_cfg(version=0.2), world_size=4)
+    b8, g8, m8 = compute_elastic_config(_cfg(version=0.2), world_size=8)
+    assert g4 == [4] and g8 == [8]
+    assert b8 >= b4  # batch grows with world size
+    assert b4 % (m4 * 4) == 0 and b8 % (m8 * 8) == 0
+
+
+def test_elasticity_errors():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({})
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(_cfg(micro_batch_sizes=[0]))
+
+
+def test_tpu_slice_restriction():
+    batch, gpus = compute_elastic_config(
+        _cfg(allowed_world_sizes=[1, 2, 4, 8]))
+    assert set(gpus) <= {1, 2, 4, 8}
+
+
+# ---------------------------------------------------------------- autotuner
+def test_autotuner_picks_best_with_fake_runner(tmp_path):
+    from deepspeed_tpu.autotuning import Autotuner
+
+    def fake_runner(cfg):
+        micro = cfg["train_micro_batch_size_per_gpu"]
+        stage = cfg["zero_optimization"]["stage"]
+        if micro > 8:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return micro * 10 - stage  # best: micro=8, stage=0
+
+    tuner = Autotuner(
+        model_factory=lambda: None,
+        base_config={"optimizer": {"type": "adamw"},
+                     "autotuning": {"enabled": True,
+                                    "micro_batch_sizes": [2, 8, 16, 32],
+                                    "zero_stages": [0, 1]}},
+        runner=fake_runner, results_dir=str(tmp_path))
+    best = tuner.tune()
+    assert best["train_micro_batch_size_per_gpu"] == 8
+    assert best["zero_optimization"]["stage"] == 0
+    # OOM pruning: per stage, micro=16 fails ONCE and micro=32 is never
+    # attempted (the infeasible floor skips it)
+    attempts = [(e.config["train_micro_batch_size_per_gpu"],
+                 e.config["zero_optimization"]["stage"])
+                for e in tuner.experiments]
+    for stage in (0, 1):
+        assert attempts.count((16, stage)) == 1
+        assert attempts.count((32, stage)) == 0
+    results = json.load(open(tmp_path / "autotuning.json"))
+    assert results["best"]["metric"] == 80  # micro=8, stage=0
+
+
+def test_autotuner_real_engine_smoke():
+    """Two tiny real trials through deepspeed_tpu.initialize."""
+    import deepspeed_tpu
+    from deepspeed_tpu.autotuning import Autotuner
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    rng = np.random.default_rng(0)
+
+    def batch_factory(global_bs):
+        return {"input_ids": rng.integers(0, 255, (1, global_bs, 16),
+                                          np.int32)}
+
+    tuner = Autotuner(
+        model_factory=lambda: GPT2Model(GPT2Config(
+            vocab_size=256, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+            pad_vocab_to_multiple=8)),
+        base_config={
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "autotuning": {"enabled": True, "micro_batch_sizes": [1, 2],
+                           "zero_stages": [0], "start_profile_step": 1,
+                           "end_profile_step": 3}},
+        batch_factory=batch_factory)
+    best = tuner.tune()
+    assert best["train_micro_batch_size_per_gpu"] in (1, 2)
+    assert all(e.feasible for e in tuner.experiments)
+
+
+def test_autotuner_all_fail_raises():
+    from deepspeed_tpu.autotuning import Autotuner
+    tuner = Autotuner(model_factory=lambda: None, base_config={
+        "autotuning": {"micro_batch_sizes": [1], "zero_stages": [0]}},
+        runner=lambda cfg: (_ for _ in ()).throw(RuntimeError("boom")))
+    with pytest.raises(RuntimeError, match="every trial failed"):
+        tuner.tune()
