@@ -1,0 +1,65 @@
+"""Reduced-scale convergence on REAL data (verdict item 10): byte-level
+GPT-2 on the repo's own text must learn (loss well below init) and ZeRO-0
+vs ZeRO-3 must produce the same trajectory on that real corpus. The full
+300-step run lives in benchmarks/convergence.py (curves committed to
+benchmarks/convergence.json)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+SEQ = 64
+
+
+def _corpus():
+    text = []
+    for path in sorted(glob.glob(os.path.join(
+            REPO, "deepspeed_tpu", "**", "*.py"), recursive=True))[:30]:
+        with open(path, "rb") as f:
+            text.append(f.read())
+    tokens = np.frombuffer(b"\n".join(text), dtype=np.uint8).astype(np.int32)
+    n = len(tokens) // (SEQ + 1)
+    return tokens[:n * (SEQ + 1)].reshape(n, SEQ + 1)
+
+
+def _train(stage, steps=25, seed=7):
+    from deepspeed_tpu.parallel import topology
+    topology.reset_mesh()
+    samples = _corpus()
+    model = GPT2Model(GPT2Config(vocab_size=256, n_positions=SEQ + 1,
+                                 n_embd=128, n_layer=2, n_head=4,
+                                 pad_vocab_to_multiple=8))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 16, "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage,
+                              "stage3_param_persistence_threshold": 0},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0})
+    rng = np.random.default_rng(seed)
+    losses = []
+    for _ in range(steps):
+        idx = rng.integers(0, len(samples), 16)
+        batch = {"input_ids": samples[idx][None]}
+        losses.append(float(engine.train_batch(batch=batch)))
+    return losses
+
+
+def test_learns_real_text_and_zero_parity():
+    l0 = _train(0)
+    assert np.isfinite(l0).all()
+    # real structured text: the model must beat its init loss clearly
+    # (byte-uniform init ~ ln(256) = 5.55; code text has low byte entropy)
+    assert np.mean(l0[-5:]) < l0[0] * 0.8, l0
+    l3 = _train(3)
+    np.testing.assert_allclose(l3, l0, rtol=2e-3,
+                               err_msg="ZeRO-3 diverges from ZeRO-0 on "
+                                       "real data")
