@@ -37,6 +37,10 @@ class GPT2Config:
     initializer_range: float = 0.02
     remat: bool = False            # activation checkpointing over the layer scan
     remat_policy: Optional[str] = None  # see runtime/activation_checkpointing
+    # vocab-chunked online-softmax loss: "auto" = only when the full logits
+    # tensor would be large (the chunked path trades ~one extra vocab matmul
+    # of recompute for never materializing [B,T,V])
+    loss_chunking: str = "auto"    # auto | always | never
     attn_backend: str = "auto"     # auto | pallas | xla
     sp_attention: str = "ulysses"  # ulysses | ring (when the 'seq' axis is live)
     dtype: str = "float32"         # compute dtype; params always fp32 masters
@@ -159,8 +163,10 @@ class GPT2Model(ModelSpec):
         return x * keep / (1.0 - cfg.dropout)
 
     # --------------------------------------------------------------- forward
-    def logits(self, params, input_ids, rng=None, train=True,
-               return_aux_loss=False):
+    def hidden_states(self, params, input_ids, rng=None, train=True):
+        """Transformer stack up to the final LN. Returns (x [B,T,D],
+        aux_loss, wte in compute dtype) — the loss path projects to vocab
+        CHUNK-WISE (never materializing [B,T,V])."""
         cfg = self.config
         # compute dtype follows the param dtype: the engine casts fp32 masters
         # to bf16/fp16 before apply (mixed-precision contract); cfg.dtype is
@@ -189,9 +195,15 @@ class GPT2Model(ModelSpec):
 
         x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                         cfg.layer_norm_epsilon)
+        return x, aux_total / cfg.n_layer, wte
+
+    def logits(self, params, input_ids, rng=None, train=True,
+               return_aux_loss=False):
+        x, aux, wte = self.hidden_states(params, input_ids, rng=rng,
+                                         train=train)
         logits = x @ wte.T
         if return_aux_loss:
-            return logits, aux_total / cfg.n_layer
+            return logits, aux
         return logits
 
     def aux_loss_weight(self) -> float:
@@ -212,13 +224,93 @@ class GPT2Model(ModelSpec):
         nll = jnp.where(valid, nll, 0.0)
         return nll.sum() / jnp.maximum(valid.sum(), 1)
 
+    @staticmethod
+    def _loss_chunk(v: int, target: int = 8192) -> int:
+        """Vocab-chunk width of the online-softmax loss: the largest
+        divisor of v that is <= target, UNLESS that divisor is tiny (prime
+        or near-prime vocabs would degrade to a scan of thousands of
+        near-empty matmuls) — then plain `target` with a masked ragged
+        tail."""
+        for c in range(min(target, v), 0, -1):
+            if v % c == 0:
+                if c >= min(target, v) // 8:
+                    return c
+                break  # largest divisor is tiny: use padding instead
+        return min(target, v)
+
+    def _chunked_lm_loss(self, h, wte, batch):
+        """Shifted next-token NLL WITHOUT materializing [B,T,V] logits: an
+        online-logsumexp scan over vocab chunks (the memory/bandwidth
+        equivalent of the reference's fused softmax-xent kernels,
+        csrc/transformer/softmax_kernels.cu — [B,T,V] in fp32 is the
+        single largest activation of GPT-2 training and caps the micro
+        batch). The chunk body is rematerialized in backward, so the
+        residual is just (m, s, target_logit) per token."""
+        cfg = self.config
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        labels_src = (batch["labels"] if isinstance(batch, dict) and
+                      "labels" in batch else input_ids)
+        h = h[:, :-1]
+        labels = labels_src[:, 1:]
+        valid = (labels >= 0) & (labels < cfg.vocab_size)
+        safe = jnp.where(valid, labels, 0)
+        b, tm1, d = h.shape
+        n = b * tm1
+        hf = h.reshape(n, d)
+        lf = safe.reshape(n)
+        v = wte.shape[0]
+        chunk = self._loss_chunk(v)
+        k = -(-v // chunk)
+        if k * chunk != v:  # ragged tail: pad rows, mask their logits below
+            wte = jnp.pad(wte, ((0, k * chunk - v), (0, 0)))
+        w_chunks = wte.reshape(k, chunk, d)
+
+        def body(carry, xs):
+            m, s, tgt = carry
+            wc, ki = xs
+            logits = (hf @ wc.T).astype(jnp.float32)          # [n, chunk]
+            if k * chunk != v:
+                col = ki * chunk + jnp.arange(chunk)
+                logits = jnp.where(col[None, :] < v, logits, -jnp.inf)
+            cmax = jnp.max(logits, axis=1)
+            nm = jnp.maximum(m, cmax)
+            s = s * jnp.exp(m - nm) + \
+                jnp.sum(jnp.exp(logits - nm[:, None]), axis=1)
+            base = ki * chunk
+            inb = (lf >= base) & (lf < base + chunk)
+            idx = jnp.clip(lf - base, 0, chunk - 1)
+            tl = jnp.take_along_axis(logits, idx[:, None], axis=1)[:, 0]
+            tgt = jnp.where(inb, tl, tgt)
+            return (nm, s, tgt), None
+
+        init = (jnp.full((n,), -jnp.inf, jnp.float32),
+                jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32))
+        (m, s, tgt), _ = lax.scan(jax.checkpoint(body), init,
+                                  (w_chunks, jnp.arange(k)))
+        nll = (m + jnp.log(s)) - tgt
+        nll = jnp.where(valid.reshape(n), nll, 0.0)
+        return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+    # dense-logits path above this many logit elements would cost multiple
+    # GB of f32 activations — switch to the chunked loss there
+    _DENSE_LOSS_MAX_ELEMS = 600_000_000
+
     def apply(self, params, batch, rng=None, train=True):
         """Next-token LM loss. batch: {'input_ids': [B,T]} (+ optional
         'labels' [B,T])."""
+        cfg = self.config
         input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
-        logits, aux = self.logits(params, input_ids, rng=rng, train=train,
-                                  return_aux_loss=True)
-        loss = self._lm_loss(logits, batch)
+        x, aux, wte = self.hidden_states(params, input_ids, rng=rng,
+                                         train=train)
+        n_logits = (input_ids.shape[0] * max(1, input_ids.shape[1] - 1) *
+                    wte.shape[0])
+        use_chunked = (cfg.loss_chunking == "always" or
+                       (cfg.loss_chunking == "auto" and
+                        n_logits > self._DENSE_LOSS_MAX_ELEMS))
+        if use_chunked:
+            loss = self._chunked_lm_loss(x, wte, batch)
+        else:
+            loss = self._lm_loss(x @ wte.T, batch)
         w = self.aux_loss_weight()
         return loss + w * aux if w else loss
 
@@ -264,8 +356,14 @@ class GPT2Model(ModelSpec):
             cfg = self.config
             x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                             cfg.layer_norm_epsilon)
-            logits = x @ params["wte"].astype(x.dtype).T
-            return self._lm_loss(logits, batch)
+            wte = params["wte"].astype(x.dtype)
+            n_logits = x.shape[0] * max(1, x.shape[1] - 1) * wte.shape[0]
+            use_chunked = (cfg.loss_chunking == "always" or
+                           (cfg.loss_chunking == "auto" and
+                            n_logits > self._DENSE_LOSS_MAX_ELEMS))
+            if use_chunked:
+                return self._chunked_lm_loss(x, wte, batch)
+            return self._lm_loss(x @ wte.T, batch)
 
         return {"blocks_key": "blocks", "embed": embed, "block": block,
                 "head_loss": head_loss,

@@ -63,3 +63,28 @@ def test_learns_real_text_and_zero_parity():
     np.testing.assert_allclose(l3, l0, rtol=2e-3,
                                err_msg="ZeRO-3 diverges from ZeRO-0 on "
                                        "real data")
+
+
+def test_chunked_loss_matches_dense_including_ragged_vocab():
+    """The online-softmax loss is exactly the dense cross-entropy — values
+    AND grads — for divisor-friendly and prime (ragged-tail) vocabs."""
+    import jax
+    import jax.numpy as jnp
+    for vocab, pad in ((300, 16), (257, 1)):   # 257 prime -> masked tail
+        cfg = GPT2Config(vocab_size=vocab, n_positions=32, n_embd=32,
+                         n_layer=1, n_head=4, pad_vocab_to_multiple=pad,
+                         loss_chunking="always")
+        m = GPT2Model(cfg)
+        m_dense = GPT2Model(GPT2Config(**{**cfg.__dict__,
+                                          "loss_chunking": "never"}))
+        p = m.init(jax.random.PRNGKey(0))
+        batch = {"input_ids": np.random.default_rng(0).integers(
+            0, vocab, (2, 20)).astype(np.int32)}
+        l1, g1 = jax.value_and_grad(
+            lambda p: m.apply(p, batch, train=False))(p)
+        l2, g2 = jax.value_and_grad(
+            lambda p: m_dense.apply(p, batch, train=False))(p)
+        assert abs(float(l1) - float(l2)) < 1e-5, vocab
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-5)
