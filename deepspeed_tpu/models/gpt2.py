@@ -35,12 +35,16 @@ class GPT2Config:
     dropout: float = 0.0
     layer_norm_epsilon: float = 1e-5
     initializer_range: float = 0.02
+    activation: str = "gelu"       # gelu | relu (OPT family)
+    mlp_ratio: int = 4
+    pos_offset: int = 0            # learned-position offset (OPT uses 2)
     remat: bool = False            # activation checkpointing over the layer scan
     remat_policy: Optional[str] = None  # see runtime/activation_checkpointing
     # vocab-chunked online-softmax loss: "auto" = only when the full logits
     # tensor would be large (the chunked path trades ~one extra vocab matmul
     # of recompute for never materializing [B,T,V])
     loss_chunking: str = "auto"    # auto | always | never
+    loss_chunk_target: int = 8192  # vocab-chunk width of the chunked loss
     attn_backend: str = "auto"     # auto | pallas | xla
     sp_attention: str = "ulysses"  # ulysses | ring (when the 'seq' axis is live)
     dtype: str = "float32"         # compute dtype; params always fp32 masters
@@ -96,14 +100,14 @@ class GPT2Model(ModelSpec):
             "attn_proj_b": jnp.zeros((l, d)),
             "ln2_scale": jnp.ones((l, d)),
             "ln2_bias": jnp.zeros((l, d)),
-            "mlp_fc_w": norm(keys[2], (l, d, 4 * d), std),
-            "mlp_fc_b": jnp.zeros((l, 4 * d)),
-            "mlp_proj_w": norm(keys[3], (l, 4 * d, d), proj_std),
+            "mlp_fc_w": norm(keys[2], (l, d, cfg.mlp_ratio * d), std),
+            "mlp_fc_b": jnp.zeros((l, cfg.mlp_ratio * d)),
+            "mlp_proj_w": norm(keys[3], (l, cfg.mlp_ratio * d, d), proj_std),
             "mlp_proj_b": jnp.zeros((l, d)),
         }
         return {
             "wte": norm(keys[4], (v, d), std),
-            "wpe": norm(keys[5], (cfg.n_positions, d), std),
+            "wpe": norm(keys[5], (cfg.n_positions + cfg.pos_offset, d), std),
             "blocks": blocks,
             "ln_f_scale": jnp.ones((d,)),
             "ln_f_bias": jnp.zeros((d,)),
@@ -144,7 +148,8 @@ class GPT2Model(ModelSpec):
         cfg = self.config
         ln2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_epsilon)
         hmid = ln2 @ p["mlp_fc_w"].astype(ln2.dtype) + p["mlp_fc_b"].astype(ln2.dtype)
-        hmid = jax.nn.gelu(hmid, approximate=True)
+        hmid = (jax.nn.relu(hmid) if cfg.activation == "relu"
+                else jax.nn.gelu(hmid, approximate=True))
         out = hmid @ p["mlp_proj_w"].astype(hmid.dtype) + p["mlp_proj_b"].astype(hmid.dtype)
         return x + self._dropout(out, rng, train, 1), jnp.float32(0.0)
 
@@ -176,7 +181,7 @@ class GPT2Model(ModelSpec):
                          else jnp.dtype(cfg.dtype))
         b, t = input_ids.shape
         wte = params["wte"].astype(compute_dtype)
-        x = wte[input_ids] + params["wpe"][:t].astype(compute_dtype)
+        x = wte[input_ids] + params["wpe"][cfg.pos_offset:cfg.pos_offset + t].astype(compute_dtype)
         x = self._dropout(x, rng, train, 2)
 
         def body(carry, layer_params):
@@ -259,7 +264,7 @@ class GPT2Model(ModelSpec):
         hf = h.reshape(n, d)
         lf = safe.reshape(n)
         v = wte.shape[0]
-        chunk = self._loss_chunk(v)
+        chunk = self._loss_chunk(v, self.config.loss_chunk_target)
         k = -(-v // chunk)
         if k * chunk != v:  # ragged tail: pad rows, mask their logits below
             wte = jnp.pad(wte, ((0, k * chunk - v), (0, 0)))
@@ -295,22 +300,25 @@ class GPT2Model(ModelSpec):
     # GB of f32 activations — switch to the chunked loss there
     _DENSE_LOSS_MAX_ELEMS = 600_000_000
 
-    def apply(self, params, batch, rng=None, train=True):
-        """Next-token LM loss. batch: {'input_ids': [B,T]} (+ optional
-        'labels' [B,T])."""
+    def _head_loss_from_hidden(self, x, wte, batch):
+        """Dense-vs-chunked dispatch, shared by apply() and the pipeline
+        head (one place to evolve the policy)."""
         cfg = self.config
-        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
-        x, aux, wte = self.hidden_states(params, input_ids, rng=rng,
-                                         train=train)
-        n_logits = (input_ids.shape[0] * max(1, input_ids.shape[1] - 1) *
-                    wte.shape[0])
+        n_logits = x.shape[0] * max(1, x.shape[1] - 1) * wte.shape[0]
         use_chunked = (cfg.loss_chunking == "always" or
                        (cfg.loss_chunking == "auto" and
                         n_logits > self._DENSE_LOSS_MAX_ELEMS))
         if use_chunked:
-            loss = self._chunked_lm_loss(x, wte, batch)
-        else:
-            loss = self._lm_loss(x @ wte.T, batch)
+            return self._chunked_lm_loss(x, wte, batch)
+        return self._lm_loss(x @ wte.T, batch)
+
+    def apply(self, params, batch, rng=None, train=True):
+        """Next-token LM loss. batch: {'input_ids': [B,T]} (+ optional
+        'labels' [B,T])."""
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        x, aux, wte = self.hidden_states(params, input_ids, rng=rng,
+                                         train=train)
+        loss = self._head_loss_from_hidden(x, wte, batch)
         w = self.aux_loss_weight()
         return loss + w * aux if w else loss
 
@@ -346,7 +354,8 @@ class GPT2Model(ModelSpec):
                              else jnp.dtype(cfg.dtype))
             t = input_ids.shape[-1]
             x = params["wte"].astype(compute_dtype)[input_ids] + \
-                params["wpe"][:t].astype(compute_dtype)
+                params["wpe"][cfg.pos_offset:cfg.pos_offset +
+                              t].astype(compute_dtype)
             return self._dropout(x, rng, train, 2)
 
         def block(block_params, x, rng, train):
@@ -356,14 +365,8 @@ class GPT2Model(ModelSpec):
             cfg = self.config
             x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                             cfg.layer_norm_epsilon)
-            wte = params["wte"].astype(x.dtype)
-            n_logits = x.shape[0] * max(1, x.shape[1] - 1) * wte.shape[0]
-            use_chunked = (cfg.loss_chunking == "always" or
-                           (cfg.loss_chunking == "auto" and
-                            n_logits > self._DENSE_LOSS_MAX_ELEMS))
-            if use_chunked:
-                return self._chunked_lm_loss(x, wte, batch)
-            return self._lm_loss(x @ wte.T, batch)
+            return self._head_loss_from_hidden(
+                x, params["wte"].astype(x.dtype), batch)
 
         return {"blocks_key": "blocks", "embed": embed, "block": block,
                 "head_loss": head_loss,
@@ -393,7 +396,7 @@ class GPT2Model(ModelSpec):
         compute_dtype = (wte_dtype if jnp.issubdtype(wte_dtype, jnp.floating)
                          else jnp.dtype(cfg.dtype))
         wte = params["wte"].astype(compute_dtype)
-        wpe = lax.dynamic_slice(params["wpe"], (start_pos, 0),
+        wpe = lax.dynamic_slice(params["wpe"], (start_pos + cfg.pos_offset, 0),
                                 (t, cfg.n_embd)).astype(compute_dtype)
         x = wte[input_ids] + wpe
 
@@ -439,7 +442,9 @@ class GPT2Model(ModelSpec):
         """Training FLOPs/token: 6N + attention term (12·L·D·T)."""
         cfg = self.config
         d, l = cfg.n_embd, cfg.n_layer
-        n_params = 12 * l * d * d + cfg.padded_vocab * d + cfg.n_positions * d
+        block_params = (4 + 2 * cfg.mlp_ratio) * l * d * d
+        n_params = block_params + cfg.padded_vocab * d + \
+            (cfg.n_positions + cfg.pos_offset) * d
         flops = 6 * n_params
         if seq_len:
             flops += 12 * l * d * seq_len  # attention matmuls (fwd+bwd)

@@ -1,0 +1,45 @@
+"""OPT family (Meta AI) — the reference's DS-Chat workhorse.
+
+Capability match for the reference's OPT support (module_inject/containers/
+opt.py HFOPTLayerPolicy; DS-Chat trains OPT-13B/30B/175B, blogs/
+deepspeed-chat/README.md). Architecturally OPT is GPT-2 with ReLU MLPs and
+learned positions offset by 2 (HF OPTLearnedPositionalEmbedding), same
+pre-LN decoder and tied LM head — so the TPU model REUSES the stacked-layer
+GPT2Model (one lax.scan decoder, flash attention, chunked loss, pipeline/
+TP/SP hooks) with those two knobs. Post-LN variants (OPT-350M) and
+word_embed_proj_dim != n_embd are rejected explicitly rather than silently
+mis-modeled.
+"""
+
+import dataclasses
+
+from .gpt2 import GPT2Config, GPT2Model
+
+
+@dataclasses.dataclass(frozen=True)
+class OPTConfig(GPT2Config):
+    activation: str = "relu"
+    pos_offset: int = 2
+    layer_norm_epsilon: float = 1e-5
+
+
+# presets matching HF facebook/opt-* shapes (BASELINE.md config #4 uses 6.7B)
+OPT_125M = OPTConfig(vocab_size=50272, n_positions=2048, n_embd=768,
+                     n_layer=12, n_head=12)
+OPT_1_3B = OPTConfig(vocab_size=50272, n_positions=2048, n_embd=2048,
+                     n_layer=24, n_head=32)
+OPT_6_7B = OPTConfig(vocab_size=50272, n_positions=2048, n_embd=4096,
+                     n_layer=32, n_head=32)
+OPT_13B = OPTConfig(vocab_size=50272, n_positions=2048, n_embd=5120,
+                    n_layer=40, n_head=40)
+
+
+class OPTModel(GPT2Model):
+
+    def __init__(self, config: OPTConfig = OPT_125M):
+        # relu is the OPT default; gelu covers OPT-architecture variants
+        # (Galactica); position offset 2 is structural to the family
+        assert config.activation in ("relu", "gelu") and \
+            config.pos_offset == 2, \
+            "OPTConfig contract: relu/gelu MLPs + position offset 2"
+        super().__init__(config)

@@ -93,6 +93,86 @@ def gpt2_policy(model) -> Tuple[Any, Any]:
     return spec, params
 
 
+@register_policy("OPTForCausalLM", "OPTModel")
+def opt_policy(model) -> Tuple[Any, Any]:
+    """HF OPT → stacked-layer OPTModel params (reference
+    module_inject/containers/opt.py HFOPTLayerPolicy). HF Linear stores
+    [out, in]: transposed into our x @ w convention; separate q/k/v
+    projections concat into the fused qkv. OPT-350M (post-LN,
+    word_embed_proj_dim != hidden) is rejected, matching the policy
+    contract in models/opt.py."""
+    import jax.numpy as jnp
+    from ..models.opt import OPTConfig, OPTModel
+
+    hf_cfg = model.config
+    if not getattr(hf_cfg, "do_layer_norm_before", True):
+        raise ValueError("post-LN OPT variants (350M) are not supported")
+    if getattr(hf_cfg, "word_embed_proj_dim",
+               hf_cfg.hidden_size) != hf_cfg.hidden_size:
+        raise ValueError("OPT word_embed_proj_dim != hidden_size "
+                         "is not supported")
+    act = getattr(hf_cfg, "activation_function", "relu")
+    if act not in ("relu", "gelu", "gelu_new"):
+        raise ValueError(f"unsupported OPT activation {act!r}")
+    if hf_cfg.ffn_dim % hf_cfg.hidden_size != 0:
+        raise ValueError(
+            f"ffn_dim {hf_cfg.ffn_dim} not a multiple of hidden_size "
+            f"{hf_cfg.hidden_size} — cfg.mlp_ratio would silently disagree "
+            f"with the loaded weights")
+    dec = model.model.decoder if hasattr(model, "model") else model.decoder
+    cfg = OPTConfig(
+        vocab_size=hf_cfg.vocab_size,
+        n_positions=hf_cfg.max_position_embeddings,
+        n_embd=hf_cfg.hidden_size,
+        n_layer=hf_cfg.num_hidden_layers,
+        n_head=hf_cfg.num_attention_heads,
+        mlp_ratio=hf_cfg.ffn_dim // hf_cfg.hidden_size,
+        activation="relu" if act == "relu" else "gelu",  # Galactica = gelu
+        pad_vocab_to_multiple=1,
+    )
+    spec = OPTModel(cfg)
+
+    def stack(field):
+        return np.stack([field(h) for h in dec.layers])
+
+    def lin_w(lin):
+        return _np(lin.weight).T            # [out,in] -> [in,out]
+
+    def qkv_w(h):
+        a = h.self_attn
+        return np.concatenate([lin_w(a.q_proj), lin_w(a.k_proj),
+                               lin_w(a.v_proj)], axis=1)
+
+    def qkv_b(h):
+        a = h.self_attn
+        return np.concatenate([_np(a.q_proj.bias), _np(a.k_proj.bias),
+                               _np(a.v_proj.bias)])
+
+    blocks = {
+        "ln1_scale": stack(lambda h: _np(h.self_attn_layer_norm.weight)),
+        "ln1_bias": stack(lambda h: _np(h.self_attn_layer_norm.bias)),
+        "qkv_w": stack(qkv_w),
+        "qkv_b": stack(qkv_b),
+        "attn_proj_w": stack(lambda h: lin_w(h.self_attn.out_proj)),
+        "attn_proj_b": stack(lambda h: _np(h.self_attn.out_proj.bias)),
+        "ln2_scale": stack(lambda h: _np(h.final_layer_norm.weight)),
+        "ln2_bias": stack(lambda h: _np(h.final_layer_norm.bias)),
+        "mlp_fc_w": stack(lambda h: lin_w(h.fc1)),
+        "mlp_fc_b": stack(lambda h: _np(h.fc1.bias)),
+        "mlp_proj_w": stack(lambda h: lin_w(h.fc2)),
+        "mlp_proj_b": stack(lambda h: _np(h.fc2.bias)),
+    }
+    params = {
+        "wte": jnp.asarray(_np(dec.embed_tokens.weight)),
+        # HF embed_positions already carries the +2 offset rows
+        "wpe": jnp.asarray(_np(dec.embed_positions.weight)),
+        "blocks": {k: jnp.asarray(v) for k, v in blocks.items()},
+        "ln_f_scale": jnp.asarray(_np(dec.final_layer_norm.weight)),
+        "ln_f_bias": jnp.asarray(_np(dec.final_layer_norm.bias)),
+    }
+    return spec, params
+
+
 def replace_transformer_layer(model, config=None) -> Tuple[Any, Any]:
     """Entry point (reference module_inject/replace_module.py:276). Dispatch
     by policy; unknown architectures fall back to AutoTP-style generic

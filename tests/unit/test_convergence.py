@@ -70,10 +70,19 @@ def test_chunked_loss_matches_dense_including_ragged_vocab():
     AND grads — for divisor-friendly and prime (ragged-tail) vocabs."""
     import jax
     import jax.numpy as jnp
-    for vocab, pad in ((300, 16), (257, 1)):   # 257 prime -> masked tail
+    # chunk target 64 forces MULTI-chunk scans: 320/64 = 5 exact chunks
+    # (cross-chunk online-logsumexp carry); 257 prime -> ceil-div padding
+    # with the -inf masked ragged tail
+    for vocab, pad in ((300, 16), (257, 1)):
         cfg = GPT2Config(vocab_size=vocab, n_positions=32, n_embd=32,
                          n_layer=1, n_head=4, pad_vocab_to_multiple=pad,
-                         loss_chunking="always")
+                         loss_chunking="always", loss_chunk_target=64)
+        from deepspeed_tpu.models.gpt2 import GPT2Model as _M
+        chunk = _M._loss_chunk(cfg.padded_vocab, cfg.loss_chunk_target)
+        assert chunk < cfg.padded_vocab, "test must run multi-chunk"
+        if vocab == 257:
+            assert cfg.padded_vocab % chunk != 0, \
+                "prime vocab must exercise the ragged tail"
         m = GPT2Model(cfg)
         m_dense = GPT2Model(GPT2Config(**{**cfg.__dict__,
                                           "loss_chunking": "never"}))
