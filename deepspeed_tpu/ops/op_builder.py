@@ -104,6 +104,11 @@ class AsyncIOBuilder(OpBuilder):
     MODULE = ".aio_ops"
 
 
+class SpatialInferenceBuilder(OpBuilder):
+    NAME = "spatial_inference"
+    MODULE = ".spatial_ops"
+
+
 class UtilsBuilder(OpBuilder):
     NAME = "utils"
     MODULE = ".utils_ops"
@@ -115,7 +120,7 @@ _BUILDERS: Dict[str, Type[OpBuilder]] = {
         FlashAttentionBuilder, FusedAdamBuilder, FusedLambBuilder,
         CPUAdamBuilder, CPUAdagradBuilder, QuantizerBuilder, TransformerBuilder,
         InferenceBuilder, SparseAttnBuilder, RandomLTDBuilder, AsyncIOBuilder,
-        UtilsBuilder
+        SpatialInferenceBuilder, UtilsBuilder
     ]
 }
 # reference-style class-name aliases (e.g. accelerator.get_op_builder("FusedAdamBuilder"))

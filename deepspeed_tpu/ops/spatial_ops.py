@@ -1,0 +1,40 @@
+"""Spatial (diffusers) ops: fused NHWC bias adds.
+
+Capability match for the reference spatial kernels
+(csrc/spatial/csrc/pt_binding.cpp:109-111 ``nhwc_bias_add``/
+``nhwc_bias_add_add``/``nhwc_bias_add_bias_add``, opt_bias_add.cu): the
+elementwise tails of diffusion UNet/VAE convolutions. On TPU these are jnp
+expressions XLA fuses into the producing conv — the value of the module is
+the op-parity surface (SpatialInferenceBuilder) and NHWC layout handling.
+"""
+
+from types import SimpleNamespace
+
+import jax.numpy as jnp
+
+
+def _bias(b, x):
+    return b.reshape((1,) * (x.ndim - 1) + (-1,)).astype(x.dtype)
+
+
+def nhwc_bias_add(activation, bias):
+    """out = act + bias (bias broadcast over the channel-last axis)."""
+    return activation + _bias(bias, activation)
+
+
+def nhwc_bias_add_add(activation, bias, other):
+    """out = (act + bias) + other (residual add)."""
+    return activation + _bias(bias, activation) + other.astype(
+        activation.dtype)
+
+
+def nhwc_bias_add_bias_add(activation, bias, other, other_bias):
+    """out = (act + bias) + (other + other_bias)."""
+    return (activation + _bias(bias, activation) +
+            other.astype(activation.dtype) + _bias(other_bias, activation))
+
+
+def get_ops(backend: str = "tpu"):
+    return SimpleNamespace(nhwc_bias_add=nhwc_bias_add,
+                           nhwc_bias_add_add=nhwc_bias_add_add,
+                           nhwc_bias_add_bias_add=nhwc_bias_add_bias_add)

@@ -1,0 +1,93 @@
+"""Tiled linear layers (ZeRO misc).
+
+Capability match for the reference's zero.TiledLinear (runtime/zero/
+tiling.py:296) and zero.Linear (runtime/zero/linear.py:188): break one huge
+linear into tiles so peak memory stays bounded. On TPU the compiler already
+tiles MATMULS onto the MXU — what a tiled linear still buys is bounding the
+OUTPUT/intermediate activation (a [B, T, out] too large for HBM can be
+produced and consumed chunk-wise under a scan) and keeping very large
+weights in a scan-friendly stacked layout that ZeRO-3 gathers tile by tile
+inside the loop instead of all at once.
+
+``tiled_linear``: functional op over a pre-split weight stack.
+``TiledLinear``: module-style wrapper with init (splits at construction).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def tiled_linear(x, w_tiles, b_tiles=None, out_axis: bool = True):
+    """x: [..., in]; w_tiles: [K, in, out/K] (out-tiled, out_axis=True) or
+    [K, in/K, out] (in-tiled). Returns the same result as one big matmul,
+    computed tile-by-tile under lax.scan (ZeRO-3 gathers one tile at a
+    time; only one tile's intermediate is live)."""
+    if out_axis:
+        def body(_, wb):
+            w, b = wb
+            y = x @ w.astype(x.dtype)
+            if b is not None:
+                y = y + b.astype(x.dtype)
+            return None, y
+
+        _, ys = lax.scan(body, None, (w_tiles, b_tiles))
+        # ys: [K, ..., out/K] -> concat on last axis
+        k = ys.shape[0]
+        return jnp.concatenate([ys[i] for i in range(k)], axis=-1)
+
+    # in-tiled: accumulate partial products
+    k, in_tile, _ = w_tiles.shape
+    x_tiles = x.reshape(x.shape[:-1] + (k, in_tile))
+
+    def body(acc, xw):
+        xt, w = xw
+        return acc + xt @ w.astype(x.dtype), None
+
+    xs = jnp.moveaxis(x_tiles, -2, 0)  # [K, ..., in/K]
+    zero = jnp.zeros(x.shape[:-1] + (w_tiles.shape[-1],), x.dtype)
+    acc, _ = lax.scan(body, zero, (xs, w_tiles))
+    if b_tiles is not None:
+        acc = acc + jnp.sum(b_tiles, axis=0).astype(x.dtype)
+    return acc
+
+
+class TiledLinear:
+    """Module-style (reference TiledLinear surface): splits [in, out] into
+    `splits` output tiles at init; apply() runs the scan."""
+
+    def __init__(self, in_features: int, out_features: int, splits: int = 2,
+                 use_bias: bool = True, init_scale: float = 0.02):
+        assert out_features % splits == 0, \
+            f"out_features {out_features} not divisible by splits {splits}"
+        self.in_features = in_features
+        self.out_features = out_features
+        self.splits = splits
+        self.use_bias = use_bias
+        self.init_scale = init_scale
+
+    def init(self, rng):
+        k = self.splits
+        w = jax.random.normal(
+            rng, (k, self.in_features, self.out_features // k),
+            jnp.float32) * self.init_scale
+        p = {"w_tiles": w}
+        if self.use_bias:
+            p["b_tiles"] = jnp.zeros((k, self.out_features // k))
+        return p
+
+    def apply(self, p, x, rng=None, train=True):
+        return tiled_linear(x, p["w_tiles"], p.get("b_tiles"))
+
+
+def zero_linear(x, w, b: Optional[jnp.ndarray] = None):
+    """reference zero.Linear (linear.py:188): a linear that tolerates
+    ZeRO-partitioned weights. Under GSPMD any jnp matmul already does —
+    the sharded weight is gathered (or the matmul is sharded) by the
+    compiler — so this IS the plain op, kept as the API name."""
+    y = x @ w.astype(x.dtype)
+    if b is not None:
+        y = y + b.astype(x.dtype)
+    return y

@@ -234,3 +234,39 @@ def test_flatten_rejects_int_leaves_and_bytes_roundtrip():
     back = ops.unflatten_bytes(flat, spec)
     assert back["step"][0] == 2 ** 25 + 1  # exact (float32 could not)
     np.testing.assert_array_equal(back["w"], tree["w"])
+
+
+def test_tiled_linear_matches_dense():
+    from deepspeed_tpu.runtime.zero.tiling import (TiledLinear, tiled_linear,
+                                                   zero_linear)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (3, 5, 32))
+    lin = TiledLinear(32, 64, splits=4)
+    p = lin.init(jax.random.fold_in(rng, 1))
+    out = lin.apply(p, x)
+    w_full = jnp.concatenate([p["w_tiles"][i] for i in range(4)], axis=-1)
+    b_full = jnp.concatenate([p["b_tiles"][i] for i in range(4)], axis=-1)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(x @ w_full + b_full), atol=1e-5)
+    # in-tiled variant
+    w_in = w_full.reshape(4, 8, 64)
+    out2 = tiled_linear(x, w_in, out_axis=False)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(x @ w_full),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(zero_linear(x, w_full, b_full)),
+                               np.asarray(x @ w_full + b_full), atol=1e-6)
+
+
+def test_spatial_ops():
+    from deepspeed_tpu.ops import spatial_ops
+    ops = spatial_ops.get_ops()
+    x = jnp.ones((2, 4, 4, 8))
+    b = jnp.arange(8.0)
+    out = ops.nhwc_bias_add(x, b)
+    assert out.shape == x.shape
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0], 1 + np.arange(8))
+    out2 = ops.nhwc_bias_add_add(x, b, x)
+    np.testing.assert_allclose(np.asarray(out2)[0, 0, 0], 2 + np.arange(8))
+    out3 = ops.nhwc_bias_add_bias_add(x, b, x, b)
+    np.testing.assert_allclose(np.asarray(out3)[0, 0, 0],
+                               2 + 2 * np.arange(8))
