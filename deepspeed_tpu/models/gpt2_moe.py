@@ -91,7 +91,7 @@ class GPT2MoEModel(GPT2Model):
         cfg = self.config
         d, l = cfg.n_embd, cfg.n_layer
         attn_params = 4 * l * d * d
-        expert_params = cfg.top_k * 8 * l * d * d
+        expert_params = cfg.top_k * 2 * cfg.mlp_ratio * l * d * d
         embed = cfg.padded_vocab * d + cfg.n_positions * d
         flops = 6 * (attn_params + expert_params + embed)
         if seq_len:

@@ -196,6 +196,27 @@ class DeepSpeedEngine:
         self.scaler_state = init_loss_scale_state(cfg.fp16 if cfg.fp16.enabled else None)
         self._base_rng = jax.random.PRNGKey(cfg.seed + 1)
 
+        # ---- elasticity guard (reference engine.py:482-491: the batch
+        #      config must belong to the pre-computed elastic plan) ----
+        el = (cfg._param_dict or {}).get("elasticity") or {}
+        if el.get("enabled") and \
+                not el.get("ignore_non_elastic_batch_info", False):
+            # world size AND batch must belong to the pre-computed plan;
+            # ignore_non_elastic_batch_info trusts the user's batch config
+            # entirely (reference semantics)
+            from ..elasticity import (ElasticityConfigError,
+                                      compute_elastic_config)
+            plan_batch, valid, micro = compute_elastic_config(
+                cfg._param_dict, world_size=self.dp_world_size)
+            if cfg.train_batch_size != plan_batch:
+                raise ElasticityConfigError(
+                    f"elasticity: config train_batch_size="
+                    f"{cfg.train_batch_size} != elastic plan batch "
+                    f"{plan_batch} for world size {self.dp_world_size}; "
+                    f"set ignore_non_elastic_batch_info to override")
+            log_dist(f"elasticity: plan batch={plan_batch} micro={micro} "
+                     f"valid world sizes={valid}", ranks=[0])
+
         # ---- curriculum learning (engine.py:1673-1676 seqlen truncation;
         #      data_pipeline/curriculum_scheduler.py) ----
         self.curriculum_scheduler = None

@@ -143,3 +143,35 @@ def test_autotuner_all_fail_raises():
         runner=lambda cfg: (_ for _ in ()).throw(RuntimeError("boom")))
     with pytest.raises(RuntimeError, match="every trial failed"):
         tuner.tune()
+
+
+def test_engine_elasticity_guard():
+    """Reference engine.py:482-491: a batch config outside the elastic plan
+    is rejected unless ignore_non_elastic_batch_info."""
+    import deepspeed_tpu
+    from deepspeed_tpu.elasticity import ElasticityConfigError
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.parallel import topology
+
+    tiny = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=1,
+                      n_head=4, pad_vocab_to_multiple=8)
+    # plan for micro [2,4], max 48: a fixed batch valid at world size 8;
+    # the configured batch 24 deliberately differs from it
+    el = {"enabled": True, "max_train_batch_size": 48,
+          "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 8,
+          "allowed_world_sizes": [1, 2, 4, 8]}
+    from deepspeed_tpu.elasticity import compute_elastic_config
+    plan_batch, _, _ = compute_elastic_config({"elasticity": el},
+                                              world_size=8)
+    assert plan_batch != 24
+    base = {"train_batch_size": 24,
+            "train_micro_batch_size_per_gpu": 3,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "steps_per_print": 0, "elasticity": el}
+    with pytest.raises(ElasticityConfigError, match="elastic plan"):
+        deepspeed_tpu.initialize(model=GPT2Model(tiny), config=base)
+    topology.reset_mesh()
+    ok = dict(base, elasticity=dict(el, ignore_non_elastic_batch_info=True))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(tiny),
+                                               config=ok)
+    assert engine.train_batch_size == 24
