@@ -41,13 +41,14 @@ def main():
     import dataclasses
 
     # defaults = the measured best on v5e: micro 8 (fits the dense-loss
-    # path), gas 32 (amortizes host dispatch through the axon tunnel —
-    # gas=8 left ~20% on the table), one global step per timing window
+    # path), gas 128 (amortizes host dispatch through the axon tunnel;
+    # 8x128x1024 = a 1M-token global batch, GPT-3-scale), one global step
+    # per timing window
     seq = int(os.environ.get("BENCH_SEQ", 1024))
     micro_bs = int(os.environ.get("BENCH_BS", 8))
     steps = max(1, int(os.environ.get("BENCH_STEPS", 1)))
-    gas = int(os.environ.get("BENCH_GAS", 32))
-    windows = max(1, int(os.environ.get("BENCH_WINDOWS", 5)))
+    gas = int(os.environ.get("BENCH_GAS", 128))
+    windows = max(1, int(os.environ.get("BENCH_WINDOWS", 3)))
     warmup = 3
 
     # 125M fits comfortably: no remat (round-1 ran full recompute and paid
