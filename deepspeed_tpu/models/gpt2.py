@@ -113,8 +113,39 @@ class GPT2Model(ModelSpec):
             "ln_f_bias": jnp.zeros((d,)),
         }
 
+    # ------------------------------------------------- family hook points
+    # Subclass families (LLaMA/BLOOM/NeoX/BERT) override these instead of
+    # re-implementing hidden_states / apply_with_cache / pipeline_spec.
+    def _compute_dtype(self, params):
+        wte_dtype = params["wte"].dtype
+        return (wte_dtype if jnp.issubdtype(wte_dtype, jnp.floating)
+                else jnp.dtype(self.config.dtype))
+
+    def _embed(self, params, input_ids, start_pos=0):
+        """Token + learned-position embeddings in compute dtype (no dropout).
+        ``start_pos`` may be a traced scalar (decode)."""
+        cfg = self.config
+        dt = self._compute_dtype(params)
+        t = input_ids.shape[-1]
+        wpe = lax.dynamic_slice(
+            params["wpe"], (start_pos + cfg.pos_offset, 0),
+            (t, cfg.n_embd)).astype(dt)
+        return params["wte"].astype(dt)[input_ids] + wpe
+
+    def _final_norm(self, params, x):
+        return _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                           self.config.layer_norm_epsilon)
+
+    def _unembed_weight(self, params, dtype):
+        """[V, D] weight of the LM head (tied to wte for GPT-2/OPT)."""
+        return params["wte"].astype(dtype)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.config.n_head
+
     # ----------------------------------------------------------------- block
-    def _attn_sublayer(self, x, p, rng, train, attn_fn=None):
+    def _attn_sublayer(self, x, p, rng, train, attn_fn=None, start_pos=0):
         """ln1 → qkv → flash attention → proj → residual (+dropout).
 
         ``attn_fn(q, k, v) -> attn`` overrides the attention inner — the
@@ -138,7 +169,8 @@ class GPT2Model(ModelSpec):
             attn = sp_attention(q, k, v, causal=True,
                                 dropout_rate=cfg.dropout if train else 0.0,
                                 dropout_rng=drop_rng, impl=cfg.sp_attention,
-                                backend=cfg.attn_backend)
+                                backend=cfg.attn_backend,
+                                bias=self._train_attn_bias(t))
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
         attn = attn @ p["attn_proj_w"].astype(attn.dtype) + p["attn_proj_b"].astype(attn.dtype)
         return x + self._dropout(attn, rng, train, 0)
@@ -159,6 +191,13 @@ class GPT2Model(ModelSpec):
         x = self._attn_sublayer(x, layer_params, rng, train)
         return self._mlp_sublayer(x, layer_params, rng, train)
 
+    def _decode_block(self, x, layer_params, attn_fn, start_pos):
+        """One block on the KV-cache decode path (no dropout/rng)."""
+        x = self._attn_sublayer(x, layer_params, None, False, attn_fn=attn_fn,
+                                start_pos=start_pos)
+        x, _ = self._mlp_sublayer(x, layer_params, None, False)
+        return x
+
     def _dropout(self, x, rng, train, salt):
         cfg = self.config
         if not train or cfg.dropout == 0.0 or rng is None:
@@ -176,12 +215,8 @@ class GPT2Model(ModelSpec):
         # compute dtype follows the param dtype: the engine casts fp32 masters
         # to bf16/fp16 before apply (mixed-precision contract); cfg.dtype is
         # the fallback for direct use.
-        wte_dtype = params["wte"].dtype
-        compute_dtype = (wte_dtype if jnp.issubdtype(wte_dtype, jnp.floating)
-                         else jnp.dtype(cfg.dtype))
-        b, t = input_ids.shape
-        wte = params["wte"].astype(compute_dtype)
-        x = wte[input_ids] + params["wpe"][cfg.pos_offset:cfg.pos_offset + t].astype(compute_dtype)
+        compute_dtype = self._compute_dtype(params)
+        x = self._embed(params, input_ids)
         x = self._dropout(x, rng, train, 2)
 
         def body(carry, layer_params):
@@ -198,9 +233,9 @@ class GPT2Model(ModelSpec):
         (x, _, aux_total), _ = lax.scan(body_fn, (x, 0, jnp.float32(0.0)),
                                         params["blocks"])
 
-        x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
-                        cfg.layer_norm_epsilon)
-        return x, aux_total / cfg.n_layer, wte
+        x = self._final_norm(params, x)
+        return x, aux_total / cfg.n_layer, \
+            self._unembed_weight(params, compute_dtype)
 
     def logits(self, params, input_ids, rng=None, train=True,
                return_aux_loss=False):
@@ -347,26 +382,17 @@ class GPT2Model(ModelSpec):
         slices across pipeline stages."""
 
         def embed(params, batch, rng, train):
-            cfg = self.config
             input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
-            wte_dtype = params["wte"].dtype
-            compute_dtype = (wte_dtype if jnp.issubdtype(wte_dtype, jnp.floating)
-                             else jnp.dtype(cfg.dtype))
-            t = input_ids.shape[-1]
-            x = params["wte"].astype(compute_dtype)[input_ids] + \
-                params["wpe"][cfg.pos_offset:cfg.pos_offset +
-                              t].astype(compute_dtype)
+            x = self._embed(params, input_ids)
             return self._dropout(x, rng, train, 2)
 
         def block(block_params, x, rng, train):
             return self._block(x, block_params, rng, train)  # (x, aux)
 
         def head_loss(params, x, batch):
-            cfg = self.config
-            x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
-                            cfg.layer_norm_epsilon)
+            x = self._final_norm(params, x)
             return self._head_loss_from_hidden(
-                x, params["wte"].astype(x.dtype), batch)
+                x, self._unembed_weight(params, x.dtype), batch)
 
         return {"blocks_key": "blocks", "embed": embed, "block": block,
                 "head_loss": head_loss,
@@ -380,8 +406,18 @@ class GPT2Model(ModelSpec):
     # caller threads through compiled prefill/decode steps.
     def init_kv_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16):
         cfg = self.config
-        shape = (cfg.n_layer, batch_size, cfg.n_head, max_len, cfg.head_dim)
+        shape = (cfg.n_layer, batch_size, self.kv_heads, max_len, cfg.head_dim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def _decode_attn_bias(self, q_pos, k_pos):
+        """Additive attention bias on the decode path ([H, T, max_len] or
+        None). ALiBi families override."""
+        return None
+
+    def _train_attn_bias(self, t):
+        """Additive attention bias for the [t, t] training case ([H, t, t] or
+        None). ALiBi families override."""
+        return None
 
     def apply_with_cache(self, params, input_ids, cache, start_pos):
         """Forward with KV cache. input_ids: [B, T] (prompt for prefill,
@@ -390,20 +426,15 @@ class GPT2Model(ModelSpec):
         new_cache)."""
         cfg = self.config
         b, t = input_ids.shape
-        h, hd = cfg.n_head, cfg.head_dim
         max_len = cache["k"].shape[-2]
-        wte_dtype = params["wte"].dtype
-        compute_dtype = (wte_dtype if jnp.issubdtype(wte_dtype, jnp.floating)
-                         else jnp.dtype(cfg.dtype))
-        wte = params["wte"].astype(compute_dtype)
-        wpe = lax.dynamic_slice(params["wpe"], (start_pos + cfg.pos_offset, 0),
-                                (t, cfg.n_embd)).astype(compute_dtype)
-        x = wte[input_ids] + wpe
+        compute_dtype = self._compute_dtype(params)
+        x = self._embed(params, input_ids, start_pos=start_pos)
 
         # attention mask over the cache: key position <= query position
         q_pos = start_pos + jnp.arange(t)[:, None]
         k_pos = jnp.arange(max_len)[None, :]
         mask = (k_pos <= q_pos)[None, None]          # [1, 1, T, max_len]
+        bias = self._decode_attn_bias(q_pos, k_pos)  # [H, T, max_len] | None
 
         from ..ops.flash_attention import reference_attention
 
@@ -417,20 +448,22 @@ class GPT2Model(ModelSpec):
                 vc = lax.dynamic_update_slice(
                     v_cache, v.astype(v_cache.dtype), (0, 0, start_pos, 0))
                 new_kv["k"], new_kv["v"] = kc, vc
-                return reference_attention(q, kc.astype(q.dtype),
-                                           vc.astype(q.dtype),
-                                           causal=False, mask=mask)
+                kq, vq = kc.astype(q.dtype), vc.astype(q.dtype)
+                if q.shape[1] != kq.shape[1]:        # GQA: repeat kv heads
+                    rep = q.shape[1] // kq.shape[1]
+                    kq = jnp.repeat(kq, rep, axis=1)
+                    vq = jnp.repeat(vq, rep, axis=1)
+                return reference_attention(q, kq, vq, causal=False, mask=mask,
+                                           bias=bias)
 
-            x = self._attn_sublayer(x, layer_params, None, False,
-                                    attn_fn=cached_attn)
-            x, _ = self._mlp_sublayer(x, layer_params, None, False)
-            return x, (new_kv["k"], new_kv["v"])
+            return self._decode_block(x, layer_params, cached_attn,
+                                      start_pos), \
+                (new_kv["k"], new_kv["v"])
 
         x, (new_k, new_v) = lax.scan(
             body, x, (params["blocks"], cache["k"], cache["v"]))
-        x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
-                        cfg.layer_norm_epsilon)
-        logits = x @ wte.T
+        x = self._final_norm(params, x)
+        logits = x @ self._unembed_weight(params, compute_dtype).T
         return logits, {"k": new_k, "v": new_v}
 
     def cache_partition_rules(self):

@@ -43,6 +43,15 @@ def _np(t):
     return np.asarray(t.detach().cpu().numpy(), dtype=np.float32)
 
 
+def _lin_w(lin):
+    """HF nn.Linear stores [out, in]; transpose into our x @ w convention."""
+    return _np(lin.weight).T
+
+
+def _stack(layers, field):
+    return np.stack([field(h) for h in layers])
+
+
 @register_policy("GPT2LMHeadModel", "GPT2Model")
 def gpt2_policy(model) -> Tuple[Any, Any]:
     """HF GPT-2 → stacked-layer GPT2Model params.
@@ -132,11 +141,9 @@ def opt_policy(model) -> Tuple[Any, Any]:
     )
     spec = OPTModel(cfg)
 
-    def stack(field):
-        return np.stack([field(h) for h in dec.layers])
-
-    def lin_w(lin):
-        return _np(lin.weight).T            # [out,in] -> [in,out]
+    import functools
+    stack = functools.partial(_stack, dec.layers)
+    lin_w = _lin_w
 
     def qkv_w(h):
         a = h.self_attn
@@ -169,6 +176,151 @@ def opt_policy(model) -> Tuple[Any, Any]:
         "blocks": {k: jnp.asarray(v) for k, v in blocks.items()},
         "ln_f_scale": jnp.asarray(_np(dec.final_layer_norm.weight)),
         "ln_f_bias": jnp.asarray(_np(dec.final_layer_norm.bias)),
+    }
+    return spec, params
+
+
+@register_policy("LlamaForCausalLM", "LlamaModel", "MistralForCausalLM")
+def llama_policy(model) -> Tuple[Any, Any]:
+    """HF LLaMA/Mistral → stacked-layer LlamaModel params. HF Linear stores
+    [out, in] (transposed into x @ w); q/k/v concat into the fused qkv;
+    rotary needs no weight permutation (both sides use the rotate_half
+    convention). Reference counterpart: auto-TP handling of LLaMA
+    (module_inject/auto_tp.py)."""
+    import jax.numpy as jnp
+    from ..models.llama import LlamaConfig, LlamaModel
+
+    hf_cfg = model.config
+    act = getattr(hf_cfg, "hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise ValueError(f"unsupported LLaMA activation {act!r}")
+    # reject silently-wrong conversions instead of mis-modeling them
+    scaling = getattr(hf_cfg, "rope_scaling", None)
+    if scaling and scaling.get("rope_type",
+                               scaling.get("type", "default")) != "default":
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not supported (plain rotary only); "
+            f"logits would silently diverge from HF")
+    if getattr(hf_cfg, "attention_bias", False):
+        raise ValueError("attention_bias=True LLaMA variants not supported")
+    window = getattr(hf_cfg, "sliding_window", None)
+    if window is not None and window < hf_cfg.max_position_embeddings:
+        raise ValueError(
+            f"sliding_window={window} attention is not supported; full-"
+            f"context attention would silently diverge past the window")
+    explicit_hd = getattr(hf_cfg, "head_dim", None)
+    if explicit_hd is not None and \
+            explicit_hd != hf_cfg.hidden_size // hf_cfg.num_attention_heads:
+        raise ValueError(
+            f"head_dim={explicit_hd} != hidden_size/num_heads "
+            f"({hf_cfg.hidden_size}/{hf_cfg.num_attention_heads}) "
+            f"is not supported")
+    dec = model.model if hasattr(model, "model") else model
+    cfg = LlamaConfig(
+        vocab_size=hf_cfg.vocab_size,
+        n_positions=hf_cfg.max_position_embeddings,
+        n_embd=hf_cfg.hidden_size,
+        n_layer=hf_cfg.num_hidden_layers,
+        n_head=hf_cfg.num_attention_heads,
+        n_kv_head=getattr(hf_cfg, "num_key_value_heads",
+                          hf_cfg.num_attention_heads),
+        mlp_hidden=hf_cfg.intermediate_size,
+        rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        layer_norm_epsilon=hf_cfg.rms_norm_eps,
+        tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+        pad_vocab_to_multiple=1,
+    )
+    spec = LlamaModel(cfg)
+
+    import functools
+    stack = functools.partial(_stack, dec.layers)
+    lin_w = _lin_w
+
+    def qkv_w(h):
+        a = h.self_attn
+        return np.concatenate([lin_w(a.q_proj), lin_w(a.k_proj),
+                               lin_w(a.v_proj)], axis=1)
+
+    blocks = {
+        "ln1_scale": stack(lambda h: _np(h.input_layernorm.weight)),
+        "qkv_w": stack(qkv_w),
+        "attn_proj_w": stack(lambda h: lin_w(h.self_attn.o_proj)),
+        "ln2_scale": stack(lambda h: _np(h.post_attention_layernorm.weight)),
+        "gate_w": stack(lambda h: lin_w(h.mlp.gate_proj)),
+        "up_w": stack(lambda h: lin_w(h.mlp.up_proj)),
+        "down_w": stack(lambda h: lin_w(h.mlp.down_proj)),
+    }
+    params = {
+        "wte": jnp.asarray(_np(dec.embed_tokens.weight)),
+        "blocks": {k: jnp.asarray(v) for k, v in blocks.items()},
+        "ln_f_scale": jnp.asarray(_np(dec.norm.weight)),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_np(model.lm_head.weight))
+    return spec, params
+
+
+@register_policy("BloomForCausalLM", "BloomModel")
+def bloom_policy(model) -> Tuple[Any, Any]:
+    """HF BLOOM → stacked-layer BloomModel params (reference
+    module_inject/containers/bloom.py BLOOMLayerPolicy). The HF fused
+    query_key_value weight is head-interleaved ([H, 3, hd, D] rows);
+    de-interleave into our head-major q|k|v concat convention."""
+    import jax.numpy as jnp
+    from ..models.bloom import BloomConfig, BloomModel
+
+    hf_cfg = model.config
+    h = hf_cfg.n_head
+    d = hf_cfg.hidden_size
+    hd = d // h
+    cfg = BloomConfig(
+        vocab_size=hf_cfg.vocab_size,
+        n_positions=getattr(hf_cfg, "seq_length", 2048),
+        n_embd=d,
+        n_layer=hf_cfg.n_layer,
+        n_head=h,
+        layer_norm_epsilon=hf_cfg.layer_norm_epsilon,
+        pad_vocab_to_multiple=1,
+    )
+    spec = BloomModel(cfg)
+    tr = model.transformer if hasattr(model, "transformer") else model
+
+    import functools
+    stack = functools.partial(_stack, tr.h)
+
+    def qkv_w(blk):
+        w = _np(blk.self_attention.query_key_value.weight)  # [3D, D]
+        w = w.reshape(h, 3, hd, d)                          # de-interleave
+        return np.concatenate([w[:, i].reshape(h * hd, d)
+                               for i in range(3)], axis=0).T  # [D, 3D]
+
+    def qkv_b(blk):
+        b = _np(blk.self_attention.query_key_value.bias).reshape(h, 3, hd)
+        return np.concatenate([b[:, i].reshape(h * hd) for i in range(3)])
+
+    lin_w = _lin_w
+
+    blocks = {
+        "ln1_scale": stack(lambda b: _np(b.input_layernorm.weight)),
+        "ln1_bias": stack(lambda b: _np(b.input_layernorm.bias)),
+        "qkv_w": stack(qkv_w),
+        "qkv_b": stack(qkv_b),
+        "attn_proj_w": stack(lambda b: lin_w(b.self_attention.dense)),
+        "attn_proj_b": stack(lambda b: _np(b.self_attention.dense.bias)),
+        "ln2_scale": stack(lambda b: _np(b.post_attention_layernorm.weight)),
+        "ln2_bias": stack(lambda b: _np(b.post_attention_layernorm.bias)),
+        "mlp_fc_w": stack(lambda b: lin_w(b.mlp.dense_h_to_4h)),
+        "mlp_fc_b": stack(lambda b: _np(b.mlp.dense_h_to_4h.bias)),
+        "mlp_proj_w": stack(lambda b: lin_w(b.mlp.dense_4h_to_h)),
+        "mlp_proj_b": stack(lambda b: _np(b.mlp.dense_4h_to_h.bias)),
+    }
+    params = {
+        "wte": jnp.asarray(_np(tr.word_embeddings.weight)),
+        "emb_ln_scale": jnp.asarray(_np(tr.word_embeddings_layernorm.weight)),
+        "emb_ln_bias": jnp.asarray(_np(tr.word_embeddings_layernorm.bias)),
+        "blocks": {k: jnp.asarray(v) for k, v in blocks.items()},
+        "ln_f_scale": jnp.asarray(_np(tr.ln_f.weight)),
+        "ln_f_bias": jnp.asarray(_np(tr.ln_f.bias)),
     }
     return spec, params
 

@@ -15,14 +15,17 @@ import jax.numpy as jnp
 
 
 def reference_attention(q, k, v, causal=True, mask=None, softmax_scale=None,
-                        dropout_rate=0.0, dropout_rng=None):
+                        dropout_rate=0.0, dropout_rng=None, bias=None):
     """Plain XLA attention. q,k,v: [B, H, T, D] (q may have Tq != Tk for
-    decode). Numerics oracle for the Pallas kernel."""
+    decode). ``bias`` is an additive logits bias broadcastable to
+    [B, H, Tq, Tk] (ALiBi). Numerics oracle for the Pallas kernel."""
     *_, t_q, d = q.shape
     t_k = k.shape[-2]
     scale = softmax_scale if softmax_scale is not None else 1.0 / jnp.sqrt(d)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     if causal:
         # offset so the last query attends to all keys (decode-friendly)
         q_pos = jnp.arange(t_q)[:, None] + (t_k - t_q)
@@ -55,38 +58,63 @@ def _on_tpu() -> bool:
 
 def flash_attention(q, k, v, causal=True, mask=None, softmax_scale=None,
                     dropout_rate=0.0, dropout_rng=None, backend="auto",
-                    interpret=None):
+                    interpret=None, bias=None):
     """Dispatch: Pallas kernel on TPU, XLA reference elsewhere.
 
     backend="pallas" runs the Pallas kernel unconditionally and RAISES if the
     shape/features are unsupported — no silent degradation on the hot path.
     backend="xla" forces the reference path. "auto" picks Pallas only when
     running on TPU with a supported shape. ``interpret=None`` auto-enables
-    interpreter mode off-TPU (CPU tests of the real kernel)."""
+    interpreter mode off-TPU (CPU tests of the real kernel). ``bias`` (ALiBi
+    etc.) currently routes to the XLA path."""
     from .pallas import flash_attention as pallas_fa
 
     if backend == "pallas":
-        if not pallas_fa.supported(q, k, causal=causal, mask=mask,
-                                   dropout_rate=dropout_rate):
+        if bias is not None or not pallas_fa.supported(
+                q, k, causal=causal, mask=mask, dropout_rate=dropout_rate):
             raise ValueError(
                 f"pallas flash attention does not support this call "
                 f"(q={q.shape} k={k.shape} causal={causal} "
                 f"mask={'yes' if mask is not None else 'no'} "
+                f"bias={'yes' if bias is not None else 'no'} "
                 f"dropout={dropout_rate}); pass backend='xla' explicitly")
         if interpret is None:
             interpret = not _on_tpu()
         return pallas_fa.flash_attention(q, k, v, causal, softmax_scale,
                                          None, None, interpret)
-    if backend == "auto" and _on_tpu() and \
-            pallas_fa.supported(q, k, causal=causal, mask=mask,
-                                dropout_rate=dropout_rate):
-        return pallas_fa.flash_attention(q, k, v, causal, softmax_scale,
-                                         None, None, False)
+    if backend == "auto" and _on_tpu():
+        if bias is None and pallas_fa.supported(q, k, causal=causal,
+                                                mask=mask,
+                                                dropout_rate=dropout_rate):
+            return pallas_fa.flash_attention(q, k, v, causal, softmax_scale,
+                                             None, None, False)
+        _warn_xla_fallback(q, bias)
     if backend not in ("auto", "xla"):
         raise ValueError(f"unknown attention backend {backend!r}")
     return reference_attention(q, k, v, causal=causal, mask=mask,
                                softmax_scale=softmax_scale,
-                               dropout_rate=dropout_rate, dropout_rng=dropout_rng)
+                               dropout_rate=dropout_rate,
+                               dropout_rng=dropout_rng, bias=bias)
+
+
+_warned_fallback = False
+
+
+def _warn_xla_fallback(q, bias):
+    """One-time visibility for the on-TPU XLA fallback: the dense path
+    materializes [B, H, Tq, Tk] fp32 logits — a real memory/bandwidth cliff
+    vs the Pallas kernel (why round-1 shipped at 16% MFU unnoticed)."""
+    global _warned_fallback
+    if _warned_fallback:
+        return
+    _warned_fallback = True
+    from ..utils.logging import logger
+    why = "attention bias (ALiBi)" if bias is not None else \
+        f"unsupported shape {tuple(q.shape)}"
+    logger.warning(
+        f"flash_attention: falling back to the dense XLA path on TPU "
+        f"({why} is not supported by the Pallas kernel); this "
+        f"materializes full [B,H,Tq,Tk] fp32 attention logits")
 
 
 def get_ops(backend: str):

@@ -117,8 +117,10 @@ def ring_attention(q, k, v, causal=True, softmax_scale=None):
 
 
 def sp_attention(q, k, v, causal=True, softmax_scale=None, dropout_rate=0.0,
-                 dropout_rng=None, impl="ulysses", backend="auto"):
-    """Dispatch by impl when the 'seq' axis is live; plain flash otherwise."""
+                 dropout_rng=None, impl="ulysses", backend="auto", bias=None):
+    """Dispatch by impl when the 'seq' axis is live; plain flash otherwise.
+    ``bias`` (additive logits bias, e.g. ALiBi) is only supported off the
+    sequence-parallel paths — a bias would need re-sharding over 'seq'."""
     if impl not in ("ulysses", "ring"):
         raise ValueError(f"sp_attention impl must be 'ulysses' or 'ring', "
                          f"got {impl!r}")
@@ -126,7 +128,12 @@ def sp_attention(q, k, v, causal=True, softmax_scale=None, dropout_rate=0.0,
         return flash_attention(q, k, v, causal=causal,
                                softmax_scale=softmax_scale,
                                dropout_rate=dropout_rate,
-                               dropout_rng=dropout_rng, backend=backend)
+                               dropout_rng=dropout_rng, backend=backend,
+                               bias=bias)
+    if bias is not None:
+        raise NotImplementedError(
+            "attention bias (ALiBi) is not supported under sequence "
+            "parallelism; run ALiBi models with sp=1")
     if impl == "ring":
         if dropout_rate > 0.0:
             raise NotImplementedError(
