@@ -1,3 +1,7 @@
 from .api import ModelSpec, FunctionalModel, from_flax
 from .gpt2 import (GPT2Config, GPT2Model, GPT2_125M, GPT2_350M, GPT2_760M,
                    GPT2_1_3B)
+from .llama import LlamaConfig, LlamaModel
+from .bloom import BloomConfig, BloomModel
+from .gpt_neox import GPTNeoXConfig, GPTNeoXModel, gptj_config
+from .bert import BertConfig, BertModel

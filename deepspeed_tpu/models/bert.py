@@ -1,0 +1,213 @@
+"""BERT family — bidirectional post-LN encoder with MLM head.
+
+Capability match for the reference's BERT support — its HEADLINE training
+benchmark (fastest-BERT: docs/_posts/2020-05-28-fastest-bert-training.md,
+fused encoder kernels csrc/transformer/ds_transformer_cuda.cpp,
+module_inject/containers/bert.py HFBertLayerPolicy). Same stacked-layer
+``lax.scan`` design as the decoder families, but post-LN residuals
+(x = LN(x + sublayer(x))), bidirectional attention with an optional padding
+mask, segment (token-type) embeddings, and a masked-LM head (dense+gelu+LN
+transform, decoder tied to wte plus a vocab bias).
+
+Batch: {"input_ids" [B,T], optional "token_type_ids" [B,T],
+"attention_mask" [B,T] (1=keep), "labels" [B,T] (-100 = unmasked)}.
+"""
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .api import ModelSpec
+from .gpt2 import (GPT2Config, _activation, _layer_norm, _token_dropout,
+                   _params_compute_dtype)
+from ..ops.flash_attention import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig(GPT2Config):
+    vocab_size: int = 30522
+    n_positions: int = 512
+    type_vocab_size: int = 2
+    activation: str = "gelu_exact"   # HF hidden_act="gelu" (erf)
+
+
+BERT_BASE = BertConfig(n_embd=768, n_layer=12, n_head=12)
+BERT_LARGE = BertConfig(n_embd=1024, n_layer=24, n_head=16)
+
+
+class BertModel(ModelSpec):
+
+    def __init__(self, config: BertConfig = BERT_BASE):
+        self.config = config
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng):
+        cfg = self.config
+        d, l, v, m = (cfg.n_embd, cfg.n_layer, cfg.padded_vocab,
+                      cfg.mlp_ratio * cfg.n_embd)
+        std = cfg.initializer_range
+        keys = jax.random.split(rng, 10)
+
+        def norm(key, shape, s=std):
+            return jax.random.normal(key, shape, jnp.float32) * s
+
+        blocks = {
+            "qkv_w": norm(keys[0], (l, d, 3 * d)),
+            "qkv_b": jnp.zeros((l, 3 * d)),
+            "attn_out_w": norm(keys[1], (l, d, d)),
+            "attn_out_b": jnp.zeros((l, d)),
+            "attn_ln_scale": jnp.ones((l, d)),
+            "attn_ln_bias": jnp.zeros((l, d)),
+            "inter_w": norm(keys[2], (l, d, m)),
+            "inter_b": jnp.zeros((l, m)),
+            "out_w": norm(keys[3], (l, m, d)),
+            "out_b": jnp.zeros((l, d)),
+            "out_ln_scale": jnp.ones((l, d)),
+            "out_ln_bias": jnp.zeros((l, d)),
+        }
+        return {
+            "wte": norm(keys[4], (v, d)),
+            "wpe": norm(keys[5], (cfg.n_positions, d)),
+            "tte": norm(keys[6], (cfg.type_vocab_size, d)),
+            "emb_ln_scale": jnp.ones((d,)),
+            "emb_ln_bias": jnp.zeros((d,)),
+            "blocks": blocks,
+            "mlm_dense_w": norm(keys[7], (d, d)),
+            "mlm_dense_b": jnp.zeros((d,)),
+            "mlm_ln_scale": jnp.ones((d,)),
+            "mlm_ln_bias": jnp.zeros((d,)),
+            "mlm_bias": jnp.zeros((v,)),
+        }
+
+    # --------------------------------------------------------------- forward
+    def _block(self, x, p, mask, rng, train):
+        """Post-LN encoder block (HF BertLayer semantics)."""
+        cfg = self.config
+        b, t, d = x.shape
+        h, hd = cfg.n_head, cfg.head_dim
+        eps = cfg.layer_norm_epsilon
+        qkv = x @ p["qkv_w"].astype(x.dtype) + p["qkv_b"].astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+        drop_rng = None
+        if train and cfg.dropout > 0 and rng is not None:
+            drop_rng = jax.random.fold_in(rng, 3)
+        attn = flash_attention(q, k, v, causal=False, mask=mask,
+                               dropout_rate=cfg.dropout if train else 0.0,
+                               dropout_rng=drop_rng,
+                               backend=cfg.attn_backend)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
+        attn = attn @ p["attn_out_w"].astype(x.dtype) + \
+            p["attn_out_b"].astype(x.dtype)
+        x = _layer_norm(x + self._dropout(attn, rng, train, 0),
+                        p["attn_ln_scale"], p["attn_ln_bias"], eps)
+        mid = _activation(x @ p["inter_w"].astype(x.dtype) +
+                          p["inter_b"].astype(x.dtype), cfg.activation)
+        out = mid @ p["out_w"].astype(x.dtype) + p["out_b"].astype(x.dtype)
+        return _layer_norm(x + self._dropout(out, rng, train, 1),
+                           p["out_ln_scale"], p["out_ln_bias"], eps)
+
+    def _dropout(self, x, rng, train, salt):
+        return _token_dropout(x, rng, train, salt, self.config.dropout)
+
+    def encode(self, params, input_ids, token_type_ids=None,
+               attention_mask=None, rng=None, train=True):
+        """Embeddings + encoder stack. Returns [B, T, D]."""
+        cfg = self.config
+        dt = _params_compute_dtype(params, cfg.dtype)
+        b, t = input_ids.shape
+        x = params["wte"].astype(dt)[input_ids] + \
+            params["wpe"][:t].astype(dt)
+        if token_type_ids is not None:
+            x = x + params["tte"].astype(dt)[token_type_ids]
+        else:
+            x = x + params["tte"][0].astype(dt)
+        x = _layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
+                        cfg.layer_norm_epsilon)
+        x = self._dropout(x, rng, train, 2)
+
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        def body(carry, layer_params):
+            h, i = carry
+            layer_rng = None if rng is None else jax.random.fold_in(rng, i)
+            h = self._block(h, layer_params, mask, layer_rng, train)
+            return (h, i + 1), None
+
+        body_fn = body
+        if cfg.remat:
+            from ..runtime.activation_checkpointing.checkpointing import \
+                get_policy
+            body_fn = jax.checkpoint(body, policy=get_policy(cfg.remat_policy))
+        (x, _), _ = lax.scan(body_fn, (x, 0), params["blocks"])
+        return x
+
+    def mlm_logits(self, params, input_ids, token_type_ids=None,
+                   attention_mask=None, rng=None, train=True):
+        cfg = self.config
+        x = self.encode(params, input_ids, token_type_ids, attention_mask,
+                        rng, train)
+        x = x @ params["mlm_dense_w"].astype(x.dtype) + \
+            params["mlm_dense_b"].astype(x.dtype)
+        x = _activation(x, cfg.activation)
+        x = _layer_norm(x, params["mlm_ln_scale"], params["mlm_ln_bias"],
+                        cfg.layer_norm_epsilon)
+        return x @ params["wte"].astype(x.dtype).T + \
+            params["mlm_bias"].astype(jnp.float32)
+
+    def logits(self, params, input_ids, rng=None, train=True,
+               return_aux_loss=False):
+        """MLM logits — the InferenceEngine scoring contract
+        (inference/engine.py forward())."""
+        out = self.mlm_logits(params, input_ids, rng=rng, train=train)
+        if return_aux_loss:
+            return out, jnp.float32(0.0)
+        return out
+
+    def apply(self, params, batch, rng=None, train=True):
+        """Masked-LM loss over labels != -100 (HF convention, unshifted)."""
+        cfg = self.config
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        tt = batch.get("token_type_ids") if isinstance(batch, dict) else None
+        am = batch.get("attention_mask") if isinstance(batch, dict) else None
+        labels = (batch["labels"] if isinstance(batch, dict) and
+                  "labels" in batch else input_ids)
+        logits = self.mlm_logits(params, input_ids, tt, am, rng, train)
+        valid = (labels >= 0) & (labels < cfg.vocab_size)
+        safe = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        nll = jnp.where(valid, nll, 0.0)
+        return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+    # ------------------------------------------------------------- sharding
+    def partition_rules(self):
+        return [
+            (r"wte$", ("model", None)),
+            (r"(wpe|tte)$", (None, None)),
+            (r"mlm_bias$", ("model",)),
+            (r"blocks/qkv_w$", ("pipe", None, "model")),
+            (r"blocks/qkv_b$", ("pipe", "model")),
+            (r"blocks/attn_out_w$", ("pipe", "model", None)),
+            (r"blocks/inter_w$", ("pipe", None, "model")),
+            (r"blocks/inter_b$", ("pipe", "model")),
+            (r"blocks/out_w$", ("pipe", "model", None)),
+            (r"blocks/", ("pipe",)),
+        ]
+
+    def flops_per_token(self, seq_len: Optional[int] = None):
+        cfg = self.config
+        d, l = cfg.n_embd, cfg.n_layer
+        block = (4 + 2 * cfg.mlp_ratio) * l * d * d
+        flops = 6 * (block + cfg.padded_vocab * d + d * d)
+        if seq_len:
+            flops += 12 * l * d * seq_len
+        return flops

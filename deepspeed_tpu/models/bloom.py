@@ -15,7 +15,6 @@ query-position dependence — one [1, H, 1, T] bias for both train and decode.
 
 import dataclasses
 import math
-from typing import Optional
 
 import jax.numpy as jnp
 
@@ -47,6 +46,8 @@ def alibi_slopes(n_heads: int):
 
 class BloomModel(GPT2Model):
 
+    has_position_table = False
+
     def __init__(self, config: BloomConfig = BLOOM_560M):
         super().__init__(config)
         self._slopes = jnp.asarray(alibi_slopes(config.n_head),
@@ -76,12 +77,3 @@ class BloomModel(GPT2Model):
     def _decode_attn_bias(self, q_pos, k_pos):
         return (self._slopes[None, :, None, None] *
                 k_pos[None, None].astype(jnp.float32))
-
-    def flops_per_token(self, seq_len: Optional[int] = None):
-        cfg = self.config
-        d, l = cfg.n_embd, cfg.n_layer
-        block = (4 + 2 * cfg.mlp_ratio) * l * d * d
-        flops = 6 * (block + cfg.padded_vocab * d)
-        if seq_len:
-            flops += 12 * l * d * seq_len
-        return flops

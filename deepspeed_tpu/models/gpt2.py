@@ -67,6 +67,36 @@ GPT2_760M = GPT2Config(n_embd=1536, n_layer=24, n_head=16)
 GPT2_1_3B = GPT2Config(n_embd=2048, n_layer=24, n_head=32)
 
 
+def _activation(x, name):
+    """gelu = tanh approximation (GPT-2 'gelu_new'); gelu_exact = erf GELU
+    (HF 'gelu', the NeoX/BERT default)."""
+    if name == "relu":
+        return jax.nn.relu(x)
+    if name == "gelu":
+        return jax.nn.gelu(x, approximate=True)
+    if name == "gelu_exact":
+        return jax.nn.gelu(x, approximate=False)
+    if name == "silu":
+        return jax.nn.silu(x)
+    raise ValueError(f"unknown activation {name!r}")
+
+
+def _token_dropout(x, rng, train, salt, rate):
+    if not train or rate == 0.0 or rng is None:
+        return x
+    key = jax.random.fold_in(rng, salt)
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return x * keep / (1.0 - rate)
+
+
+def _params_compute_dtype(params, fallback):
+    """Compute dtype follows the param dtype (engine casts fp32 masters to
+    bf16/fp16 before apply — the mixed-precision contract)."""
+    wte_dtype = params["wte"].dtype
+    return (wte_dtype if jnp.issubdtype(wte_dtype, jnp.floating)
+            else jnp.dtype(fallback))
+
+
 def _layer_norm(x, scale, bias, eps):
     x32 = x.astype(jnp.float32)
     mean = jnp.mean(x32, axis=-1, keepdims=True)
@@ -116,10 +146,10 @@ class GPT2Model(ModelSpec):
     # ------------------------------------------------- family hook points
     # Subclass families (LLaMA/BLOOM/NeoX/BERT) override these instead of
     # re-implementing hidden_states / apply_with_cache / pipeline_spec.
+    has_position_table = True   # families without a wpe table set False
+
     def _compute_dtype(self, params):
-        wte_dtype = params["wte"].dtype
-        return (wte_dtype if jnp.issubdtype(wte_dtype, jnp.floating)
-                else jnp.dtype(self.config.dtype))
+        return _params_compute_dtype(params, self.config.dtype)
 
     def _embed(self, params, input_ids, start_pos=0):
         """Token + learned-position embeddings in compute dtype (no dropout).
@@ -139,6 +169,10 @@ class GPT2Model(ModelSpec):
     def _unembed_weight(self, params, dtype):
         """[V, D] weight of the LM head (tied to wte for GPT-2/OPT)."""
         return params["wte"].astype(dtype)
+
+    def _head_bias(self, params, dtype):
+        """[V] LM-head bias or None (GPT-J has one)."""
+        return None
 
     @property
     def kv_heads(self) -> int:
@@ -180,8 +214,7 @@ class GPT2Model(ModelSpec):
         cfg = self.config
         ln2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_epsilon)
         hmid = ln2 @ p["mlp_fc_w"].astype(ln2.dtype) + p["mlp_fc_b"].astype(ln2.dtype)
-        hmid = (jax.nn.relu(hmid) if cfg.activation == "relu"
-                else jax.nn.gelu(hmid, approximate=True))
+        hmid = _activation(hmid, cfg.activation)
         out = hmid @ p["mlp_proj_w"].astype(hmid.dtype) + p["mlp_proj_b"].astype(hmid.dtype)
         return x + self._dropout(out, rng, train, 1), jnp.float32(0.0)
 
@@ -199,12 +232,7 @@ class GPT2Model(ModelSpec):
         return x
 
     def _dropout(self, x, rng, train, salt):
-        cfg = self.config
-        if not train or cfg.dropout == 0.0 or rng is None:
-            return x
-        key = jax.random.fold_in(rng, salt)
-        keep = jax.random.bernoulli(key, 1.0 - cfg.dropout, x.shape)
-        return x * keep / (1.0 - cfg.dropout)
+        return _token_dropout(x, rng, train, salt, self.config.dropout)
 
     # --------------------------------------------------------------- forward
     def hidden_states(self, params, input_ids, rng=None, train=True):
@@ -242,6 +270,9 @@ class GPT2Model(ModelSpec):
         x, aux, wte = self.hidden_states(params, input_ids, rng=rng,
                                          train=train)
         logits = x @ wte.T
+        head_b = self._head_bias(params, logits.dtype)
+        if head_b is not None:
+            logits = logits + head_b
         if return_aux_loss:
             return logits, aux
         return logits
@@ -278,7 +309,7 @@ class GPT2Model(ModelSpec):
                 break  # largest divisor is tiny: use padding instead
         return min(target, v)
 
-    def _chunked_lm_loss(self, h, wte, batch):
+    def _chunked_lm_loss(self, h, wte, batch, head_b=None):
         """Shifted next-token NLL WITHOUT materializing [B,T,V] logits: an
         online-logsumexp scan over vocab chunks (the memory/bandwidth
         equivalent of the reference's fused softmax-xent kernels,
@@ -301,14 +332,18 @@ class GPT2Model(ModelSpec):
         v = wte.shape[0]
         chunk = self._loss_chunk(v, self.config.loss_chunk_target)
         k = -(-v // chunk)
+        if head_b is None:
+            head_b = jnp.zeros((v,), wte.dtype)
         if k * chunk != v:  # ragged tail: pad rows, mask their logits below
             wte = jnp.pad(wte, ((0, k * chunk - v), (0, 0)))
+            head_b = jnp.pad(head_b, (0, k * chunk - v))
         w_chunks = wte.reshape(k, chunk, d)
+        b_chunks = head_b.reshape(k, chunk)
 
         def body(carry, xs):
             m, s, tgt = carry
-            wc, ki = xs
-            logits = (hf @ wc.T).astype(jnp.float32)          # [n, chunk]
+            wc, bc, ki = xs
+            logits = (hf @ wc.T + bc[None, :]).astype(jnp.float32)  # [n, chunk]
             if k * chunk != v:
                 col = ki * chunk + jnp.arange(chunk)
                 logits = jnp.where(col[None, :] < v, logits, -jnp.inf)
@@ -326,7 +361,7 @@ class GPT2Model(ModelSpec):
         init = (jnp.full((n,), -jnp.inf, jnp.float32),
                 jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32))
         (m, s, tgt), _ = lax.scan(jax.checkpoint(body), init,
-                                  (w_chunks, jnp.arange(k)))
+                                  (w_chunks, b_chunks, jnp.arange(k)))
         nll = (m + jnp.log(s)) - tgt
         nll = jnp.where(valid.reshape(n), nll, 0.0)
         return nll.sum() / jnp.maximum(valid.sum(), 1)
@@ -335,7 +370,7 @@ class GPT2Model(ModelSpec):
     # GB of f32 activations — switch to the chunked loss there
     _DENSE_LOSS_MAX_ELEMS = 600_000_000
 
-    def _head_loss_from_hidden(self, x, wte, batch):
+    def _head_loss_from_hidden(self, x, wte, batch, head_b=None):
         """Dense-vs-chunked dispatch, shared by apply() and the pipeline
         head (one place to evolve the policy)."""
         cfg = self.config
@@ -344,8 +379,11 @@ class GPT2Model(ModelSpec):
                        (cfg.loss_chunking == "auto" and
                         n_logits > self._DENSE_LOSS_MAX_ELEMS))
         if use_chunked:
-            return self._chunked_lm_loss(x, wte, batch)
-        return self._lm_loss(x @ wte.T, batch)
+            return self._chunked_lm_loss(x, wte, batch, head_b=head_b)
+        logits = x @ wte.T
+        if head_b is not None:
+            logits = logits + head_b
+        return self._lm_loss(logits, batch)
 
     def apply(self, params, batch, rng=None, train=True):
         """Next-token LM loss. batch: {'input_ids': [B,T]} (+ optional
@@ -353,7 +391,8 @@ class GPT2Model(ModelSpec):
         input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
         x, aux, wte = self.hidden_states(params, input_ids, rng=rng,
                                          train=train)
-        loss = self._head_loss_from_hidden(x, wte, batch)
+        loss = self._head_loss_from_hidden(
+            x, wte, batch, head_b=self._head_bias(params, wte.dtype))
         w = self.aux_loss_weight()
         return loss + w * aux if w else loss
 
@@ -392,7 +431,8 @@ class GPT2Model(ModelSpec):
         def head_loss(params, x, batch):
             x = self._final_norm(params, x)
             return self._head_loss_from_hidden(
-                x, self._unembed_weight(params, x.dtype), batch)
+                x, self._unembed_weight(params, x.dtype), batch,
+                head_b=self._head_bias(params, x.dtype))
 
         return {"blocks_key": "blocks", "embed": embed, "block": block,
                 "head_loss": head_loss,
@@ -464,6 +504,9 @@ class GPT2Model(ModelSpec):
             body, x, (params["blocks"], cache["k"], cache["v"]))
         x = self._final_norm(params, x)
         logits = x @ self._unembed_weight(params, compute_dtype).T
+        head_b = self._head_bias(params, logits.dtype)
+        if head_b is not None:
+            logits = logits + head_b
         return logits, {"k": new_k, "v": new_v}
 
     def cache_partition_rules(self):
@@ -476,8 +519,9 @@ class GPT2Model(ModelSpec):
         cfg = self.config
         d, l = cfg.n_embd, cfg.n_layer
         block_params = (4 + 2 * cfg.mlp_ratio) * l * d * d
-        n_params = block_params + cfg.padded_vocab * d + \
-            (cfg.n_positions + cfg.pos_offset) * d
+        n_params = block_params + cfg.padded_vocab * d
+        if self.has_position_table:
+            n_params += (cfg.n_positions + cfg.pos_offset) * d
         flops = 6 * n_params
         if seq_len:
             flops += 12 * l * d * seq_len  # attention matmuls (fwd+bwd)

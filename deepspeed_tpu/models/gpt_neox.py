@@ -1,0 +1,226 @@
+"""GPT-NeoX / GPT-J family — partial rotary + parallel residual decoders.
+
+Capability match for the reference's GPT-NeoX and GPT-J support
+(module_inject/containers/gptneox.py GPTNEOXLayerPolicy, containers/gptj.py
+HFGPTJLayerPolicy). One model class covers both: the differences are config
+flags —
+
+  GPT-NeoX: two LayerNorms per block (input + post-attention, both feeding
+            the PARALLEL residual x + attn(ln1 x) + mlp(ln2 x)), partial
+            rotate_half rotary (rotary_pct), qkv/proj biases, exact GELU.
+  GPT-J:    ONE shared LayerNorm feeds both branches (shared_ln), partial
+            INTERLEAVED rotary (rotate_every_two), no attention biases,
+            LM head WITH bias, tanh GELU.
+
+Both: no position table, untied LM head. Reuses the stacked-scan skeleton,
+KV-cache decode, chunked loss, and pipeline hooks from models/gpt2.py.
+"""
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .gpt2 import GPT2Config, GPT2Model, _activation, _layer_norm
+from .llama import apply_rope, rope_cos_sin
+from ..ops.seq_parallel import sp_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoXConfig(GPT2Config):
+    activation: str = "gelu_exact"    # HF NeoX hidden_act="gelu" (erf)
+    rotary_pct: float = 0.25
+    rotary_ndims: Optional[int] = None  # explicit rotary dims (GPT-J rotary_dim)
+    rope_theta: float = 10000.0
+    use_parallel_residual: bool = True
+    shared_ln: bool = False           # GPT-J: ln_1 feeds attn AND mlp
+    rotary_interleaved: bool = False  # GPT-J rotate_every_two convention
+    attn_bias: bool = True            # GPT-J: False
+    head_bias: bool = False           # GPT-J lm_head has a bias
+
+    @property
+    def rot_dims(self):
+        if self.rotary_ndims is not None:
+            return self.rotary_ndims
+        return int(self.head_dim * self.rotary_pct)
+
+
+def gptj_config(**kw) -> GPTNeoXConfig:
+    """GPT-J flavor of the shared config."""
+    base = dict(activation="gelu", shared_ln=True, rotary_interleaved=True,
+                attn_bias=False, head_bias=True, use_parallel_residual=True)
+    base.update(kw)
+    return GPTNeoXConfig(**base)
+
+
+# presets matching EleutherAI shapes
+PYTHIA_160M = GPTNeoXConfig(vocab_size=50304, n_embd=768, n_layer=12,
+                            n_head=12)
+NEOX_20B = GPTNeoXConfig(vocab_size=50432, n_embd=6144, n_layer=44,
+                         n_head=64, n_positions=2048)
+GPTJ_6B = gptj_config(vocab_size=50400, n_embd=4096, n_layer=28, n_head=16,
+                      rotary_ndims=64, n_positions=2048)
+
+
+def apply_rope_interleaved(x, angles):
+    """GPT-J rotate_every_two: pairs are (x[2i], x[2i+1]).
+    x: [B, H, T, rot]; angles: [T, rot/2]."""
+    cos = jnp.cos(angles).astype(x.dtype)[None, None]
+    sin = jnp.sin(angles).astype(x.dtype)[None, None]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+
+
+class GPTNeoXModel(GPT2Model):
+
+    has_position_table = False
+
+    def __init__(self, config: GPTNeoXConfig = PYTHIA_160M):
+        assert 0 < config.rot_dims <= config.head_dim
+        assert config.rot_dims % 2 == 0
+        super().__init__(config)
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng):
+        cfg = self.config
+        d, l, v = cfg.n_embd, cfg.n_layer, cfg.padded_vocab
+        std = cfg.initializer_range
+        proj_std = std / math.sqrt(2 * l)
+        keys = jax.random.split(rng, 8)
+
+        def norm(key, shape, s):
+            return jax.random.normal(key, shape, jnp.float32) * s
+
+        blocks = {
+            "ln1_scale": jnp.ones((l, d)),
+            "ln1_bias": jnp.zeros((l, d)),
+            "qkv_w": norm(keys[0], (l, d, 3 * d), std),
+            "attn_proj_w": norm(keys[1], (l, d, d), proj_std),
+            "mlp_fc_w": norm(keys[2], (l, d, cfg.mlp_ratio * d), std),
+            "mlp_fc_b": jnp.zeros((l, cfg.mlp_ratio * d)),
+            "mlp_proj_w": norm(keys[3], (l, cfg.mlp_ratio * d, d), proj_std),
+            "mlp_proj_b": jnp.zeros((l, d)),
+        }
+        if cfg.attn_bias:
+            blocks["qkv_b"] = jnp.zeros((l, 3 * d))
+            blocks["attn_proj_b"] = jnp.zeros((l, d))
+        if not cfg.shared_ln:
+            blocks["ln2_scale"] = jnp.ones((l, d))
+            blocks["ln2_bias"] = jnp.zeros((l, d))
+        params = {
+            "wte": norm(keys[4], (v, d), std),
+            "blocks": blocks,
+            "ln_f_scale": jnp.ones((d,)),
+            "ln_f_bias": jnp.zeros((d,)),
+            "lm_head": norm(keys[5], (v, d), std),
+        }
+        if cfg.head_bias:
+            params["lm_head_b"] = jnp.zeros((v,))
+        return params
+
+    # ------------------------------------------------- family hook overrides
+    def _embed(self, params, input_ids, start_pos=0):
+        return params["wte"].astype(self._compute_dtype(params))[input_ids]
+
+    def _unembed_weight(self, params, dtype):
+        return params["lm_head"].astype(dtype)
+
+    def _head_bias(self, params, dtype):
+        b = params.get("lm_head_b")
+        return None if b is None else b.astype(dtype)
+
+    # ----------------------------------------------------------------- block
+    def _partial_rope(self, x, pos):
+        cfg = self.config
+        rot = cfg.rot_dims
+        x_rot, x_pass = x[..., :rot], x[..., rot:]
+        if cfg.rotary_interleaved:
+            inv = 1.0 / (cfg.rope_theta **
+                         (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
+            angles = pos.astype(jnp.float32)[:, None] * inv[None, :]
+            x_rot = apply_rope_interleaved(x_rot, angles)
+        else:
+            cos, sin = rope_cos_sin(pos, rot, cfg.rope_theta, x.dtype)
+            x_rot = apply_rope(x_rot, cos, sin)
+        return jnp.concatenate([x_rot, x_pass], axis=-1) \
+            if rot < x.shape[-1] else x_rot
+
+    def _attn_branch(self, ln1, p, rng, train, attn_fn, start_pos):
+        cfg = self.config
+        b, t, d = ln1.shape
+        h, hd = cfg.n_head, cfg.head_dim
+        qkv = ln1 @ p["qkv_w"].astype(ln1.dtype)
+        if cfg.attn_bias:
+            qkv = qkv + p["qkv_b"].astype(ln1.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+        pos = start_pos + jnp.arange(t)
+        q = self._partial_rope(q, pos)
+        k = self._partial_rope(k, pos)
+        if attn_fn is not None:
+            attn = attn_fn(q, k, v)
+        else:
+            drop_rng = None
+            if train and cfg.dropout > 0 and rng is not None:
+                drop_rng = jax.random.fold_in(rng, 3)
+            attn = sp_attention(q, k, v, causal=True,
+                                dropout_rate=cfg.dropout if train else 0.0,
+                                dropout_rng=drop_rng, impl=cfg.sp_attention,
+                                backend=cfg.attn_backend)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
+        attn = attn @ p["attn_proj_w"].astype(attn.dtype)
+        if cfg.attn_bias:
+            attn = attn + p["attn_proj_b"].astype(attn.dtype)
+        return attn
+
+    def _mlp_branch(self, ln2, p):
+        cfg = self.config
+        hmid = ln2 @ p["mlp_fc_w"].astype(ln2.dtype) + \
+            p["mlp_fc_b"].astype(ln2.dtype)
+        hmid = _activation(hmid, cfg.activation)
+        return hmid @ p["mlp_proj_w"].astype(hmid.dtype) + \
+            p["mlp_proj_b"].astype(hmid.dtype)
+
+    def _block_impl(self, x, p, rng, train, attn_fn, start_pos):
+        cfg = self.config
+        eps = cfg.layer_norm_epsilon
+        ln1 = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], eps)
+        attn = self._attn_branch(ln1, p, rng, train, attn_fn, start_pos)
+        if cfg.use_parallel_residual:
+            mlp_in = ln1 if cfg.shared_ln else \
+                _layer_norm(x, p["ln2_scale"], p["ln2_bias"], eps)
+            mlp = self._mlp_branch(mlp_in, p)
+            return x + self._dropout(attn, rng, train, 0) + \
+                self._dropout(mlp, rng, train, 1)
+        h = x + self._dropout(attn, rng, train, 0)
+        ln2 = _layer_norm(h, p["ln2_scale"], p["ln2_bias"], eps)
+        return h + self._dropout(self._mlp_branch(ln2, p), rng, train, 1)
+
+    def _block(self, x, layer_params, rng, train):
+        return self._block_impl(x, layer_params, rng, train, None, 0), \
+            jnp.float32(0.0)
+
+    def _decode_block(self, x, layer_params, attn_fn, start_pos):
+        return self._block_impl(x, layer_params, None, False, attn_fn,
+                                start_pos)
+
+    # ------------------------------------------------------------- sharding
+    def partition_rules(self):
+        return [
+            (r"wte$", ("model", None)),
+            (r"lm_head$", ("model", None)),
+            (r"lm_head_b$", ("model",)),
+            (r"blocks/qkv_w$", ("pipe", None, "model")),
+            (r"blocks/qkv_b$", ("pipe", "model")),
+            (r"blocks/attn_proj_w$", ("pipe", "model", None)),
+            (r"blocks/mlp_fc_w$", ("pipe", None, "model")),
+            (r"blocks/mlp_fc_b$", ("pipe", "model")),
+            (r"blocks/mlp_proj_w$", ("pipe", "model", None)),
+            (r"blocks/", ("pipe",)),
+        ]

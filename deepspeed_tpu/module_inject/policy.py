@@ -180,7 +180,7 @@ def opt_policy(model) -> Tuple[Any, Any]:
     return spec, params
 
 
-@register_policy("LlamaForCausalLM", "LlamaModel", "MistralForCausalLM")
+@register_policy("LlamaForCausalLM", "MistralForCausalLM")
 def llama_policy(model) -> Tuple[Any, Any]:
     """HF LLaMA/Mistral → stacked-layer LlamaModel params. HF Linear stores
     [out, in] (transposed into x @ w); q/k/v concat into the fused qkv;
@@ -270,6 +270,10 @@ def bloom_policy(model) -> Tuple[Any, Any]:
     from ..models.bloom import BloomConfig, BloomModel
 
     hf_cfg = model.config
+    if getattr(hf_cfg, "apply_residual_connection_post_layernorm", False):
+        raise ValueError(
+            "apply_residual_connection_post_layernorm BLOOM variants are "
+            "not supported; residuals would silently diverge from HF")
     h = hf_cfg.n_head
     d = hf_cfg.hidden_size
     hd = d // h
@@ -321,6 +325,222 @@ def bloom_policy(model) -> Tuple[Any, Any]:
         "blocks": {k: jnp.asarray(v) for k, v in blocks.items()},
         "ln_f_scale": jnp.asarray(_np(tr.ln_f.weight)),
         "ln_f_bias": jnp.asarray(_np(tr.ln_f.bias)),
+    }
+    return spec, params
+
+
+@register_policy("GPTNeoXForCausalLM")
+def gpt_neox_policy(model) -> Tuple[Any, Any]:
+    """HF GPT-NeoX/Pythia → stacked-layer GPTNeoXModel params (reference
+    module_inject/containers/gptneox.py GPTNEOXLayerPolicy). The fused
+    query_key_value is head-interleaved like BLOOM's; de-interleave into
+    head-major q|k|v."""
+    import functools
+    import jax.numpy as jnp
+    from ..models.gpt_neox import GPTNeoXConfig, GPTNeoXModel
+
+    hf_cfg = model.config
+    act = getattr(hf_cfg, "hidden_act", "gelu")
+    if act not in ("gelu", "gelu_new", "gelu_fast", "gelu_pytorch_tanh"):
+        raise ValueError(f"unsupported NeoX activation {act!r}")
+    scaling = getattr(hf_cfg, "rope_scaling", None)
+    if scaling and scaling.get("rope_type",
+                               scaling.get("type", "default")) != "default":
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not supported (plain rotary only); "
+            f"logits would silently diverge from HF")
+    if hf_cfg.intermediate_size % hf_cfg.hidden_size != 0:
+        raise ValueError("intermediate_size must be a multiple of "
+                         "hidden_size")
+    h = hf_cfg.num_attention_heads
+    d = hf_cfg.hidden_size
+    hd = d // h
+    cfg = GPTNeoXConfig(
+        vocab_size=hf_cfg.vocab_size,
+        n_positions=hf_cfg.max_position_embeddings,
+        n_embd=d,
+        n_layer=hf_cfg.num_hidden_layers,
+        n_head=h,
+        mlp_ratio=hf_cfg.intermediate_size // d,
+        rotary_pct=hf_cfg.rotary_pct,
+        rope_theta=getattr(hf_cfg, "rotary_emb_base", 10000.0),
+        use_parallel_residual=getattr(hf_cfg, "use_parallel_residual", True),
+        activation="gelu_exact" if act == "gelu" else "gelu",
+        layer_norm_epsilon=hf_cfg.layer_norm_eps,
+        pad_vocab_to_multiple=1,
+    )
+    spec = GPTNeoXModel(cfg)
+    nx = model.gpt_neox if hasattr(model, "gpt_neox") else model
+    stack = functools.partial(_stack, nx.layers)
+
+    def qkv_w(blk):
+        w = _np(blk.attention.query_key_value.weight)       # [3D, D]
+        w = w.reshape(h, 3, hd, d)
+        return np.concatenate([w[:, i].reshape(h * hd, d)
+                               for i in range(3)], axis=0).T
+
+    def qkv_b(blk):
+        b = _np(blk.attention.query_key_value.bias).reshape(h, 3, hd)
+        return np.concatenate([b[:, i].reshape(h * hd) for i in range(3)])
+
+    blocks = {
+        "ln1_scale": stack(lambda b: _np(b.input_layernorm.weight)),
+        "ln1_bias": stack(lambda b: _np(b.input_layernorm.bias)),
+        "qkv_w": stack(qkv_w),
+        "qkv_b": stack(qkv_b),
+        "attn_proj_w": stack(lambda b: _lin_w(b.attention.dense)),
+        "attn_proj_b": stack(lambda b: _np(b.attention.dense.bias)),
+        "ln2_scale": stack(
+            lambda b: _np(b.post_attention_layernorm.weight)),
+        "ln2_bias": stack(lambda b: _np(b.post_attention_layernorm.bias)),
+        "mlp_fc_w": stack(lambda b: _lin_w(b.mlp.dense_h_to_4h)),
+        "mlp_fc_b": stack(lambda b: _np(b.mlp.dense_h_to_4h.bias)),
+        "mlp_proj_w": stack(lambda b: _lin_w(b.mlp.dense_4h_to_h)),
+        "mlp_proj_b": stack(lambda b: _np(b.mlp.dense_4h_to_h.bias)),
+    }
+    params = {
+        "wte": jnp.asarray(_np(nx.embed_in.weight)),
+        "blocks": {k: jnp.asarray(v) for k, v in blocks.items()},
+        "ln_f_scale": jnp.asarray(_np(nx.final_layer_norm.weight)),
+        "ln_f_bias": jnp.asarray(_np(nx.final_layer_norm.bias)),
+        "lm_head": jnp.asarray(_np(model.embed_out.weight)),
+    }
+    return spec, params
+
+
+@register_policy("GPTJForCausalLM")
+def gptj_policy(model) -> Tuple[Any, Any]:
+    """HF GPT-J → stacked-layer GPTNeoXModel params in its GPT-J flavor
+    (reference module_inject/containers/gptj.py HFGPTJLayerPolicy): shared
+    block LayerNorm, interleaved partial rotary, no attention biases,
+    LM head with bias."""
+    import functools
+    import jax.numpy as jnp
+    from ..models.gpt_neox import GPTNeoXModel, gptj_config
+
+    hf_cfg = model.config
+    act = getattr(hf_cfg, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu", "gelu_pytorch_tanh"):
+        raise ValueError(f"unsupported GPT-J activation {act!r}")
+    d = hf_cfg.n_embd
+    inner = getattr(hf_cfg, "n_inner", None) or 4 * d
+    if inner % d != 0:
+        raise ValueError("n_inner must be a multiple of n_embd")
+    cfg = gptj_config(
+        vocab_size=hf_cfg.vocab_size,
+        n_positions=hf_cfg.n_positions,
+        n_embd=d,
+        n_layer=hf_cfg.n_layer,
+        n_head=hf_cfg.n_head,
+        mlp_ratio=inner // d,
+        rotary_ndims=hf_cfg.rotary_dim,
+        activation="gelu_exact" if act == "gelu" else "gelu",
+        layer_norm_epsilon=hf_cfg.layer_norm_epsilon,
+        pad_vocab_to_multiple=1,
+    )
+    spec = GPTNeoXModel(cfg)
+    tr = model.transformer if hasattr(model, "transformer") else model
+    stack = functools.partial(_stack, tr.h)
+
+    def qkv_w(blk):
+        a = blk.attn
+        return np.concatenate([_lin_w(a.q_proj), _lin_w(a.k_proj),
+                               _lin_w(a.v_proj)], axis=1)
+
+    blocks = {
+        "ln1_scale": stack(lambda b: _np(b.ln_1.weight)),
+        "ln1_bias": stack(lambda b: _np(b.ln_1.bias)),
+        "qkv_w": stack(qkv_w),
+        "attn_proj_w": stack(lambda b: _lin_w(b.attn.out_proj)),
+        "mlp_fc_w": stack(lambda b: _lin_w(b.mlp.fc_in)),
+        "mlp_fc_b": stack(lambda b: _np(b.mlp.fc_in.bias)),
+        "mlp_proj_w": stack(lambda b: _lin_w(b.mlp.fc_out)),
+        "mlp_proj_b": stack(lambda b: _np(b.mlp.fc_out.bias)),
+    }
+    params = {
+        "wte": jnp.asarray(_np(tr.wte.weight)),
+        "blocks": {k: jnp.asarray(v) for k, v in blocks.items()},
+        "ln_f_scale": jnp.asarray(_np(tr.ln_f.weight)),
+        "ln_f_bias": jnp.asarray(_np(tr.ln_f.bias)),
+        "lm_head": jnp.asarray(_np(model.lm_head.weight)),
+        "lm_head_b": jnp.asarray(_np(model.lm_head.bias)),
+    }
+    return spec, params
+
+
+@register_policy("BertForMaskedLM", "BertForPreTraining")
+def bert_policy(model) -> Tuple[Any, Any]:
+    """HF BERT → stacked-layer BertModel params (reference
+    module_inject/containers/bert.py HFBertLayerPolicy). Post-LN encoder;
+    separate q/k/v concat into fused qkv; MLM transform + tied decoder +
+    vocab bias."""
+    import functools
+    import jax.numpy as jnp
+    from ..models.bert import BertConfig, BertModel
+
+    hf_cfg = model.config
+    act = getattr(hf_cfg, "hidden_act", "gelu")
+    if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(f"unsupported BERT activation {act!r}")
+    if hf_cfg.intermediate_size % hf_cfg.hidden_size != 0:
+        raise ValueError("intermediate_size must be a multiple of "
+                         "hidden_size")
+    cfg = BertConfig(
+        vocab_size=hf_cfg.vocab_size,
+        n_positions=hf_cfg.max_position_embeddings,
+        type_vocab_size=hf_cfg.type_vocab_size,
+        n_embd=hf_cfg.hidden_size,
+        n_layer=hf_cfg.num_hidden_layers,
+        n_head=hf_cfg.num_attention_heads,
+        mlp_ratio=hf_cfg.intermediate_size // hf_cfg.hidden_size,
+        activation="gelu_exact" if act == "gelu" else "gelu",
+        layer_norm_epsilon=hf_cfg.layer_norm_eps,
+        pad_vocab_to_multiple=1,
+    )
+    spec = BertModel(cfg)
+    bert = model.bert if hasattr(model, "bert") else model
+    emb = bert.embeddings
+    stack = functools.partial(_stack, bert.encoder.layer)
+
+    def qkv_w(blk):
+        a = blk.attention.self
+        return np.concatenate([_lin_w(a.query), _lin_w(a.key),
+                               _lin_w(a.value)], axis=1)
+
+    def qkv_b(blk):
+        a = blk.attention.self
+        return np.concatenate([_np(a.query.bias), _np(a.key.bias),
+                               _np(a.value.bias)])
+
+    blocks = {
+        "qkv_w": stack(qkv_w),
+        "qkv_b": stack(qkv_b),
+        "attn_out_w": stack(lambda b: _lin_w(b.attention.output.dense)),
+        "attn_out_b": stack(lambda b: _np(b.attention.output.dense.bias)),
+        "attn_ln_scale": stack(
+            lambda b: _np(b.attention.output.LayerNorm.weight)),
+        "attn_ln_bias": stack(
+            lambda b: _np(b.attention.output.LayerNorm.bias)),
+        "inter_w": stack(lambda b: _lin_w(b.intermediate.dense)),
+        "inter_b": stack(lambda b: _np(b.intermediate.dense.bias)),
+        "out_w": stack(lambda b: _lin_w(b.output.dense)),
+        "out_b": stack(lambda b: _np(b.output.dense.bias)),
+        "out_ln_scale": stack(lambda b: _np(b.output.LayerNorm.weight)),
+        "out_ln_bias": stack(lambda b: _np(b.output.LayerNorm.bias)),
+    }
+    pred = model.cls.predictions
+    params = {
+        "wte": jnp.asarray(_np(emb.word_embeddings.weight)),
+        "wpe": jnp.asarray(_np(emb.position_embeddings.weight)),
+        "tte": jnp.asarray(_np(emb.token_type_embeddings.weight)),
+        "emb_ln_scale": jnp.asarray(_np(emb.LayerNorm.weight)),
+        "emb_ln_bias": jnp.asarray(_np(emb.LayerNorm.bias)),
+        "blocks": {k: jnp.asarray(v) for k, v in blocks.items()},
+        "mlm_dense_w": jnp.asarray(_lin_w(pred.transform.dense)),
+        "mlm_dense_b": jnp.asarray(_np(pred.transform.dense.bias)),
+        "mlm_ln_scale": jnp.asarray(_np(pred.transform.LayerNorm.weight)),
+        "mlm_ln_bias": jnp.asarray(_np(pred.transform.LayerNorm.bias)),
+        "mlm_bias": jnp.asarray(_np(pred.bias)),
     }
     return spec, params
 

@@ -1,0 +1,92 @@
+"""GPT-NeoX / GPT-J family tests: parallel-residual training, KV-cache
+decode parity across the cache boundary (partial rotary offsets), and HF
+logits parity for BOTH flavors (NeoX: rotate_half partial rotary + two LNs;
+GPT-J: interleaved rotary + shared LN + biasless attention + head bias)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt_neox import (GPTNeoXConfig, GPTNeoXModel,
+                                           gptj_config)
+
+TINY_NEOX = GPTNeoXConfig(vocab_size=256, n_positions=64, n_embd=64,
+                          n_layer=2, n_head=4, pad_vocab_to_multiple=8)
+TINY_GPTJ = gptj_config(vocab_size=256, n_positions=64, n_embd=64,
+                        n_layer=2, n_head=4, rotary_ndims=8,
+                        pad_vocab_to_multiple=8)
+
+
+@pytest.mark.parametrize("cfg", [TINY_NEOX, TINY_GPTJ],
+                         ids=["neox", "gptj"])
+def test_trains_with_zero(cfg):
+    model = GPTNeoXModel(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+        "zero_optimization": {"stage": 3,
+                              "stage3_param_persistence_threshold": 0},
+        "steps_per_print": 0})
+    rng = np.random.default_rng(0)
+    fixed = {"input_ids": rng.integers(0, 255, (1, 8, 16), np.int32)}
+    losses = [float(engine.train_batch(batch=fixed)) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    assert "lm_head" in engine.param_shapes    # untied head, no positions
+    assert "wpe" not in engine.param_shapes
+
+
+@pytest.mark.parametrize("cfg", [TINY_NEOX, TINY_GPTJ],
+                         ids=["neox", "gptj"])
+def test_cache_matches_full_forward(cfg):
+    import jax
+    import jax.numpy as jnp
+    model = GPTNeoXModel(cfg)
+    params = model.init(jax.random.PRNGKey(1))
+    ids = np.random.default_rng(2).integers(0, 255, (2, 10)).astype(np.int32)
+    full = model.logits(params, jnp.asarray(ids), train=False)
+
+    cache = model.init_kv_cache(2, 16, dtype=jnp.float32)
+    pre, cache = model.apply_with_cache(params, jnp.asarray(ids[:, :7]),
+                                        cache, 0)
+    np.testing.assert_allclose(np.asarray(pre), np.asarray(full[:, :7]),
+                               atol=1e-4)
+    for i in range(7, 10):
+        step, cache = model.apply_with_cache(params,
+                                             jnp.asarray(ids[:, i:i+1]),
+                                             cache, i)
+        np.testing.assert_allclose(np.asarray(step[:, 0]),
+                                   np.asarray(full[:, i]), atol=1e-4)
+
+
+def test_hf_neox_injection_logits_parity():
+    transformers = pytest.importorskip("transformers")
+    import torch
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256, rotary_pct=0.25,
+        max_position_embeddings=64, use_parallel_residual=True,
+        hidden_act="gelu")
+    hf = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+    ids = np.random.default_rng(0).integers(0, 128, (2, 12)).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    eng = deepspeed_tpu.init_inference(hf, {"dtype": "float32"})
+    got = np.asarray(eng(ids.astype(np.int32)))
+    np.testing.assert_allclose(got[..., :128], ref, atol=2e-3)
+
+
+def test_hf_gptj_injection_logits_parity():
+    transformers = pytest.importorskip("transformers")
+    import torch
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, rotary_dim=8,
+        n_positions=64, activation_function="gelu_new")
+    hf = transformers.GPTJForCausalLM(hf_cfg).eval()
+    ids = np.random.default_rng(0).integers(0, 128, (2, 12)).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    eng = deepspeed_tpu.init_inference(hf, {"dtype": "float32"})
+    got = np.asarray(eng(ids.astype(np.int32)))
+    np.testing.assert_allclose(got[..., :128], ref, atol=2e-3)
