@@ -159,7 +159,7 @@ class InferenceEngine:
     def generate(self, input_ids, max_new_tokens: int = 64,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_token_id: Optional[int] = None, seed: int = 0,
-                 max_length: Optional[int] = None):
+                 max_length: Optional[int] = None, top_p: float = 1.0):
         """Autoregressive generation, one compiled program per
         (prompt_shape, max_new_tokens) bucket. Returns [B, T+max_new_tokens]
         (prompt + generated; positions after EOS hold eos_token_id)."""
@@ -186,17 +186,17 @@ class InferenceEngine:
                 f"(reference inference/engine.py:588 guard); growing cache")
 
         key = ("gen", b, t, max_new_tokens, float(temperature), top_k,
-               eos_token_id)
+               float(top_p), eos_token_id)
         if key not in self._fns:
             self._fns[key] = self._build_generate(
-                b, t, cache_len, max_new_tokens, temperature, top_k,
+                b, t, cache_len, max_new_tokens, temperature, top_k, top_p,
                 eos_token_id)
         with self.mesh:
             return self._fns[key](self.params, input_ids,
                                   jax.random.PRNGKey(seed))
 
     def _build_generate(self, b, t, cache_len, max_new_tokens, temperature,
-                        top_k, eos_token_id):
+                        top_k, top_p, eos_token_id):
         model = self.module
         vocab = model.config.vocab_size
 
@@ -209,6 +209,16 @@ class InferenceEngine:
             if top_k:
                 kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
                 logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_p < 1.0:
+                # nucleus: keep the smallest prefix of descending-prob
+                # tokens whose mass reaches top_p (always >= 1 token)
+                desc = jnp.sort(logits, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(desc, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = (cum - probs) < top_p
+                thresh = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                                 keepdims=True)
+                logits = jnp.where(logits >= thresh, logits, -jnp.inf)
             return jax.random.categorical(key, logits, axis=-1).astype(
                 jnp.int32)
 

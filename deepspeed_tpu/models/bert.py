@@ -161,7 +161,7 @@ class BertModel(ModelSpec):
         x = _layer_norm(x, params["mlm_ln_scale"], params["mlm_ln_bias"],
                         cfg.layer_norm_epsilon)
         return x @ params["wte"].astype(x.dtype).T + \
-            params["mlm_bias"].astype(jnp.float32)
+            params["mlm_bias"].astype(x.dtype)
 
     def logits(self, params, input_ids, rng=None, train=True,
                return_aux_loss=False):

@@ -449,6 +449,11 @@ class GPT2Model(ModelSpec):
         shape = (cfg.n_layer, batch_size, self.kv_heads, max_len, cfg.head_dim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
+    def _decode_attn_mask(self, q_pos, k_pos):
+        """[T, max_len] boolean keep-mask over the KV cache. Sliding-window
+        families tighten it."""
+        return k_pos <= q_pos
+
     def _decode_attn_bias(self, q_pos, k_pos):
         """Additive attention bias on the decode path ([H, T, max_len] or
         None). ALiBi families override."""
@@ -473,7 +478,7 @@ class GPT2Model(ModelSpec):
         # attention mask over the cache: key position <= query position
         q_pos = start_pos + jnp.arange(t)[:, None]
         k_pos = jnp.arange(max_len)[None, :]
-        mask = (k_pos <= q_pos)[None, None]          # [1, 1, T, max_len]
+        mask = self._decode_attn_mask(q_pos, k_pos)[None, None]
         bias = self._decode_attn_bias(q_pos, k_pos)  # [H, T, max_len] | None
 
         from ..ops.flash_attention import reference_attention

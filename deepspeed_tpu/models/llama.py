@@ -31,6 +31,7 @@ class LlamaConfig(GPT2Config):
     n_kv_head: Optional[int] = None     # None => MHA
     rope_theta: float = 10000.0
     mlp_hidden: Optional[int] = None    # intermediate size; None => mlp_ratio*d
+    sliding_window: Optional[int] = None  # Mistral windowed causal attention
     tie_word_embeddings: bool = False
     layer_norm_epsilon: float = 1e-5    # rms_norm eps
 
@@ -128,6 +129,12 @@ class LlamaModel(GPT2Model):
         head = params.get("lm_head", params["wte"])
         return head.astype(dtype)
 
+    def _decode_attn_mask(self, q_pos, k_pos):
+        keep = k_pos <= q_pos
+        if self.config.sliding_window is not None:
+            keep &= (q_pos - k_pos) < self.config.sliding_window
+        return keep
+
     # ----------------------------------------------------------------- block
     def _attn_sublayer(self, x, p, rng, train, attn_fn=None, start_pos=0):
         cfg = self.config
@@ -155,7 +162,8 @@ class LlamaModel(GPT2Model):
                                              if train and cfg.dropout > 0 and
                                              rng is not None else None),
                                 impl=cfg.sp_attention,
-                                backend=cfg.attn_backend)
+                                backend=cfg.attn_backend,
+                                window=cfg.sliding_window)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
         attn = attn @ p["attn_proj_w"].astype(attn.dtype)
         return x + self._dropout(attn, rng, train, 0)

@@ -203,11 +203,6 @@ def llama_policy(model) -> Tuple[Any, Any]:
             f"logits would silently diverge from HF")
     if getattr(hf_cfg, "attention_bias", False):
         raise ValueError("attention_bias=True LLaMA variants not supported")
-    window = getattr(hf_cfg, "sliding_window", None)
-    if window is not None and window < hf_cfg.max_position_embeddings:
-        raise ValueError(
-            f"sliding_window={window} attention is not supported; full-"
-            f"context attention would silently diverge past the window")
     explicit_hd = getattr(hf_cfg, "head_dim", None)
     if explicit_hd is not None and \
             explicit_hd != hf_cfg.hidden_size // hf_cfg.num_attention_heads:
@@ -226,6 +221,7 @@ def llama_policy(model) -> Tuple[Any, Any]:
                           hf_cfg.num_attention_heads),
         mlp_hidden=hf_cfg.intermediate_size,
         rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        sliding_window=getattr(hf_cfg, "sliding_window", None),
         layer_norm_epsilon=hf_cfg.rms_norm_eps,
         tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
         pad_vocab_to_multiple=1,

@@ -15,13 +15,18 @@ import jax.numpy as jnp
 
 
 def reference_attention(q, k, v, causal=True, mask=None, softmax_scale=None,
-                        dropout_rate=0.0, dropout_rng=None, bias=None):
+                        dropout_rate=0.0, dropout_rng=None, bias=None,
+                        window=None):
     """Plain XLA attention. q,k,v: [B, H, T, D] (q may have Tq != Tk for
     decode). ``bias`` is an additive logits bias broadcastable to
-    [B, H, Tq, Tk] (ALiBi). Numerics oracle for the Pallas kernel."""
+    [B, H, Tq, Tk] (ALiBi). ``window`` (with causal) keeps only keys with
+    q_pos - k_pos < window — Mistral sliding-window semantics. Numerics
+    oracle for the Pallas kernel."""
     *_, t_q, d = q.shape
     t_k = k.shape[-2]
     scale = softmax_scale if softmax_scale is not None else 1.0 / jnp.sqrt(d)
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal=True")
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     logits = logits.astype(jnp.float32)
     if bias is not None:
@@ -31,6 +36,8 @@ def reference_attention(q, k, v, causal=True, mask=None, softmax_scale=None,
         q_pos = jnp.arange(t_q)[:, None] + (t_k - t_q)
         k_pos = jnp.arange(t_k)[None, :]
         causal_mask = q_pos >= k_pos
+        if window is not None:
+            causal_mask &= (q_pos - k_pos) < window
         logits = jnp.where(causal_mask[None, None], logits, -1e30)
     if mask is not None:
         logits = jnp.where(mask, logits, -1e30)
@@ -58,7 +65,7 @@ def _on_tpu() -> bool:
 
 def flash_attention(q, k, v, causal=True, mask=None, softmax_scale=None,
                     dropout_rate=0.0, dropout_rng=None, backend="auto",
-                    interpret=None, bias=None):
+                    interpret=None, bias=None, window=None):
     """Dispatch: Pallas kernel on TPU, XLA reference elsewhere.
 
     backend="pallas" runs the Pallas kernel unconditionally and RAISES if the
@@ -71,30 +78,34 @@ def flash_attention(q, k, v, causal=True, mask=None, softmax_scale=None,
 
     if backend == "pallas":
         if bias is not None or not pallas_fa.supported(
-                q, k, causal=causal, mask=mask, dropout_rate=dropout_rate):
+                q, k, causal=causal, mask=mask, dropout_rate=dropout_rate,
+                window=window):
             raise ValueError(
                 f"pallas flash attention does not support this call "
                 f"(q={q.shape} k={k.shape} causal={causal} "
                 f"mask={'yes' if mask is not None else 'no'} "
                 f"bias={'yes' if bias is not None else 'no'} "
+                f"window={window} "
                 f"dropout={dropout_rate}); pass backend='xla' explicitly")
         if interpret is None:
             interpret = not _on_tpu()
         return pallas_fa.flash_attention(q, k, v, causal, softmax_scale,
-                                         None, None, interpret)
+                                         None, None, interpret, window)
     if backend == "auto" and _on_tpu():
         if bias is None and pallas_fa.supported(q, k, causal=causal,
                                                 mask=mask,
-                                                dropout_rate=dropout_rate):
+                                                dropout_rate=dropout_rate,
+                                                window=window):
             return pallas_fa.flash_attention(q, k, v, causal, softmax_scale,
-                                             None, None, False)
+                                             None, None, False, window)
         _warn_xla_fallback(q, bias)
     if backend not in ("auto", "xla"):
         raise ValueError(f"unknown attention backend {backend!r}")
     return reference_attention(q, k, v, causal=causal, mask=mask,
                                softmax_scale=softmax_scale,
                                dropout_rate=dropout_rate,
-                               dropout_rng=dropout_rng, bias=bias)
+                               dropout_rng=dropout_rng, bias=bias,
+                               window=window)
 
 
 _warned_fallback = False

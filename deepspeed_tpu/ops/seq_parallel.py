@@ -117,10 +117,11 @@ def ring_attention(q, k, v, causal=True, softmax_scale=None):
 
 
 def sp_attention(q, k, v, causal=True, softmax_scale=None, dropout_rate=0.0,
-                 dropout_rng=None, impl="ulysses", backend="auto", bias=None):
+                 dropout_rng=None, impl="ulysses", backend="auto", bias=None,
+                 window=None):
     """Dispatch by impl when the 'seq' axis is live; plain flash otherwise.
-    ``bias`` (additive logits bias, e.g. ALiBi) is only supported off the
-    sequence-parallel paths — a bias would need re-sharding over 'seq'."""
+    ``bias`` (additive logits bias, e.g. ALiBi) and ``window`` (sliding-
+    window causal) are only supported off the sequence-parallel paths."""
     if impl not in ("ulysses", "ring"):
         raise ValueError(f"sp_attention impl must be 'ulysses' or 'ring', "
                          f"got {impl!r}")
@@ -129,11 +130,15 @@ def sp_attention(q, k, v, causal=True, softmax_scale=None, dropout_rate=0.0,
                                softmax_scale=softmax_scale,
                                dropout_rate=dropout_rate,
                                dropout_rng=dropout_rng, backend=backend,
-                               bias=bias)
+                               bias=bias, window=window)
     if bias is not None:
         raise NotImplementedError(
             "attention bias (ALiBi) is not supported under sequence "
             "parallelism; run ALiBi models with sp=1")
+    if window is not None:
+        raise NotImplementedError(
+            "sliding-window attention is not supported under sequence "
+            "parallelism; run windowed models with sp=1")
     if impl == "ring":
         if dropout_rate > 0.0:
             raise NotImplementedError(

@@ -89,6 +89,21 @@ def test_sampling_respects_top_k():
     assert out.shape == (2, 14)
 
 
+def test_sampling_top_p_nucleus():
+    """top_p=tiny degenerates to greedy (only the argmax survives the
+    nucleus); top_p=1.0 is plain sampling."""
+    model = _tiny_model()
+    eng = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    ids = _ids()
+    greedy = np.asarray(eng.generate(ids, max_new_tokens=4, temperature=0.0))
+    nucleus = np.asarray(eng.generate(ids, max_new_tokens=4, temperature=1.0,
+                                      top_p=1e-6, seed=11))
+    np.testing.assert_array_equal(greedy, nucleus)
+    out = eng.generate(ids, max_new_tokens=4, temperature=1.0, top_p=0.9,
+                       seed=7)
+    assert np.asarray(out).shape == (2, 14)
+
+
 def test_checkpoint_to_inference_roundtrip(tmp_path):
     model = _tiny_model()
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={

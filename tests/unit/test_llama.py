@@ -86,3 +86,44 @@ def test_hf_llama_injection_logits_parity():
     eng = deepspeed_tpu.init_inference(hf, {"dtype": "float32"})
     got = np.asarray(eng(ids.astype(np.int32)))
     np.testing.assert_allclose(got[..., :128], ref, atol=2e-3)
+
+
+def test_mistral_sliding_window_cache_matches_full():
+    """Windowed training forward == windowed decode through the cache."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    cfg = dataclasses.replace(TINY, sliding_window=6)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(3))
+    ids = np.random.default_rng(4).integers(0, 255, (2, 12)).astype(np.int32)
+    full = model.logits(params, jnp.asarray(ids), train=False)
+
+    cache = model.init_kv_cache(2, 16, dtype=jnp.float32)
+    pre, cache = model.apply_with_cache(params, jnp.asarray(ids[:, :8]),
+                                        cache, 0)
+    np.testing.assert_allclose(np.asarray(pre), np.asarray(full[:, :8]),
+                               atol=1e-4)
+    for i in range(8, 12):
+        step, cache = model.apply_with_cache(params,
+                                             jnp.asarray(ids[:, i:i+1]),
+                                             cache, i)
+        np.testing.assert_allclose(np.asarray(step[:, 0]),
+                                   np.asarray(full[:, i]), atol=1e-4)
+
+
+def test_hf_mistral_sliding_window_injection_parity():
+    transformers = pytest.importorskip("transformers")
+    import torch
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8)
+    hf = transformers.MistralForCausalLM(hf_cfg).eval()
+    ids = np.random.default_rng(5).integers(0, 128, (2, 14)).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    eng = deepspeed_tpu.init_inference(hf, {"dtype": "float32"})
+    assert eng.module.config.sliding_window == 8
+    got = np.asarray(eng(ids.astype(np.int32)))
+    np.testing.assert_allclose(got[..., :128], ref, atol=2e-3)

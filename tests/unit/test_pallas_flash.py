@@ -99,3 +99,42 @@ def test_supported_predicate():
     assert not pallas_fa.supported(q, k, dropout_rate=0.1)
     q2, k2, _ = _rand_qkv(1, 1, 100, 64)
     assert not pallas_fa.supported(q2, k2)
+
+
+@pytest.mark.parametrize("window", [64, 100, 256])
+def test_sliding_window_forward_matches_reference(window):
+    q, k, v = _rand_qkv(1, 2, 256, 64, seed=3)
+    ref = reference_attention(q, k, v, causal=True, window=window)
+    out = pallas_fa.flash_attention(q, k, v, True, None, None, None, True,
+                                    window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_sliding_window_grads_match_reference():
+    q, k, v = _rand_qkv(1, 2, 256, 32, seed=4)
+    window = 96
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True,
+                                           window=window) ** 2)
+
+    def loss_pallas(q, k, v):
+        return jnp.sum(pallas_fa.flash_attention(
+            q, k, v, True, None, None, None, True, window) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_pal = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_pal):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_window_dispatch_and_supported():
+    q, k, _ = _rand_qkv(1, 1, 256, 64)
+    assert pallas_fa.supported(q, k, window=64)
+    assert not pallas_fa.supported(q, k, causal=False, window=64)
+    ref = reference_attention(q, k, k, causal=True, window=64)
+    out = flash_attention(q, k, k, causal=True, backend="pallas", window=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
