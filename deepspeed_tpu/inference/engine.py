@@ -163,6 +163,8 @@ class InferenceEngine:
         """Autoregressive generation, one compiled program per
         (prompt_shape, max_new_tokens) bucket. Returns [B, T+max_new_tokens]
         (prompt + generated; positions after EOS hold eos_token_id)."""
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         input_ids = jnp.asarray(input_ids)
         if input_ids.ndim == 1:
             input_ids = input_ids[None]
@@ -206,13 +208,17 @@ class InferenceEngine:
             if temperature <= 0.0:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
             logits = logits / temperature
+            if top_k or top_p < 1.0:     # one descending sort serves both
+                desc = jnp.sort(logits, axis=-1)[:, ::-1]
             if top_k:
-                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
+                logits = jnp.where(logits < desc[:, top_k - 1][:, None],
+                                   -jnp.inf, logits)
             if top_p < 1.0:
                 # nucleus: keep the smallest prefix of descending-prob
-                # tokens whose mass reaches top_p (always >= 1 token)
-                desc = jnp.sort(logits, axis=-1)[:, ::-1]
+                # tokens whose mass reaches top_p (always >= 1 token);
+                # applied on the pre-top-k distribution like HF's default
+                # warper order would after renormalization — identical
+                # support because both filters are rank cutoffs on `desc`
                 probs = jax.nn.softmax(desc, axis=-1)
                 cum = jnp.cumsum(probs, axis=-1)
                 keep = (cum - probs) < top_p

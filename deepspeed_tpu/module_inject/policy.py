@@ -221,7 +221,11 @@ def llama_policy(model) -> Tuple[Any, Any]:
                           hf_cfg.num_attention_heads),
         mlp_hidden=hf_cfg.intermediate_size,
         rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
-        sliding_window=getattr(hf_cfg, "sliding_window", None),
+        # a window >= context can never mask anything — normalize to None so
+        # such checkpoints keep full-context attention (incl. under SP)
+        sliding_window=(lambda w: w if w is not None and
+                        w < hf_cfg.max_position_embeddings else None)(
+                            getattr(hf_cfg, "sliding_window", None)),
         layer_norm_epsilon=hf_cfg.rms_norm_eps,
         tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
         pad_vocab_to_multiple=1,
@@ -537,6 +541,79 @@ def bert_policy(model) -> Tuple[Any, Any]:
         "mlm_ln_scale": jnp.asarray(_np(pred.transform.LayerNorm.weight)),
         "mlm_ln_bias": jnp.asarray(_np(pred.transform.LayerNorm.bias)),
         "mlm_bias": jnp.asarray(_np(pred.bias)),
+    }
+    return spec, params
+
+
+@register_policy("DistilBertForMaskedLM")
+def distil_bert_policy(model) -> Tuple[Any, Any]:
+    """HF DistilBERT → BertModel params (reference module_inject/containers/
+    distil_bert.py HFDistilBertLayerPolicy). Architecturally BERT without
+    token-type embeddings (tte maps to a zero row) and with renamed
+    submodules; same post-LN encoder + MLM transform head."""
+    import functools
+    import jax.numpy as jnp
+    from ..models.bert import BertConfig, BertModel
+
+    hf_cfg = model.config
+    act = getattr(hf_cfg, "activation", "gelu")
+    if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(f"unsupported DistilBERT activation {act!r}")
+    if hf_cfg.hidden_dim % hf_cfg.dim != 0:
+        raise ValueError("hidden_dim must be a multiple of dim")
+    cfg = BertConfig(
+        vocab_size=hf_cfg.vocab_size,
+        n_positions=hf_cfg.max_position_embeddings,
+        type_vocab_size=1,
+        n_embd=hf_cfg.dim,
+        n_layer=hf_cfg.n_layers,
+        n_head=hf_cfg.n_heads,
+        mlp_ratio=hf_cfg.hidden_dim // hf_cfg.dim,
+        activation="gelu_exact" if act == "gelu" else "gelu",
+        layer_norm_epsilon=1e-12,
+        pad_vocab_to_multiple=1,
+    )
+    spec = BertModel(cfg)
+    db = model.distilbert if hasattr(model, "distilbert") else model
+    emb = db.embeddings
+    stack = functools.partial(_stack, db.transformer.layer)
+
+    def qkv_w(blk):
+        a = blk.attention
+        return np.concatenate([_lin_w(a.q_lin), _lin_w(a.k_lin),
+                               _lin_w(a.v_lin)], axis=1)
+
+    def qkv_b(blk):
+        a = blk.attention
+        return np.concatenate([_np(a.q_lin.bias), _np(a.k_lin.bias),
+                               _np(a.v_lin.bias)])
+
+    blocks = {
+        "qkv_w": stack(qkv_w),
+        "qkv_b": stack(qkv_b),
+        "attn_out_w": stack(lambda b: _lin_w(b.attention.out_lin)),
+        "attn_out_b": stack(lambda b: _np(b.attention.out_lin.bias)),
+        "attn_ln_scale": stack(lambda b: _np(b.sa_layer_norm.weight)),
+        "attn_ln_bias": stack(lambda b: _np(b.sa_layer_norm.bias)),
+        "inter_w": stack(lambda b: _lin_w(b.ffn.lin1)),
+        "inter_b": stack(lambda b: _np(b.ffn.lin1.bias)),
+        "out_w": stack(lambda b: _lin_w(b.ffn.lin2)),
+        "out_b": stack(lambda b: _np(b.ffn.lin2.bias)),
+        "out_ln_scale": stack(lambda b: _np(b.output_layer_norm.weight)),
+        "out_ln_bias": stack(lambda b: _np(b.output_layer_norm.bias)),
+    }
+    params = {
+        "wte": jnp.asarray(_np(emb.word_embeddings.weight)),
+        "wpe": jnp.asarray(_np(emb.position_embeddings.weight)),
+        "tte": jnp.zeros((1, hf_cfg.dim), jnp.float32),
+        "emb_ln_scale": jnp.asarray(_np(emb.LayerNorm.weight)),
+        "emb_ln_bias": jnp.asarray(_np(emb.LayerNorm.bias)),
+        "blocks": {k: jnp.asarray(v) for k, v in blocks.items()},
+        "mlm_dense_w": jnp.asarray(_lin_w(model.vocab_transform)),
+        "mlm_dense_b": jnp.asarray(_np(model.vocab_transform.bias)),
+        "mlm_ln_scale": jnp.asarray(_np(model.vocab_layer_norm.weight)),
+        "mlm_ln_bias": jnp.asarray(_np(model.vocab_layer_norm.bias)),
+        "mlm_bias": jnp.asarray(_np(model.vocab_projector.bias)),
     }
     return spec, params
 

@@ -86,3 +86,18 @@ def test_hf_bert_injection_logits_parity():
     eng = deepspeed_tpu.init_inference(hf, {"dtype": "float32"})
     got = np.asarray(eng(ids.astype(np.int32)))
     np.testing.assert_allclose(got[..., :128], ref, atol=2e-3)
+
+
+def test_hf_distilbert_injection_logits_parity():
+    transformers = pytest.importorskip("transformers")
+    import torch
+    hf_cfg = transformers.DistilBertConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, hidden_dim=256,
+        max_position_embeddings=64, dropout=0.0, attention_dropout=0.0)
+    hf = transformers.DistilBertForMaskedLM(hf_cfg).eval()
+    ids = np.random.default_rng(1).integers(0, 128, (2, 12)).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    eng = deepspeed_tpu.init_inference(hf, {"dtype": "float32"})
+    got = np.asarray(eng(ids.astype(np.int32)))
+    np.testing.assert_allclose(got[..., :128], ref, atol=2e-3)
