@@ -213,12 +213,14 @@ class InferenceEngine:
             if top_k:
                 logits = jnp.where(logits < desc[:, top_k - 1][:, None],
                                    -jnp.inf, logits)
+                # top-k survivors are exactly the first k sorted entries
+                desc = jnp.where(
+                    jnp.arange(desc.shape[-1])[None] < top_k, desc, -jnp.inf)
             if top_p < 1.0:
                 # nucleus: keep the smallest prefix of descending-prob
-                # tokens whose mass reaches top_p (always >= 1 token);
-                # applied on the pre-top-k distribution like HF's default
-                # warper order would after renormalization — identical
-                # support because both filters are rank cutoffs on `desc`
+                # tokens whose mass reaches top_p (always >= 1 token),
+                # computed on the top-k-RENORMALIZED distribution — HF's
+                # TopK-then-TopP warper order
                 probs = jax.nn.softmax(desc, axis=-1)
                 cum = jnp.cumsum(probs, axis=-1)
                 keep = (cum - probs) < top_p

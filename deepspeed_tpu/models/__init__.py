@@ -5,3 +5,4 @@ from .llama import LlamaConfig, LlamaModel
 from .bloom import BloomConfig, BloomModel
 from .gpt_neox import GPTNeoXConfig, GPTNeoXModel, gptj_config
 from .bert import BertConfig, BertModel
+from .clip import CLIPConfig, CLIPModel

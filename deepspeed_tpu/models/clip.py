@@ -1,0 +1,230 @@
+"""CLIP family — dual-tower contrastive vision/text model.
+
+Capability match for the reference's CLIP support (module_inject/
+containers/clip.py HFCLIPLayerPolicy serves the stable-diffusion text
+encoder). Both towers reuse the stacked-scan GPT-2 block (pre-LN, fused
+qkv, biases) with CLIP's quick_gelu:
+
+  text tower:   token + learned-position embeddings, CAUSAL attention,
+                final LN, pooled at the EOT token (highest token id — the
+                HF legacy pooling rule).
+  vision tower: non-overlapping patch embedding as ONE matmul (the conv
+                with stride == kernel is exactly a reshaped matmul — MXU
+                native, no conv lowering), prepended class token, learned
+                positions, pre-LN + post-LN, BIDIRECTIONAL attention,
+                pooled at the class token.
+
+``CLIPModel`` composes the towers with the two projections and the learned
+logit scale; ``apply`` is the symmetric InfoNCE contrastive loss, so the
+model trains through the engine like any other family.
+
+Batch: {"input_ids" [B, T], "pixel_values" [B, 3, H, W] (HF processor
+layout)}.
+"""
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .api import ModelSpec
+from .gpt2 import GPT2Config, GPT2Model, _layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig(GPT2Config):
+    vocab_size: int = 49408
+    n_positions: int = 77
+    n_embd: int = 512
+    n_layer: int = 12
+    n_head: int = 8
+    activation: str = "quick_gelu"
+    pad_vocab_to_multiple: int = 1
+    # None = HF legacy pooling (argmax token id); an id = first-eos pooling
+    eos_token_id: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPVisionConfig(GPT2Config):
+    image_size: int = 224
+    patch_size: int = 32
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    activation: str = "quick_gelu"
+
+    @property
+    def num_patches(self):
+        return (self.image_size // self.patch_size) ** 2
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPConfig:
+    text: CLIPTextConfig = CLIPTextConfig()
+    vision: CLIPVisionConfig = CLIPVisionConfig()
+    projection_dim: int = 512
+    logit_scale_init: float = 2.6592     # ln(1/0.07), HF default
+
+
+class CLIPTextTower(GPT2Model):
+    """Causal pre-LN encoder; pooled output at the EOT (argmax-id) token."""
+
+    def __init__(self, config: CLIPTextConfig):
+        super().__init__(config)
+
+    def pooled(self, params, input_ids, rng=None, train=False):
+        x, _, _ = self.hidden_states(params, input_ids, rng=rng, train=train)
+        eos = self.config.eos_token_id
+        if eos is None:
+            eot = jnp.argmax(input_ids, axis=-1)          # HF legacy rule
+        else:                                             # first eos position
+            eot = jnp.argmax((input_ids == eos).astype(jnp.int32), axis=-1)
+        return jnp.take_along_axis(x, eot[:, None, None], axis=1)[:, 0]
+
+    def _unembed_weight(self, params, dtype):
+        return None                                       # no LM head
+
+
+class CLIPVisionTower(GPT2Model):
+    """Bidirectional pre-LN encoder over patch tokens + class token."""
+
+    causal_attention = False
+
+    def __init__(self, config: CLIPVisionConfig):
+        super().__init__(config)
+
+    def init(self, rng):
+        cfg = self.config
+        params = super().init(rng)
+        del params["wte"]
+        d, p = cfg.n_embd, cfg.patch_size
+        keys = jax.random.split(jax.random.fold_in(rng, 77), 3)
+        params["patch_w"] = jax.random.normal(
+            keys[0], (3 * p * p, d), jnp.float32) * cfg.initializer_range
+        params["class_emb"] = jax.random.normal(
+            keys[1], (d,), jnp.float32) * cfg.initializer_range
+        params["wpe"] = jax.random.normal(
+            keys[2], (cfg.num_patches + 1, d),
+            jnp.float32) * cfg.initializer_range
+        params["pre_ln_scale"] = jnp.ones((d,))
+        params["pre_ln_bias"] = jnp.zeros((d,))
+        return params
+
+    def _compute_dtype(self, params):
+        pw = params["patch_w"].dtype
+        return (pw if jnp.issubdtype(pw, jnp.floating)
+                else jnp.dtype(self.config.dtype))
+
+    def _embed(self, params, pixel_values, start_pos=0):
+        """pixel_values: [B, 3, H, W] (HF layout). The stride==kernel conv
+        is a reshape + one [N, 3p²] @ [3p², D] matmul."""
+        cfg = self.config
+        dt = self._compute_dtype(params)
+        b = pixel_values.shape[0]
+        p = cfg.patch_size
+        g = cfg.image_size // p
+        x = pixel_values.astype(dt).reshape(b, 3, g, p, g, p)
+        x = x.transpose(0, 2, 4, 1, 3, 5).reshape(b, g * g, 3 * p * p)
+        x = x @ params["patch_w"].astype(dt)
+        cls = jnp.broadcast_to(params["class_emb"].astype(dt), (b, 1, x.shape[-1]))
+        x = jnp.concatenate([cls, x], axis=1)
+        x = x + params["wpe"].astype(dt)[None]
+        return _layer_norm(x, params["pre_ln_scale"], params["pre_ln_bias"],
+                           cfg.layer_norm_epsilon)
+
+    def pooled(self, params, pixel_values, rng=None, train=False):
+        # final_norm (ln_f) plays HF's post_layernorm role
+        x, _, _ = self.hidden_states(params, pixel_values, rng=rng,
+                                     train=train)
+        return x[:, 0]
+
+    def _unembed_weight(self, params, dtype):
+        return None                                       # no LM head
+
+    def partition_rules(self):
+        return [(r"patch_w$", (None, "model"))] + super().partition_rules()
+
+
+class CLIPModel(ModelSpec):
+
+    def __init__(self, config: CLIPConfig = CLIPConfig()):
+        self.config = config
+        self.text = CLIPTextTower(config.text)
+        self.vision = CLIPVisionTower(config.vision)
+
+    def init(self, rng):
+        cfg = self.config
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        return {
+            "text": self.text.init(k1),
+            "vision": self.vision.init(k2),
+            "text_proj": jax.random.normal(
+                k3, (cfg.text.n_embd, cfg.projection_dim), jnp.float32) * 0.02,
+            "visual_proj": jax.random.normal(
+                k4, (cfg.vision.n_embd, cfg.projection_dim),
+                jnp.float32) * 0.02,
+            "logit_scale": jnp.float32(cfg.logit_scale_init),
+        }
+
+    # ------------------------------------------------------------- encoders
+    def encode_text(self, params, input_ids, rng=None, train=False):
+        pooled = self.text.pooled(params["text"], input_ids, rng, train)
+        return pooled @ params["text_proj"].astype(pooled.dtype)
+
+    def encode_image(self, params, pixel_values, rng=None, train=False):
+        pooled = self.vision.pooled(params["vision"], pixel_values, rng,
+                                    train)
+        return pooled @ params["visual_proj"].astype(pooled.dtype)
+
+    def similarity(self, params, input_ids, pixel_values, rng=None,
+                   train=False):
+        """Returns (logits_per_image [Bi, Bt], logits_per_text [Bt, Bi])."""
+        te = self.encode_text(params, input_ids, rng, train)
+        ie = self.encode_image(params, pixel_values, rng, train)
+        te = te / jnp.linalg.norm(te.astype(jnp.float32), axis=-1,
+                                  keepdims=True)
+        ie = ie / jnp.linalg.norm(ie.astype(jnp.float32), axis=-1,
+                                  keepdims=True)
+        scale = jnp.exp(params["logit_scale"])
+        logits_per_text = scale * te.astype(jnp.float32) @ \
+            ie.astype(jnp.float32).T
+        return logits_per_text.T, logits_per_text
+
+    def apply(self, params, batch, rng=None, train=True):
+        """Symmetric InfoNCE over the in-batch pairs (CLIP pretraining
+        objective)."""
+        lpi, lpt = self.similarity(params, batch["input_ids"],
+                                   batch["pixel_values"], rng, train)
+        n = lpt.shape[0]
+        labels = jnp.arange(n)
+        def ce(lg):
+            return -jnp.mean(jnp.take_along_axis(
+                jax.nn.log_softmax(lg, axis=-1), labels[:, None], axis=1))
+        return 0.5 * (ce(lpt) + ce(lpi))
+
+    # ------------------------------------------------------------- sharding
+    def partition_rules(self):
+        rules = [("text/" + pat, spec)
+                 for pat, spec in self.text.partition_rules()]
+        rules += [("vision/" + pat, spec)
+                  for pat, spec in self.vision.partition_rules()]
+        rules += [(r"(text_proj|visual_proj)$", (None, "model"))]
+        return rules
+
+    def flops_per_token(self, seq_len: Optional[int] = None):
+        """Per TEXT token, counting both towers (vision cost amortized over
+        the text length) and the projections."""
+        cfg = self.config
+        t, v = cfg.text, cfg.vision
+
+        def tower(c):
+            return 6 * (4 + 2 * c.mlp_ratio) * c.n_layer * c.n_embd * c.n_embd
+
+        vision_tokens = v.num_patches + 1
+        per_text_token = (tower(t) +
+                          tower(v) * vision_tokens // t.n_positions +
+                          6 * (t.n_embd + v.n_embd) * cfg.projection_dim //
+                          t.n_positions)
+        return per_text_token

@@ -78,6 +78,8 @@ def _activation(x, name):
         return jax.nn.gelu(x, approximate=False)
     if name == "silu":
         return jax.nn.silu(x)
+    if name == "quick_gelu":             # CLIP: x * sigmoid(1.702 x)
+        return x * jax.nn.sigmoid(1.702 * x)
     raise ValueError(f"unknown activation {name!r}")
 
 
@@ -147,6 +149,7 @@ class GPT2Model(ModelSpec):
     # Subclass families (LLaMA/BLOOM/NeoX/BERT) override these instead of
     # re-implementing hidden_states / apply_with_cache / pipeline_spec.
     has_position_table = True   # families without a wpe table set False
+    causal_attention = True     # bidirectional towers (CLIP vision) set False
 
     def _compute_dtype(self, params):
         return _params_compute_dtype(params, self.config.dtype)
@@ -200,7 +203,7 @@ class GPT2Model(ModelSpec):
             drop_rng = None
             if train and cfg.dropout > 0 and rng is not None:
                 drop_rng = jax.random.fold_in(rng, 3)
-            attn = sp_attention(q, k, v, causal=True,
+            attn = sp_attention(q, k, v, causal=self.causal_attention,
                                 dropout_rate=cfg.dropout if train else 0.0,
                                 dropout_rng=drop_rng, impl=cfg.sp_attention,
                                 backend=cfg.attn_backend,

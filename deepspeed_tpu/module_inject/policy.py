@@ -618,6 +618,118 @@ def distil_bert_policy(model) -> Tuple[Any, Any]:
     return spec, params
 
 
+def _clip_tower_blocks(layers):
+    """Shared CLIP encoder-layer mapping (text and vision towers are the
+    same pre-LN block)."""
+    import functools
+    stack = functools.partial(_stack, layers)
+
+    def qkv_w(blk):
+        a = blk.self_attn
+        return np.concatenate([_lin_w(a.q_proj), _lin_w(a.k_proj),
+                               _lin_w(a.v_proj)], axis=1)
+
+    def qkv_b(blk):
+        a = blk.self_attn
+        return np.concatenate([_np(a.q_proj.bias), _np(a.k_proj.bias),
+                               _np(a.v_proj.bias)])
+
+    return {
+        "ln1_scale": stack(lambda b: _np(b.layer_norm1.weight)),
+        "ln1_bias": stack(lambda b: _np(b.layer_norm1.bias)),
+        "qkv_w": stack(qkv_w),
+        "qkv_b": stack(qkv_b),
+        "attn_proj_w": stack(lambda b: _lin_w(b.self_attn.out_proj)),
+        "attn_proj_b": stack(lambda b: _np(b.self_attn.out_proj.bias)),
+        "ln2_scale": stack(lambda b: _np(b.layer_norm2.weight)),
+        "ln2_bias": stack(lambda b: _np(b.layer_norm2.bias)),
+        "mlp_fc_w": stack(lambda b: _lin_w(b.mlp.fc1)),
+        "mlp_fc_b": stack(lambda b: _np(b.mlp.fc1.bias)),
+        "mlp_proj_w": stack(lambda b: _lin_w(b.mlp.fc2)),
+        "mlp_proj_b": stack(lambda b: _np(b.mlp.fc2.bias)),
+    }
+
+
+@register_policy("CLIPModel")
+def clip_policy(model) -> Tuple[Any, Any]:
+    """HF CLIPModel → dual-tower CLIPModel params (reference
+    module_inject/containers/clip.py HFCLIPLayerPolicy). The stride==kernel
+    patch conv flattens into patch_w [3p², D]."""
+    import jax.numpy as jnp
+    from ..models.clip import (CLIPConfig, CLIPModel, CLIPTextConfig,
+                               CLIPVisionConfig)
+
+    tc, vc = model.config.text_config, model.config.vision_config
+    for c in (tc, vc):
+        act = getattr(c, "hidden_act", "quick_gelu")
+        if act not in ("quick_gelu", "gelu"):
+            raise ValueError(f"unsupported CLIP activation {act!r}")
+        if c.intermediate_size % c.hidden_size != 0:
+            raise ValueError("intermediate_size must be a multiple of "
+                             "hidden_size")
+    # HF pools at argmax(token id) when eos_token_id==2 (legacy) and at the
+    # first eos position otherwise (PR #24773)
+    hf_eos = getattr(tc, "eos_token_id", 2)
+    cfg = CLIPConfig(
+        text=CLIPTextConfig(
+            vocab_size=tc.vocab_size,
+            n_positions=tc.max_position_embeddings,
+            n_embd=tc.hidden_size,
+            n_layer=tc.num_hidden_layers,
+            n_head=tc.num_attention_heads,
+            mlp_ratio=tc.intermediate_size // tc.hidden_size,
+            activation="gelu_exact" if tc.hidden_act == "gelu"
+            else "quick_gelu",
+            layer_norm_epsilon=tc.layer_norm_eps,
+            eos_token_id=None if hf_eos == 2 else hf_eos,
+        ),
+        vision=CLIPVisionConfig(
+            image_size=vc.image_size,
+            patch_size=vc.patch_size,
+            n_embd=vc.hidden_size,
+            n_layer=vc.num_hidden_layers,
+            n_head=vc.num_attention_heads,
+            mlp_ratio=vc.intermediate_size // vc.hidden_size,
+            activation="gelu_exact" if vc.hidden_act == "gelu"
+            else "quick_gelu",
+            layer_norm_epsilon=vc.layer_norm_eps,
+        ),
+        projection_dim=model.config.projection_dim,
+    )
+    spec = CLIPModel(cfg)
+    tm, vm = model.text_model, model.vision_model
+
+    text = {
+        "wte": jnp.asarray(_np(tm.embeddings.token_embedding.weight)),
+        "wpe": jnp.asarray(_np(tm.embeddings.position_embedding.weight)),
+        "blocks": {k: jnp.asarray(v) for k, v in
+                   _clip_tower_blocks(tm.encoder.layers).items()},
+        "ln_f_scale": jnp.asarray(_np(tm.final_layer_norm.weight)),
+        "ln_f_bias": jnp.asarray(_np(tm.final_layer_norm.bias)),
+    }
+    d = vc.hidden_size
+    patch = _np(vm.embeddings.patch_embedding.weight)    # [D, 3, p, p]
+    vision = {
+        "patch_w": jnp.asarray(patch.reshape(d, -1).T),  # [3p², D]
+        "class_emb": jnp.asarray(_np(vm.embeddings.class_embedding)),
+        "wpe": jnp.asarray(_np(vm.embeddings.position_embedding.weight)),
+        "pre_ln_scale": jnp.asarray(_np(vm.pre_layrnorm.weight)),
+        "pre_ln_bias": jnp.asarray(_np(vm.pre_layrnorm.bias)),
+        "blocks": {k: jnp.asarray(v) for k, v in
+                   _clip_tower_blocks(vm.encoder.layers).items()},
+        "ln_f_scale": jnp.asarray(_np(vm.post_layernorm.weight)),
+        "ln_f_bias": jnp.asarray(_np(vm.post_layernorm.bias)),
+    }
+    params = {
+        "text": text,
+        "vision": vision,
+        "text_proj": jnp.asarray(_lin_w(model.text_projection)),
+        "visual_proj": jnp.asarray(_lin_w(model.visual_projection)),
+        "logit_scale": jnp.asarray(_np(model.logit_scale)),
+    }
+    return spec, params
+
+
 def replace_transformer_layer(model, config=None) -> Tuple[Any, Any]:
     """Entry point (reference module_inject/replace_module.py:276). Dispatch
     by policy; unknown architectures fall back to AutoTP-style generic

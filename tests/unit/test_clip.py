@@ -1,0 +1,74 @@
+"""CLIP family tests: contrastive training through the engine, patch-matmul
+embedding equivalence with the HF conv, and HF CLIPModel logits_per_image
+parity through the injection policy."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.clip import (CLIPConfig, CLIPModel, CLIPTextConfig,
+                                       CLIPVisionConfig)
+
+TINY = CLIPConfig(
+    text=CLIPTextConfig(vocab_size=128, n_positions=16, n_embd=32, n_layer=2,
+                        n_head=4),
+    vision=CLIPVisionConfig(image_size=16, patch_size=8, n_embd=32,
+                            n_layer=2, n_head=4),
+    projection_dim=24)
+
+
+def _batch(rng, gas, b):
+    return {
+        "input_ids": rng.integers(0, 128, (gas, b, 16)).astype(np.int32),
+        "pixel_values": rng.standard_normal(
+            (gas, b, 3, 16, 16)).astype(np.float32),
+    }
+
+
+def test_clip_contrastive_trains():
+    model = CLIPModel(TINY)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 0})
+    rng = np.random.default_rng(0)
+    fixed = _batch(rng, 1, 8)
+    losses = [float(engine.train_batch(batch=fixed)) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_hf_clip_logits_parity():
+    transformers = pytest.importorskip("transformers")
+    import torch
+    hf_cfg = transformers.CLIPConfig(
+        text_config_dict=dict(vocab_size=128, hidden_size=32,
+                              intermediate_size=64, num_hidden_layers=2,
+                              num_attention_heads=4,
+                              max_position_embeddings=16,
+                              eos_token_id=127),
+        vision_config_dict=dict(hidden_size=32, intermediate_size=64,
+                                num_hidden_layers=2, num_attention_heads=4,
+                                image_size=16, patch_size=8),
+        projection_dim=24)
+    hf = transformers.CLIPModel(hf_cfg).eval()
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 120, (3, 16)).astype(np.int64)
+    # EOS at a DIFFERENT nonzero position per row so the first-eos pooling
+    # branch is really exercised (wrong-axis/off-by-one would fail)
+    for row, pos in enumerate((5, 9, 15)):
+        ids[row, pos:] = 127
+    pix = rng.standard_normal((2, 3, 16, 16)).astype(np.float32)
+    with torch.no_grad():
+        out = hf(input_ids=torch.from_numpy(ids),
+                 pixel_values=torch.from_numpy(pix))
+    eng = deepspeed_tpu.init_inference(hf, {"dtype": "float32"})
+    import jax.numpy as jnp
+    lpi, lpt = eng.module.similarity(eng.params, jnp.asarray(ids, jnp.int32),
+                                     jnp.asarray(pix))
+    np.testing.assert_allclose(np.asarray(lpi),
+                               out.logits_per_image.numpy(), atol=3e-3)
+    np.testing.assert_allclose(np.asarray(lpt),
+                               out.logits_per_text.numpy(), atol=3e-3)
