@@ -3,9 +3,10 @@ checkpointing capability proof.
 
 The reference's long-sequence story is block-sparse attention (ops/
 sparse_attention/) capped by the quadratic [T, T] materialization of its
-dense path. Here the Pallas flash kernel never materializes [T, T], so a
-single v5e chip trains GPT-2-125M at seq 8192 (64x the dense-path memory
-for attention logits would have been ~100 GB in fp32 at this batch).
+dense path. Here the Pallas flash kernel never materializes [T, T]
+(streamed k-block grid past 8k), so a single v5e chip trains GPT-2-125M
+at seq 8192-32768 — dense fp32 attention logits would need ~3 GB (8k) to
+~52 GB (32k) per micro batch.
 Records tokens/s + achieved TFLOPS to benchmarks/longseq.json.
 
 Run on the real chip:  python benchmarks/longseq.py

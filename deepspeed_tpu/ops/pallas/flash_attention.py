@@ -52,6 +52,18 @@ _BTN = (((1,), (1,)), ((0,), (0,)))
 _VMEM_BUDGET = 3 * 1024 * 1024
 
 
+def _mask(s, q_off, k_off, gh, block_q, block_k, window):
+    """Causal (+ optional sliding-window) keep-mask applied to one
+    [GH, BQ, BK] logits block — shared by the resident and streamed
+    fwd/dq/dkv kernels."""
+    q_pos = q_off + lax.broadcasted_iota(jnp.int32, (gh, block_q, block_k), 1)
+    k_pos = k_off + lax.broadcasted_iota(jnp.int32, (gh, block_q, block_k), 2)
+    keep = q_pos >= k_pos
+    if window is not None:
+        keep &= (q_pos - k_pos) < window
+    return jnp.where(keep, s, NEG_INF)
+
+
 def _pick_blocks(t: int):
     """Largest preferred block sizes that divide t (t % 128 == 0 is already
     guaranteed by supported()/_resolve, so 128 always works)."""
@@ -73,6 +85,28 @@ def _pick_gh(bh: int, t: int, d: int, bq: int, bk: int) -> int:
     return 1
 
 
+# Above this K/V footprint the resident kernels (full K/V per head in VMEM)
+# give way to the streamed kernels (k-blocks as a grid dimension, online
+# accumulators in scratch) — the long-context single-chip path.
+_RESIDENT_MAX_KV_BYTES = 1024 * 1024
+
+
+def _streamed(t: int, d: int, itemsize: int) -> bool:
+    return t * d * itemsize > _RESIDENT_MAX_KV_BYTES
+
+
+def _pick_gh_streamed(bh: int, d: int, bq: int, bk: int) -> int:
+    for gh in (8, 4, 2, 1):
+        if bh % gh:
+            continue
+        s_bytes = gh * bq * bk * (4 + 2)
+        kv_bytes = 2 * gh * bk * d * 2 * 2        # double-buffered blocks
+        qo_bytes = gh * bq * d * (2 + 2 + 4 * 3)  # q, o, f32 acc+m+l scratch
+        if s_bytes + kv_bytes + qo_bytes <= _VMEM_BUDGET:
+            return gh
+    return 1
+
+
 def supported(q, k, causal=True, mask=None, dropout_rate=0.0,
               window=None) -> bool:
     """Static shape/feature check for the Pallas path."""
@@ -85,9 +119,9 @@ def supported(q, k, causal=True, mask=None, dropout_rate=0.0,
     if q.shape[1] != k.shape[1]:        # GQA callers repeat kv heads first
         return False
     t, d = q.shape[-2], q.shape[-1]
-    # full K/V per head must fit VMEM alongside fp32 accumulators; longer
-    # sequences belong to ring attention (SP)
-    if t * d * q.dtype.itemsize > 4 * 1024 * 1024:
+    # short sequences: full K/V resident per head; long sequences: streamed
+    # k-block grid. Cap the total so one (b, h) pair stays addressable.
+    if t > 128 * 1024:
         return False
     return t >= 128 and t % 128 == 0 and d % 8 == 0 and d <= 256
 
@@ -111,14 +145,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
         s = lax.dot_general(q, k_j, _BNT,
                             preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = q_off + lax.broadcasted_iota(
-                jnp.int32, (gh, block_q, block_k), 1)
-            k_pos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (gh, block_q, block_k), 2)
-            keep = q_pos >= k_pos
-            if window is not None:
-                keep &= (q_pos - k_pos) < window
-            s = jnp.where(keep, s, NEG_INF)
+            s = _mask(s, q_off, j * block_k, gh, block_q, block_k, window)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
@@ -140,6 +167,11 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret, window=None):
     b, h, t, d = q.shape
     bh = b * h
     qf, kf, vf = (x.reshape(bh, t, d) for x in (q, k, v))
+    if _streamed(t, d, q.dtype.itemsize):
+        gh = _pick_gh_streamed(bh, d, block_q, block_k)
+        out, lse = _fwd_streamed(qf, kf, vf, causal, scale, block_q, block_k,
+                                 interpret, window, gh)
+        return out.reshape(b, h, t, d), lse.reshape(b, h, t, 1)
     gh = _pick_gh(bh, t, d, block_q, block_k)
     grid = (bh // gh, t // block_q)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
@@ -192,14 +224,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         s = lax.dot_general(q, k_j, _BNT,
                             preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = q_off + lax.broadcasted_iota(
-                jnp.int32, (gh, block_q, block_k), 1)
-            k_pos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (gh, block_q, block_k), 2)
-            keep = q_pos >= k_pos
-            if window is not None:
-                keep &= (q_pos - k_pos) < window
-            s = jnp.where(keep, s, NEG_INF)
+            s = _mask(s, q_off, j * block_k, gh, block_q, block_k, window)
         p = jnp.exp(s - lse)                     # [GH, BQ, BK]
         dp = lax.dot_general(do, v_j, _BNT, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
@@ -232,14 +257,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = lax.dot_general(q_i, k_blk, _BNT,
                             preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = i * block_q + lax.broadcasted_iota(
-                jnp.int32, (gh, block_q, block_k), 1)
-            k_pos = k_off + lax.broadcasted_iota(
-                jnp.int32, (gh, block_q, block_k), 2)
-            keep = q_pos >= k_pos
-            if window is not None:
-                keep &= (q_pos - k_pos) < window
-            s = jnp.where(keep, s, NEG_INF)
+            s = _mask(s, i * block_q, k_off, gh, block_q, block_k, window)
         p = jnp.exp(s - lse_i)                   # [GH, BQ, BK]
         dv_new = dv + lax.dot_general(
             p.astype(do_i.dtype), do_i, _BTN,
@@ -269,6 +287,13 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret,
     qf, kf, vf, dof = (x.reshape(bh, t, d) for x in (q, k, v, do))
     lsef = lse.reshape(bh, t, 1)
     deltaf = delta.reshape(bh, t, 1)
+    if _streamed(t, d, q.dtype.itemsize):
+        gh = _pick_gh_streamed(bh, d, block_q, block_k)
+        dq, dk, dv = _bwd_streamed(qf, kf, vf, dof, lsef, deltaf, causal,
+                                   scale, block_q, block_k, interpret,
+                                   window, gh)
+        return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
+                dv.reshape(b, h, t, d))
     gh = _pick_gh(bh, t, d, block_q, block_k)
 
     blk_spec = pl.BlockSpec((gh, block_q, d), lambda n, i: (n, i, 0))
@@ -316,6 +341,260 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret,
     )(qf, kf, vf, dof, lsef, deltaf)
     return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
             dv.reshape(b, h, t, d))
+
+
+
+
+# ------------------------------------------------- streamed (long-T) kernels
+# K/V blocks arrive via a THIRD grid dimension instead of residing whole in
+# VMEM; online-softmax accumulators live in VMEM scratch that persists
+# across the innermost grid dim. Dead blocks (causal/window) are skipped
+# with pl.when — compute-free, though their DMA still runs.
+
+def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                         acc_ref, m_ref, l_ref, *, causal, scale,
+                         block_q, block_k, t_k, gh, window):
+    j = pl.program_id(2)
+    nkj = t_k // block_k
+    q_off = pl.program_id(1) * block_q
+
+    @pl.when(j == 0)
+    def init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    k_off = j * block_k
+    live = True
+    if causal:
+        live = k_off <= q_off + block_q - 1
+    if causal and window is not None:
+        live = live & (k_off + block_k - 1 >= q_off - window + 1)
+
+    def compute():
+        q = q_ref[...]
+        k_j = k_ref[...]
+        v_j = v_ref[...]
+        s = lax.dot_general(q, k_j, _BNT,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _mask(s, q_off, k_off, gh, block_q, block_k, window)
+        m, l, acc = m_ref[...], l_ref[...], acc_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[...] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc * alpha + lax.dot_general(
+            p.astype(v_j.dtype), v_j, _BNN, preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    if live is True:
+        compute()
+    else:
+        pl.when(live)(compute)
+
+    @pl.when(j == nkj - 1)
+    def finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[...] = m_ref[...] + jnp.log(l)
+
+
+def _fwd_streamed(qf, kf, vf, causal, scale, block_q, block_k, interpret,
+                  window, gh):
+    bh, t, d = qf.shape
+    grid = (bh // gh, t // block_q, t // block_k)
+    kernel = functools.partial(_fwd_kernel_streamed, causal=causal,
+                               scale=scale, block_q=block_q, block_k=block_k,
+                               t_k=t, gh=gh, window=window)
+    flops = 4 * bh * t * t * d // (2 if causal else 1)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((gh, block_q, d), lambda n, i, j: (n, i, 0)),
+            pl.BlockSpec((gh, block_k, d), lambda n, i, j: (n, j, 0)),
+            pl.BlockSpec((gh, block_k, d), lambda n, i, j: (n, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((gh, block_q, d), lambda n, i, j: (n, i, 0)),
+            pl.BlockSpec((gh, block_q, 1), lambda n, i, j: (n, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((gh, block_q, d), jnp.float32),
+            pltpu.VMEM((gh, block_q, 1), jnp.float32),
+            pltpu.VMEM((gh, block_q, 1), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=int(flops),
+            bytes_accessed=(2 * bh * t * d + 2 * bh * t * t // block_q * d)
+            * qf.dtype.itemsize,
+            transcendentals=bh * t * t // (2 if causal else 1)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+
+def _bwd_dq_kernel_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            dq_ref, dq_acc_ref, *, causal, scale, block_q,
+                            block_k, t_k, gh, window):
+    j = pl.program_id(2)
+    nkj = t_k // block_k
+    q_off = pl.program_id(1) * block_q
+    k_off = j * block_k
+
+    @pl.when(j == 0)
+    def init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    live = True
+    if causal:
+        live = k_off <= q_off + block_q - 1
+    if causal and window is not None:
+        live = live & (k_off + block_k - 1 >= q_off - window + 1)
+
+    def compute():
+        q = q_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...]
+        delta = delta_ref[...]
+        k_j = k_ref[...]
+        v_j = v_ref[...]
+        s = lax.dot_general(q, k_j, _BNT,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _mask(s, q_off, k_off, gh, block_q, block_k, window)
+        p = jnp.exp(s - lse)
+        dp = lax.dot_general(do, v_j, _BNT, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc_ref[...] = dq_acc_ref[...] + lax.dot_general(
+            ds.astype(k_j.dtype), k_j, _BNN,
+            preferred_element_type=jnp.float32)
+
+    if live is True:
+        compute()
+    else:
+        pl.when(live)(compute)
+
+    @pl.when(j == nkj - 1)
+    def finalize():
+        dq_ref[...] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                             dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
+                             causal, scale, block_q, block_k, t_q, gh,
+                             window):
+    i = pl.program_id(2)
+    nqi = t_q // block_q
+    k_off = pl.program_id(1) * block_k
+    q_off = i * block_q
+
+    @pl.when(i == 0)
+    def init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    live = True
+    if causal:
+        live = q_off + block_q - 1 >= k_off
+    if causal and window is not None:
+        live = live & (q_off <= k_off + block_k - 1 + window - 1)
+
+    def compute():
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
+        q_i = q_ref[...]
+        do_i = do_ref[...]
+        lse_i = lse_ref[...]
+        delta_i = delta_ref[...]
+        s = lax.dot_general(q_i, k_blk, _BNT,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _mask(s, q_off, k_off, gh, block_q, block_k, window)
+        p = jnp.exp(s - lse_i)
+        dv_acc_ref[...] = dv_acc_ref[...] + lax.dot_general(
+            p.astype(do_i.dtype), do_i, _BTN,
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do_i, v_blk, _BNT,
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_i) * scale
+        dk_acc_ref[...] = dk_acc_ref[...] + lax.dot_general(
+            ds.astype(q_i.dtype), q_i, _BTN,
+            preferred_element_type=jnp.float32)
+
+    if live is True:
+        compute()
+    else:
+        pl.when(live)(compute)
+
+    @pl.when(i == nqi - 1)
+    def finalize():
+        dk_ref[...] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _bwd_streamed(qf, kf, vf, dof, lsef, deltaf, causal, scale, block_q,
+                  block_k, interpret, window, gh):
+    bh, t, d = qf.shape
+    flops = 4 * bh * t * t * d // (2 if causal else 1)
+    q_blk = pl.BlockSpec((gh, block_q, d), lambda n, i, j: (n, i, 0))
+    kv_blk = pl.BlockSpec((gh, block_k, d), lambda n, i, j: (n, j, 0))
+    vec_q = pl.BlockSpec((gh, block_q, 1), lambda n, i, j: (n, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_streamed, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k, t_k=t, gh=gh,
+                          window=window),
+        grid=(bh // gh, t // block_q, t // block_k),
+        in_specs=[q_blk, kv_blk, kv_blk, q_blk, vec_q, vec_q],
+        out_specs=q_blk,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
+        scratch_shapes=[pltpu.VMEM((gh, block_q, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=int(flops * 1.5),
+            # K/V refetched once per q block
+            bytes_accessed=(3 * bh * t * d +
+                            2 * bh * t * (t // block_q) * d)
+            * qf.dtype.itemsize,
+            transcendentals=bh * t * t // (2 if causal else 1)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    # dkv: middle grid dim over k blocks, innermost over q blocks
+    q_blk2 = pl.BlockSpec((gh, block_q, d), lambda n, j, i: (n, i, 0))
+    kv_blk2 = pl.BlockSpec((gh, block_k, d), lambda n, j, i: (n, j, 0))
+    vec_q2 = pl.BlockSpec((gh, block_q, 1), lambda n, j, i: (n, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_streamed, causal=causal,
+                          scale=scale, block_q=block_q, block_k=block_k,
+                          t_q=t, gh=gh, window=window),
+        grid=(bh // gh, t // block_k, t // block_q),
+        in_specs=[q_blk2, kv_blk2, kv_blk2, q_blk2, vec_q2, vec_q2],
+        out_specs=[kv_blk2, kv_blk2],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), kf.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), vf.dtype)],
+        scratch_shapes=[pltpu.VMEM((gh, block_k, d), jnp.float32),
+                        pltpu.VMEM((gh, block_k, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=int(flops * 2.5),
+            # Q/dO/lse/delta refetched once per k block
+            bytes_accessed=(4 * bh * t * d +
+                            2 * bh * t * (t // block_k) * d)
+            * qf.dtype.itemsize,
+            transcendentals=bh * t * t // (2 if causal else 1)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+    return dq, dk, dv
 
 
 # ------------------------------------------------------------------ public op

@@ -308,8 +308,11 @@ class DeepSpeedEngine:
         spec = P(None, *base) if leading_gas else base
         return NamedSharding(self.mesh, spec)
 
-    def _micro_loss(self, params, mb, rng, train=True):
-        pc = _cast_tree(params, self._compute_dtype)
+    def _micro_loss(self, params, mb, rng, train=True, precast=False):
+        """Loss of one micro batch. ``precast=True`` means ``params`` is
+        already in compute dtype (the train path hoists the cast out of the
+        gas scan)."""
+        pc = params if precast else _cast_tree(params, self._compute_dtype)
         out = self.module.apply(pc, mb, rng=rng, train=train)
         loss = out[0] if isinstance(out, tuple) else out
         return loss.astype(jnp.float32)
@@ -367,9 +370,7 @@ class DeepSpeedEngine:
             pc = _cast_tree(params, self._compute_dtype)
 
             def scaled_loss(pc_, mb, r):
-                out = self.module.apply(pc_, mb, rng=r, train=True)
-                loss = out[0] if isinstance(out, tuple) else out
-                return loss.astype(jnp.float32) * scale
+                return self._micro_loss(pc_, mb, r, precast=True) * scale
 
             grad_fn = jax.value_and_grad(scaled_loss)
             grad_specs = jax.tree.map(lambda s: s.spec, self.grad_shardings)
