@@ -43,19 +43,29 @@ def build_corpus(prefix: str, seq: int):
     return n_samples, len(tokens)
 
 
+def make_model(family: str, seq: int):
+    if family == "llama":
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+        return LlamaModel(LlamaConfig(
+            vocab_size=256, n_positions=seq + 1, n_embd=256, n_layer=6,
+            n_head=8, n_kv_head=4, mlp_hidden=768, pad_vocab_to_multiple=128,
+            dropout=0.0)), "llama-byte 256d x 6L (GQA, SwiGLU)"
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    return GPT2Model(GPT2Config(
+        vocab_size=256, n_positions=seq + 1, n_embd=256, n_layer=6, n_head=8,
+        pad_vocab_to_multiple=128, dropout=0.0)), "gpt2-byte 256d x 6L"
+
+
 def train(stage: int, steps: int, seq: int, prefix: str, micro_bs: int,
-          log_every: int = 10):
+          log_every: int = 10, family: str = "gpt2"):
     import jax
     import deepspeed_tpu
-    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
     from deepspeed_tpu.parallel import topology
     from deepspeed_tpu.runtime.data_pipeline import MMapIndexedDataset
 
     topology.reset_mesh()
     ds = MMapIndexedDataset(prefix)
-    model = GPT2Model(GPT2Config(
-        vocab_size=256, n_positions=seq + 1, n_embd=256, n_layer=6, n_head=8,
-        pad_vocab_to_multiple=128, dropout=0.0))
+    model, _ = make_model(family, seq)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
         "train_micro_batch_size_per_gpu": micro_bs,
         "gradient_accumulation_steps": 1,
@@ -90,10 +100,14 @@ def main():
     ap.add_argument("--seq", type=int, default=256)
     ap.add_argument("--micro_bs", type=int, default=8)
     ap.add_argument("--stages", type=int, nargs="+", default=[0, 3])
+    ap.add_argument("--model", default="gpt2", choices=["gpt2", "llama"])
     ap.add_argument("--cpu", action="store_true")
-    ap.add_argument("--out", default=os.path.join(REPO, "benchmarks",
-                                                  "convergence.json"))
+    ap.add_argument("--out", default=None)
     args = ap.parse_args()
+    if args.out is None:
+        suffix = "" if args.model == "gpt2" else f"_{args.model}"
+        args.out = os.path.join(REPO, "benchmarks",
+                                f"convergence{suffix}.json")
     if args.cpu:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
@@ -110,12 +124,12 @@ def main():
     for stage in args.stages:
         print(f"training ZeRO-{stage} for {args.steps} steps", flush=True)
         curves[f"zero{stage}"] = train(stage, args.steps, args.seq, prefix,
-                                       args.micro_bs)
+                                       args.micro_bs, family=args.model)
 
     keys = list(curves)
     report = {
         "corpus_tokens": n_tokens, "steps": args.steps, "seq": args.seq,
-        "model": "gpt2-byte 256d x 6L", "curves": curves,
+        "model": make_model(args.model, args.seq)[1], "curves": curves,
         "init_loss": curves[keys[0]][0],
         "final_loss": {k: float(np.mean(v[-10:])) for k, v in curves.items()},
     }
