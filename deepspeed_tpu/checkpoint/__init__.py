@@ -1,0 +1,6 @@
+"""Offline checkpoint tooling (reference deepspeed/checkpoint/ +
+runtime/state_dict_factory.py): Megatron-LM TP-merge loading. Further
+resharding is handled by the universal reshard-on-load path in
+runtime/checkpointing.py."""
+
+from .megatron import load_megatron_checkpoint

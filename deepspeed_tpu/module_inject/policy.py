@@ -52,6 +52,22 @@ def _stack(layers, field):
     return np.stack([field(h) for h in layers])
 
 
+def deinterleave_qkv_rows(w, n_head, head_dim):
+    """[3D, D] fused qkv whose rows are per-head [q|k|v] blocks (BLOOM,
+    GPT-NeoX, Megatron-LM layout) → [D, 3D] head-major q|k|v (this repo's
+    convention)."""
+    d = w.shape[1]
+    w = w.reshape(n_head, 3, head_dim, d)
+    return np.concatenate([w[:, i].reshape(n_head * head_dim, d)
+                           for i in range(3)], axis=0).T
+
+
+def deinterleave_qkv_bias(b, n_head, head_dim):
+    b = b.reshape(n_head, 3, head_dim)
+    return np.concatenate([b[:, i].reshape(n_head * head_dim)
+                           for i in range(3)])
+
+
 @register_policy("GPT2LMHeadModel", "GPT2Model")
 def gpt2_policy(model) -> Tuple[Any, Any]:
     """HF GPT-2 → stacked-layer GPT2Model params.
@@ -293,14 +309,12 @@ def bloom_policy(model) -> Tuple[Any, Any]:
     stack = functools.partial(_stack, tr.h)
 
     def qkv_w(blk):
-        w = _np(blk.self_attention.query_key_value.weight)  # [3D, D]
-        w = w.reshape(h, 3, hd, d)                          # de-interleave
-        return np.concatenate([w[:, i].reshape(h * hd, d)
-                               for i in range(3)], axis=0).T  # [D, 3D]
+        return deinterleave_qkv_rows(
+            _np(blk.self_attention.query_key_value.weight), h, hd)
 
     def qkv_b(blk):
-        b = _np(blk.self_attention.query_key_value.bias).reshape(h, 3, hd)
-        return np.concatenate([b[:, i].reshape(h * hd) for i in range(3)])
+        return deinterleave_qkv_bias(
+            _np(blk.self_attention.query_key_value.bias), h, hd)
 
     lin_w = _lin_w
 
@@ -374,14 +388,12 @@ def gpt_neox_policy(model) -> Tuple[Any, Any]:
     stack = functools.partial(_stack, nx.layers)
 
     def qkv_w(blk):
-        w = _np(blk.attention.query_key_value.weight)       # [3D, D]
-        w = w.reshape(h, 3, hd, d)
-        return np.concatenate([w[:, i].reshape(h * hd, d)
-                               for i in range(3)], axis=0).T
+        return deinterleave_qkv_rows(
+            _np(blk.attention.query_key_value.weight), h, hd)
 
     def qkv_b(blk):
-        b = _np(blk.attention.query_key_value.bias).reshape(h, 3, hd)
-        return np.concatenate([b[:, i].reshape(h * hd) for i in range(3)])
+        return deinterleave_qkv_bias(
+            _np(blk.attention.query_key_value.bias), h, hd)
 
     blocks = {
         "ln1_scale": stack(lambda b: _np(b.input_layernorm.weight)),

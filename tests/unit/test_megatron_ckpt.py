@@ -1,0 +1,130 @@
+"""Megatron-LM checkpoint loader: TP-merge axes, per-head qkv
+de-interleave, and end-to-end forward through the loaded model.
+
+Builds a synthetic 2-way-TP Megatron GPT checkpoint (classic
+language_model/transformer naming) and checks tp=2 merge == tp=1 load."""
+
+import os
+import types
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from deepspeed_tpu.checkpoint.megatron import load_megatron_checkpoint
+
+V, T, D, L, H = 64, 32, 16, 2, 4
+HD = D // H
+FF = 4 * D
+
+
+def _full_tensors(rng):
+    full = {}
+    full["wte"] = rng.standard_normal((V, D)).astype(np.float32)
+    full["wpe"] = rng.standard_normal((T, D)).astype(np.float32)
+    for i in range(L):
+        pre = f"layers.{i}."
+        full[pre + "input_layernorm.weight"] = rng.standard_normal(D).astype(np.float32)
+        full[pre + "input_layernorm.bias"] = rng.standard_normal(D).astype(np.float32)
+        full[pre + "attention.query_key_value.weight"] = \
+            rng.standard_normal((3 * D, D)).astype(np.float32)
+        full[pre + "attention.query_key_value.bias"] = \
+            rng.standard_normal(3 * D).astype(np.float32)
+        full[pre + "attention.dense.weight"] = \
+            rng.standard_normal((D, D)).astype(np.float32)
+        full[pre + "attention.dense.bias"] = \
+            rng.standard_normal(D).astype(np.float32)
+        full[pre + "post_attention_layernorm.weight"] = \
+            rng.standard_normal(D).astype(np.float32)
+        full[pre + "post_attention_layernorm.bias"] = \
+            rng.standard_normal(D).astype(np.float32)
+        full[pre + "mlp.dense_h_to_4h.weight"] = \
+            rng.standard_normal((FF, D)).astype(np.float32)
+        full[pre + "mlp.dense_h_to_4h.bias"] = \
+            rng.standard_normal(FF).astype(np.float32)
+        full[pre + "mlp.dense_4h_to_h.weight"] = \
+            rng.standard_normal((D, FF)).astype(np.float32)
+        full[pre + "mlp.dense_4h_to_h.bias"] = \
+            rng.standard_normal(D).astype(np.float32)
+    full["final_layernorm.weight"] = rng.standard_normal(D).astype(np.float32)
+    full["final_layernorm.bias"] = rng.standard_normal(D).astype(np.float32)
+    return full
+
+
+def _write_ckpt(path, full, tp):
+    os.makedirs(path, exist_ok=True)
+    for r in range(tp):
+        trans = {}
+        for k, v in full.items():
+            if k in ("wte",):
+                shard = np.split(v, tp, axis=0)[r]
+            elif "query_key_value" in k or "dense_h_to_4h" in k:
+                shard = np.split(v, tp, axis=0)[r]
+            elif k.endswith("attention.dense.weight") or \
+                    k.endswith("mlp.dense_4h_to_h.weight"):
+                shard = np.split(v, tp, axis=1)[r]
+            else:
+                shard = v
+            trans[k] = torch.from_numpy(np.ascontiguousarray(shard))
+        state = {
+            "args": types.SimpleNamespace(num_attention_heads=H),
+            "model": {"language_model": {
+                "embedding": {
+                    "word_embeddings": {"weight": trans.pop("wte")},
+                    "position_embeddings": {"weight": trans.pop("wpe")},
+                },
+                "transformer": trans,
+            }},
+        }
+        d = os.path.join(path, f"mp_rank_{r:02d}")
+        os.makedirs(d, exist_ok=True)
+        torch.save(state, os.path.join(d, "model_optim_rng.pt"))
+
+
+def test_tp_merge_matches_single_shard(tmp_path):
+    import jax
+    rng = np.random.default_rng(0)
+    full = _full_tensors(rng)
+    _write_ckpt(str(tmp_path / "tp1"), full, tp=1)
+    _write_ckpt(str(tmp_path / "tp2"), full, tp=2)
+
+    spec1, p1 = load_megatron_checkpoint(str(tmp_path / "tp1"))
+    spec2, p2 = load_megatron_checkpoint(str(tmp_path / "tp2"))
+    assert spec1.config == spec2.config
+    assert spec1.config.n_layer == L and spec1.config.n_head == H
+    f1 = {str(k): v for k, v in jax.tree_util.tree_flatten_with_path(p1)[0]}
+    f2 = {str(k): v for k, v in jax.tree_util.tree_flatten_with_path(p2)[0]}
+    assert f1.keys() == f2.keys()
+    for k in f1:
+        np.testing.assert_allclose(np.asarray(f1[k]), np.asarray(f2[k]),
+                                   atol=0, err_msg=k)
+
+    # the loaded model runs end-to-end
+    import jax.numpy as jnp
+    ids = rng.integers(0, V, (2, 8)).astype(np.int32)
+    logits = spec1.logits(p1, jnp.asarray(ids), train=False)
+    assert np.isfinite(np.asarray(logits)).all()
+    assert logits.shape == (2, 8, V)
+
+
+def test_qkv_deinterleave_against_reference_math(tmp_path):
+    """The merged qkv must equal manual per-head extraction: row block
+    h*3*HD + j*HD + r of the Megatron fused weight is head h, tensor j
+    (q/k/v), row r."""
+    rng = np.random.default_rng(1)
+    full = _full_tensors(rng)
+    _write_ckpt(str(tmp_path / "c"), full, tp=2)
+    _, params = load_megatron_checkpoint(str(tmp_path / "c"))
+    w = full["layers.0.attention.query_key_value.weight"]   # [3D, D]
+    got = np.asarray(params["blocks"]["qkv_w"][0])          # [D, 3D]
+    for h in range(H):
+        for j in range(3):                                  # q, k, v
+            rows = w[h * 3 * HD + j * HD:h * 3 * HD + (j + 1) * HD]
+            np.testing.assert_allclose(
+                got[:, j * D + h * HD:j * D + (h + 1) * HD], rows.T)
+
+
+def test_missing_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_megatron_checkpoint(str(tmp_path / "nope"))
