@@ -71,11 +71,16 @@ def _shard_paths(ckpt_dir: str, tag: Optional[str]):
     paths = sorted(glob.glob(os.path.join(root, "mp_rank_*",
                                           "model_optim_rng.pt")))
     if not paths:
-        candidates = [p for p in sorted(glob.glob(
-            os.path.join(root, "mp_rank_*", "*.pt")))
-            if "optim" not in os.path.basename(p) or
-            os.path.basename(p) == "model_optim_rng.pt"]
-        paths = candidates
+        # fallback: exactly ONE .pt per mp_rank dir, else ambiguous
+        by_dir = {}
+        for p in sorted(glob.glob(os.path.join(root, "mp_rank_*", "*.pt"))):
+            by_dir.setdefault(os.path.dirname(p), []).append(p)
+        for d, ps in by_dir.items():
+            if len(ps) > 1:
+                raise ValueError(
+                    f"ambiguous Megatron shard dir {d!r}: no "
+                    f"model_optim_rng.pt and multiple .pt candidates {ps}")
+        paths = sorted(ps[0] for ps in by_dir.values())
     if not paths:
         raise FileNotFoundError(
             f"no Megatron mp_rank_* shards under {root!r}")

@@ -159,12 +159,21 @@ class InferenceEngine:
     def generate(self, input_ids, max_new_tokens: int = 64,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_token_id: Optional[int] = None, seed: int = 0,
-                 max_length: Optional[int] = None, top_p: float = 1.0):
+                 max_length: Optional[int] = None, top_p: float = 1.0,
+                 num_beams: int = 1):
         """Autoregressive generation, one compiled program per
         (prompt_shape, max_new_tokens) bucket. Returns [B, T+max_new_tokens]
-        (prompt + generated; positions after EOS hold eos_token_id)."""
+        (prompt + generated; positions after EOS hold eos_token_id).
+        ``num_beams > 1`` runs deterministic beam search (temperature/
+        top-k/top-p must be off)."""
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if num_beams < 1:
+            raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+        if num_beams > 1 and (temperature > 0 or top_k or top_p < 1.0):
+            raise ValueError(
+                "beam search is deterministic: temperature/top_k/top_p "
+                "cannot be combined with num_beams > 1")
         input_ids = jnp.asarray(input_ids)
         if input_ids.ndim == 1:
             input_ids = input_ids[None]
@@ -188,11 +197,15 @@ class InferenceEngine:
                 f"(reference inference/engine.py:588 guard); growing cache")
 
         key = ("gen", b, t, max_new_tokens, float(temperature), top_k,
-               float(top_p), eos_token_id)
+               float(top_p), eos_token_id, num_beams)
         if key not in self._fns:
-            self._fns[key] = self._build_generate(
-                b, t, cache_len, max_new_tokens, temperature, top_k, top_p,
-                eos_token_id)
+            if num_beams > 1:
+                self._fns[key] = self._build_beam_generate(
+                    b, t, cache_len, max_new_tokens, num_beams, eos_token_id)
+            else:
+                self._fns[key] = self._build_generate(
+                    b, t, cache_len, max_new_tokens, temperature, top_k,
+                    top_p, eos_token_id)
         with self.mesh:
             return self._fns[key](self.params, input_ids,
                                   jax.random.PRNGKey(seed))
@@ -268,6 +281,92 @@ class InferenceEngine:
             else:
                 toks = tok[:, None]
             return jnp.concatenate([prompt, toks], axis=-1)
+
+        return jax.jit(run, in_shardings=(
+            self.param_shardings, self._batch_sharding(b), None))
+
+    def _build_beam_generate(self, b, t, cache_len, max_new_tokens, k,
+                             eos_token_id):
+        """Deterministic beam search, fully in-jit (reference parity:
+        inference/engine.py:588 delegates beams to HF generate; here the
+        whole search — expand, score, reorder-cache, backtrack-free
+        sequence buffer — is one compiled program)."""
+        model = self.module
+        vocab = model.config.vocab_size
+        NEG = jnp.float32(-1e30)
+        cache_shapes = jax.eval_shape(
+            lambda: model.init_kv_cache(b * k, cache_len, dtype=self.dtype))
+        cache_specs = jax.tree.map(
+            lambda sh: sh.spec, self._cache_shardings(cache_shapes))
+
+        def run(params, prompt, _key):
+            # prefill ONCE at batch B, then tile the cache to B*K beams
+            small = model.init_kv_cache(b, cache_len, dtype=self.dtype)
+            logits, small = model.apply_with_cache(params, prompt, small,
+                                                   jnp.int32(0))
+            cache = lax.with_sharding_constraint(
+                jax.tree.map(lambda c: jnp.repeat(c, k, axis=1), small),
+                cache_specs)
+            logp = jax.nn.log_softmax(
+                logits[:, -1, :vocab].astype(jnp.float32), axis=-1)
+            logp = jnp.repeat(logp, k, axis=0).reshape(b, k, vocab)
+            # beams start identical: only beam 0 may propose, or the top-k
+            # picks would be k copies of the same token
+            first = jnp.where(jnp.arange(k)[None, :, None] == 0, logp[:, :1],
+                              NEG)
+            scores, flat = lax.top_k(first.reshape(b, k * vocab), k)
+            tok = (flat % vocab).astype(jnp.int32)          # [B, K]
+            finished = (tok == eos_token_id) if eos_token_id is not None \
+                else jnp.zeros((b, k), jnp.bool_)
+            seqs = jnp.zeros((b, k, max_new_tokens), jnp.int32)
+            seqs = seqs.at[:, :, 0].set(tok)
+
+            def step(carry, i):
+                cache, seqs, tok, scores, finished = carry
+                logits, cache = model.apply_with_cache(
+                    params, tok.reshape(b * k, 1), cache, t + i - 1)
+                logp = jax.nn.log_softmax(
+                    logits[:, -1, :vocab].astype(jnp.float32), axis=-1)
+                logp = logp.reshape(b, k, vocab)
+                if eos_token_id is not None:
+                    # finished beams: frozen score, only-EOS continuation
+                    only_eos = jnp.where(
+                        jnp.arange(vocab)[None, None] == eos_token_id,
+                        0.0, NEG)
+                    logp = jnp.where(finished[..., None], only_eos, logp)
+                total = scores[..., None] + logp            # [B, K, V]
+                scores, flat = lax.top_k(total.reshape(b, k * vocab), k)
+                parent = flat // vocab                      # [B, K]
+                tok = (flat % vocab).astype(jnp.int32)
+                # reorder beam state by parent
+                gather = jnp.take_along_axis
+                seqs = gather(seqs, parent[..., None], axis=1)
+                seqs = seqs.at[:, :, i].set(tok)
+                finished = gather(finished, parent, axis=1)
+                if eos_token_id is not None:
+                    finished = finished | (tok == eos_token_id)
+                flat_parent = (jnp.arange(b)[:, None] * k +
+                               parent).reshape(b * k)
+                cache = lax.with_sharding_constraint(
+                    jax.tree.map(
+                        lambda c: jnp.take(c, flat_parent, axis=1), cache),
+                    cache_specs)
+                return (cache, seqs, tok, scores, finished), None
+
+            if max_new_tokens > 1:
+                (cache, seqs, tok, scores, finished), _ = lax.scan(
+                    step, (cache, seqs, tok, scores, finished),
+                    jnp.arange(1, max_new_tokens, dtype=jnp.int32))
+            best = jnp.argmax(scores, axis=-1)              # [B]
+            out = jnp.take_along_axis(seqs, best[:, None, None],
+                                      axis=1)[:, 0]         # [B, max_new]
+            if eos_token_id is not None:
+                # positions after EOS hold eos_token_id (sampled-path
+                # semantics)
+                hit = jnp.cumsum(
+                    (out == eos_token_id).astype(jnp.int32), axis=-1)
+                out = jnp.where(hit > 1, eos_token_id, out)
+            return jnp.concatenate([prompt, out], axis=-1)
 
         return jax.jit(run, in_shardings=(
             self.param_shardings, self._batch_sharding(b), None))

@@ -108,3 +108,31 @@ def test_requires_cache_capable_model():
             "train_batch_size": 8,
             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
             "hybrid_engine": {"enabled": True}})
+
+
+def test_hybrid_engine_with_llama_gqa():
+    """DS-Chat's flagship pairing: the hybrid engine drives a LLaMA-family
+    actor (rotary + GQA cache) through generate -> train -> generate."""
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+    cfg = LlamaConfig(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                      n_head=4, n_kv_head=2, mlp_hidden=96,
+                      pad_vocab_to_multiple=8)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=LlamaModel(cfg), config={
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "steps_per_print": 0,
+        "hybrid_engine": {"enabled": True, "max_out_tokens": 64}})
+    assert isinstance(engine, DeepSpeedHybridEngine)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 255, (2, 8)).astype(np.int32)
+    out1 = np.asarray(engine.generate(prompt, max_new_tokens=6,
+                                      temperature=0.0))
+    assert out1.shape == (2, 14)
+    for _ in range(8):
+        engine.train_batch(batch={
+            "input_ids": rng.integers(0, 255, (1, 8, 16), np.int32)})
+    out2 = np.asarray(engine.generate(prompt, max_new_tokens=6,
+                                      temperature=0.0))
+    assert not np.array_equal(out1, out2), \
+        "generation did not reflect trained weights"

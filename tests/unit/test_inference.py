@@ -181,3 +181,38 @@ def test_auto_tp_rules():
                for p, s in by_path.items())
     assert not any("ln" in p for p in by_path)
     assert auto_tp_rules(params, tp_size=1) == []
+
+
+def test_beam_search_beats_or_matches_greedy():
+    """num_beams=1 beam path == greedy chain; num_beams=4 finds a sequence
+    whose model log-prob is >= greedy's."""
+    import jax
+    import jax.numpy as jnp
+    model = _tiny_model()
+    eng = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    ids = _ids()
+    greedy = np.asarray(eng.generate(ids, max_new_tokens=6, temperature=0.0))
+    beam1 = np.asarray(eng.generate(ids, max_new_tokens=6, num_beams=1))
+    np.testing.assert_array_equal(greedy, beam1)   # num_beams=1 -> greedy
+
+    beam4 = np.asarray(eng.generate(ids, max_new_tokens=6, num_beams=4))
+    assert beam4.shape == greedy.shape
+    np.testing.assert_array_equal(beam4[:, :ids.shape[1]], ids)
+
+    def seq_logp(full):
+        logits = np.asarray(eng(full.astype(np.int32)))
+        logp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
+        tot = 0.0
+        for b in range(full.shape[0]):
+            for i in range(ids.shape[1] - 1, full.shape[1] - 1):
+                tot += float(logp[b, i, full[b, i + 1]])
+        return tot
+
+    assert seq_logp(beam4) >= seq_logp(greedy) - 1e-4
+
+
+def test_beam_search_rejects_sampling_args():
+    model = _tiny_model()
+    eng = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    with pytest.raises(ValueError, match="beam"):
+        eng.generate(_ids(), num_beams=4, temperature=0.7)
