@@ -160,12 +160,14 @@ class InferenceEngine:
                  temperature: float = 0.0, top_k: int = 0,
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  max_length: Optional[int] = None, top_p: float = 1.0,
-                 num_beams: int = 1):
+                 num_beams: int = 1, attention_mask=None):
         """Autoregressive generation, one compiled program per
         (prompt_shape, max_new_tokens) bucket. Returns [B, T+max_new_tokens]
         (prompt + generated; positions after EOS hold eos_token_id).
         ``num_beams > 1`` runs deterministic beam search (temperature/
-        top-k/top-p must be off)."""
+        top-k/top-p must be off). ``attention_mask`` [B, T] (HF convention,
+        1 = real token) serves LEFT-padded batches of uneven prompts: pad
+        columns never act as keys and logical positions shift per row."""
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if num_beams < 1:
@@ -178,6 +180,27 @@ class InferenceEngine:
         if input_ids.ndim == 1:
             input_ids = input_ids[None]
         b, t = input_ids.shape
+        pad_counts = None
+        if attention_mask is not None:
+            attention_mask = jnp.asarray(attention_mask)
+            if attention_mask.shape != (b, t):
+                raise ValueError(
+                    f"attention_mask shape {attention_mask.shape} != "
+                    f"input_ids shape {(b, t)}")
+            if num_beams > 1:
+                raise NotImplementedError(
+                    "attention_mask (padded prompts) + beam search is not "
+                    "supported yet")
+            # HF left-padding: mask must be 0..0 1..1 per row — enforce it
+            # (a right-padded mask would silently shift positions wrongly
+            # and sample from a pad token's hidden state)
+            pad_counts = (t - attention_mask.sum(-1)).astype(jnp.int32)
+            expect = jnp.arange(t)[None, :] >= pad_counts[:, None]
+            if not bool(jnp.all(attention_mask.astype(bool) == expect)):
+                raise ValueError(
+                    "attention_mask must be contiguous LEFT padding "
+                    "(rows of 0..0 1..1); right-padded or interior-zero "
+                    "masks are not supported")
         if max_length is not None:
             max_new_tokens = max(0, max_length - t)
         if max_new_tokens <= 0:
@@ -197,7 +220,7 @@ class InferenceEngine:
                 f"(reference inference/engine.py:588 guard); growing cache")
 
         key = ("gen", b, t, max_new_tokens, float(temperature), top_k,
-               float(top_p), eos_token_id, num_beams)
+               float(top_p), eos_token_id, num_beams, pad_counts is not None)
         if key not in self._fns:
             if num_beams > 1:
                 self._fns[key] = self._build_beam_generate(
@@ -205,13 +228,16 @@ class InferenceEngine:
             else:
                 self._fns[key] = self._build_generate(
                     b, t, cache_len, max_new_tokens, temperature, top_k,
-                    top_p, eos_token_id)
+                    top_p, eos_token_id, padded=pad_counts is not None)
         with self.mesh:
+            if num_beams > 1:
+                return self._fns[key](self.params, input_ids,
+                                      jax.random.PRNGKey(seed))
             return self._fns[key](self.params, input_ids,
-                                  jax.random.PRNGKey(seed))
+                                  jax.random.PRNGKey(seed), pad_counts)
 
     def _build_generate(self, b, t, cache_len, max_new_tokens, temperature,
-                        top_k, top_p, eos_token_id):
+                        top_k, top_p, eos_token_id, padded=False):
         model = self.module
         vocab = model.config.vocab_size
 
@@ -251,11 +277,13 @@ class InferenceEngine:
         def constrain(cache):
             return lax.with_sharding_constraint(cache, cache_specs)
 
-        def run(params, prompt, key):
+        def run(params, prompt, key, pad_counts=None):
+            pc = pad_counts if padded else None
             cache = constrain(
                 model.init_kv_cache(b, cache_len, dtype=self.dtype))
             logits, cache = model.apply_with_cache(params, prompt, cache,
-                                                   jnp.int32(0))
+                                                   jnp.int32(0),
+                                                   pad_counts=pc)
             tok = sample(logits[:, -1], key)
             finished = (jnp.zeros((b,), jnp.bool_) if eos_token_id is None
                         else tok == eos_token_id)
@@ -265,7 +293,7 @@ class InferenceEngine:
                 key, sub = jax.random.split(key)
                 # tok was sampled for position t+i-1; write its K/V there
                 logits, cache = model.apply_with_cache(
-                    params, tok[:, None], cache, t + i - 1)
+                    params, tok[:, None], cache, t + i - 1, pad_counts=pc)
                 cache = constrain(cache)
                 nxt = sample(logits[:, -1], sub)
                 if eos_token_id is not None:
@@ -283,7 +311,7 @@ class InferenceEngine:
             return jnp.concatenate([prompt, toks], axis=-1)
 
         return jax.jit(run, in_shardings=(
-            self.param_shardings, self._batch_sharding(b), None))
+            self.param_shardings, self._batch_sharding(b), None, None))
 
     def _build_beam_generate(self, b, t, cache_len, max_new_tokens, k,
                              eos_token_id):

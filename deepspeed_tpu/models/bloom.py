@@ -63,7 +63,9 @@ class BloomModel(GPT2Model):
         return params
 
     # ------------------------------------------------- family hook overrides
-    def _embed(self, params, input_ids, start_pos=0):
+    def _embed(self, params, input_ids, start_pos=0, positions=None):
+        # ALiBi: per-row position shifts are softmax-invariant (row-constant
+        # bias), so positions are ignored here too
         x = params["wte"].astype(self._compute_dtype(params))[input_ids]
         return _layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
                            self.config.layer_norm_epsilon)

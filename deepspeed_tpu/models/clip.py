@@ -117,7 +117,7 @@ class CLIPVisionTower(GPT2Model):
         return (pw if jnp.issubdtype(pw, jnp.floating)
                 else jnp.dtype(self.config.dtype))
 
-    def _embed(self, params, pixel_values, start_pos=0):
+    def _embed(self, params, pixel_values, start_pos=0, positions=None):
         """pixel_values: [B, 3, H, W] (HF layout). The stride==kernel conv
         is a reshape + one [N, 3p²] @ [3p², D] matmul."""
         cfg = self.config

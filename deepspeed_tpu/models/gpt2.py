@@ -154,15 +154,19 @@ class GPT2Model(ModelSpec):
     def _compute_dtype(self, params):
         return _params_compute_dtype(params, self.config.dtype)
 
-    def _embed(self, params, input_ids, start_pos=0):
+    def _embed(self, params, input_ids, start_pos=0, positions=None):
         """Token + learned-position embeddings in compute dtype (no dropout).
-        ``start_pos`` may be a traced scalar (decode)."""
+        ``start_pos`` may be a traced scalar (decode); ``positions`` [B, T]
+        overrides it for per-row offsets (left-padded serving batches)."""
         cfg = self.config
         dt = self._compute_dtype(params)
         t = input_ids.shape[-1]
-        wpe = lax.dynamic_slice(
-            params["wpe"], (start_pos + cfg.pos_offset, 0),
-            (t, cfg.n_embd)).astype(dt)
+        if positions is not None:
+            wpe = params["wpe"].astype(dt)[positions + cfg.pos_offset]
+        else:
+            wpe = lax.dynamic_slice(
+                params["wpe"], (start_pos + cfg.pos_offset, 0),
+                (t, cfg.n_embd)).astype(dt)
         return params["wte"].astype(dt)[input_ids] + wpe
 
     def _final_norm(self, params, x):
@@ -182,7 +186,8 @@ class GPT2Model(ModelSpec):
         return self.config.n_head
 
     # ----------------------------------------------------------------- block
-    def _attn_sublayer(self, x, p, rng, train, attn_fn=None, start_pos=0):
+    def _attn_sublayer(self, x, p, rng, train, attn_fn=None, start_pos=0,
+                       positions=None):
         """ln1 → qkv → flash attention → proj → residual (+dropout).
 
         ``attn_fn(q, k, v) -> attn`` overrides the attention inner — the
@@ -227,10 +232,11 @@ class GPT2Model(ModelSpec):
         x = self._attn_sublayer(x, layer_params, rng, train)
         return self._mlp_sublayer(x, layer_params, rng, train)
 
-    def _decode_block(self, x, layer_params, attn_fn, start_pos):
+    def _decode_block(self, x, layer_params, attn_fn, start_pos,
+                      positions=None):
         """One block on the KV-cache decode path (no dropout/rng)."""
         x = self._attn_sublayer(x, layer_params, None, False, attn_fn=attn_fn,
-                                start_pos=start_pos)
+                                start_pos=start_pos, positions=positions)
         x, _ = self._mlp_sublayer(x, layer_params, None, False)
         return x
 
@@ -467,21 +473,34 @@ class GPT2Model(ModelSpec):
         None). ALiBi families override."""
         return None
 
-    def apply_with_cache(self, params, input_ids, cache, start_pos):
+    def apply_with_cache(self, params, input_ids, cache, start_pos,
+                         pad_counts=None):
         """Forward with KV cache. input_ids: [B, T] (prompt for prefill,
-        [B, 1] for decode); start_pos: traced scalar — tokens occupy
-        positions [start_pos, start_pos+T). Returns (logits [B,T,V],
+        [B, 1] for decode); start_pos: traced scalar — tokens occupy cache
+        columns [start_pos, start_pos+T). ``pad_counts`` [B]: number of
+        LEFT-padding tokens per row (serving batches of uneven prompts) —
+        cache columns below pad_counts[b] are masked out and logical
+        positions shift down by pad_counts[b] (ALiBi needs no shift: a
+        per-row constant is softmax-invariant). Returns (logits [B,T,V],
         new_cache)."""
         cfg = self.config
         b, t = input_ids.shape
         max_len = cache["k"].shape[-2]
         compute_dtype = self._compute_dtype(params)
-        x = self._embed(params, input_ids, start_pos=start_pos)
+        positions = None
+        if pad_counts is not None:
+            positions = jnp.maximum(
+                (start_pos + jnp.arange(t))[None, :] - pad_counts[:, None], 0)
+        x = self._embed(params, input_ids, start_pos=start_pos,
+                        positions=positions)
 
         # attention mask over the cache: key position <= query position
         q_pos = start_pos + jnp.arange(t)[:, None]
         k_pos = jnp.arange(max_len)[None, :]
         mask = self._decode_attn_mask(q_pos, k_pos)[None, None]
+        if pad_counts is not None:     # left-pad columns are never valid keys
+            valid = jnp.arange(max_len)[None, :] >= pad_counts[:, None]
+            mask = mask & valid[:, None, None, :]
         bias = self._decode_attn_bias(q_pos, k_pos)  # [H, T, max_len] | None
 
         from ..ops.flash_attention import reference_attention
@@ -505,7 +524,7 @@ class GPT2Model(ModelSpec):
                                            bias=bias)
 
             return self._decode_block(x, layer_params, cached_attn,
-                                      start_pos), \
+                                      start_pos, positions=positions), \
                 (new_kv["k"], new_kv["v"])
 
         x, (new_k, new_v) = lax.scan(

@@ -66,9 +66,13 @@ GPTJ_6B = gptj_config(vocab_size=50400, n_embd=4096, n_layer=28, n_head=16,
 
 def apply_rope_interleaved(x, angles):
     """GPT-J rotate_every_two: pairs are (x[2i], x[2i+1]).
-    x: [B, H, T, rot]; angles: [T, rot/2]."""
-    cos = jnp.cos(angles).astype(x.dtype)[None, None]
-    sin = jnp.sin(angles).astype(x.dtype)[None, None]
+    x: [B, H, T, rot]; angles: [T, rot/2] or [B, T, rot/2]."""
+    if angles.ndim == 2:
+        cos = jnp.cos(angles).astype(x.dtype)[None, None]
+        sin = jnp.sin(angles).astype(x.dtype)[None, None]
+    else:
+        cos = jnp.cos(angles).astype(x.dtype)[:, None]
+        sin = jnp.sin(angles).astype(x.dtype)[:, None]
     x1, x2 = x[..., 0::2], x[..., 1::2]
     o1 = x1 * cos - x2 * sin
     o2 = x2 * cos + x1 * sin
@@ -123,7 +127,8 @@ class GPTNeoXModel(GPT2Model):
         return params
 
     # ------------------------------------------------- family hook overrides
-    def _embed(self, params, input_ids, start_pos=0):
+    def _embed(self, params, input_ids, start_pos=0, positions=None):
+        # rotary: positions enter through attention, not the embedding
         return params["wte"].astype(self._compute_dtype(params))[input_ids]
 
     def _unembed_weight(self, params, dtype):
@@ -141,7 +146,7 @@ class GPTNeoXModel(GPT2Model):
         if cfg.rotary_interleaved:
             inv = 1.0 / (cfg.rope_theta **
                          (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
-            angles = pos.astype(jnp.float32)[:, None] * inv[None, :]
+            angles = pos.astype(jnp.float32)[..., None] * inv
             x_rot = apply_rope_interleaved(x_rot, angles)
         else:
             cos, sin = rope_cos_sin(pos, rot, cfg.rope_theta, x.dtype)
@@ -149,7 +154,8 @@ class GPTNeoXModel(GPT2Model):
         return jnp.concatenate([x_rot, x_pass], axis=-1) \
             if rot < x.shape[-1] else x_rot
 
-    def _attn_branch(self, ln1, p, rng, train, attn_fn, start_pos):
+    def _attn_branch(self, ln1, p, rng, train, attn_fn, start_pos,
+                     positions=None):
         cfg = self.config
         b, t, d = ln1.shape
         h, hd = cfg.n_head, cfg.head_dim
@@ -160,7 +166,7 @@ class GPTNeoXModel(GPT2Model):
         q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
-        pos = start_pos + jnp.arange(t)
+        pos = positions if positions is not None else start_pos + jnp.arange(t)
         q = self._partial_rope(q, pos)
         k = self._partial_rope(k, pos)
         if attn_fn is not None:
@@ -187,11 +193,13 @@ class GPTNeoXModel(GPT2Model):
         return hmid @ p["mlp_proj_w"].astype(hmid.dtype) + \
             p["mlp_proj_b"].astype(hmid.dtype)
 
-    def _block_impl(self, x, p, rng, train, attn_fn, start_pos):
+    def _block_impl(self, x, p, rng, train, attn_fn, start_pos,
+                    positions=None):
         cfg = self.config
         eps = cfg.layer_norm_epsilon
         ln1 = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], eps)
-        attn = self._attn_branch(ln1, p, rng, train, attn_fn, start_pos)
+        attn = self._attn_branch(ln1, p, rng, train, attn_fn, start_pos,
+                                 positions=positions)
         if cfg.use_parallel_residual:
             mlp_in = ln1 if cfg.shared_ln else \
                 _layer_norm(x, p["ln2_scale"], p["ln2_bias"], eps)
@@ -206,9 +214,10 @@ class GPTNeoXModel(GPT2Model):
         return self._block_impl(x, layer_params, rng, train, None, 0), \
             jnp.float32(0.0)
 
-    def _decode_block(self, x, layer_params, attn_fn, start_pos):
+    def _decode_block(self, x, layer_params, attn_fn, start_pos,
+                      positions=None):
         return self._block_impl(x, layer_params, None, False, attn_fn,
-                                start_pos)
+                                start_pos, positions=positions)
 
     # ------------------------------------------------------------- sharding
     def partition_rules(self):

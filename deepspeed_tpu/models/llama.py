@@ -58,21 +58,27 @@ def _rms_norm(x, scale, eps):
 
 
 def rope_cos_sin(pos, head_dim, theta, dtype):
-    """cos/sin tables for HF rotate_half rotary. pos: [T] (may be traced).
-    Returns ([T, head_dim], [T, head_dim]) with the half-table duplicated."""
+    """cos/sin tables for HF rotate_half rotary. pos: [T] or [B, T] (may be
+    traced). Returns cos/sin of shape pos.shape + (head_dim,) with the
+    half-table duplicated."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
                                            dtype=jnp.float32) / head_dim))
-    angles = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [T, hd/2]
+    angles = pos.astype(jnp.float32)[..., None] * inv_freq
     emb = jnp.concatenate([angles, angles], axis=-1)
     return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
 
 
 def apply_rope(x, cos, sin):
-    """x: [B, H, T, hd]; cos/sin: [T, hd]. HF rotate_half convention."""
+    """x: [B, H, T, hd]; cos/sin: [T, hd] (shared) or [B, T, hd]
+    (per-row positions). HF rotate_half convention."""
+    if cos.ndim == 2:
+        cos, sin = cos[None, None], sin[None, None]
+    else:                               # [B, T, hd] -> [B, 1, T, hd]
+        cos, sin = cos[:, None], sin[:, None]
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
     rotated = jnp.concatenate([-x2, x1], axis=-1)
-    return x * cos[None, None] + rotated * sin[None, None]
+    return x * cos + rotated * sin
 
 
 class LlamaModel(GPT2Model):
@@ -118,7 +124,8 @@ class LlamaModel(GPT2Model):
         return params
 
     # ------------------------------------------------- family hook overrides
-    def _embed(self, params, input_ids, start_pos=0):
+    def _embed(self, params, input_ids, start_pos=0, positions=None):
+        # rotary: positions enter through attention, not the embedding
         return params["wte"].astype(self._compute_dtype(params))[input_ids]
 
     def _final_norm(self, params, x):
@@ -136,7 +143,8 @@ class LlamaModel(GPT2Model):
         return keep
 
     # ----------------------------------------------------------------- block
-    def _attn_sublayer(self, x, p, rng, train, attn_fn=None, start_pos=0):
+    def _attn_sublayer(self, x, p, rng, train, attn_fn=None, start_pos=0,
+                       positions=None):
         cfg = self.config
         b, t, d = x.shape
         h, hk, hd = cfg.n_head, cfg.kv_head_count, cfg.head_dim
@@ -146,7 +154,7 @@ class LlamaModel(GPT2Model):
         q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, hk, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, t, hk, hd).transpose(0, 2, 1, 3)
-        pos = start_pos + jnp.arange(t)
+        pos = positions if positions is not None else start_pos + jnp.arange(t)
         cos, sin = rope_cos_sin(pos, hd, cfg.rope_theta, q.dtype)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)

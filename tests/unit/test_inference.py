@@ -216,3 +216,57 @@ def test_beam_search_rejects_sampling_args():
     eng = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
     with pytest.raises(ValueError, match="beam"):
         eng.generate(_ids(), num_beams=4, temperature=0.7)
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama", "gptj", "neox",
+                                    "bloom"])
+def test_left_padded_batch_matches_unpadded_rows(family):
+    """generate(attention_mask=...) on a LEFT-padded batch of uneven
+    prompts must produce, per row, exactly what generating that row alone
+    (unpadded) produces — positions shift and pad keys are masked."""
+    if family == "llama":
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+        model = LlamaModel(LlamaConfig(
+            vocab_size=256, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+            n_kv_head=2, mlp_hidden=96, pad_vocab_to_multiple=8))
+    elif family in ("gptj", "neox"):
+        from deepspeed_tpu.models.gpt_neox import (GPTNeoXConfig,
+                                                   GPTNeoXModel, gptj_config)
+        mk = gptj_config if family == "gptj" else GPTNeoXConfig
+        model = GPTNeoXModel(mk(
+            vocab_size=256, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+            pad_vocab_to_multiple=8))
+    elif family == "bloom":
+        from deepspeed_tpu.models.bloom import BloomConfig, BloomModel
+        model = BloomModel(BloomConfig(
+            vocab_size=256, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+            pad_vocab_to_multiple=8))
+    else:
+        model = _tiny_model()
+    eng = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+
+    rng = np.random.default_rng(0)
+    rows = [rng.integers(5, 255, n).astype(np.int32) for n in (6, 10)]
+    T = 10
+    padded = np.zeros((2, T), np.int32)
+    mask = np.zeros((2, T), np.int32)
+    for i, r in enumerate(rows):
+        padded[i, T - len(r):] = r
+        mask[i, T - len(r):] = 1
+
+    batch_out = np.asarray(eng.generate(padded, max_new_tokens=5,
+                                        attention_mask=mask))
+    for i, r in enumerate(rows):
+        solo = np.asarray(eng.generate(r[None], max_new_tokens=5))
+        np.testing.assert_array_equal(batch_out[i, T:], solo[0, len(r):],
+                                      err_msg=f"row {i} ({family})")
+
+
+def test_right_padded_mask_rejected():
+    model = _tiny_model()
+    eng = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    ids = np.asarray(_ids())
+    mask = np.ones_like(ids)
+    mask[:, -2:] = 0                               # RIGHT padding
+    with pytest.raises(ValueError, match="LEFT"):
+        eng.generate(ids, max_new_tokens=3, attention_mask=mask)
