@@ -172,15 +172,39 @@ class BertModel(ModelSpec):
             return out, jnp.float32(0.0)
         return out
 
+    def _mlm_head(self, params, x):
+        """Transform + tied decoder + vocab bias on hidden states x."""
+        cfg = self.config
+        x = x @ params["mlm_dense_w"].astype(x.dtype) + \
+            params["mlm_dense_b"].astype(x.dtype)
+        x = _activation(x, cfg.activation)
+        x = _layer_norm(x, params["mlm_ln_scale"], params["mlm_ln_bias"],
+                        cfg.layer_norm_epsilon)
+        return x @ params["wte"].astype(x.dtype).T + \
+            params["mlm_bias"].astype(x.dtype)
+
     def apply(self, params, batch, rng=None, train=True):
-        """Masked-LM loss over labels != -100 (HF convention, unshifted)."""
+        """Masked-LM loss over labels != -100 (HF convention, unshifted).
+
+        If the batch carries ``masked_positions`` [B, P] (+ ``masked_labels``
+        [B, P], -100 = slot unused), the vocab head runs ONLY on those P
+        gathered positions — at the standard 15% mask rate that is ~6.7x
+        less head compute than projecting every token (the reference's
+        fused softmax kernels still do the full [B, T, V] product)."""
         cfg = self.config
         input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
         tt = batch.get("token_type_ids") if isinstance(batch, dict) else None
         am = batch.get("attention_mask") if isinstance(batch, dict) else None
-        labels = (batch["labels"] if isinstance(batch, dict) and
-                  "labels" in batch else input_ids)
-        logits = self.mlm_logits(params, input_ids, tt, am, rng, train)
+        mpos = (batch.get("masked_positions") if isinstance(batch, dict)
+                else None)
+        x = self.encode(params, input_ids, tt, am, rng, train)
+        if mpos is not None:
+            labels = batch["masked_labels"]
+            x = jnp.take_along_axis(x, mpos[..., None], axis=1)  # [B, P, D]
+        else:
+            labels = (batch["labels"] if isinstance(batch, dict) and
+                      "labels" in batch else input_ids)
+        logits = self._mlm_head(params, x)
         valid = (labels >= 0) & (labels < cfg.vocab_size)
         safe = jnp.where(valid, labels, 0)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
