@@ -46,7 +46,7 @@ def main():
     # per timing window
     seq = int(os.environ.get("BENCH_SEQ", 1024))
     micro_bs = int(os.environ.get("BENCH_BS", 8))
-    steps = max(1, int(os.environ.get("BENCH_STEPS", 1)))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", 4)))
     gas = int(os.environ.get("BENCH_GAS", 128))
     windows = max(1, int(os.environ.get("BENCH_WINDOWS", 3)))
     warmup = 3

@@ -1,0 +1,141 @@
+"""Dissect the flash fwd kernel cost: which stage makes it 40x off peak?"""
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B, H, T, D = 8, 12, 1024, 64
+ITERS = 50
+BQ, BK, GH = 512, 256, 2
+_BNT = (((2,), (2,)), ((0,), (0,)))
+_BNN = (((2,), (1,)), ((0,), (0,)))
+
+
+def timed(fn, *args):
+    @jax.jit
+    def run(args):
+        def body(c, _):
+            out = fn(*[a + c for a in args])
+            return jnp.sum(out.astype(jnp.float32)) * 1e-9, None
+        c, _ = lax.scan(body, jnp.float32(0), None, length=ITERS)
+        return c
+    r = run(args); float(r)
+    t0 = time.perf_counter(); r = run(args); float(r)
+    return (time.perf_counter() - t0) / ITERS * 1e3
+
+
+def make(variant, gh=GH, bq=BQ, bk=BK):
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        q = q_ref[...]
+
+        def body(j, acc):
+            k_j = k_ref[:, pl.ds(j * bk, bk), :]
+            v_j = v_ref[:, pl.ds(j * bk, bk), :]
+            s = lax.dot_general(q, k_j, _BNT,
+                                preferred_element_type=jnp.float32)
+            if variant == "dots":
+                p = s
+            elif variant == "exp":
+                p = jnp.exp(s)
+            elif variant == "exp_max":
+                m = jnp.max(s, axis=-1, keepdims=True)
+                p = jnp.exp(s - m)
+            elif variant == "exp2":
+                p = jnp.exp2(s)
+            return acc + lax.dot_general(p.astype(v_j.dtype), v_j, _BNN,
+                                         preferred_element_type=jnp.float32)
+
+        acc = lax.fori_loop(0, T // bk, body,
+                            jnp.zeros((gh, bq, D), jnp.float32))
+        o_ref[...] = acc.astype(o_ref.dtype)
+
+    def run(q, k, v):
+        bh = B * H
+        qf, kf, vf = (x.reshape(bh, T, D) for x in (q, k, v))
+        out = pl.pallas_call(
+            kernel,
+            grid=(bh // gh, T // bq),
+            in_specs=[
+                pl.BlockSpec((gh, bq, D), lambda n, i: (n, i, 0)),
+                pl.BlockSpec((gh, T, D), lambda n, i: (n, 0, 0)),
+                pl.BlockSpec((gh, T, D), lambda n, i: (n, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((gh, bq, D), lambda n, i: (n, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((bh, T, D), q.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+        )(qf, kf, vf)
+        return out
+
+    return run
+
+
+def single_shot(gh, bq):
+    """No online softmax: full-width scores row in VMEM."""
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        s = lax.dot_general(q, k, _BNT, preferred_element_type=jnp.float32)
+        q_off = pl.program_id(1) * bq
+        q_pos = q_off + lax.broadcasted_iota(jnp.int32, (gh, bq, T), 1)
+        k_pos = lax.broadcasted_iota(jnp.int32, (gh, bq, T), 2)
+        s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        acc = lax.dot_general(p.astype(v.dtype), v, _BNN,
+                              preferred_element_type=jnp.float32)
+        o_ref[...] = (acc / l).astype(o_ref.dtype)
+
+    def run(q, k, v):
+        bh = B * H
+        qf, kf, vf = (x.reshape(bh, T, D) for x in (q, k, v))
+        return pl.pallas_call(
+            kernel,
+            grid=(bh // gh, T // bq),
+            in_specs=[
+                pl.BlockSpec((gh, bq, D), lambda n, i: (n, i, 0)),
+                pl.BlockSpec((gh, T, D), lambda n, i: (n, 0, 0)),
+                pl.BlockSpec((gh, T, D), lambda n, i: (n, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((gh, bq, D), lambda n, i: (n, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((bh, T, D), q.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+        )(qf, kf, vf)
+
+    return run
+
+
+def main():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16) * 0.1
+    k = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16) * 0.1
+    v = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16) * 0.1
+
+    for name in ("dots", "exp2", "exp", "exp_max"):
+        ms = timed(make(name), q, k, v)
+        print(f"probe {name:8s}: {ms:.3f} ms")
+    for gh, bq in ((2, 512), (4, 256), (1, 1024), (8, 128), (4, 512)):
+        try:
+            ms = timed(single_shot(gh, bq), q, k, v)
+            print(f"single-shot gh{gh} bq{bq}: {ms:.3f} ms")
+        except Exception as e:
+            print(f"single-shot gh{gh} bq{bq}: FAIL {type(e).__name__}: "
+                  f"{str(e)[:120]}")
+
+
+if __name__ == "__main__":
+    main()

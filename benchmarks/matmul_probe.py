@@ -1,0 +1,76 @@
+"""Is the D=64 batched matmul the limit, or Mosaic codegen?"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+ITERS = 50
+
+
+def timed(fn, *args, flops=None):
+    @jax.jit
+    def run(args):
+        def body(c, _):
+            out = fn(*[(a + c).astype(a.dtype) for a in args])
+            return jnp.sum(out.astype(jnp.float32)) * 1e-9, None
+        c, _ = lax.scan(body, jnp.float32(0), None, length=ITERS)
+        return c
+    r = run(args); float(r)
+    t0 = time.perf_counter(); r = run(args); float(r)
+    ms = (time.perf_counter() - t0) / ITERS * 1e3
+    tf = (flops / ms / 1e9) if flops else 0
+    return ms, tf
+
+
+def main():
+    rng = np.random.default_rng(0)
+    bf = jnp.bfloat16
+
+    # control: the dense-layer shape (known-good ~100+ TFLOPs)
+    a = jnp.asarray(rng.standard_normal((8192, 768)), bf)
+    b = jnp.asarray(rng.standard_normal((768, 3072)), bf)
+    ms, tf = timed(lambda a, b: a @ b, a, b, flops=2 * 8192 * 768 * 3072)
+    print(f"2D [8192,768]x[768,3072]: {ms:.3f} ms  {tf:.0f} TFLOPs")
+
+    # attention score shapes, batched
+    for bh, t, d in ((96, 1024, 64), (48, 1024, 128), (96, 1024, 128)):
+        q = jnp.asarray(rng.standard_normal((bh, t, d)), bf)
+        k = jnp.asarray(rng.standard_normal((bh, t, d)), bf)
+        fl = 2 * bh * t * t * d
+        ms, tf = timed(lambda q, k: jnp.einsum("bqd,bkd->bqk", q, k),
+                       q, k, flops=fl)
+        print(f"xla qk^T bh{bh} t{t} d{d}: {ms:.3f} ms  {tf:.0f} TFLOPs")
+        p = jnp.asarray(rng.standard_normal((bh, t, t)), bf)
+        v = jnp.asarray(rng.standard_normal((bh, t, d)), bf)
+        ms, tf = timed(lambda p, v: jnp.einsum("bqk,bkd->bqd", p, v),
+                       p, v, flops=fl)
+        print(f"xla p@v  bh{bh} t{t} d{d}: {ms:.3f} ms  {tf:.0f} TFLOPs")
+
+    # whole attention in XLA at bf16 (s kept bf16)
+    q = jnp.asarray(rng.standard_normal((96, 1024, 64)), bf)
+    k = jnp.asarray(rng.standard_normal((96, 1024, 64)), bf)
+    v = jnp.asarray(rng.standard_normal((96, 1024, 64)), bf)
+
+    def attn(q, k, v):
+        s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * 0.125
+        qpos = jnp.arange(1024)[:, None]
+        kpos = jnp.arange(1024)[None, :]
+        s = jnp.where(qpos >= kpos, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bqk,bkd->bqd", p.astype(bf), v)
+
+    fl = 2 * 2 * 96 * 1024 * 1024 * 64
+    ms, tf = timed(attn, q, k, v, flops=fl)
+    print(f"xla full attn (f32 softmax): {ms:.3f} ms  {tf:.0f} TFLOPs")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .api import ModelSpec
+from ..ops import memory_efficient as me
 from ..ops.seq_parallel import sp_attention
 
 
@@ -69,17 +70,20 @@ GPT2_1_3B = GPT2Config(n_embd=2048, n_layer=24, n_head=32)
 
 def _activation(x, name):
     """gelu = tanh approximation (GPT-2 'gelu_new'); gelu_exact = erf GELU
-    (HF 'gelu', the NeoX/BERT default)."""
+    (HF 'gelu', the NeoX/BERT default). All route through the
+    memory-efficient custom-VJP ops (ops/memory_efficient.py) whose
+    backward recomputes from the input instead of stashing wide
+    intermediates."""
     if name == "relu":
         return jax.nn.relu(x)
     if name == "gelu":
-        return jax.nn.gelu(x, approximate=True)
+        return me.gelu(x)
     if name == "gelu_exact":
-        return jax.nn.gelu(x, approximate=False)
+        return me.gelu_exact(x)
     if name == "silu":
-        return jax.nn.silu(x)
+        return me.silu(x)
     if name == "quick_gelu":             # CLIP: x * sigmoid(1.702 x)
-        return x * jax.nn.sigmoid(1.702 * x)
+        return me.quick_gelu(x)
     raise ValueError(f"unknown activation {name!r}")
 
 
@@ -100,11 +104,7 @@ def _params_compute_dtype(params, fallback):
 
 
 def _layer_norm(x, scale, bias, eps):
-    x32 = x.astype(jnp.float32)
-    mean = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
-    y = (x32 - mean) * lax.rsqrt(var + eps)
-    return (y * scale + bias).astype(x.dtype)
+    return me.layer_norm(x, scale, bias, eps)
 
 
 class GPT2Model(ModelSpec):
@@ -299,10 +299,9 @@ class GPT2Model(ModelSpec):
             shift_logits, shift_labels = logits[:, :-1], input_ids[:, 1:]
         valid = (shift_labels >= 0) & (shift_labels < cfg.vocab_size)
         safe_labels = jnp.where(valid, shift_labels, 0)
-        logp = jax.nn.log_softmax(shift_logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
-        nll = jnp.where(valid, nll, 0.0)
-        return nll.sum() / jnp.maximum(valid.sum(), 1)
+        total = me.dense_xent_sum(shift_logits,
+                                  safe_labels.astype(jnp.int32), valid)
+        return total / jnp.maximum(valid.sum(), 1)
 
     @staticmethod
     def _loss_chunk(v: int, target: int = 8192) -> int:

@@ -1,0 +1,196 @@
+"""Memory-efficient custom-VJP building blocks for the training hot path.
+
+Round-3 HLO profiling of the GPT-2 125M fused step showed the layer-scan
+stash dominated by autodiff residuals that are pure recompute-bait:
+
+- ``jax.nn.gelu`` (tanh approx) linearizes into SIX saved ``[B,T,4D]``
+  bf16 intermediates per layer (~3.6 GB/micro at 125M bs8) — its
+  derivative is a closed-form elementwise function of the input.
+- LayerNorm saves its fp32 normalized tensor and friends (three f32
+  ``[B,T,D]`` buffers per LN, ~2.4 GB/micro) — recomputable from the
+  bf16 input plus the tiny per-row (mean, rstd).
+- ``log_softmax`` over the vocab materializes an f32 ``[B,T,V]``
+  (~1.65 GB at 125M) where streaming reductions over the bf16 logits
+  suffice.
+
+These custom-VJP versions save only the (already materialized) inputs and
+O(rows) statistics, cutting ~10 GB of HBM round-trip per micro step. This
+is the TPU-shaped counterpart of the reference's hand-written fused
+backward kernels (reference csrc/transformer/gelu_kernels.cu,
+normalize_kernels.cu d_gelu/d_ln, softmax_kernels.cu
+cross-entropy path): same goal — never spill wide intermediates — but via
+VJP rules + XLA fusion instead of CUDA.
+
+Numerics: all stats and gradients accumulate in fp32; outputs/grads are
+cast back to the input dtype. Parity with ``jax.grad`` of the naive
+compositions is tested in tests/unit/test_memory_efficient.py.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_SQRT_2_OVER_PI = 0.7978845608028654
+_GELU_C = 0.044715
+
+
+# ------------------------------------------------------------------ layer norm
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def layer_norm(x, scale, bias, eps=1e-5):
+    """LayerNorm with fp32 stats; saves (x, mean, rstd) instead of the
+    fp32 normalized tensor."""
+    y, _ = _ln_fwd_impl(x, scale, bias, eps)
+    return y
+
+
+def _ln_fwd_impl(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    rstd = lax.rsqrt(var + eps)
+    xhat = (xf - mean) * rstd
+    y = xhat * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(x.dtype), (mean, rstd)
+
+
+def _ln_fwd(x, scale, bias, eps):
+    y, (mean, rstd) = _ln_fwd_impl(x, scale, bias, eps)
+    return y, (x, scale, bias, mean, rstd)
+
+
+def _ln_bwd(eps, res, g):
+    x, scale, bias, mean, rstd = res
+    gf = g.astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    xhat = (xf - mean) * rstd                       # recomputed, not saved
+    sf = scale.astype(jnp.float32)
+    dxhat = gf * sf
+    # dx = rstd * (dxhat - mean(dxhat) - xhat * mean(dxhat*xhat))
+    m1 = jnp.mean(dxhat, axis=-1, keepdims=True)
+    m2 = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
+    dx = rstd * (dxhat - m1 - xhat * m2)
+    reduce_axes = tuple(range(x.ndim - 1))
+    dscale = jnp.sum(gf * xhat, axis=reduce_axes)
+    dbias = jnp.sum(gf, axis=reduce_axes)
+    return (dx.astype(x.dtype), dscale.astype(scale.dtype),
+            dbias.astype(bias.dtype))
+
+
+layer_norm.defvjp(_ln_fwd, _ln_bwd)
+
+
+# ----------------------------------------------------------------- activations
+
+def _make_unary(fwd_f32, grad_f32, name):
+    """Elementwise activation whose VJP saves ONLY the input and evaluates
+    a closed-form derivative in fp32."""
+
+    @jax.custom_vjp
+    def act(x):
+        return fwd_f32(x.astype(jnp.float32)).astype(x.dtype)
+
+    def fwd(x):
+        return act(x), (x,)
+
+    def bwd(res, g):
+        (x,) = res
+        xf = x.astype(jnp.float32)
+        return ((g.astype(jnp.float32) * grad_f32(xf)).astype(x.dtype),)
+
+    act.defvjp(fwd, bwd)
+    act.__name__ = name
+    return act
+
+
+def _gelu_tanh_f32(x):
+    u = _SQRT_2_OVER_PI * (x + _GELU_C * x * x * x)
+    return 0.5 * x * (1.0 + jnp.tanh(u))
+
+
+def _gelu_tanh_grad_f32(x):
+    u = _SQRT_2_OVER_PI * (x + _GELU_C * x * x * x)
+    t = jnp.tanh(u)
+    du = _SQRT_2_OVER_PI * (1.0 + 3.0 * _GELU_C * x * x)
+    return 0.5 * (1.0 + t) + 0.5 * x * (1.0 - t * t) * du
+
+
+def _gelu_exact_f32(x):
+    return 0.5 * x * (1.0 + lax.erf(x * (2.0 ** -0.5)))
+
+
+def _gelu_exact_grad_f32(x):
+    cdf = 0.5 * (1.0 + lax.erf(x * (2.0 ** -0.5)))
+    pdf = jnp.exp(-0.5 * x * x) * (1.0 / jnp.sqrt(2.0 * jnp.pi))
+    return cdf + x * pdf
+
+
+def _silu_f32(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def _silu_grad_f32(x):
+    s = jax.nn.sigmoid(x)
+    return s * (1.0 + x * (1.0 - s))
+
+
+def _quick_gelu_f32(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def _quick_gelu_grad_f32(x):
+    s = jax.nn.sigmoid(1.702 * x)
+    return s * (1.0 + 1.702 * x * (1.0 - s))
+
+
+gelu = _make_unary(_gelu_tanh_f32, _gelu_tanh_grad_f32, "gelu")
+gelu_exact = _make_unary(_gelu_exact_f32, _gelu_exact_grad_f32, "gelu_exact")
+silu = _make_unary(_silu_f32, _silu_grad_f32, "silu")
+quick_gelu = _make_unary(_quick_gelu_f32, _quick_gelu_grad_f32, "quick_gelu")
+
+
+# -------------------------------------------------------------- cross entropy
+
+@jax.custom_vjp
+def dense_xent_sum(logits, labels, valid):
+    """Sum over valid tokens of next-token NLL, WITHOUT materializing the
+    f32 log-softmax tensor. logits: [..., V] (any float dtype; leading
+    dims arbitrary — do NOT pre-flatten: merging a padded sublane dim
+    forces a full copy of the logits); labels: [...] int32 (already
+    clamped to range); valid: [...] bool.
+
+    Saves (logits, lse, labels, valid): backward streams one pass over the
+    bf16 logits computing (softmax - onehot) * g. Divide by the valid
+    count OUTSIDE (it is autodiff-transparent there)."""
+    nll, _ = _xent_impl(logits, labels, valid)
+    return nll
+
+
+def _xent_impl(logits, labels, valid):
+    lf = logits.astype(jnp.float32)
+    m = jnp.max(lf, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(lf - m[..., None]), axis=-1))
+    tgt = jnp.take_along_axis(lf, labels[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, lse - tgt, 0.0)
+    return jnp.sum(nll), lse
+
+
+def _xent_fwd(logits, labels, valid):
+    total, lse = _xent_impl(logits, labels, valid)
+    return total, (logits, lse, labels, valid)
+
+
+def _xent_bwd(res, g):
+    logits, lse, labels, valid = res
+    lf = logits.astype(jnp.float32)
+    p = jnp.exp(lf - lse[..., None])
+    cols = lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
+    onehot = cols == labels[..., None]
+    scale = jnp.where(valid, g, 0.0).astype(jnp.float32)[..., None]
+    dlogits = (p - onehot.astype(jnp.float32)) * scale
+    return dlogits.astype(logits.dtype), None, None
+
+
+dense_xent_sum.defvjp(_xent_fwd, _xent_bwd)

@@ -19,20 +19,21 @@ import jax.numpy as jnp
 
 
 def layer_norm(x, scale, bias, eps: float = 1e-5):
-    """Fused LN (normalize_kernels.cu): stats in fp32, output in x.dtype."""
-    xf = x.astype(jnp.float32)
-    mu = jnp.mean(xf, axis=-1, keepdims=True)
-    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
-    y = (xf - mu) * jax.lax.rsqrt(var + eps)
-    return (y * scale + bias).astype(x.dtype)
+    """Fused LN (normalize_kernels.cu): stats in fp32, output in x.dtype.
+    Custom-VJP: backward recomputes the normalized tensor from (x, mean,
+    rstd) instead of stashing it (ops/memory_efficient.py)."""
+    from ..memory_efficient import layer_norm as _ln
+    return _ln(x, scale, bias, eps)
 
 
 def bias_gelu(x, bias=None, approximate: bool = True):
     """Fused bias + GELU (gelu_kernels.cu; tanh approximation like the
-    reference's gelu(sqrt(2/pi)(x+0.044715x^3)) form)."""
+    reference's gelu(sqrt(2/pi)(x+0.044715x^3)) form). Custom-VJP saves
+    only the activation input."""
+    from ..memory_efficient import gelu, gelu_exact
     if bias is not None:
         x = x + bias
-    return jax.nn.gelu(x, approximate=approximate)
+    return gelu(x) if approximate else gelu_exact(x)
 
 
 def bias_relu(x, bias=None):

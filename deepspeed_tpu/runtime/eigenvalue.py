@@ -4,8 +4,10 @@ Capability match for the reference Eigenvalue module (runtime/
 eigenvalue.py, 149 LoC; consumed by MoQ at engine.py:1995-2008): per-block
 curvature estimates drive quantization precision switching. The reference
 power-iterates with autograd retain_graph loops; in JAX the
-Hessian-vector product is a first-class transform (jvp of grad), so the
-whole estimator is a jittable scan."""
+Hessian-vector product is a first-class transform. HVP here is
+reverse-over-reverse (grad of <grad,v>) rather than jvp-of-grad: the model
+losses route through custom_vjp ops (ops/memory_efficient.py, pallas flash
+attention) which support reverse mode only."""
 
 from functools import partial
 from typing import Callable, Dict, Optional
@@ -35,13 +37,20 @@ class Eigenvalue:
     def compute_eigenvalue(self, loss_fn: Callable, params,
                            rng: Optional[jax.Array] = None) -> float:
         """Top Hessian eigenvalue of loss_fn at params (power iteration
-        with HVP = jvp(grad))."""
+        with HVP = grad of <grad, v> — reverse-over-reverse, which works
+        through custom_vjp ops)."""
         if rng is None:
             rng = jax.random.PRNGKey(0)
         grad_fn = jax.grad(loss_fn)
 
         def hvp(v):
-            return jax.jvp(grad_fn, (params,), (v,))[1]
+            def gdotv(p):
+                g = grad_fn(p)
+                return sum(jnp.sum(a.astype(jnp.float32) *
+                                   b.astype(jnp.float32))
+                           for a, b in zip(jax.tree.leaves(g),
+                                           jax.tree.leaves(v)))
+            return jax.grad(gdotv)(params)
 
         leaves, treedef = jax.tree.flatten(params)
         keys = jax.random.split(rng, len(leaves))
