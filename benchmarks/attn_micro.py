@@ -18,47 +18,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from _timing import timed, timed_grad
+
 B, H, T, D = 8, 12, 1024, 64
 ITERS = 50
 
 
-def timed(fn, *args):
-    """scan fn ITERS times inside one jit; returns ms per iteration."""
-
-    @jax.jit
-    def run(args):
-        def body(c, _):
-            out = fn(*[(a + c).astype(a.dtype) for a in args])
-            return jnp.sum(out.astype(jnp.float32)) * 1e-9, None
-        c, _ = lax.scan(body, jnp.float32(0), None, length=ITERS)
-        return c
-
-    r = run(args)
-    float(r)
-    t0 = time.perf_counter()
-    r = run(args)
-    float(r)
-    dt = time.perf_counter() - t0
-    return dt / ITERS * 1e3
 
 
-def timed_grad(fn, *args):
-    @jax.jit
-    def run(args):
-        def body(c, _):
-            shifted = [(a + c).astype(a.dtype) for a in args]
-            g = jax.grad(lambda *xs: jnp.sum(fn(*xs).astype(jnp.float32)))(
-                *shifted)
-            return jnp.sum(g.astype(jnp.float32)) * 1e-9, None
-        c, _ = lax.scan(body, jnp.float32(0), None, length=ITERS)
-        return c
-
-    r = run(args)
-    float(r)
-    t0 = time.perf_counter()
-    r = run(args)
-    float(r)
-    return (time.perf_counter() - t0) / ITERS * 1e3
 
 
 def main():

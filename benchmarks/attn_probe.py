@@ -12,6 +12,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from _timing import timed
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -22,17 +24,6 @@ _BNT = (((2,), (2,)), ((0,), (0,)))
 _BNN = (((2,), (1,)), ((0,), (0,)))
 
 
-def timed(fn, *args):
-    @jax.jit
-    def run(args):
-        def body(c, _):
-            out = fn(*[a + c for a in args])
-            return jnp.sum(out.astype(jnp.float32)) * 1e-9, None
-        c, _ = lax.scan(body, jnp.float32(0), None, length=ITERS)
-        return c
-    r = run(args); float(r)
-    t0 = time.perf_counter(); r = run(args); float(r)
-    return (time.perf_counter() - t0) / ITERS * 1e3
 
 
 def make(variant, gh=GH, bq=BQ, bk=BK):

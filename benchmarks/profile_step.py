@@ -38,7 +38,8 @@ def run(trace_dir):
 
     cfg = dataclasses.replace(
         GPT2_125M, n_positions=seq, remat=bool(remat_policy),
-        remat_policy=remat_policy, attn_backend="auto",
+        remat_policy=remat_policy,
+        attn_backend=os.environ.get("BENCH_ATTN", "auto"),
         loss_chunking=loss_chunking)
     model = GPT2Model(cfg)
     engine, _, _, _ = deepspeed_tpu.initialize(

@@ -48,8 +48,11 @@ _BTN = (((1,), (1,)), ((0,), (0,)))
 # Pallas double-buffers grid-windowed inputs, and Mosaic needs stack room
 # for fp32 temporaries — budget well under the 16M scoped-vmem limit (the
 # train-step context proved tighter than a standalone call: GH=4 at
-# T=1024/D=64 compiled alone but blew scoped vmem inside the fused step)
-_VMEM_BUDGET = 3 * 1024 * 1024
+# T=1024/D=64 compiled alone but blew scoped vmem inside the fused step).
+# Env override for experiments: DSTPU_FLASH_VMEM_BUDGET (bytes).
+import os as _os
+_VMEM_BUDGET = int(_os.environ.get("DSTPU_FLASH_VMEM_BUDGET",
+                                   3 * 1024 * 1024))
 
 
 def _mask(s, q_off, k_off, gh, block_q, block_k, window):
@@ -66,9 +69,12 @@ def _mask(s, q_off, k_off, gh, block_q, block_k, window):
 
 def _pick_blocks(t: int):
     """Largest preferred block sizes that divide t (t % 128 == 0 is already
-    guaranteed by supported()/_resolve, so 128 always works)."""
+    guaranteed by supported()/_resolve, so 128 always works). Env override
+    for experiments: DSTPU_FLASH_BQ / DSTPU_FLASH_BK."""
     bq = next(b for b in (512, 256, 128) if t % b == 0)
     bk = next(b for b in (256, 128) if t % b == 0)
+    bq = int(_os.environ.get("DSTPU_FLASH_BQ", bq))
+    bk = int(_os.environ.get("DSTPU_FLASH_BK", bk))
     return min(t, bq), min(t, bk)
 
 
@@ -172,7 +178,8 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret, window=None):
         out, lse = _fwd_streamed(qf, kf, vf, causal, scale, block_q, block_k,
                                  interpret, window, gh)
         return out.reshape(b, h, t, d), lse.reshape(b, h, t, 1)
-    gh = _pick_gh(bh, t, d, block_q, block_k)
+    gh = int(_os.environ.get("DSTPU_FLASH_GH_FWD", 0)) or \
+        _pick_gh(bh, t, d, block_q, block_k)
     grid = (bh // gh, t // block_q)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k, t_k=t, gh=gh,
@@ -206,6 +213,112 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret, window=None):
 
 
 # -------------------------------------------------------------------- backward
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dq_acc_ref, *,
+                      causal, scale, block_q, block_k, t_q, gh, window):
+    """One-pass backward: grid over k blocks (sequential), inner loop over
+    q blocks. Computes s/p ONCE per (q, k) block pair and derives dv, dk
+    (local accumulators) AND dq (f32 scratch [GH, T, D] persisting across
+    the k-block grid dim — initialized at j==0, flushed at j==last).
+    Versus the classic two-kernel (dq + dkv) split this saves two of seven
+    dots, one of two exp sweeps, and a full re-fetch of q/do/lse/delta."""
+    j = pl.program_id(1)
+    nk = t_q // block_k
+    k_off = j * block_k
+    k_blk = k_ref[...]                           # [GH, BK, D]
+    v_blk = v_ref[...]
+
+    @pl.when(j == 0)
+    def init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    nq = t_q // block_q
+    start = k_off // block_q if causal else 0
+    if causal and window is not None:
+        nq = jnp.minimum(nq, pl.cdiv(k_off + block_k + window - 1, block_q))
+
+    def body(i, carry):
+        dk, dv = carry
+        q_i = q_ref[:, pl.ds(i * block_q, block_q), :]
+        do_i = do_ref[:, pl.ds(i * block_q, block_q), :]
+        lse_i = lse_ref[:, pl.ds(i * block_q, block_q), :]
+        delta_i = delta_ref[:, pl.ds(i * block_q, block_q), :]
+        s = lax.dot_general(q_i, k_blk, _BNT,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _mask(s, i * block_q, k_off, gh, block_q, block_k, window)
+        p = jnp.exp(s - lse_i)                   # [GH, BQ, BK]
+        dv_new = dv + lax.dot_general(
+            p.astype(do_i.dtype), do_i, _BTN,
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do_i, v_blk, _BNT,
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_i) * scale          # [GH, BQ, BK]
+        ds_lp = ds.astype(q_i.dtype)
+        dk_new = dk + lax.dot_general(
+            ds_lp, q_i, _BTN, preferred_element_type=jnp.float32)
+        dq_acc_ref[:, pl.ds(i * block_q, block_q), :] += lax.dot_general(
+            ds_lp, k_blk, _BNN, preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    d = k_blk.shape[-1]
+    dk0 = jnp.zeros((gh, block_k, d), jnp.float32)
+    dv0 = jnp.zeros((gh, block_k, d), jnp.float32)
+    dk, dv = lax.fori_loop(start, nq, body, (dk0, dv0))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+    @pl.when(j == nk - 1)
+    def flush():
+        dq_ref[...] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _pick_gh_fused_bwd(bh: int, t: int, d: int, bq: int, bk: int) -> int:
+    """Head fold for the fused backward: q/do resident [GH,T,D] plus the
+    f32 dq scratch dominate. Budget is 2x the fwd budget — calibrated on
+    the real chip: gh=2 at (bh96, t1024, d64, bq512, bk256) compiles
+    inside the fused train step (estimate 5.2M), gh=4 blows the 16M
+    scoped-vmem limit by 1.8M (estimate 12.6M)."""
+    for gh in (8, 4, 2, 1):
+        if bh % gh:
+            continue
+        resident = 2 * gh * t * d * 2 * 2        # q, do (double-buffered)
+        dq_bytes = gh * t * d * (4 + 2)          # f32 scratch + bf16 out
+        kv_bytes = 2 * gh * bk * d * 2 * 2
+        tmp = gh * bq * bk * (4 + 2 + 4 + 2)     # s, p, dp/ds, ds_lp
+        if resident + dq_bytes + kv_bytes + tmp <= 2 * _VMEM_BUDGET:
+            return gh
+    return 1
+
+
+def _bwd_fused(qf, kf, vf, dof, lsef, deltaf, causal, scale, block_q,
+               block_k, interpret, window, gh):
+    bh, t, d = qf.shape
+    flops = 4 * bh * t * t * d // (2 if causal else 1)
+    q_full = pl.BlockSpec((gh, t, d), lambda n, j: (n, 0, 0))
+    kv_blk = pl.BlockSpec((gh, block_k, d), lambda n, j: (n, j, 0))
+    vec_full = pl.BlockSpec((gh, t, 1), lambda n, j: (n, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k, t_q=t, gh=gh,
+                          window=window),
+        grid=(bh // gh, t // block_k),
+        in_specs=[q_full, kv_blk, kv_blk, q_full, vec_full, vec_full],
+        out_specs=[q_full, kv_blk, kv_blk],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), kf.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), vf.dtype)],
+        scratch_shapes=[pltpu.VMEM((gh, t, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=int(flops * 2.5),
+            bytes_accessed=7 * bh * t * d * qf.dtype.itemsize,
+            transcendentals=bh * t * t // (2 if causal else 1)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                    causal, scale, block_q, block_k, t_k, gh, window):
@@ -292,6 +405,14 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret,
         dq, dk, dv = _bwd_streamed(qf, kf, vf, dof, lsef, deltaf, causal,
                                    scale, block_q, block_k, interpret,
                                    window, gh)
+        return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
+                dv.reshape(b, h, t, d))
+    gh_fused = int(_os.environ.get("DSTPU_FLASH_GH_BWD", 0)) or \
+        _pick_gh_fused_bwd(bh, t, d, block_q, block_k)
+    if _os.environ.get("DSTPU_FLASH_BWD", "fused") == "fused":
+        dq, dk, dv = _bwd_fused(qf, kf, vf, dof, lsef, deltaf, causal,
+                                scale, block_q, block_k, interpret, window,
+                                gh_fused)
         return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
                 dv.reshape(b, h, t, d))
     gh = _pick_gh(bh, t, d, block_q, block_k)
