@@ -1,6 +1,6 @@
 """Microbench flash-attention variants on the real chip.
 
-Times are amortized over a lax.scan of ITERS inside one jit (the axon
+Times are amortized over a lax.scan inside one jit (the axon
 tunnel costs ~90ms per call) and all outputs are consumed into the carry
 so XLA cannot DCE or hoist anything.
 """
@@ -8,7 +8,6 @@ so XLA cannot DCE or hoist anything.
 import functools
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -21,11 +20,6 @@ from jax import lax
 from _timing import timed, timed_grad
 
 B, H, T, D = 8, 12, 1024, 64
-ITERS = 50
-
-
-
-
 
 
 def main():

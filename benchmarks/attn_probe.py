@@ -3,7 +3,6 @@
 import functools
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -18,12 +17,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 B, H, T, D = 8, 12, 1024, 64
-ITERS = 50
 BQ, BK, GH = 512, 256, 2
 _BNT = (((2,), (2,)), ((0,), (0,)))
 _BNN = (((2,), (1,)), ((0,), (0,)))
-
-
 
 
 def make(variant, gh=GH, bq=BQ, bk=BK):

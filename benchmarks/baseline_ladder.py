@@ -1,0 +1,174 @@
+"""BASELINE.md ladder configs 2 and 3: measured MFU + loss JSONs.
+
+  2. GPT-2 350M, ZeRO-1 + fused Adam, bf16      -> benchmarks/gpt2_350m.json
+  3. GPT-2 1.3B, ZeRO-2 + CPU offload, bf16     -> benchmarks/gpt2_1p3b.json
+     (fp32 masters + Adam moments are ~15.7 GB — over the 15.75 GB HBM of
+      one chip net of params/grads/activations, so device-resident
+      optimizer state cannot hold; ZeRO-Offload runs the C++ SIMD Adam on
+      host. In THIS dev rig the host link is an axon tunnel measured at
+      ~0.03 GB/s, so the per-step optimizer exchange dominates wall time;
+      the JSON reports both the end-to-end MFU and the device-compute MFU
+      (micro steps only), the latter being what scales on real hardware
+      where PCIe/DMA moves 10-50 GB/s.)
+
+Run on the real chip:
+  python benchmarks/baseline_ladder.py 350m
+  python benchmarks/baseline_ladder.py 1p3b
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PEAK = 197e12  # v5e bf16
+
+
+def run_350m():
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Model, GPT2_350M
+
+    seq = int(os.environ.get("BENCH_SEQ", 1024))
+    micro = int(os.environ.get("BENCH_BS", 8))
+    gas = int(os.environ.get("BENCH_GAS", 32))
+    steps = int(os.environ.get("BENCH_STEPS", 4))
+    windows = int(os.environ.get("BENCH_WINDOWS", 2))
+
+    cfg = dataclasses.replace(GPT2_350M, n_positions=seq, remat=False,
+                              attn_backend="auto")
+    model = GPT2Model(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 0,
+    })
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return {"input_ids": rng.integers(0, 50256, (gas, micro, seq),
+                                          dtype=np.int32)}
+
+    for _ in range(3):
+        loss = engine.train_batch(batch=batch())
+    float(loss)
+
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(batch=batch())
+        float(loss)
+        best = min(best, time.perf_counter() - t0)
+    tok_s = steps * gas * micro * seq / best
+    fpt = model.flops_per_token(seq)
+    report = {
+        "benchmark": "gpt2_350m_zero1_bf16_train",
+        "model": "gpt2-350M", "zero_stage": 1,
+        "seq": seq, "micro_bs": micro, "gas": gas, "steps": steps,
+        "tokens_per_sec": round(tok_s, 1),
+        "achieved_tflops": round(tok_s * fpt / 1e12, 2),
+        "mfu": round(tok_s * fpt / PEAK, 4),
+        "final_loss": round(float(loss), 4),
+    }
+    _write("gpt2_350m.json", report)
+
+
+def run_1p3b():
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Model, GPT2_1_3B
+
+    seq = int(os.environ.get("BENCH_SEQ", 1024))
+    micro = int(os.environ.get("BENCH_BS", 4))
+    gas = int(os.environ.get("BENCH_GAS", 64))
+    steps = int(os.environ.get("BENCH_STEPS", 2))
+
+    cfg = dataclasses.replace(
+        GPT2_1_3B, n_positions=seq, remat=True,
+        remat_policy="dots_with_no_batch_dims_saveable")
+    model = GPT2Model(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2,
+                              "offload_optimizer": {"device": "cpu"}},
+        "steps_per_print": 0,
+    })
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return {"input_ids": rng.integers(0, 50256, (gas, micro, seq),
+                                          dtype=np.int32)}
+
+    # compile + one full step (engine pulls/pushes params through the host)
+    loss = engine.train_batch(batch=batch())
+    print(f"compile step done, loss {float(loss):.4f}", flush=True)
+
+    # device-compute phase alone (the part that scales on real hardware):
+    # the fused grad step over gas micros, no optimizer exchange
+    b = engine._to_device_batch(batch())
+    rng_key = jax.random.fold_in(engine._base_rng, 999)
+    with engine.mesh:
+        l, gsum = engine._grad_step_fn(engine.params, engine.scaler_state,
+                                       b, rng_key)
+    float(l)
+    t0 = time.perf_counter()
+    with engine.mesh:
+        l, gsum = engine._grad_step_fn(engine.params, engine.scaler_state,
+                                       b, rng_key)
+    float(l)
+    del gsum
+    dt_compute = time.perf_counter() - t0
+
+    losses = []
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        losses.append(float(engine.train_batch(batch=batch())))
+        print(f"e2e step: loss {losses[-1]:.4f} "
+              f"({time.perf_counter() - t0:.0f}s)", flush=True)
+    dt_e2e = (time.perf_counter() - t0) / steps
+
+    tokens = gas * micro * seq
+    fpt = model.flops_per_token(seq)
+    report = {
+        "benchmark": "gpt2_1p3b_zero2_offload_bf16_train",
+        "model": "gpt2-1.3B", "zero_stage": 2,
+        "offload_optimizer": "cpu",
+        "seq": seq, "micro_bs": micro, "gas": gas, "steps": steps,
+        "tokens_per_sec": round(tokens / dt_e2e, 1),
+        "achieved_tflops": round(tokens / dt_e2e * fpt / 1e12, 2),
+        "mfu": round(tokens / dt_e2e * fpt / PEAK, 4),
+        "device_compute_tokens_per_sec": round(tokens / dt_compute, 1),
+        "device_compute_mfu": round(tokens / dt_compute * fpt / PEAK, 4),
+        "final_loss": round(losses[-1], 4),
+        "note": ("end-to-end wall time is dominated by this dev rig's "
+                 "axon-tunnel host link (~0.03 GB/s measured) carrying the "
+                 "per-global-step grad download + param upload; "
+                 "device_compute_mfu times the fused gas-scan grad step "
+                 "alone, which is what the optimizer exchange overlaps "
+                 "against on real PCIe/DMA hosts (10-50 GB/s)."),
+    }
+    _write("gpt2_1p3b.json", report)
+
+
+def _write(name, report):
+    out = os.path.join(REPO, "benchmarks", name)
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "350m"
+    {"350m": run_350m, "1p3b": run_1p3b}[which]()

@@ -1,27 +1,37 @@
-"""Megatron-LM GPT checkpoint loader — offline TP-merge into a
+"""Megatron-LM GPT checkpoint loader — offline TP×PP merge into a
 deepspeed_tpu model.
 
-Capability match for the reference's Megatron handling: the
-state-dict factory merges/splits mp-sharded inference checkpoints
-(reference runtime/state_dict_factory.py:427 SDLoaderFactory — qkv merge
-quirks per version) and the megatron injection containers map the names
-(module_inject/containers/megatron_gpt.py). Here one loader walks the
-``mp_rank_XX`` shards of a classic Megatron-LM GPT checkpoint, merges the
-tensor-parallel partitions (column-parallel on dim 0, row-parallel on
-dim 1, vocab-parallel embeddings on dim 0), de-interleaves the per-head
-[q|k|v] fused qkv into this repo's head-major q|k|v convention, and emits
-``(GPT2Model, params)`` ready for `initialize()` or `InferenceEngine`.
+Capability match for the reference's Megatron handling: the state-dict
+factory merges/splits mp-sharded inference checkpoints (reference
+runtime/state_dict_factory.py:220 merge_query_key_value — qkv layout
+differs per ``checkpoint_version``) and the offline reshaper reads
+tp×pp-sharded Megatron-DeepSpeed checkpoints (reference
+checkpoint/deepspeed_checkpoint.py:33, reshape_meg_2d.py). Here one
+loader walks the ``mp_rank_XX`` (tp-only) or ``mp_rank_XX_YYY`` (tp×pp)
+shards of a Megatron-LM GPT checkpoint, merges the tensor-parallel
+partitions (column-parallel on dim 0, row-parallel on dim 1,
+vocab-parallel embeddings on dim 0), remaps each pipeline stage's LOCAL
+layer numbering onto the global stack, converts the fused qkv rows of
+whichever ``checkpoint_version`` the shard declares (0, 1.0 or 2.0) into
+this repo's head-major q|k|v convention, and emits ``(GPT2Model, params)``
+ready for `initialize()` or `InferenceEngine`.
+
+QKV row layouts by version (reference state_dict_factory.py:222-236;
+h = hidden, n = heads, p = tp degree, np = n/p, hn = h/n):
+  v0   : [(3·np·hn), h] per shard — [Q|K|V] component-major; tp-merge must
+         split each shard into thirds and concat per component
+  v1.0 : [(np·hn·3), h] — element-interleaved per head (hn, 3)
+  v2.0 : [(np·3·hn), h] — per-head [q|k|v] blocks (the classic layout)
 
 Once loaded, the params are ordinary global arrays — the universal
 reshard-on-load checkpointing (runtime/checkpointing.py) takes over for
-any further mp/dp layout changes, replacing the reference's offline
-reshape tools (checkpoint/deepspeed_checkpoint.py, reshape_meg_2d.py).
+any further mp/dp layout changes.
 """
 
 import glob
 import os
 import re
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -29,14 +39,26 @@ from ..module_inject.policy import (deinterleave_qkv_bias,
                                     deinterleave_qkv_rows)
 
 
-_COLUMN_PARALLEL = (r"attention\.query_key_value\.(weight|bias)",
-                    r"mlp\.dense_h_to_4h\.(weight|bias)")
+_QKV = r"attention\.query_key_value\.(weight|bias)"
+_COLUMN_PARALLEL = (_QKV, r"mlp\.dense_h_to_4h\.(weight|bias)")
 _ROW_PARALLEL = (r"attention\.dense\.weight",
                  r"mlp\.dense_4h_to_h\.weight")
 
 
-def _merge(key: str, shards):
+def _merge_qkv_v0(shards: List[np.ndarray]) -> np.ndarray:
+    """v0: each shard is [Q|K|V] component-major — split thirds, concat per
+    component across shards (reference merge_query_key_value ckpt_ver 0)."""
+    assert shards[0].shape[0] % 3 == 0
+    thirds = [np.split(s, 3, axis=0) for s in shards]
+    return np.concatenate(
+        [np.concatenate([t[i] for t in thirds], axis=0) for i in range(3)],
+        axis=0)
+
+
+def _merge(key: str, shards, ckpt_ver):
     """Merge one transformer-layer tensor across TP shards."""
+    if re.search(_QKV, key) and ckpt_ver == 0:
+        return _merge_qkv_v0(shards) if len(shards) > 1 else shards[0]
     if len(shards) == 1:
         return shards[0]
     if any(re.search(p, key) for p in _COLUMN_PARALLEL):
@@ -44,6 +66,31 @@ def _merge(key: str, shards):
     if any(re.search(p, key) for p in _ROW_PARALLEL):
         return np.concatenate(shards, axis=1)
     return shards[0]            # replicated (layernorms, row-parallel bias)
+
+
+def _qkv_to_ours(w: np.ndarray, b: np.ndarray, ckpt_ver, n_head: int,
+                 hd: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Merged fused-qkv rows (+bias) of the given checkpoint_version →
+    ([D, 3D] weight, [3D] bias) in this repo's head-major q|k|v columns."""
+    if ckpt_ver == 0:
+        # already [Q|K|V] component-major, head-major within each
+        return w.T, b
+    if ckpt_ver == 1.0:
+        # per head (hn, 3) element-interleave → (3, n, hn)
+        d = w.shape[1]
+        wr = w.reshape(n_head, hd, 3, d)
+        wq = np.concatenate([wr[:, :, i].reshape(n_head * hd, d)
+                             for i in range(3)], axis=0)
+        br = b.reshape(n_head, hd, 3)
+        bq = np.concatenate([br[:, :, i].reshape(n_head * hd)
+                             for i in range(3)])
+        return wq.T, bq
+    if ckpt_ver == 2.0:
+        return (deinterleave_qkv_rows(w, n_head, hd),
+                deinterleave_qkv_bias(b, n_head, hd))
+    raise ValueError(
+        f"unsupported Megatron checkpoint_version {ckpt_ver!r} "
+        f"(known: 0, 1.0, 2.0 — reference state_dict_factory.py:220)")
 
 
 def _np(t):
@@ -54,6 +101,8 @@ def _np(t):
 
 
 def _shard_paths(ckpt_dir: str, tag: Optional[str]):
+    """-> list of (tp_rank, pp_rank, path), pp_rank -1 for tp-only
+    layouts."""
     if tag is None:
         latest = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
         if os.path.exists(latest):
@@ -61,30 +110,60 @@ def _shard_paths(ckpt_dir: str, tag: Optional[str]):
                 it = f.read().strip()
             tag = "release" if it == "release" else f"iter_{int(it):07d}"
     root = os.path.join(ckpt_dir, tag) if tag else ckpt_dir
-    pp_dirs = glob.glob(os.path.join(root, "mp_rank_*_*"))
-    if pp_dirs:
-        raise NotImplementedError(
-            f"pipeline-parallel Megatron checkpoints (mp_rank_XX_YYY "
-            f"layout) are not supported; found {sorted(pp_dirs)[:3]}")
-    # model_optim_rng.pt specifically — a bare *.pt glob would also pick
-    # up distrib_optim.pt and double-count the TP degree
-    paths = sorted(glob.glob(os.path.join(root, "mp_rank_*",
-                                          "model_optim_rng.pt")))
-    if not paths:
-        # fallback: exactly ONE .pt per mp_rank dir, else ambiguous
-        by_dir = {}
-        for p in sorted(glob.glob(os.path.join(root, "mp_rank_*", "*.pt"))):
-            by_dir.setdefault(os.path.dirname(p), []).append(p)
-        for d, ps in by_dir.items():
-            if len(ps) > 1:
-                raise ValueError(
-                    f"ambiguous Megatron shard dir {d!r}: no "
-                    f"model_optim_rng.pt and multiple .pt candidates {ps}")
-        paths = sorted(ps[0] for ps in by_dir.values())
-    if not paths:
+
+    def pick(d):
+        """One .pt per shard dir: model_optim_rng.pt or an unambiguous
+        single candidate (a bare glob would double-count
+        distrib_optim.pt)."""
+        p = os.path.join(d, "model_optim_rng.pt")
+        if os.path.exists(p):
+            return p
+        cands = sorted(glob.glob(os.path.join(d, "*.pt")))
+        if len(cands) != 1:
+            raise ValueError(
+                f"ambiguous Megatron shard dir {d!r}: no "
+                f"model_optim_rng.pt and candidates {cands}")
+        return cands[0]
+
+    out = []
+    for d in sorted(glob.glob(os.path.join(root, "mp_rank_*"))):
+        m = re.match(r"mp_rank_(\d+)_(\d+)$", os.path.basename(d))
+        if m:
+            out.append((int(m.group(1)), int(m.group(2)), pick(d)))
+            continue
+        m = re.match(r"mp_rank_(\d+)$", os.path.basename(d))
+        if m:
+            out.append((int(m.group(1)), -1, pick(d)))
+    if not out:
         raise FileNotFoundError(
             f"no Megatron mp_rank_* shards under {root!r}")
-    return paths
+    pp_modes = {pp == -1 for _, pp, _ in out}
+    if len(pp_modes) > 1:
+        raise ValueError(
+            f"mixed mp_rank_XX and mp_rank_XX_YYY dirs under {root!r}")
+    return sorted(out)
+
+
+def _read_shard(path) -> Tuple[Dict[str, np.ndarray], Any, Any]:
+    import torch
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    lm = ckpt["model"]["language_model"]
+    flat = {}
+    emb = lm.get("embedding") or {}
+    if "word_embeddings" in emb:
+        flat["wte"] = _np(emb["word_embeddings"]["weight"])
+    if "position_embeddings" in emb:
+        flat["wpe"] = _np(emb["position_embeddings"]["weight"])
+    enc = lm.get("transformer", lm.get("encoder"))
+    if enc is None:
+        raise KeyError(
+            "checkpoint has neither 'transformer' nor 'encoder' under "
+            "language_model — not a Megatron-LM GPT checkpoint")
+    for k, v in enc.items():
+        # newer Megatron renamed attention -> self_attention; normalize
+        # to the classic names the mapping below uses
+        flat[k.replace(".self_attention.", ".attention.")] = _np(v)
+    return flat, ckpt.get("args"), ckpt.get("checkpoint_version", 0)
 
 
 def load_megatron_checkpoint(ckpt_dir: str, tag: Optional[str] = None,
@@ -92,47 +171,71 @@ def load_megatron_checkpoint(ckpt_dir: str, tag: Optional[str] = None,
                              ) -> Tuple[Any, Any]:
     """Load a Megatron-LM GPT checkpoint directory → (GPT2Model, params).
 
+    Handles tp-only (``mp_rank_XX``) and tp×pp (``mp_rank_XX_YYY``)
+    layouts; pipeline stages' local ``layers.N`` indices are offset onto
+    the global stack in pp order (reference
+    checkpoint/deepspeed_checkpoint.py:33 + reshape_meg_2d.py).
     ``n_head`` may be omitted when the checkpoint stores its training args
     (Megatron saves them under ``checkpoint['args']``)."""
-    import torch
     import jax.numpy as jnp
     from ..models.gpt2 import GPT2Config, GPT2Model
 
-    shards = []
+    triples = _shard_paths(ckpt_dir, tag)
+    pp_ranks = sorted({pp for _, pp, _ in triples})
     args = None
-    for path in _shard_paths(ckpt_dir, tag):
-        ckpt = torch.load(path, map_location="cpu", weights_only=False)
-        args = args or ckpt.get("args")
-        lm = ckpt["model"]["language_model"]
-        flat = {}
-        flat["wte"] = _np(lm["embedding"]["word_embeddings"]["weight"])
-        flat["wpe"] = _np(lm["embedding"]["position_embeddings"]["weight"])
-        enc = lm.get("transformer", lm.get("encoder"))
-        if enc is None:
-            raise KeyError(
-                "checkpoint has neither 'transformer' nor 'encoder' under "
-                "language_model — not a Megatron-LM GPT checkpoint")
-        for k, v in enc.items():
-            # newer Megatron renamed attention -> self_attention; normalize
-            # to the classic names the mapping below uses
-            flat[k.replace(".self_attention.", ".attention.")] = _np(v)
-        shards.append(flat)
+    ckpt_ver = None
 
-    tp = len(shards)
+    # per pp stage: merge tp shards, then remap local layer ids
+    merged: Dict[str, np.ndarray] = {}
+    layer_offset = 0
+    for pp in pp_ranks:
+        shards = []
+        for tp, pp_r, path in triples:
+            if pp_r != pp:
+                continue
+            flat, a, ver = _read_shard(path)
+            args = args or a
+            if ckpt_ver is None:
+                ckpt_ver = ver
+            elif ver != ckpt_ver:
+                raise ValueError(
+                    f"inconsistent checkpoint_version across shards: "
+                    f"{ckpt_ver} vs {ver} ({path})")
+            shards.append(flat)
+        stage: Dict[str, np.ndarray] = {}
+        keys = set().union(*[set(s) for s in shards])
+        for k in keys:
+            have = [s[k] for s in shards if k in s]
+            if k == "wte":
+                stage[k] = np.concatenate(have, axis=0)
+            elif k == "wpe":
+                stage[k] = have[0]
+            else:
+                stage[k] = _merge(k, have, ckpt_ver)
+        # remap this stage's local layer numbering onto the global stack
+        local_ids = sorted({int(m.group(1)) for k in stage
+                            if (m := re.match(r"layers\.(\d+)\.", k))})
+        remap = {i: layer_offset + j for j, i in enumerate(local_ids)}
+        for k, v in stage.items():
+            m = re.match(r"layers\.(\d+)\.(.*)", k)
+            if m:
+                merged[f"layers.{remap[int(m.group(1))]}.{m.group(2)}"] = v
+            elif k in merged and pp != pp_ranks[0]:
+                # embeddings live on the first stage; later stages may
+                # carry tied copies (word_embeddings_for_head) — first wins
+                continue
+            else:
+                merged[k] = v
+        layer_offset += len(local_ids)
+
+    if "wte" not in merged:
+        raise KeyError("no word_embeddings found on the first pipeline "
+                       "stage — not a GPT checkpoint?")
     if n_head is None:
         if args is None or not hasattr(args, "num_attention_heads"):
             raise ValueError(
                 "checkpoint stores no args; pass n_head= explicitly")
         n_head = int(args.num_attention_heads)
-
-    merged = {}
-    for k in shards[0]:
-        if k == "wte":
-            merged[k] = np.concatenate([s[k] for s in shards], axis=0)
-        elif k == "wpe":
-            merged[k] = shards[0][k]
-        else:
-            merged[k] = _merge(k, [s[k] for s in shards])
 
     layer_ids = sorted({int(m.group(1)) for k in merged
                         if (m := re.match(r"layers\.(\d+)\.", k))})
@@ -150,22 +253,17 @@ def load_megatron_checkpoint(ckpt_dir: str, tag: Optional[str] = None,
     def layer(i, name):
         return merged[f"layers.{i}.{name}"]
 
-    def qkv_w(i):
-        # Megatron fuses per-head [q|k|v]: shared de-interleave helper
-        return deinterleave_qkv_rows(
-            layer(i, "attention.query_key_value.weight"), n_head, hd)
-
-    def qkv_b(i):
-        return deinterleave_qkv_bias(
-            layer(i, "attention.query_key_value.bias"), n_head, hd)
+    qkv = [_qkv_to_ours(layer(i, "attention.query_key_value.weight"),
+                        layer(i, "attention.query_key_value.bias"),
+                        ckpt_ver, n_head, hd) for i in layer_ids]
 
     blocks = {
         "ln1_scale": np.stack([layer(i, "input_layernorm.weight")
                                for i in layer_ids]),
         "ln1_bias": np.stack([layer(i, "input_layernorm.bias")
                               for i in layer_ids]),
-        "qkv_w": np.stack([qkv_w(i) for i in layer_ids]),
-        "qkv_b": np.stack([qkv_b(i) for i in layer_ids]),
+        "qkv_w": np.stack([w for w, _ in qkv]),
+        "qkv_b": np.stack([b for _, b in qkv]),
         "attn_proj_w": np.stack([layer(i, "attention.dense.weight").T
                                  for i in layer_ids]),
         "attn_proj_b": np.stack([layer(i, "attention.dense.bias")

@@ -160,7 +160,8 @@ class InferenceEngine:
                  temperature: float = 0.0, top_k: int = 0,
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  max_length: Optional[int] = None, top_p: float = 1.0,
-                 num_beams: int = 1, attention_mask=None):
+                 num_beams: int = 1, attention_mask=None,
+                 length_penalty: float = 1.0):
         """Autoregressive generation, one compiled program per
         (prompt_shape, max_new_tokens) bucket. Returns [B, T+max_new_tokens]
         (prompt + generated; positions after EOS hold eos_token_id).
@@ -170,8 +171,17 @@ class InferenceEngine:
         columns never act as keys and logical positions shift per row."""
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if temperature <= 0.0 and (top_k or top_p < 1.0):
+            raise ValueError(
+                "top_k/top_p require temperature > 0 (temperature<=0 means "
+                "greedy decoding, which would silently ignore them); pass "
+                "temperature=1.0 for plain top-k/top-p sampling")
         if num_beams < 1:
             raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+        if num_beams == 1 and length_penalty != 1.0:
+            raise ValueError(
+                "length_penalty only applies to beam search "
+                f"(got length_penalty={length_penalty} with num_beams=1)")
         if num_beams > 1 and (temperature > 0 or top_k or top_p < 1.0):
             raise ValueError(
                 "beam search is deterministic: temperature/top_k/top_p "
@@ -220,11 +230,13 @@ class InferenceEngine:
                 f"(reference inference/engine.py:588 guard); growing cache")
 
         key = ("gen", b, t, max_new_tokens, float(temperature), top_k,
-               float(top_p), eos_token_id, num_beams, pad_counts is not None)
+               float(top_p), eos_token_id, num_beams, pad_counts is not None,
+               float(length_penalty))
         if key not in self._fns:
             if num_beams > 1:
                 self._fns[key] = self._build_beam_generate(
-                    b, t, cache_len, max_new_tokens, num_beams, eos_token_id)
+                    b, t, cache_len, max_new_tokens, num_beams, eos_token_id,
+                    length_penalty)
             else:
                 self._fns[key] = self._build_generate(
                     b, t, cache_len, max_new_tokens, temperature, top_k,
@@ -314,7 +326,7 @@ class InferenceEngine:
             self.param_shardings, self._batch_sharding(b), None, None))
 
     def _build_beam_generate(self, b, t, cache_len, max_new_tokens, k,
-                             eos_token_id):
+                             eos_token_id, length_penalty=1.0):
         """Deterministic beam search, fully in-jit (reference parity:
         inference/engine.py:588 delegates beams to HF generate; here the
         whole search — expand, score, reorder-cache, backtrack-free
@@ -346,11 +358,12 @@ class InferenceEngine:
             tok = (flat % vocab).astype(jnp.int32)          # [B, K]
             finished = (tok == eos_token_id) if eos_token_id is not None \
                 else jnp.zeros((b, k), jnp.bool_)
+            lengths = jnp.ones((b, k), jnp.float32)   # generated incl. EOS
             seqs = jnp.zeros((b, k, max_new_tokens), jnp.int32)
             seqs = seqs.at[:, :, 0].set(tok)
 
             def step(carry, i):
-                cache, seqs, tok, scores, finished = carry
+                cache, seqs, tok, scores, finished, lengths = carry
                 logits, cache = model.apply_with_cache(
                     params, tok.reshape(b * k, 1), cache, t + i - 1)
                 logp = jax.nn.log_softmax(
@@ -371,6 +384,10 @@ class InferenceEngine:
                 seqs = gather(seqs, parent[..., None], axis=1)
                 seqs = seqs.at[:, :, i].set(tok)
                 finished = gather(finished, parent, axis=1)
+                lengths = gather(lengths, parent, axis=1)
+                # unfinished beams grew by one token (incl. a fresh EOS);
+                # already-finished beams' appended EOS is padding
+                lengths = lengths + (~finished).astype(jnp.float32)
                 if eos_token_id is not None:
                     finished = finished | (tok == eos_token_id)
                 flat_parent = (jnp.arange(b)[:, None] * k +
@@ -379,13 +396,18 @@ class InferenceEngine:
                     jax.tree.map(
                         lambda c: jnp.take(c, flat_parent, axis=1), cache),
                     cache_specs)
-                return (cache, seqs, tok, scores, finished), None
+                return (cache, seqs, tok, scores, finished, lengths), None
 
             if max_new_tokens > 1:
-                (cache, seqs, tok, scores, finished), _ = lax.scan(
-                    step, (cache, seqs, tok, scores, finished),
+                (cache, seqs, tok, scores, finished, lengths), _ = lax.scan(
+                    step, (cache, seqs, tok, scores, finished, lengths),
                     jnp.arange(1, max_new_tokens, dtype=jnp.int32))
-            best = jnp.argmax(scores, axis=-1)              # [B]
+            # HF default semantics: pick by score / length**length_penalty
+            # (length_penalty 1.0) so beams that hit EOS early are not
+            # unconditionally favored
+            norm = scores / jnp.power(jnp.maximum(lengths, 1.0),
+                                      jnp.float32(length_penalty))
+            best = jnp.argmax(norm, axis=-1)                # [B]
             out = jnp.take_along_axis(seqs, best[:, None, None],
                                       axis=1)[:, 0]         # [B, max_new]
             if eos_token_id is not None:

@@ -78,14 +78,17 @@ def _pick_blocks(t: int):
     return min(t, bq), min(t, bk)
 
 
-def _pick_gh(bh: int, t: int, d: int, bq: int, bk: int) -> int:
-    """Largest head fold whose resident footprint fits the VMEM budget."""
+def _pick_gh(bh: int, t: int, d: int, bq: int, bk: int,
+             itemsize: int = 2) -> int:
+    """Largest head fold whose resident footprint fits the VMEM budget.
+    ``itemsize`` is the q/k/v element size (2 for bf16, 4 for fp32 —
+    fp32 inputs double the K/V, q/o and p footprints)."""
     for gh in (8, 4, 2, 1):
         if bh % gh:
             continue
-        s_bytes = gh * bq * bk * (4 + 2)          # fp32 s + bf16 p copy
-        kv_bytes = 2 * gh * t * d * 2
-        qo_bytes = gh * bq * d * (2 + 2 + 4)      # q, o, fp32 acc
+        s_bytes = gh * bq * bk * (4 + itemsize)   # fp32 s + p copy
+        kv_bytes = 2 * gh * t * d * itemsize
+        qo_bytes = gh * bq * d * (2 * itemsize + 4)   # q, o, fp32 acc
         if s_bytes + kv_bytes + qo_bytes <= _VMEM_BUDGET:
             return gh
     return 1
@@ -101,13 +104,14 @@ def _streamed(t: int, d: int, itemsize: int) -> bool:
     return t * d * itemsize > _RESIDENT_MAX_KV_BYTES
 
 
-def _pick_gh_streamed(bh: int, d: int, bq: int, bk: int) -> int:
+def _pick_gh_streamed(bh: int, d: int, bq: int, bk: int,
+                      itemsize: int = 2) -> int:
     for gh in (8, 4, 2, 1):
         if bh % gh:
             continue
-        s_bytes = gh * bq * bk * (4 + 2)
-        kv_bytes = 2 * gh * bk * d * 2 * 2        # double-buffered blocks
-        qo_bytes = gh * bq * d * (2 + 2 + 4 * 3)  # q, o, f32 acc+m+l scratch
+        s_bytes = gh * bq * bk * (4 + itemsize)
+        kv_bytes = 2 * gh * bk * d * itemsize * 2  # double-buffered blocks
+        qo_bytes = gh * bq * d * (2 * itemsize + 4 * 3)  # q, o, acc+m+l f32
         if s_bytes + kv_bytes + qo_bytes <= _VMEM_BUDGET:
             return gh
     return 1
@@ -174,12 +178,13 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret, window=None):
     bh = b * h
     qf, kf, vf = (x.reshape(bh, t, d) for x in (q, k, v))
     if _streamed(t, d, q.dtype.itemsize):
-        gh = _pick_gh_streamed(bh, d, block_q, block_k)
+        gh = _pick_gh_streamed(bh, d, block_q, block_k,
+                               q.dtype.itemsize)
         out, lse = _fwd_streamed(qf, kf, vf, causal, scale, block_q, block_k,
                                  interpret, window, gh)
         return out.reshape(b, h, t, d), lse.reshape(b, h, t, 1)
     gh = int(_os.environ.get("DSTPU_FLASH_GH_FWD", 0)) or \
-        _pick_gh(bh, t, d, block_q, block_k)
+        _pick_gh(bh, t, d, block_q, block_k, q.dtype.itemsize)
     grid = (bh // gh, t // block_q)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k, t_k=t, gh=gh,
@@ -274,19 +279,20 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[...] = dq_acc_ref[...].astype(dq_ref.dtype)
 
 
-def _pick_gh_fused_bwd(bh: int, t: int, d: int, bq: int, bk: int) -> int:
+def _pick_gh_fused_bwd(bh: int, t: int, d: int, bq: int, bk: int,
+                       itemsize: int = 2) -> int:
     """Head fold for the fused backward: q/do resident [GH,T,D] plus the
     f32 dq scratch dominate. Budget is 2x the fwd budget — calibrated on
-    the real chip: gh=2 at (bh96, t1024, d64, bq512, bk256) compiles
-    inside the fused train step (estimate 5.2M), gh=4 blows the 16M
-    scoped-vmem limit by 1.8M (estimate 12.6M)."""
+    the real chip: gh=2 at (bh96, t1024, d64, bq512, bk256, bf16)
+    compiles inside the fused train step (estimate 5.2M), gh=4 blows the
+    16M scoped-vmem limit by 1.8M (estimate 12.6M)."""
     for gh in (8, 4, 2, 1):
         if bh % gh:
             continue
-        resident = 2 * gh * t * d * 2 * 2        # q, do (double-buffered)
-        dq_bytes = gh * t * d * (4 + 2)          # f32 scratch + bf16 out
-        kv_bytes = 2 * gh * bk * d * 2 * 2
-        tmp = gh * bq * bk * (4 + 2 + 4 + 2)     # s, p, dp/ds, ds_lp
+        resident = 2 * gh * t * d * itemsize * 2  # q, do (double-buffered)
+        dq_bytes = gh * t * d * (4 + itemsize)    # f32 scratch + lp out
+        kv_bytes = 2 * gh * bk * d * itemsize * 2
+        tmp = gh * bq * bk * (4 + 4 + 2 * itemsize)  # s/p, dp/ds, p_lp+ds_lp
         if resident + dq_bytes + kv_bytes + tmp <= 2 * _VMEM_BUDGET:
             return gh
     return 1
@@ -401,21 +407,23 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret,
     lsef = lse.reshape(bh, t, 1)
     deltaf = delta.reshape(bh, t, 1)
     if _streamed(t, d, q.dtype.itemsize):
-        gh = _pick_gh_streamed(bh, d, block_q, block_k)
+        gh = _pick_gh_streamed(bh, d, block_q, block_k,
+                               q.dtype.itemsize)
         dq, dk, dv = _bwd_streamed(qf, kf, vf, dof, lsef, deltaf, causal,
                                    scale, block_q, block_k, interpret,
                                    window, gh)
         return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
                 dv.reshape(b, h, t, d))
-    gh_fused = int(_os.environ.get("DSTPU_FLASH_GH_BWD", 0)) or \
-        _pick_gh_fused_bwd(bh, t, d, block_q, block_k)
     if _os.environ.get("DSTPU_FLASH_BWD", "fused") == "fused":
+        gh_fused = int(_os.environ.get("DSTPU_FLASH_GH_BWD", 0)) or \
+            _pick_gh_fused_bwd(bh, t, d, block_q, block_k,
+                               q.dtype.itemsize)
         dq, dk, dv = _bwd_fused(qf, kf, vf, dof, lsef, deltaf, causal,
                                 scale, block_q, block_k, interpret, window,
                                 gh_fused)
         return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
                 dv.reshape(b, h, t, d))
-    gh = _pick_gh(bh, t, d, block_q, block_k)
+    gh = _pick_gh(bh, t, d, block_q, block_k, q.dtype.itemsize)
 
     blk_spec = pl.BlockSpec((gh, block_q, d), lambda n, i: (n, i, 0))
     full_spec = pl.BlockSpec((gh, t, d), lambda n, i: (n, 0, 0))

@@ -1,8 +1,10 @@
 """Megatron-LM checkpoint loader: TP-merge axes, per-head qkv
-de-interleave, and end-to-end forward through the loaded model.
+de-interleave across checkpoint_versions, pp-sharded (mp_rank_XX_YYY)
+layer remapping, and end-to-end forward through the loaded model.
 
-Builds a synthetic 2-way-TP Megatron GPT checkpoint (classic
-language_model/transformer naming) and checks tp=2 merge == tp=1 load."""
+Builds synthetic TP×PP Megatron GPT checkpoints (classic
+language_model/transformer naming) and checks every sharding/version
+combination loads to identical params."""
 
 import os
 import types
@@ -13,6 +15,8 @@ import pytest
 torch = pytest.importorskip("torch")
 
 from deepspeed_tpu.checkpoint.megatron import load_megatron_checkpoint
+
+pytestmark = pytest.mark.smoke
 
 V, T, D, L, H = 64, 32, 16, 2, 4
 HD = D // H
@@ -52,38 +56,88 @@ def _full_tensors(rng):
     return full
 
 
-def _write_ckpt(path, full, tp):
+def _qkv_relayout(shard_v2, version, heads_in_shard):
+    """Shard qkv rows from the canonical v2.0 per-head [q|k|v] layout into
+    the requested checkpoint_version's row layout."""
+    w = shard_v2.reshape(heads_in_shard, 3, HD, -1)
+    if version == 2.0:
+        return shard_v2
+    if version == 1.0:          # per head (hn, 3) element interleave
+        return np.transpose(w, (0, 2, 1, 3)).reshape(shard_v2.shape)
+    if version == 0:            # [Q|K|V] component-major within the shard
+        return np.transpose(w, (1, 0, 2, 3)).reshape(shard_v2.shape)
+    return shard_v2             # unknown version: layout irrelevant (the
+    #                             loader must raise before using it)
+
+
+def _write_ckpt(path, full, tp, pp=1, version=2.0):
     os.makedirs(path, exist_ok=True)
-    for r in range(tp):
-        trans = {}
-        for k, v in full.items():
-            if k in ("wte",):
-                shard = np.split(v, tp, axis=0)[r]
-            elif "query_key_value" in k or "dense_h_to_4h" in k:
-                shard = np.split(v, tp, axis=0)[r]
-            elif k.endswith("attention.dense.weight") or \
-                    k.endswith("mlp.dense_4h_to_h.weight"):
-                shard = np.split(v, tp, axis=1)[r]
-            else:
-                shard = v
-            trans[k] = torch.from_numpy(np.ascontiguousarray(shard))
-        state = {
-            "args": types.SimpleNamespace(num_attention_heads=H),
-            "model": {"language_model": {
-                "embedding": {
-                    "word_embeddings": {"weight": trans.pop("wte")},
-                    "position_embeddings": {"weight": trans.pop("wpe")},
-                },
-                "transformer": trans,
-            }},
-        }
-        d = os.path.join(path, f"mp_rank_{r:02d}")
-        os.makedirs(d, exist_ok=True)
-        torch.save(state, os.path.join(d, "model_optim_rng.pt"))
+    per_stage = L // pp
+    for s in range(pp):
+        stage_layers = range(s * per_stage, (s + 1) * per_stage)
+        for r in range(tp):
+            trans = {}
+            for g in stage_layers:
+                for k, v in full.items():
+                    if not k.startswith(f"layers.{g}."):
+                        continue
+                    suffix = k.split(".", 1)[1].split(".", 1)[1]
+                    if "query_key_value" in k:
+                        shard = np.split(v, tp, axis=0)[r]
+                        shard = _qkv_relayout(
+                            shard.reshape(shard.shape[0], -1)
+                            if shard.ndim > 1 else shard[:, None],
+                            version, H // tp).reshape(shard.shape)
+                    elif "dense_h_to_4h" in k:
+                        shard = np.split(v, tp, axis=0)[r]
+                    elif k.endswith("attention.dense.weight") or \
+                            k.endswith("mlp.dense_4h_to_h.weight"):
+                        shard = np.split(v, tp, axis=1)[r]
+                    else:
+                        shard = v
+                    local = g - s * per_stage
+                    trans[f"layers.{local}.{suffix}"] = torch.from_numpy(
+                        np.ascontiguousarray(shard))
+            lm = {"transformer": trans}
+            if s == 0:
+                lm["embedding"] = {
+                    "word_embeddings": {"weight": torch.from_numpy(
+                        np.ascontiguousarray(
+                            np.split(full["wte"], tp, axis=0)[r]))},
+                    "position_embeddings": {"weight": torch.from_numpy(
+                        full["wpe"])},
+                }
+            if s == pp - 1:
+                trans["final_layernorm.weight"] = torch.from_numpy(
+                    full["final_layernorm.weight"])
+                trans["final_layernorm.bias"] = torch.from_numpy(
+                    full["final_layernorm.bias"])
+            state = {
+                "args": types.SimpleNamespace(num_attention_heads=H),
+                "checkpoint_version": version,
+                "model": {"language_model": lm},
+            }
+            d = os.path.join(path, f"mp_rank_{r:02d}_{s:03d}" if pp > 1
+                             else f"mp_rank_{r:02d}")
+            os.makedirs(d, exist_ok=True)
+            torch.save(state, os.path.join(d, "model_optim_rng.pt"))
+
+
+def _flat(params):
+    import jax
+    return {str(k): v
+            for k, v in jax.tree_util.tree_flatten_with_path(params)[0]}
+
+
+def _assert_same(p1, p2):
+    f1, f2 = _flat(p1), _flat(p2)
+    assert f1.keys() == f2.keys()
+    for k in f1:
+        np.testing.assert_allclose(np.asarray(f1[k]), np.asarray(f2[k]),
+                                   atol=0, err_msg=k)
 
 
 def test_tp_merge_matches_single_shard(tmp_path):
-    import jax
     rng = np.random.default_rng(0)
     full = _full_tensors(rng)
     _write_ckpt(str(tmp_path / "tp1"), full, tp=1)
@@ -93,12 +147,7 @@ def test_tp_merge_matches_single_shard(tmp_path):
     spec2, p2 = load_megatron_checkpoint(str(tmp_path / "tp2"))
     assert spec1.config == spec2.config
     assert spec1.config.n_layer == L and spec1.config.n_head == H
-    f1 = {str(k): v for k, v in jax.tree_util.tree_flatten_with_path(p1)[0]}
-    f2 = {str(k): v for k, v in jax.tree_util.tree_flatten_with_path(p2)[0]}
-    assert f1.keys() == f2.keys()
-    for k in f1:
-        np.testing.assert_allclose(np.asarray(f1[k]), np.asarray(f2[k]),
-                                   atol=0, err_msg=k)
+    _assert_same(p1, p2)
 
     # the loaded model runs end-to-end
     import jax.numpy as jnp
@@ -106,6 +155,41 @@ def test_tp_merge_matches_single_shard(tmp_path):
     logits = spec1.logits(p1, jnp.asarray(ids), train=False)
     assert np.isfinite(np.asarray(logits)).all()
     assert logits.shape == (2, 8, V)
+
+
+def test_pp_sharded_matches_tp_only(tmp_path):
+    """tp2 x pp2 (mp_rank_XX_YYY) load == tp1/pp1 load — the round-trip
+    the reference does via deepspeed_checkpoint.py + reshape_meg_2d.py."""
+    rng = np.random.default_rng(2)
+    full = _full_tensors(rng)
+    _write_ckpt(str(tmp_path / "flat"), full, tp=1, pp=1)
+    _write_ckpt(str(tmp_path / "grid"), full, tp=2, pp=2)
+    spec1, p1 = load_megatron_checkpoint(str(tmp_path / "flat"))
+    spec2, p2 = load_megatron_checkpoint(str(tmp_path / "grid"))
+    assert spec1.config == spec2.config
+    _assert_same(p1, p2)
+
+
+@pytest.mark.parametrize("version", [0, 1.0])
+def test_qkv_checkpoint_versions(tmp_path, version):
+    """v0 ([Q|K|V] component-major per shard) and v1.0 (per-head (hn,3)
+    element interleave) load to the same params as the classic v2.0
+    layout (reference state_dict_factory.py:220 merge_query_key_value)."""
+    rng = np.random.default_rng(3)
+    full = _full_tensors(rng)
+    _write_ckpt(str(tmp_path / "v2"), full, tp=2, version=2.0)
+    _write_ckpt(str(tmp_path / "vx"), full, tp=2, version=version)
+    _, p2 = load_megatron_checkpoint(str(tmp_path / "v2"))
+    _, px = load_megatron_checkpoint(str(tmp_path / "vx"))
+    _assert_same(p2, px)
+
+
+def test_unknown_version_raises(tmp_path):
+    rng = np.random.default_rng(4)
+    full = _full_tensors(rng)
+    _write_ckpt(str(tmp_path / "c"), full, tp=1, version=3.0)
+    with pytest.raises(ValueError, match="checkpoint_version"):
+        load_megatron_checkpoint(str(tmp_path / "c"))
 
 
 def test_qkv_deinterleave_against_reference_math(tmp_path):
