@@ -62,12 +62,27 @@ class GPT2MoEModel(GPT2Model):
         return params
 
     # ----------------------------------------------------------------- block
-    def _mlp_sublayer(self, x, p, rng, train):
+    def _mlp_sublayer(self, x, p, rng, train, serve=False):
         cfg = self.config
         ln2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"],
                           cfg.layer_norm_epsilon)
-        y, l_aux, _ = self.moe.apply(p["moe"], ln2, rng=rng, train=train)
+        if serve:
+            # capacity-free routing (no drops, no noise): the reference's
+            # MoE inference semantics (ops/transformer/inference/
+            # moe_inference.py:160); shares the training gate/expert params
+            y, l_aux, _ = self.moe.apply_dense(p["moe"], ln2)
+        else:
+            y, l_aux, _ = self.moe.apply(p["moe"], ln2, rng=rng, train=train)
         return x + self._dropout(y, rng, train, 1), l_aux
+
+    def _decode_block(self, x, layer_params, attn_fn, start_pos,
+                      positions=None):
+        """KV-cache decode block: attention from the base class, MoE FFN
+        through the capacity-free serving path."""
+        x = self._attn_sublayer(x, layer_params, None, False, attn_fn=attn_fn,
+                                start_pos=start_pos, positions=positions)
+        x, _ = self._mlp_sublayer(x, layer_params, None, False, serve=True)
+        return x
 
     # ------------------------------------------------------------- sharding
     def partition_rules(self):

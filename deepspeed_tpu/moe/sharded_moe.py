@@ -128,6 +128,33 @@ def topk_gating(logits: jnp.ndarray,
     return l_aux, combine, dispatch, exp_counts
 
 
+def topk_weights(logits: jnp.ndarray, k: int) -> Tuple[jnp.ndarray,
+                                                       jnp.ndarray]:
+    """Capacity-free top-k combine weights: [S, E] with the same gate
+    semantics as ``topk_gating`` (argmax loop with -inf re-masking; raw
+    gate prob for k=1, renormalized picked gates for k>=2) but NO
+    capacity/slot machinery — every token keeps all its picks. Returns
+    (weights [S, E] f32, exp_counts [E] i32)."""
+    s, e = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    masked = logits.astype(jnp.float32)
+    picks = []
+    gate_sum = jnp.zeros((s,), jnp.float32)
+    exp_counts = jnp.zeros((e,), jnp.int32)
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)
+        mask = _one_hot(idx, e)
+        gate_val = jnp.sum(gates * mask, axis=-1)
+        picks.append((mask, gate_val))
+        gate_sum = gate_sum + gate_val
+        exp_counts = exp_counts + jnp.sum(mask, axis=0).astype(jnp.int32)
+        masked = jnp.where(mask > 0, -jnp.inf, masked)
+    denom = jnp.ones_like(gate_sum) if k == 1 else \
+        jnp.maximum(gate_sum, jnp.finfo(jnp.float32).eps)
+    w = sum(mask * (gate_val / denom)[:, None] for mask, gate_val in picks)
+    return w, exp_counts
+
+
 class TopKGate:
     """Linear gate + top-k routing (reference ``TopKGate``,
     sharded_moe.py:377): holds the [M, E] projection and the routing
@@ -215,3 +242,30 @@ class MOELayer:
         if self.use_sharding_constraints:
             y = maybe_constraint(y, (DATA_AXIS, EXPERT_AXIS), None)
         return y.reshape(*lead, m), l_aux, exp_counts
+
+    def apply_dense(self, params, x, rng=None, train=False):
+        """Capacity-free serving path (the reference's MoE-inference
+        semantics, reference ops/transformer/inference/moe_inference.py:160
+        — route every token, drop nothing): evaluate ALL experts on all
+        tokens and combine with ``topk_weights``. Costs E/k x the routed
+        FLOPs but has no [S, E, C] one-hot tensors, whose O(S^2·E)
+        dispatch einsum would dominate long-prompt prefill. Same return
+        shape as apply(); l_aux is 0 (no load-balance objective when
+        serving)."""
+        lead = x.shape[:-1]
+        m = x.shape[-1]
+        xs = x.reshape(-1, m)                                      # [S, M]
+        logits = xs.astype(jnp.float32) @ params["gate"]["wg"]
+        w, exp_counts = topk_weights(logits, self.gate.k)          # [S, E]
+        e = logits.shape[-1]
+        expert_in = jnp.broadcast_to(xs[None], (e,) + xs.shape)    # [E, S, M]
+        if self.use_sharding_constraints:
+            expert_in = maybe_constraint(expert_in, EXPERT_AXIS, None, None)
+        expert_out = self.experts.apply(params["experts"], expert_in,
+                                        rng=rng, train=train)      # [E, S, M]
+        if self.use_sharding_constraints:
+            expert_out = maybe_constraint(expert_out, EXPERT_AXIS, None, None)
+        y = jnp.einsum("se,esm->sm", w.astype(x.dtype), expert_out)
+        if self.use_sharding_constraints:
+            y = maybe_constraint(y, (DATA_AXIS, EXPERT_AXIS), None)
+        return y.reshape(*lead, m), jnp.float32(0.0), exp_counts
