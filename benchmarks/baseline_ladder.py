@@ -116,20 +116,23 @@ def run_1p3b():
     print(f"compile step done, loss {float(loss):.4f}", flush=True)
 
     # device-compute phase alone (the part that scales on real hardware):
-    # the fused grad step over gas micros, no optimizer exchange
+    # the fused grad step over gas micros, no optimizer exchange. Only one
+    # f32 grad-sum buffer (~5.2 GB) fits next to the bf16 params — drop
+    # each result before the next call.
     b = engine._to_device_batch(batch())
     rng_key = jax.random.fold_in(engine._base_rng, 999)
     with engine.mesh:
         l, gsum = engine._grad_step_fn(engine.params, engine.scaler_state,
                                        b, rng_key)
     float(l)
+    del l, gsum
     t0 = time.perf_counter()
     with engine.mesh:
         l, gsum = engine._grad_step_fn(engine.params, engine.scaler_state,
                                        b, rng_key)
     float(l)
-    del gsum
     dt_compute = time.perf_counter() - t0
+    del l, gsum, b
 
     losses = []
     t0 = time.perf_counter()

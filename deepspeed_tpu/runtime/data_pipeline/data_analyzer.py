@@ -1,0 +1,168 @@
+"""Offline data analyzer: corpus → per-sample metric files.
+
+Capability match for the reference DataAnalyzer
+(runtime/data_pipeline/data_sampling/data_analyzer.py:20): an offline
+map/reduce over the training dataset that scores every sample on one or
+more difficulty metrics and persists the maps the curriculum sampler
+consumes. The reference shards the map across workers/threads and writes
+indexed-dataset files; here each worker writes one ``.npy`` shard per
+metric and the reduce concatenates them and derives the auxiliary maps:
+
+  {save_path}/{metric}/worker{i}_{n}.npy      map output (per-worker)
+  {save_path}/{metric}/sample_to_metric.npy   [N] float64 metric values
+  {save_path}/{metric}/percentiles.npy        [N] float64 per-sample
+                                              percentile (0..100)
+  {save_path}/{metric}/metric_to_sample.npz   value -> sample-id arrays
+                                              (for value-indexed curricula)
+
+``DeepSpeedDataSampler`` accepts the reduced ``sample_to_metric`` array as
+``metric_values`` — see ``load_metric_values``. The engine wires this
+automatically when ``curriculum_learning.data_analysis_path`` is set
+(runtime/engine.py curriculum configuration).
+"""
+
+import glob
+import json
+import os
+import re
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def seqlen_metric(sample):
+    """Default difficulty metric: token count."""
+    if isinstance(sample, dict):
+        sample = next(iter(sample.values()))
+    return len(sample)
+
+
+def vocab_rarity_metric(sample, token_freq: Optional[np.ndarray] = None):
+    """Mean negative-log-frequency of the sample's tokens (reference
+    data_analyzer's vocab_rarity): higher = rarer vocabulary = harder."""
+    ids = np.asarray(sample["input_ids"] if isinstance(sample, dict)
+                     else sample)
+    if token_freq is None:
+        return float(len(np.unique(ids))) / max(1, ids.size)
+    p = token_freq[np.clip(ids, 0, len(token_freq) - 1)]
+    return float(np.mean(-np.log(np.maximum(p, 1e-12))))
+
+
+class DataAnalyzer:
+    """Map/reduce metric computation over a dataset.
+
+    ``metric_fns`` maps metric name → callable(sample) → float. A worker
+    (``worker_id`` of ``num_workers``) maps its contiguous shard with
+    ``run_map``; any process may then ``run_reduce`` once all shards
+    exist. ``run_map_reduce`` does both in-process (the single-machine
+    path the unit tests and small corpora use)."""
+
+    def __init__(self, dataset, metric_fns: Optional[Dict[str, Callable]] = None,
+                 save_path: Optional[str] = None,
+                 num_workers: int = 1, worker_id: int = 0,
+                 metric_fn: Optional[Callable] = None):
+        self.dataset = dataset
+        if metric_fns is None:
+            metric_fns = {"seqlen": metric_fn or seqlen_metric}
+        self.metric_fns = metric_fns
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+
+    # -- single-metric in-memory convenience (round-2 API, kept) ---------
+    def run(self) -> np.ndarray:
+        fn = next(iter(self.metric_fns.values()))
+        return np.asarray([float(fn(self.dataset[i]))
+                           for i in range(len(self.dataset))])
+
+    # -- offline map/reduce ----------------------------------------------
+    def _shard_range(self):
+        n = len(self.dataset)
+        per = -(-n // self.num_workers)
+        lo = min(n, self.worker_id * per)
+        return lo, min(n, lo + per)
+
+    def run_map(self) -> Dict[str, str]:
+        """Score this worker's shard; write one .npy per metric. Returns
+        {metric: path}."""
+        assert self.save_path, "run_map needs save_path"
+        lo, hi = self._shard_range()
+        out = {}
+        for name, fn in self.metric_fns.items():
+            vals = np.asarray([float(fn(self.dataset[i]))
+                               for i in range(lo, hi)], np.float64)
+            d = os.path.join(self.save_path, name)
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"worker{self.worker_id}_{lo}.npy")
+            np.save(path, vals)
+            out[name] = path
+        meta = {"num_workers": self.num_workers, "n": len(self.dataset),
+                "metrics": sorted(self.metric_fns)}
+        with open(os.path.join(self.save_path, "analysis.json"), "w") as f:
+            json.dump(meta, f)
+        return out
+
+    def run_reduce(self) -> Dict[str, np.ndarray]:
+        """Concatenate worker shards in index order; write
+        sample_to_metric / percentiles / metric_to_sample per metric.
+        Validates the shard set against analysis.json — stale shards from
+        an earlier run with a different num_workers, duplicates, or a
+        missing (crashed) worker are errors, not silent misalignment."""
+        assert self.save_path, "run_reduce needs save_path"
+        meta_path = os.path.join(self.save_path, "analysis.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        out = {}
+        for name in self.metric_fns:
+            d = os.path.join(self.save_path, name)
+            shards = {}
+            for p in glob.glob(os.path.join(d, "worker*_*.npy")):
+                m = re.match(r"worker(\d+)_(\d+)\.npy", os.path.basename(p))
+                wid, lo = int(m.group(1)), int(m.group(2))
+                if lo in shards:
+                    raise ValueError(
+                        f"duplicate map shards at offset {lo} under {d} "
+                        f"(stale files from a previous run with a "
+                        f"different num_workers?) — clear the directory "
+                        f"and re-run run_map")
+                shards[lo] = np.load(p)
+            if not shards:
+                raise FileNotFoundError(f"no map outputs under {d}; run "
+                                        f"run_map on every worker first")
+            vals = np.concatenate([shards[lo] for lo in sorted(shards)])
+            if len(vals) != meta["n"]:
+                raise ValueError(
+                    f"reduce found {len(vals)} scored samples under {d} "
+                    f"but analysis.json records n={meta['n']} — a worker "
+                    f"shard is missing or stale")
+            np.save(os.path.join(d, "sample_to_metric.npy"), vals)
+            order = np.argsort(vals, kind="stable")
+            pct = np.empty(len(vals), np.float64)
+            pct[order] = (np.arange(len(vals)) + 1) * 100.0 / len(vals)
+            np.save(os.path.join(d, "percentiles.npy"), pct)
+            uniq = {}
+            for i, v in enumerate(vals):
+                uniq.setdefault(v, []).append(i)
+            np.savez(os.path.join(d, "metric_to_sample.npz"),
+                     **{str(k): np.asarray(v, np.int64)
+                        for k, v in uniq.items()})
+            out[name] = vals
+        return out
+
+    def run_map_reduce(self) -> Dict[str, np.ndarray]:
+        """All workers' maps + the reduce, in-process."""
+        for w in range(self.num_workers):
+            DataAnalyzer(self.dataset, self.metric_fns, self.save_path,
+                         num_workers=self.num_workers, worker_id=w).run_map()
+        return self.run_reduce()
+
+
+def load_metric_values(save_path: str, metric: str) -> np.ndarray:
+    """Read the reduced per-sample metric map for ``metric``."""
+    p = os.path.join(save_path, metric, "sample_to_metric.npy")
+    if not os.path.exists(p):
+        raise FileNotFoundError(
+            f"{p} not found — run DataAnalyzer(dataset, "
+            f"metric_fns={{'{metric}': fn}}, save_path=...).run_map_reduce() "
+            f"first")
+    return np.load(p)

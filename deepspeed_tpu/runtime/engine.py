@@ -232,16 +232,19 @@ class DeepSpeedEngine:
         if cl.get("enabled"):
             from .data_pipeline.curriculum_scheduler import CurriculumScheduler
             self.curriculum_scheduler = CurriculumScheduler(cl)
+            self._curriculum_config = cl
             self._curriculum_metric = cl.get("curriculum_metric",
                                              cl.get("curriculum_type",
                                                     "seqlen"))
-            if self._curriculum_metric != "seqlen":
+            if self._curriculum_metric != "seqlen" and \
+                    not cl.get("data_analysis_path"):
                 logger.warning(
-                    f"curriculum metric '{self._curriculum_metric}': the "
-                    f"engine only truncates seqlen; wire a "
-                    f"DeepSpeedDataSampler with metric_values through "
-                    f"deepspeed_io(data_sampler=...) to filter by this "
-                    f"metric")
+                    f"curriculum metric '{self._curriculum_metric}': no "
+                    f"data_analysis_path configured — either run the "
+                    f"offline DataAnalyzer (data_pipeline/data_analyzer.py) "
+                    f"and set curriculum_learning.data_analysis_path, or "
+                    f"wire a DeepSpeedDataSampler with metric_values "
+                    f"through deepspeed_io(data_sampler=...)")
 
         # ---- activation checkpointing: JSON block -> remat policy on the
         #      model (reference checkpointing.py:789 configure()) ----
@@ -497,12 +500,52 @@ class DeepSpeedEngine:
         cfg = self._config
         if batch_size is None:
             batch_size = cfg.train_micro_batch_size_per_gpu * self.dp_world_size
+        if data_sampler is None and route in (None, "train"):
+            data_sampler = self._maybe_curriculum_sampler(dataset, batch_size)
         return DeepSpeedDataLoader(dataset,
                                    batch_size=batch_size,
                                    collate_fn=collate_fn or self.collate_fn,
                                    drop_last=cfg.dataloader_drop_last,
                                    data_sampler=data_sampler,
                                    seed=cfg.seed)
+
+    def _maybe_curriculum_sampler(self, dataset, batch_size):
+        """Auto-build the curriculum data sampler when a non-seqlen metric
+        is configured with an offline analysis directory
+        (curriculum_learning.data_analysis_path — produced by
+        data_pipeline/data_analyzer.py, the reference data_analyzer.py:20
+        equivalent). Training route only; seqlen curricula keep the
+        in-batch truncation path; iterable (non-Sized) datasets cannot be
+        index-sampled and fall through to plain iteration."""
+        cl = getattr(self, "_curriculum_config", None)
+        if (not cl or self._curriculum_metric == "seqlen" or
+                not cl.get("data_analysis_path") or
+                not hasattr(dataset, "__len__")):
+            return None
+        from .data_pipeline.data_analyzer import load_metric_values
+        from .data_pipeline.data_sampler import DeepSpeedDataSampler
+        values = load_metric_values(cl["data_analysis_path"],
+                                    self._curriculum_metric)
+        if len(values) != len(dataset):
+            raise ValueError(
+                f"data_analysis_path metric map has {len(values)} entries "
+                f"but the dataset has {len(dataset)} samples — re-run the "
+                f"DataAnalyzer on this dataset")
+        cfg = self._config
+        sampler = DeepSpeedDataSampler(
+            dataset,
+            batch_size=batch_size,
+            metric_values=values,
+            curriculum_config=dict(cl),
+            difficulty_type=cl.get("difficulty_type", "percentile"),
+            # single-controller: each draw is the GLOBAL batch, rank 0 of 1
+            dp_rank=0, dp_world=1,
+            gradient_accumulation_steps=cfg.gradient_accumulation_steps,
+            seed=cfg.seed)
+        log_dist(f"curriculum sampler: metric="
+                 f"'{self._curriculum_metric}' over "
+                 f"{len(values)} analyzed samples", ranks=[0])
+        return sampler
 
     # ------------------------------------------------------------------
     # reference-style API: forward / backward / step  (engine.py:1634+)

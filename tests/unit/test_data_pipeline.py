@@ -199,3 +199,140 @@ def test_curriculum_reaches_nonmultiple_max():
         "schedule_config": {"seq_per_step": 16, "require_steps": 10}}})
     assert ltd.get_current_seq(10) == 1000
     assert ltd.is_fully_ramped(10)
+
+
+# ---------------------------------------------------------------- analyzer
+
+class _Corpus:
+    """Samples of varying length and vocabulary rarity."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.default_rng(seed)
+        self.samples = [
+            {"input_ids": rng.integers(0, 16 + 16 * (i % 4),
+                                       size=4 + (i % 8) * 4)}
+            for i in range(n)
+        ]
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+def test_data_analyzer_map_reduce(tmp_path):
+    """2-worker map + reduce == single-pass values; percentile map is a
+    valid rank transform; metric_to_sample inverts sample_to_metric."""
+    from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+        DataAnalyzer, load_metric_values, seqlen_metric)
+
+    ds = _Corpus()
+    fns = {"seqlen": lambda s: len(s["input_ids"]),
+           "uniq": lambda s: float(len(np.unique(s["input_ids"])))}
+    out = DataAnalyzer(ds, fns, str(tmp_path), num_workers=2).run_map_reduce()
+    direct = np.asarray([len(s["input_ids"]) for s in ds.samples], float)
+    np.testing.assert_array_equal(out["seqlen"], direct)
+    np.testing.assert_array_equal(
+        load_metric_values(str(tmp_path), "seqlen"), direct)
+    pct = np.load(tmp_path / "seqlen" / "percentiles.npy")
+    assert pct.shape == direct.shape and pct.max() == 100.0
+    # percentile order must follow the metric order
+    assert (np.argsort(pct, kind="stable") ==
+            np.argsort(direct, kind="stable")).all()
+    m2s = np.load(tmp_path / "seqlen" / "metric_to_sample.npz")
+    for val, ids in m2s.items():
+        assert all(direct[i] == float(val) for i in ids)
+
+
+def test_curriculum_by_metric_changes_sample_order(tmp_path):
+    """A rarity-metric curriculum draws measurably different (easier)
+    early batches than the no-curriculum order."""
+    from deepspeed_tpu.runtime.data_pipeline.data_analyzer import DataAnalyzer
+
+    ds = _Corpus()
+    fns = {"uniq": lambda s: float(len(np.unique(s["input_ids"])))}
+    vals = DataAnalyzer(ds, fns, str(tmp_path), num_workers=1
+                        ).run_map_reduce()["uniq"]
+    cl = {"enabled": True, "curriculum_metric": "uniq",
+          "schedule_type": "fixed_linear",
+          "min_difficulty": 25, "max_difficulty": 100,
+          "schedule_config": {"total_curriculum_step": 8,
+                              "difficulty_step": 25}}
+    sampler = DeepSpeedDataSampler(ds, batch_size=8, metric_values=vals,
+                                   curriculum_config=cl,
+                                   difficulty_type="percentile")
+    it = iter(sampler)
+    first = np.asarray(next(it)).reshape(-1)
+    # at difficulty=25th percentile, early draws come from the easiest
+    # quartile of the rarity metric
+    thresh = np.quantile(vals, 0.25)
+    assert (vals[first] <= thresh + 1e-9).all(), \
+        (vals[first], thresh)
+    # ramp to max difficulty: later draws may use the whole corpus
+    sampler.set_step(100)
+    later = np.asarray(next(iter(sampler))).reshape(-1)
+    assert vals[later].max() > thresh
+
+
+def test_engine_wires_curriculum_sampler(tmp_path):
+    """initialize() with curriculum_learning.data_analysis_path builds the
+    metric sampler automatically (kills the round-2 'wire it yourself'
+    warning path)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.runtime.data_pipeline.data_analyzer import DataAnalyzer
+
+    rng = np.random.default_rng(0)
+    samples = [{"input_ids": rng.integers(0, 64, size=16).astype(np.int32)}
+               for _ in range(32)]
+
+    class _DS:
+        def __len__(self):
+            return len(samples)
+
+        def __getitem__(self, i):
+            return samples[i]
+
+    ds = _DS()
+    fns = {"uniq": lambda s: float(len(np.unique(s["input_ids"])))}
+    DataAnalyzer(ds, fns, str(tmp_path)).run_map_reduce()
+
+    model = GPT2Model(GPT2Config(vocab_size=64, n_positions=16, n_embd=32,
+                                 n_layer=1, n_head=2,
+                                 pad_vocab_to_multiple=64))
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=model, training_data=ds,
+        config={
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "steps_per_print": 0,
+            "data_efficiency": {"enabled": True, "data_sampling": {
+                "enabled": True, "curriculum_learning": {
+                    "enabled": True, "curriculum_metric": "uniq",
+                    "data_analysis_path": str(tmp_path),
+                    "schedule_type": "fixed_linear",
+                    "min_difficulty": 25, "max_difficulty": 100,
+                    "schedule_config": {"total_curriculum_step": 4,
+                                        "difficulty_step": 25}}}},
+        })
+    assert loader is not None and loader.data_sampler is not None
+    batch = next(iter(loader))
+    assert batch["input_ids"].shape[0] == 4 * engine.dp_world_size
+
+
+def test_data_analyzer_stale_shards_detected(tmp_path):
+    """Shards left by a previous run with different num_workers must fail
+    the reduce loudly, not silently misalign."""
+    from deepspeed_tpu.runtime.data_pipeline.data_analyzer import DataAnalyzer
+
+    ds = _Corpus(n=8)
+    fns = {"seqlen": lambda s: len(s["input_ids"])}
+    DataAnalyzer(ds, fns, str(tmp_path), num_workers=2).run_map_reduce()
+    # second run with different sharding leaves overlapping offsets
+    for w in range(4):
+        DataAnalyzer(ds, fns, str(tmp_path), num_workers=4,
+                     worker_id=w).run_map()
+    with pytest.raises(ValueError, match="duplicate|stale"):
+        DataAnalyzer(ds, fns, str(tmp_path), num_workers=4).run_reduce()
