@@ -48,6 +48,15 @@ def parse_args(argv=None):
                    help="extra args for ssh/pdsh")
     p.add_argument("--nproc_per_node", type=int, default=1,
                    help="processes per node (CPU-backend testing; TPU uses 1)")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="worker-group restarts after a failure (elastic "
+                        "agent behavior; see launcher/launch.py)")
+    p.add_argument("--restart_backoff", type=float, default=1.0,
+                   help="base seconds of exponential restart backoff")
+    p.add_argument("--elastic_training", action="store_true",
+                   help="enable elastic re-plan on repeated failures "
+                        "(reads the 'elasticity' block of the JSON in "
+                        "DSTPU_ELASTIC_CONFIG)")
     p.add_argument("--force_multi", action="store_true",
                    help="multinode codepath even for one node")
     p.add_argument("--module", action="store_true")
@@ -124,7 +133,10 @@ def _launch_cmd(args, node_rank, nnodes, master_addr):
            f"--node_rank={node_rank}", f"--nnodes={nnodes}",
            f"--nproc_per_node={args.nproc_per_node}",
            f"--master_addr={master_addr}",
-           f"--master_port={args.master_port}"]
+           f"--master_port={args.master_port}",
+           f"--max_restarts={args.max_restarts}",
+           f"--restart_backoff={args.restart_backoff}"] + \
+          (["--elastic_training"] if args.elastic_training else [])
     if args.module:
         cmd.append("--module")
     if args.no_python:

@@ -855,10 +855,18 @@ class DeepSpeedEngine:
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
         from .checkpointing import load_checkpoint
-        return load_checkpoint(self, load_dir, tag=tag,
-                               load_optimizer_states=load_optimizer_states,
-                               load_lr_scheduler_states=load_lr_scheduler_states,
-                               load_module_only=load_module_only)
+        out = load_checkpoint(self, load_dir, tag=tag,
+                              load_optimizer_states=load_optimizer_states,
+                              load_lr_scheduler_states=load_lr_scheduler_states,
+                              load_module_only=load_module_only)
+        # resume the curriculum data sampler at the restored step (a fresh
+        # sampler would restart the difficulty ramp AND replay the seeded
+        # batch stream from step 0)
+        sampler = getattr(self.training_dataloader, "data_sampler", None) \
+            if self.training_dataloader is not None else None
+        if sampler is not None and hasattr(sampler, "set_step"):
+            sampler.set_step(self.global_steps)
+        return out
 
     def get_fp32_params(self):
         """Gathered, fully-replicated fp32 params (the zero_to_fp32 path,

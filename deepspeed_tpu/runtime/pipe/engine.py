@@ -26,6 +26,7 @@ Two execution modes:
      semantics for tied weights (ReduceTiedGrads) included.
 """
 
+import os
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -49,6 +50,9 @@ class PipelineEngine(DeepSpeedEngine):
     def __init__(self, *args, **kwargs):
         model = kwargs.get("model") or (args[1] if len(args) > 1 else None)
         self._interpreted = isinstance(model, PipelineModule)
+        self._stage_fn_cache = {}
+        self._eager_interpret = bool(int(
+            os.environ.get("DSTPU_PIPE_EAGER", "0")))
         if not self._interpreted:
             if not hasattr(model, "pipeline_spec"):
                 raise ValueError("pipeline_parallel_size>1 needs a model "
@@ -334,6 +338,35 @@ class PipelineEngine(DeepSpeedEngine):
 
         return fn
 
+    def _compiled_stage_fns(self, a: int, b: int, last: bool):
+        """Jitted forward and backward for one stage of the interpreted
+        executor. The schedule stays host-interpreted (mailboxes, stage
+        hops), but per-micro compute compiles ONCE per stage instead of
+        re-tracing jax.vjp on every micro (round-2 review: the eager
+        interpreter was the only path for heterogeneous PipelineModules
+        and far slower than it needed to be). jax.vjp runs INSIDE the
+        jitted forward — its returned VJP is a tree_util.Partial pytree
+        (residual arrays as leaves), so it crosses the jit boundary and
+        feeds the jitted backward with no forward recompute. Set
+        DSTPU_PIPE_EAGER=1 to restore the eager path (debugging)."""
+        key = (a, b, last)
+        if key not in self._stage_fn_cache:
+            fn = self._stage_apply(a, b, last)
+
+            def fwd(stage_p, tied, x, batch, rng):
+                return jax.vjp(
+                    lambda sp, tp, xx: fn(sp, tp, xx, batch, rng),
+                    stage_p, tied, x)
+
+            self._stage_fn_cache[key] = (jax.jit(fwd),
+                                         jax.jit(lambda vjp, g: vjp(g)))
+        return self._stage_fn_cache[key]
+
+    @staticmethod
+    @jax.jit
+    def _tree_add(t1, t2):
+        return jax.tree.map(jnp.add, t1, t2)
+
     def train_batch(self, data_iter=None, batch=None):
         if self._interpreted and self.mesh_manager.pp > 1:
             if batch is None:
@@ -409,14 +442,20 @@ class PipelineEngine(DeepSpeedEngine):
                     elif isinstance(c, sched.ForwardPass):
                         x = stage_inputs[(s, m)]
                         mrng = jax.random.fold_in(rng, m)
-                        fn = self._stage_apply(a, b, last)
                         tied_s = self._tied_for_stage(tied_p, s)
                         mb_s = self._to_stage(micros[m], s) if last else \
                             micros[m]
-                        out, vjp = jax.vjp(
-                            lambda sp, tp, xx: fn(sp, tp, xx, mb_s, mrng),
-                            stage_p, tied_s, x)
-                        vjps[(s, m)] = vjp
+                        if self._eager_interpret:
+                            fn = self._stage_apply(a, b, last)
+                            out, vjp = jax.vjp(
+                                lambda sp, tp, xx: fn(sp, tp, xx, mb_s,
+                                                      mrng),
+                                stage_p, tied_s, x)
+                            vjps[(s, m)] = vjp
+                        else:
+                            fwd, _ = self._compiled_stage_fns(a, b, last)
+                            out, vjp = fwd(stage_p, tied_s, x, mb_s, mrng)
+                            vjps[(s, m)] = vjp
                         if last:
                             losses.append(out)
                         else:
@@ -436,17 +475,21 @@ class PipelineEngine(DeepSpeedEngine):
                         g = (self._to_stage(
                             jnp.float32(1.0 / M) * self.scaler_state.scale, s)
                              if last else stage_inputs.pop((s, m, "gin")))
-                        dstage, dtied, dx = vjps.pop((s, m))(g)
+                        if self._eager_interpret:
+                            dstage, dtied, dx = vjps.pop((s, m))(g)
+                        else:
+                            _, bwd_fn = self._compiled_stage_fns(a, b, last)
+                            dstage, dtied, dx = bwd_fn(vjps.pop((s, m)), g)
                         for j, layer_idx in enumerate(range(a, b)):
-                            grads_layers[layer_idx] = jax.tree.map(
-                                jnp.add, grads_layers[layer_idx], dstage[j])
+                            grads_layers[layer_idx] = self._tree_add(
+                                grads_layers[layer_idx], dstage[j])
                         if self._stage_shardings is not None:
                             # tied grads accumulate across STAGES — bring
                             # them to a common placement first
                             dtied = jax.device_put(
                                 dtied, NamedSharding(self.mesh, P()))
-                        grads_tied_acc[0] = jax.tree.map(
-                            jnp.add, grads_tied_acc[0], dtied)
+                        grads_tied_acc[0] = self._tree_add(grads_tied_acc[0],
+                                                           dtied)
                         stage_inputs[(s, m, "gout")] = dx
                     elif isinstance(c, sched.SendGrad):
                         grad_mail[(s, m)] = stage_inputs.pop((s, m, "gout"))

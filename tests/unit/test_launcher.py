@@ -109,3 +109,81 @@ def test_ds_report_runs():
         env=env, capture_output=True, text=True, timeout=180)
     assert proc.returncode == 0, proc.stderr
     assert "op compatibility" in proc.stdout
+
+
+def test_restart_resumes_from_checkpoint(tmp_path):
+    """Elastic-agent behavior (reference elasticity/elastic_agent.py:28):
+    a killed rank triggers a whole-group restart with backoff and a fresh
+    rendezvous; the restarted run resumes from the 'checkpoint' the first
+    attempt saved."""
+    ckpt = tmp_path / "progress.txt"
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(f"""
+        import os, sys
+        rank = os.environ["RANK"]
+        attempt = int(os.environ["DSTPU_RESTART_COUNT"])
+        ckpt = {str(ckpt)!r} + "." + rank
+        start = int(open(ckpt).read()) if os.path.exists(ckpt) else 0
+        for step in range(start, 4):
+            open(ckpt, "w").write(str(step + 1))
+            if step == 1 and rank == "1" and attempt == 0:
+                sys.exit(7)  # simulated rank failure mid-training
+        print(f"rank {{rank}} done at step 4 (attempt {{attempt}}, "
+              f"resumed from {{start}})", flush=True)
+    """))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+         "--nproc_per_node=2", "--master_port=29713",
+         "--max_restarts=2", "--restart_backoff=0.1", str(worker)],
+        env=env, capture_output=True, text=True, timeout=120)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    assert "rank 0 done" in out and "rank 1 done" in out, out
+    # the restarted rank 1 resumed from its saved step, not from zero
+    assert "attempt 1, resumed from 2" in out, out
+
+
+def test_restart_exhaustion_propagates_failure(tmp_path):
+    worker = tmp_path / "always_bad.py"
+    worker.write_text("import sys; sys.exit(9)\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+         "--nproc_per_node=2", "--master_port=29714",
+         "--max_restarts=1", "--restart_backoff=0.05", str(worker)],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 9, proc.stdout + proc.stderr
+
+
+def test_elastic_replan_shrinks_world(tmp_path):
+    """Repeated failures at nproc=4 re-plan to the next valid world size
+    from the elasticity block (compute_elastic_config) and succeed."""
+    import json as _json
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent("""
+        import os, sys
+        # a 4-process group always dies; a 2-process group is healthy
+        if os.environ["WORLD_SIZE"] == "4":
+            sys.exit(5)
+        print(f"rank {os.environ['RANK']} healthy at world "
+              f"{os.environ['WORLD_SIZE']}", flush=True)
+    """))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DSTPU_ELASTIC_CONFIG"] = _json.dumps({"elasticity": {
+        "enabled": True, "max_train_batch_size": 16,
+        "micro_batch_sizes": [1, 2, 4], "min_gpus": 1, "max_gpus": 4,
+        "version": 0.1}})
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+         "--nproc_per_node=4", "--master_port=29715",
+         "--max_restarts=4", "--restart_backoff=0.05",
+         "--elastic_training", str(worker)],
+        env=env, capture_output=True, text=True, timeout=120)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    assert "elastic re-plan 4 -> 3" in out, out
+    assert "healthy at world 3" in out, out
