@@ -751,3 +751,74 @@ def replace_transformer_layer(model, config=None) -> Tuple[Any, Any]:
     logger.info(f"injected {type(model).__name__} -> "
                 f"{type(spec).__name__} ({policy.__name__})")
     return spec, params
+
+
+def _cfg_get(config, name, default):
+    """diffusers configs are attr-style or FrozenDict-style."""
+    if isinstance(config, dict):
+        return config.get(name, default)
+    return getattr(config, name, default)
+
+
+@register_policy("UNet2DConditionModel")
+def unet_policy(model) -> Tuple[Any, Any]:
+    """diffusers UNet2DConditionModel → (UNet2DConditionSpec, flat params)
+    (reference module_inject/containers/unet.py + the generic diffusers
+    injection at replace_module.py:184). Weights keep their diffusers
+    state_dict names; convs go OIHW→HWIO, linears [out,in]→[in,out].
+    Only the standard SD topology (cross-attn on all but the last level)
+    is supported — anything else raises rather than mis-injecting."""
+    from ..models.diffusion import (UNet2DConditionConfig,
+                                    UNet2DConditionSpec, convert_state_dict)
+
+    c = model.config
+    get = lambda name, default: _cfg_get(c, name, default)  # noqa: E731
+    nb = len(get("block_out_channels", (32, 64)))
+    down_types = tuple(get("down_block_types",
+                           ("CrossAttnDownBlock2D",) * (nb - 1) +
+                           ("DownBlock2D",)))
+    up_types = tuple(get("up_block_types",
+                         ("UpBlock2D",) +
+                         ("CrossAttnUpBlock2D",) * (nb - 1)))
+    want_down = ("CrossAttnDownBlock2D",) * (nb - 1) + ("DownBlock2D",)
+    want_up = ("UpBlock2D",) + ("CrossAttnUpBlock2D",) * (nb - 1)
+    if down_types != want_down or up_types != want_up:
+        raise ValueError(
+            f"unsupported UNet topology: down={down_types} up={up_types}; "
+            f"this injection supports the standard SD layout "
+            f"down={want_down} up={want_up}")
+    head = get("attention_head_dim", 8)
+    # diffusers quirk: attention_head_dim IS the head count (per level
+    # when a list)
+    head = tuple(head) if isinstance(head, (list, tuple)) else (int(head),)
+    cfg = UNet2DConditionConfig(
+        in_channels=get("in_channels", 4),
+        out_channels=get("out_channels", 4),
+        block_out_channels=tuple(get("block_out_channels", (32, 64))),
+        layers_per_block=get("layers_per_block", 2),
+        cross_attention_dim=get("cross_attention_dim", 32),
+        attention_head_dim=head,
+        norm_num_groups=get("norm_num_groups", 32),
+        norm_eps=get("norm_eps", 1e-5),
+        sample_size=get("sample_size", 32))
+    return UNet2DConditionSpec(cfg), convert_state_dict(model.state_dict())
+
+
+@register_policy("AutoencoderKL")
+def vae_policy(model) -> Tuple[Any, Any]:
+    """diffusers AutoencoderKL → (AutoencoderKLSpec, flat params)
+    (reference module_inject/containers/vae.py)."""
+    from ..models.diffusion import (AutoencoderKLConfig, AutoencoderKLSpec,
+                                    convert_state_dict)
+
+    c = model.config
+    get = lambda name, default: _cfg_get(c, name, default)  # noqa: E731
+    cfg = AutoencoderKLConfig(
+        in_channels=get("in_channels", 3),
+        out_channels=get("out_channels", 3),
+        latent_channels=get("latent_channels", 4),
+        block_out_channels=tuple(get("block_out_channels", (32, 64))),
+        layers_per_block=get("layers_per_block", 1),
+        norm_num_groups=get("norm_num_groups", 32),
+        scaling_factor=get("scaling_factor", 0.18215))
+    return AutoencoderKLSpec(cfg), convert_state_dict(model.state_dict())

@@ -1,0 +1,439 @@
+"""Pallas-TPU block-sparse attention that SKIPS masked blocks.
+
+FLOP-skipping counterpart of the reference's Triton SDD/DSD kernels
+(reference deepspeed/ops/sparse_attention/matmul.py:17 block-CSR matmuls,
+softmax.py): the dense-masked XLA path (ops/sparse_attention_ops.py)
+computes every (q, k) tile and masks; this kernel iterates ONLY the live
+key tiles of each query tile, driven by a compacted per-(head, q-tile)
+column list delivered through scalar prefetch — the column index feeds the
+K/V BlockSpec index_map, so dead tiles are neither DMA'd nor computed.
+
+Design:
+- The SparsityConfig layout ([H, nb, nb] bool at its own fine ``block``,
+  typically 16) is coarsened to TPU-sized tiles (``tile``, default 256):
+  a tile is live if any fine block inside it is. Fine-grained masking
+  within a live tile comes from the fine layout, delivered as an int8
+  input windowed per tile pair and expanded in-kernel.
+- grid = (B*H, q_tiles, max_nnz); online-softmax accumulators persist in
+  VMEM scratch across the innermost (key-tile) grid dim; steps beyond the
+  row's nnz are compute-skipped with pl.when.
+- backward: dq mirrors the forward (recompute p from lse); dk/dv iterate
+  the TRANSPOSED plan (per key tile, the live q tiles).
+
+Numerics oracle: the dense-masked path; parity is tested in interpret
+mode (tests/unit/test_block_sparse.py) for Fixed/BigBird/Longformer
+layouts including per-head patterns, forward and grads.
+"""
+
+import functools
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+class _Plan(NamedTuple):
+    """Host-side routing plan for one (layout, tile) pair."""
+    kcols: np.ndarray      # [H, nt, max_nnz] i32 — live key-tile ids (padded
+    #                        with the last live id so dead DMAs stay in range)
+    nnz: np.ndarray        # [H, nt] i32
+    qrows_t: np.ndarray    # [H, nt, max_nnz_t] i32 — transposed plan
+    nnz_t: np.ndarray      # [H, nt] i32
+    coarse: np.ndarray     # [H, nt, nt] bool
+    tile: int
+    fine_block: int
+
+
+def build_plan(layout: np.ndarray, fine_block: int, tile: int) -> _Plan:
+    layout = np.asarray(layout, bool)
+    h, nb, _ = layout.shape
+    r = tile // fine_block
+    if tile % fine_block or nb % r:
+        raise ValueError(f"tile {tile} incompatible with layout blocks "
+                         f"{fine_block} x {nb}")
+    nt = nb // r
+    coarse = layout.reshape(h, nt, r, nt, r).any(axis=(2, 4))
+
+    def compact(mat):  # [H, nt, nt] -> padded index lists along last dim
+        nnz = mat.sum(-1).astype(np.int32)
+        width = max(1, int(nnz.max()))
+        idx = np.zeros((h, nt, width), np.int32)
+        for hh in range(h):
+            for i in range(nt):
+                cols = np.nonzero(mat[hh, i])[0]
+                idx[hh, i, :len(cols)] = cols
+                if len(cols):          # pad with a live id (in-range DMA)
+                    idx[hh, i, len(cols):] = cols[-1]
+        return idx, nnz
+
+    kcols, nnz = compact(coarse)
+    qrows_t, nnz_t = compact(coarse.transpose(0, 2, 1))
+    return _Plan(kcols, nnz, qrows_t, nnz_t, coarse, tile, fine_block)
+
+
+# Mosaic requires the last two BlockSpec dims to be (8k, 128m): the r x r
+# fine window is shipped padded inside an (8, 128) f32 tile
+_FINE_PAD = (8, 128)
+
+
+def pack_fine_windows(layout: np.ndarray, tile: int,
+                      fine_block: int) -> np.ndarray:
+    """[H, nb, nb] bool -> [H, nt, nt, 8, 128] f32 padded windows."""
+    h, nb, _ = layout.shape
+    r = tile // fine_block
+    nt = nb // r
+    win = layout.reshape(h, nt, r, nt, r).transpose(0, 1, 3, 2, 4)
+    out = np.zeros((h, nt, nt) + _FINE_PAD, np.float32)
+    out[..., :r, :r] = win
+    return out
+
+
+def _expand_fine(sub_padded, tile, fine_block):
+    """[8, 128] padded fine window -> [tile, tile] bool keep-mask via two
+    one-hot expansion matmuls (gathers don't lower on Mosaic; the MXU
+    expansion always does): keep = E^T (sub) E with E[i, j] = [j//fb == i]."""
+    r = tile // fine_block
+    sub = sub_padded[:r, :r]
+    e = (lax.broadcasted_iota(jnp.int32, (r, tile), 1) // fine_block ==
+         lax.broadcasted_iota(jnp.int32, (r, tile), 0)).astype(jnp.float32)
+    expanded = jnp.dot(e.T, jnp.dot(sub, e,
+                                    preferred_element_type=jnp.float32),
+                       preferred_element_type=jnp.float32)
+    return expanded > 0.5
+
+
+# --------------------------------------------------------------------- forward
+
+def _fwd_kernel(kcols_ref, nnz_ref, q_ref, k_ref, v_ref, fine_ref,
+                o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                scale, tile, fine_block, n_heads, max_nnz):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    h = b % n_heads
+    i = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j < nnz_ref[h, i])
+    def compute():
+        q = q_ref[0]                              # [tile, D]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        keep = _expand_fine(fine_ref[0, 0, 0], tile, fine_block)
+        s = jnp.where(keep, s, NEG_INF)
+        m, l, acc = m_ref[...], l_ref[...], acc_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[...] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(j == max_nnz - 1)
+    def finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(l)).astype(jnp.float32)
+
+
+def _fwd(q, k, v, plan: _Plan, fine_i8, scale, interpret):
+    b, h, t, d = q.shape
+    bh = b * h
+    tile = plan.tile
+    nt = t // tile
+    max_nnz = plan.kcols.shape[-1]
+    qf, kf, vf = (x.reshape(bh, t, d) for x in (q, k, v))
+    r = tile // plan.fine_block
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, nt, max_nnz),
+        in_specs=[
+            pl.BlockSpec((1, tile, d), lambda b_, i, j, kc, nz: (b_, i, 0)),
+            pl.BlockSpec((1, tile, d),
+                         lambda b_, i, j, kc, nz, nh=h: (
+                             b_, kc[b_ % nh, i, j], 0)),
+            pl.BlockSpec((1, tile, d),
+                         lambda b_, i, j, kc, nz, nh=h: (
+                             b_, kc[b_ % nh, i, j], 0)),
+            pl.BlockSpec((1, 1, 1) + _FINE_PAD,
+                         lambda b_, i, j, kc, nz, nh=h: (
+                             b_ % nh, i, kc[b_ % nh, i, j], 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tile, d), lambda b_, i, j, kc, nz: (b_, i, 0)),
+            pl.BlockSpec((1, tile, 1), lambda b_, i, j, kc, nz: (b_, i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile, d), jnp.float32),
+            pltpu.VMEM((tile, 1), jnp.float32),
+            pltpu.VMEM((tile, 1), jnp.float32),
+        ],
+    )
+    # fine layout windowed [r, r] per (h, q-tile, k-tile): reshape to
+    # [H, nt*r(=nb), nt*r] is exactly the fine layout itself
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, tile=tile, fine_block=plan.fine_block,
+        n_heads=h, max_nnz=max_nnz)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, t, 1), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(plan.kcols), jnp.asarray(plan.nnz), qf, kf, vf, fine_i8)
+    return out.reshape(b, h, t, d), lse.reshape(b, h, t, 1)
+
+
+# -------------------------------------------------------------------- backward
+
+def _dq_kernel(kcols_ref, nnz_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               delta_ref, fine_ref, dq_ref, dq_acc_ref, *,
+               scale, tile, fine_block, n_heads, max_nnz):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    h = b % n_heads
+
+    @pl.when(j == 0)
+    def init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    @pl.when(j < nnz_ref[h, i])
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        keep = _expand_fine(fine_ref[0, 0, 0], tile, fine_block)
+        s = jnp.where(keep, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc_ref[...] += jnp.dot(ds.astype(k.dtype), k,
+                                   preferred_element_type=jnp.float32)
+
+    @pl.when(j == max_nnz - 1)
+    def flush():
+        dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(qrows_ref, nnz_t_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                delta_ref, fine_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+                *, scale, tile, fine_block, n_heads, max_nnz_t):
+    b = pl.program_id(0)
+    jt = pl.program_id(1)              # key tile
+    it = pl.program_id(2)              # position in its live-q list
+    h = b % n_heads
+
+    @pl.when(it == 0)
+    def init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    @pl.when(it < nnz_t_ref[h, jt])
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        keep = _expand_fine(fine_ref[0, 0, 0], tile, fine_block)
+        s = jnp.where(keep, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_acc_ref[...] += jnp.dot(p.astype(do.dtype).T, do,
+                                   preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc_ref[...] += jnp.dot(ds.astype(q.dtype).T, q,
+                                   preferred_element_type=jnp.float32)
+
+    @pl.when(it == max_nnz_t - 1)
+    def flush():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, plan: _Plan, fine_i8, scale, interpret):
+    b, h, t, d = q.shape
+    bh = b * h
+    tile = plan.tile
+    nt = t // tile
+    r = tile // plan.fine_block
+    max_nnz = plan.kcols.shape[-1]
+    max_nnz_t = plan.qrows_t.shape[-1]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    qf, kf, vf, dof = (x.reshape(bh, t, d) for x in (q, k, v, do))
+    lsef = lse.reshape(bh, t, 1)
+    deltaf = delta.reshape(bh, t, 1)
+
+    q_at_i = pl.BlockSpec((1, tile, d), lambda b_, i, j, kc, nz: (b_, i, 0))
+    vec_at_i = pl.BlockSpec((1, tile, 1), lambda b_, i, j, kc, nz: (b_, i, 0))
+    kv_at_col = pl.BlockSpec(
+        (1, tile, d), lambda b_, i, j, kc, nz, nh=h: (b_, kc[b_ % nh, i, j], 0))
+    fine_at = pl.BlockSpec(
+        (1, 1, 1) + _FINE_PAD, lambda b_, i, j, kc, nz, nh=h: (
+            b_ % nh, i, kc[b_ % nh, i, j], 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, tile=tile,
+                          fine_block=plan.fine_block, n_heads=h,
+                          max_nnz=max_nnz),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nt, max_nnz),
+            in_specs=[q_at_i, kv_at_col, kv_at_col, q_at_i, vec_at_i,
+                      vec_at_i, fine_at],
+            out_specs=q_at_i,
+            scratch_shapes=[pltpu.VMEM((tile, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(plan.kcols), jnp.asarray(plan.nnz),
+      qf, kf, vf, dof, lsef, deltaf, fine_i8)
+
+    # transposed plan: grid (bh, key tile, live-q position)
+    q_at_row = pl.BlockSpec(
+        (1, tile, d), lambda b_, jt, it, qr, nz, nh=h: (
+            b_, qr[b_ % nh, jt, it], 0))
+    vec_at_row = pl.BlockSpec(
+        (1, tile, 1), lambda b_, jt, it, qr, nz, nh=h: (
+            b_, qr[b_ % nh, jt, it], 0))
+    kv_at_jt = pl.BlockSpec((1, tile, d),
+                            lambda b_, jt, it, qr, nz: (b_, jt, 0))
+    fine_at_t = pl.BlockSpec(
+        (1, 1, 1) + _FINE_PAD, lambda b_, jt, it, qr, nz, nh=h: (
+            b_ % nh, qr[b_ % nh, jt, it], jt, 0, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, tile=tile,
+                          fine_block=plan.fine_block, n_heads=h,
+                          max_nnz_t=max_nnz_t),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nt, max_nnz_t),
+            in_specs=[q_at_row, kv_at_jt, kv_at_jt, q_at_row, vec_at_row,
+                      vec_at_row, fine_at_t],
+            out_specs=[kv_at_jt, kv_at_jt],
+            scratch_shapes=[pltpu.VMEM((tile, d), jnp.float32),
+                            pltpu.VMEM((tile, d), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), v.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(plan.qrows_t), jnp.asarray(plan.nnz_t),
+      qf, kf, vf, dof, lsef, deltaf, fine_i8)
+    return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
+            dv.reshape(b, h, t, d))
+
+
+# ------------------------------------------------------------------ public op
+
+# custom_vjp static args must be hashable: plans live in this registry and
+# cross the custom_vjp boundary as a compact digest key. Bounded FIFO (a
+# training run cycles a handful of layouts; runaway layout generation must
+# not leak plans).
+_PLAN_CACHE = {}
+_PLAN_CACHE_MAX = 32
+
+
+def _get_plan(layout_key, layout=None, fine_block=None, tile=None):
+    if layout_key not in _PLAN_CACHE:
+        if layout is None:
+            raise KeyError(
+                f"block-sparse plan {layout_key!r} evicted — rebuild via "
+                f"sparse_attention_pallas")
+        while len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
+            _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))
+        _PLAN_CACHE[layout_key] = (
+            build_plan(layout, fine_block, tile),
+            jnp.asarray(pack_fine_windows(layout, tile, fine_block)))
+    return _PLAN_CACHE[layout_key]
+
+
+def default_tile(t: int, fine_block: int) -> int:
+    for cand in (256, 128):
+        if (t % cand == 0 and cand % fine_block == 0 and cand <= t and
+                cand // fine_block <= _FINE_PAD[0]):
+            return cand
+    return fine_block if fine_block >= 128 else 0
+
+
+def supported(q, layout, fine_block: int, tile: int = 0) -> bool:
+    t, d = q.shape[-2], q.shape[-1]
+    tile = tile or default_tile(t, fine_block)
+    if tile < 128:                 # sub-lane tiles can't feed the MXU
+        return False
+    if tile // fine_block > _FINE_PAD[0]:   # fine window must fit (8, 128)
+        return False
+    nb = np.asarray(layout).shape[-1]
+    return (q.ndim == 4 and t % tile == 0 and d % 8 == 0 and d <= 256 and
+            nb * fine_block == t)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def block_sparse_attention(q, k, v, fine_i8, layout_key, scale,
+                           interpret=False):
+    out, _ = _bsa_fwd(q, k, v, fine_i8, layout_key, scale, interpret)
+    return out
+
+
+def _bsa_fwd(q, k, v, fine_i8, layout_key, scale, interpret):
+    plan, _ = _get_plan(layout_key)
+    out, lse = _fwd(q, k, v, plan, fine_i8, scale, interpret)
+    return out, (q, k, v, fine_i8, out, lse)
+
+
+def _bsa_bwd(layout_key, scale, interpret, res, g):
+    plan, _ = _get_plan(layout_key)
+    q, k, v, fine_i8, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, out, lse, g, plan, fine_i8, scale, interpret)
+    return dq, dk, dv, None
+
+
+block_sparse_attention.defvjp(_bsa_fwd, _bsa_bwd)
+
+
+def sparse_attention_pallas(q, k, v, layout, fine_block: int,
+                            softmax_scale=None, tile: int = 0,
+                            interpret: bool = False):
+    """Block-skipping sparse attention behind the SparsityConfig layout
+    contract. q/k/v: [B, H, T, D]; layout: [H, nb, nb] bool numpy."""
+    t, d = q.shape[-2], q.shape[-1]
+    tile = tile or default_tile(t, fine_block)
+    if not supported(q, layout, fine_block, tile):
+        raise ValueError(
+            f"unsupported shapes for the pallas block-sparse kernel: "
+            f"t={t} d={d} tile={tile} fine_block={fine_block}")
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    import hashlib
+    layout_np = np.asarray(layout, bool)
+    key = (hashlib.sha1(layout_np.tobytes()).hexdigest(),
+           layout_np.shape, fine_block, tile)
+    _, fine_win = _get_plan(key, layout_np, fine_block, tile)
+    return block_sparse_attention(q, k, v, fine_win, key, scale, interpret)

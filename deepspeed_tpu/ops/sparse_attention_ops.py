@@ -215,8 +215,38 @@ def layout_to_mask(layout, block):
     return np.repeat(np.repeat(layout, block, axis=1), block, axis=2)
 
 
-def sparse_attention(q, k, v, layout, block, softmax_scale=None):
-    """Block-sparse attention. q/k/v: [B, H, T, D]; layout [H, nb, nb]."""
+def sparse_attention(q, k, v, layout, block, softmax_scale=None,
+                     impl="auto"):
+    """Block-sparse attention. q/k/v: [B, H, T, D]; layout [H, nb, nb].
+
+    impl: 'auto' (Pallas block-skipping kernel on TPU when shapes fit,
+    dense-masked XLA otherwise), 'pallas', or 'dense'. The Pallas path is
+    the FLOP-skipping counterpart of the reference Triton SDD/DSD kernels
+    (reference ops/sparse_attention/matmul.py:17)."""
+    if impl in ("auto", "pallas"):
+        from .pallas.block_sparse_attention import (
+            sparse_attention_pallas, supported)
+        ok = supported(q, layout, block)
+        if impl == "pallas":
+            if not ok:
+                raise ValueError(
+                    f"impl='pallas' requested but shapes are unsupported "
+                    f"(T={q.shape[-2]}, D={q.shape[-1]}, "
+                    f"fine_block={block}) — use impl='auto' for the "
+                    f"dense-masked fallback")
+            return sparse_attention_pallas(
+                q, k, v, layout, block, softmax_scale=softmax_scale)
+        on_tpu = jax.devices()[0].platform == "tpu"
+        if ok and on_tpu:
+            try:
+                return sparse_attention_pallas(
+                    q, k, v, layout, block, softmax_scale=softmax_scale)
+            except Exception as exc:  # noqa: BLE001
+                import warnings
+                warnings.warn(
+                    f"pallas block-sparse kernel failed "
+                    f"({type(exc).__name__}: {exc}); falling back to the "
+                    f"dense-masked path", RuntimeWarning)
     from .flash_attention import reference_attention
     mask = jnp.asarray(layout_to_mask(layout, block))[None]  # [1,H,T,T]
     return reference_attention(q, k, v, causal=False, mask=mask,

@@ -1,0 +1,412 @@
+"""Diffusion family parity: the NHWC JAX UNet/VAE vs a torch oracle built
+from torch.nn primitives with diffusers module naming (and, when the
+``diffusers`` package is installed, the real UNet2DConditionModel /
+AutoencoderKL). Reference surface:
+module_inject/containers/unet.py, vae.py."""
+
+import math
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from deepspeed_tpu.models.diffusion import (  # noqa: E402
+    AutoencoderKLConfig, AutoencoderKLSpec, UNet2DConditionConfig,
+    UNet2DConditionSpec, convert_state_dict, timestep_embedding)
+
+CH = (32, 64)
+LAYERS = 2
+XDIM = 32
+HEAD = 8
+G = 8
+
+
+# ----------------------------------------------------------- torch oracle
+
+class TResnet(nn.Module):
+    def __init__(self, cin, cout, temb_dim=None, eps=1e-5):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(G, cin, eps=eps)
+        self.conv1 = nn.Conv2d(cin, cout, 3, padding=1)
+        if temb_dim:
+            self.time_emb_proj = nn.Linear(temb_dim, cout)
+        self.norm2 = nn.GroupNorm(G, cout, eps=eps)
+        self.conv2 = nn.Conv2d(cout, cout, 3, padding=1)
+        if cin != cout:
+            self.conv_shortcut = nn.Conv2d(cin, cout, 1)
+
+    def forward(self, x, temb=None):
+        h = self.conv1(torch.nn.functional.silu(self.norm1(x)))
+        if temb is not None and hasattr(self, "time_emb_proj"):
+            h = h + self.time_emb_proj(
+                torch.nn.functional.silu(temb))[:, :, None, None]
+        h = self.conv2(torch.nn.functional.silu(self.norm2(h)))
+        if hasattr(self, "conv_shortcut"):
+            x = self.conv_shortcut(x)
+        return h + x
+
+
+class TAttn(nn.Module):
+    def __init__(self, c, ctx_dim):
+        super().__init__()
+        self.to_q = nn.Linear(c, c, bias=False)
+        self.to_k = nn.Linear(ctx_dim, c, bias=False)
+        self.to_v = nn.Linear(ctx_dim, c, bias=False)
+        self.to_out = nn.ModuleList([nn.Linear(c, c)])
+        self.heads = HEAD   # diffusers semantics: head COUNT
+
+    def forward(self, x, ctx):
+        b, t, c = x.shape
+        tk = ctx.shape[1]
+        q = self.to_q(x).view(b, t, self.heads, -1).transpose(1, 2)
+        k = self.to_k(ctx).view(b, tk, self.heads, -1).transpose(1, 2)
+        v = self.to_v(ctx).view(b, tk, self.heads, -1).transpose(1, 2)
+        hd = c // self.heads
+        s = (q.float() @ k.float().transpose(-1, -2)) * (hd ** -0.5)
+        p = s.softmax(-1)
+        o = (p @ v.float()).transpose(1, 2).reshape(b, t, c)
+        return self.to_out[0](o)
+
+
+class TBasicBlock(nn.Module):
+    def __init__(self, c, ctx_dim):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(c)
+        self.attn1 = TAttn(c, c)
+        self.norm2 = nn.LayerNorm(c)
+        self.attn2 = TAttn(c, ctx_dim)
+        self.norm3 = nn.LayerNorm(c)
+        self.ff = nn.ModuleList()  # named net.0.proj / net.2 via Sequential
+
+        class GEGLUProj(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.proj = nn.Linear(c, 8 * c)
+
+            def forward(self, y):
+                y, gate = self.proj(y).chunk(2, dim=-1)
+                return y * torch.nn.functional.gelu(gate.float()).to(y.dtype)
+
+        self.ff = nn.Sequential(GEGLUProj(), nn.Identity(),
+                                nn.Linear(4 * c, c))
+        # rename to diffusers' ff.net.* layout
+        self.ff = nn.ModuleDict({"net": self.ff})
+
+    def forward(self, x, ctx):
+        x = x + self.attn1(self.norm1(x), self.norm1(x))
+        x = x + self.attn2(self.norm2(x), ctx)
+        x = x + self.ff["net"][2](self.ff["net"][0](self.norm3(x)))
+        return x
+
+
+class TTransformer2D(nn.Module):
+    def __init__(self, c, ctx_dim):
+        super().__init__()
+        self.norm = nn.GroupNorm(G, c, eps=1e-6)
+        self.proj_in = nn.Linear(c, c)
+        self.transformer_blocks = nn.ModuleList([TBasicBlock(c, ctx_dim)])
+        self.proj_out = nn.Linear(c, c)
+
+    def forward(self, x, ctx):
+        b, c, hh, ww = x.shape
+        res = x
+        h = self.norm(x).permute(0, 2, 3, 1).reshape(b, hh * ww, c)
+        h = self.proj_in(h)
+        h = self.transformer_blocks[0](h, ctx)
+        h = self.proj_out(h)
+        return h.reshape(b, hh, ww, c).permute(0, 3, 1, 2) + res
+
+
+class TDown(nn.Module):
+    def __init__(self, cin, cout, temb, attn, ctx_dim, down):
+        super().__init__()
+        self.resnets = nn.ModuleList(
+            [TResnet(cin if i == 0 else cout, cout, temb)
+             for i in range(LAYERS)])
+        if attn:
+            self.attentions = nn.ModuleList(
+                [TTransformer2D(cout, ctx_dim) for _ in range(LAYERS)])
+        if down:
+            self.downsamplers = nn.ModuleList(
+                [nn.ModuleDict({"conv": nn.Conv2d(cout, cout, 3, stride=2,
+                                                  padding=1)})])
+
+
+class TUp(nn.Module):
+    def __init__(self, cin_skip, cout, prev, temb, attn, ctx_dim, up):
+        super().__init__()
+        self.resnets = nn.ModuleList()
+        for i in range(LAYERS + 1):
+            rin = (prev if i == 0 else cout) + cin_skip[i]
+            self.resnets.append(TResnet(rin, cout, temb))
+        if attn:
+            self.attentions = nn.ModuleList(
+                [TTransformer2D(cout, ctx_dim) for _ in range(LAYERS + 1)])
+        if up:
+            self.upsamplers = nn.ModuleList(
+                [nn.ModuleDict({"conv": nn.Conv2d(cout, cout, 3,
+                                                  padding=1)})])
+
+
+class TUNet(nn.Module):
+    """torch oracle with diffusers state_dict naming + forward order."""
+
+    def __init__(self):
+        super().__init__()
+        temb_dim = CH[0] * 4
+        self.conv_in = nn.Conv2d(4, CH[0], 3, padding=1)
+        self.time_embedding = nn.ModuleDict({
+            "linear_1": nn.Linear(CH[0], temb_dim),
+            "linear_2": nn.Linear(temb_dim, temb_dim)})
+        self.down_blocks = nn.ModuleList([
+            TDown(CH[0], CH[0], temb_dim, attn=True, ctx_dim=XDIM,
+                  down=True),
+            TDown(CH[0], CH[1], temb_dim, attn=False, ctx_dim=XDIM,
+                  down=False)])
+        self.mid_block = nn.ModuleDict({
+            "resnets": nn.ModuleList([TResnet(CH[1], CH[1], temb_dim),
+                                      TResnet(CH[1], CH[1], temb_dim)]),
+            "attentions": nn.ModuleList([TTransformer2D(CH[1], XDIM)])})
+        # up blocks consume skips in reverse
+        self.up_blocks = nn.ModuleList([
+            TUp([CH[1], CH[1], CH[0]], CH[1], CH[1], temb_dim, attn=False,
+                ctx_dim=XDIM, up=True),
+            TUp([CH[0], CH[0], CH[0]], CH[0], CH[1], temb_dim, attn=True,
+                ctx_dim=XDIM, up=False)])
+        self.conv_norm_out = nn.GroupNorm(G, CH[0])
+        self.conv_out = nn.Conv2d(CH[0], 4, 3, padding=1)
+
+    def forward(self, sample, t, ctx):
+        temb = torch.from_numpy(np.asarray(
+            timestep_embedding(jnp.asarray(t.numpy()), CH[0])))
+        temb = self.time_embedding["linear_2"](
+            torch.nn.functional.silu(self.time_embedding["linear_1"](temb)))
+        x = self.conv_in(sample)
+        skips = [x]
+        for bi, blk in enumerate(self.down_blocks):
+            for li, rn in enumerate(blk.resnets):
+                x = rn(x, temb)
+                if hasattr(blk, "attentions"):
+                    x = blk.attentions[li](x, ctx)
+                skips.append(x)
+            if hasattr(blk, "downsamplers"):
+                x = blk.downsamplers[0]["conv"](x)
+                skips.append(x)
+        x = self.mid_block["resnets"][0](x, temb)
+        x = self.mid_block["attentions"][0](x, ctx)
+        x = self.mid_block["resnets"][1](x, temb)
+        for ui, blk in enumerate(self.up_blocks):
+            for li, rn in enumerate(blk.resnets):
+                x = torch.cat([x, skips.pop()], dim=1)
+                x = rn(x, temb)
+                if hasattr(blk, "attentions"):
+                    x = blk.attentions[li](x, ctx)
+            if hasattr(blk, "upsamplers"):
+                x = torch.nn.functional.interpolate(x, scale_factor=2,
+                                                    mode="nearest")
+                x = blk.upsamplers[0]["conv"](x)
+        x = self.conv_norm_out(x)
+        return self.conv_out(torch.nn.functional.silu(x))
+
+
+def test_unet_matches_torch_oracle():
+    torch.manual_seed(0)
+    tm = TUNet().eval()
+    cfg = UNet2DConditionConfig(block_out_channels=CH,
+                                layers_per_block=LAYERS,
+                                cross_attention_dim=XDIM,
+                                attention_head_dim=(HEAD,), norm_num_groups=G)
+    spec = UNet2DConditionSpec(cfg)
+    params = convert_state_dict(tm.state_dict())
+    rng = np.random.default_rng(0)
+    sample = rng.standard_normal((2, 4, 16, 16)).astype(np.float32)
+    t = np.asarray([3.0, 77.0], np.float32)
+    ctx = rng.standard_normal((2, 5, XDIM)).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(sample), torch.from_numpy(t),
+                  torch.from_numpy(ctx)).numpy()
+    got = spec.apply(params, jnp.asarray(sample.transpose(0, 2, 3, 1)),
+                     jnp.asarray(t), jnp.asarray(ctx))
+    np.testing.assert_allclose(np.asarray(got).transpose(0, 3, 1, 2), want,
+                               atol=2e-4, rtol=2e-4)
+
+
+# ----------------------------------------------------------------- VAE oracle
+
+class TVAEAttn(nn.Module):
+    def __init__(self, c):
+        super().__init__()
+        self.group_norm = nn.GroupNorm(G, c, eps=1e-6)
+        self.to_q = nn.Linear(c, c)
+        self.to_k = nn.Linear(c, c)
+        self.to_v = nn.Linear(c, c)
+        self.to_out = nn.ModuleList([nn.Linear(c, c)])
+
+    def forward(self, x):
+        b, c, hh, ww = x.shape
+        h = self.group_norm(x).permute(0, 2, 3, 1).reshape(b, hh * ww, c)
+        q, k, v = self.to_q(h), self.to_k(h), self.to_v(h)
+        s = (q.float() @ k.float().transpose(-1, -2)) * (c ** -0.5)
+        o = s.softmax(-1) @ v.float()
+        o = self.to_out[0](o.to(h.dtype))
+        return x + o.reshape(b, hh, ww, c).permute(0, 3, 1, 2)
+
+
+class TVAE(nn.Module):
+    def __init__(self):
+        super().__init__()
+        lat = 4
+        enc = nn.Module()
+        enc.conv_in = nn.Conv2d(3, CH[0], 3, padding=1)
+        enc.down_blocks = nn.ModuleList()
+        for bi in range(2):
+            blk = nn.Module()
+            cin = CH[max(0, bi - 1)] if bi else CH[0]
+            blk.resnets = nn.ModuleList(
+                [TResnet(CH[bi - 1] if bi and i == 0 else CH[bi], CH[bi],
+                         None, eps=1e-6) for i in range(1)])
+            if bi != 1:
+                blk.downsamplers = nn.ModuleList([nn.ModuleDict(
+                    {"conv": nn.Conv2d(CH[bi], CH[bi], 3, stride=2)})])
+            enc.down_blocks.append(blk)
+        enc.mid_block = nn.ModuleDict({
+            "resnets": nn.ModuleList([TResnet(CH[1], CH[1], None, eps=1e-6),
+                                      TResnet(CH[1], CH[1], None,
+                                              eps=1e-6)]),
+            "attentions": nn.ModuleList([TVAEAttn(CH[1])])})
+        enc.conv_norm_out = nn.GroupNorm(G, CH[1], eps=1e-6)
+        enc.conv_out = nn.Conv2d(CH[1], 2 * lat, 3, padding=1)
+        self.encoder = enc
+        self.quant_conv = nn.Conv2d(2 * lat, 2 * lat, 1)
+        self.post_quant_conv = nn.Conv2d(lat, lat, 1)
+        dec = nn.Module()
+        dec.conv_in = nn.Conv2d(lat, CH[1], 3, padding=1)
+        dec.mid_block = nn.ModuleDict({
+            "resnets": nn.ModuleList([TResnet(CH[1], CH[1], None, eps=1e-6),
+                                      TResnet(CH[1], CH[1], None,
+                                              eps=1e-6)]),
+            "attentions": nn.ModuleList([TVAEAttn(CH[1])])})
+        dec.up_blocks = nn.ModuleList()
+        rev = list(reversed(CH))            # decoder runs wide -> narrow
+        for bi in range(2):
+            blk = nn.Module()
+            cin = rev[max(0, bi - 1)]
+            cout = rev[bi]
+            blk.resnets = nn.ModuleList(
+                [TResnet(cin if i == 0 else cout, cout, None, eps=1e-6)
+                 for i in range(2)])
+            if bi != 1:
+                blk.upsamplers = nn.ModuleList([nn.ModuleDict(
+                    {"conv": nn.Conv2d(cout, cout, 3, padding=1)})])
+            dec.up_blocks.append(blk)
+        dec.conv_norm_out = nn.GroupNorm(G, CH[0], eps=1e-6)
+        dec.conv_out = nn.Conv2d(CH[0], 3, 3, padding=1)
+        self.decoder = dec
+
+    def encode(self, x):
+        e = self.encoder
+        x = e.conv_in(x)
+        for bi, blk in enumerate(e.down_blocks):
+            for rn in blk.resnets:
+                x = rn(x)
+            if hasattr(blk, "downsamplers"):
+                x = torch.nn.functional.pad(x, (0, 1, 0, 1))
+                x = blk.downsamplers[0]["conv"](x)
+        x = e.mid_block["resnets"][0](x)
+        x = e.mid_block["attentions"][0](x)
+        x = e.mid_block["resnets"][1](x)
+        x = e.conv_out(torch.nn.functional.silu(e.conv_norm_out(x)))
+        return self.quant_conv(x).chunk(2, dim=1)
+
+    def decode(self, z):
+        d = self.decoder
+        x = d.conv_in(self.post_quant_conv(z))
+        x = d.mid_block["resnets"][0](x)
+        x = d.mid_block["attentions"][0](x)
+        x = d.mid_block["resnets"][1](x)
+        for blk in d.up_blocks:
+            for rn in blk.resnets:
+                x = rn(x)
+            if hasattr(blk, "upsamplers"):
+                x = torch.nn.functional.interpolate(x, scale_factor=2,
+                                                    mode="nearest")
+                x = blk.upsamplers[0]["conv"](x)
+        return d.conv_out(torch.nn.functional.silu(d.conv_norm_out(x)))
+
+
+def test_vae_matches_torch_oracle():
+    torch.manual_seed(1)
+    tm = TVAE().eval()
+    cfg = AutoencoderKLConfig(block_out_channels=CH, layers_per_block=1,
+                              norm_num_groups=G)
+    spec = AutoencoderKLSpec(cfg)
+    params = convert_state_dict(tm.state_dict())
+    rng = np.random.default_rng(1)
+    img = rng.standard_normal((2, 3, 16, 16)).astype(np.float32)
+    with torch.no_grad():
+        mean_t, logvar_t = tm.encode(torch.from_numpy(img))
+        dec_t = tm.decode(mean_t).numpy()
+    mean, logvar = spec.encode(params, jnp.asarray(img.transpose(0, 2, 3, 1)))
+    np.testing.assert_allclose(np.asarray(mean).transpose(0, 3, 1, 2),
+                               mean_t.numpy(), atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(logvar).transpose(0, 3, 1, 2),
+                               logvar_t.numpy(), atol=2e-4, rtol=2e-4)
+    dec = spec.decode(params, mean)
+    np.testing.assert_allclose(np.asarray(dec).transpose(0, 3, 1, 2), dec_t,
+                               atol=3e-4, rtol=3e-4)
+
+
+def test_injection_policy_resolves():
+    """policy_for dispatches by class NAME — a duck-typed stand-in with
+    diffusers' class name and config/state_dict surface must inject."""
+    from deepspeed_tpu.module_inject.policy import policy_for
+
+    torch.manual_seed(2)
+    oracle = TVAE().eval()
+
+    class AutoencoderKL(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.config = {"in_channels": 3, "out_channels": 3,
+                           "latent_channels": 4, "block_out_channels": CH,
+                           "layers_per_block": 1, "norm_num_groups": G}
+
+        def state_dict(self):
+            return oracle.state_dict()
+
+    model = AutoencoderKL()
+    spec, params = policy_for(model)(model)
+    rng = np.random.default_rng(2)
+    img = rng.standard_normal((1, 16, 16, 3)).astype(np.float32)
+    mean, logvar = spec.encode(params, jnp.asarray(img))
+    assert mean.shape == (1, 8, 8, 4)
+    assert np.isfinite(np.asarray(mean)).all()
+
+
+def test_real_diffusers_parity_if_installed():
+    diffusers = pytest.importorskip("diffusers")
+    unet = diffusers.UNet2DConditionModel(
+        sample_size=16, in_channels=4, out_channels=4,
+        block_out_channels=CH, layers_per_block=LAYERS,
+        cross_attention_dim=XDIM, attention_head_dim=HEAD,
+        norm_num_groups=G,
+        down_block_types=("CrossAttnDownBlock2D", "DownBlock2D"),
+        up_block_types=("UpBlock2D", "CrossAttnUpBlock2D")).eval()
+    from deepspeed_tpu.module_inject.policy import policy_for
+    spec, params = policy_for(unet)(unet)
+    rng = np.random.default_rng(3)
+    sample = rng.standard_normal((1, 4, 16, 16)).astype(np.float32)
+    t = np.asarray([5.0], np.float32)
+    ctx = rng.standard_normal((1, 7, XDIM)).astype(np.float32)
+    with torch.no_grad():
+        want = unet(torch.from_numpy(sample), torch.from_numpy(t),
+                    torch.from_numpy(ctx)).sample.numpy()
+    got = spec.apply(params, jnp.asarray(sample.transpose(0, 2, 3, 1)),
+                     jnp.asarray(t), jnp.asarray(ctx))
+    np.testing.assert_allclose(np.asarray(got).transpose(0, 3, 1, 2), want,
+                               atol=5e-4, rtol=5e-4)
