@@ -1,0 +1,71 @@
+"""Serving decode throughput: tokens/s across a batch sweep on one chip.
+Writes benchmarks/decode.json — the first decode-path number (VERDICT
+round-2 missing #10; reference anchor: the fused softmax_context decode
+kernels, csrc/transformer/inference/csrc/pt_binding.cpp:1747).
+
+Run on the real chip: python benchmarks/decode.py
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    import jax
+    from deepspeed_tpu.models.gpt2 import GPT2Model, GPT2_125M
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    prompt_len = int(os.environ.get("DEC_PROMPT", 128))
+    new_tokens = int(os.environ.get("DEC_NEW", 128))
+    cfg = dataclasses.replace(GPT2_125M, n_positions=1024)
+    model = GPT2Model(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    icfg = DeepSpeedInferenceConfig.from_dict(
+        {"dtype": "bfloat16", "max_tokens": prompt_len + new_tokens})
+    eng = InferenceEngine(model, icfg, params=params)
+    rng = np.random.default_rng(0)
+
+    results = {}
+    for b in (1, 8, 32):
+        prompt = rng.integers(0, 50256, (b, prompt_len)).astype(np.int32)
+        out = eng.generate(prompt, max_new_tokens=new_tokens)  # compile
+        np.asarray(out)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = eng.generate(prompt, max_new_tokens=new_tokens)
+            np.asarray(out)
+            best = min(best, time.perf_counter() - t0)
+        tok_s = b * new_tokens / best
+        results[f"batch_{b}"] = {
+            "decode_tokens_per_sec": round(tok_s, 1),
+            "ms_per_token_step": round(best / new_tokens * 1e3, 3),
+        }
+        print(b, results[f"batch_{b}"], flush=True)
+
+    report = {
+        "benchmark": "gpt2_125m_decode_throughput",
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "dtype": "bfloat16",
+        "results": results,
+        "note": ("whole-generate wall time (compiled prefill + scan "
+                 "decode) on one chip; each generate() is ONE dispatch "
+                 "through the axon tunnel, so the ~90 ms tunnel overhead "
+                 "amortizes over new_tokens steps"),
+    }
+    with open(os.path.join(REPO, "benchmarks", "decode.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

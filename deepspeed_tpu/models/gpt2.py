@@ -199,6 +199,22 @@ class GPT2Model(ModelSpec):
         ln1 = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_epsilon)
         qkv = ln1 @ p["qkv_w"].astype(ln1.dtype) + p["qkv_b"].astype(ln1.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
+        bias = None if attn_fn is not None else self._train_attn_bias(t)
+        dropping = train and cfg.dropout > 0 and rng is not None
+        if (attn_fn is None and bias is None and not dropping and
+                self.causal_attention and self._packed_attn_ok(t, hd, h)):
+            # packed [B, T, H*D] Pallas path: q/k/v stay in the layout the
+            # qkv matmul produced — no head transposes in fwd OR bwd, and
+            # no duplicate [B,H,T,D] residual save (round-3 profiling:
+            # ~5 ms/micro of relayout copies at 125M)
+            from ..ops.flash_attention import _on_tpu
+            from ..ops.pallas.flash_attention_packed import \
+                packed_flash_attention
+            attn = packed_flash_attention(q, k, v, h,
+                                          interpret=not _on_tpu())
+            attn = attn @ p["attn_proj_w"].astype(attn.dtype) + \
+                p["attn_proj_b"].astype(attn.dtype)
+            return x + self._dropout(attn, rng, train, 0)
         q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
@@ -206,16 +222,36 @@ class GPT2Model(ModelSpec):
             attn = attn_fn(q, k, v)
         else:
             drop_rng = None
-            if train and cfg.dropout > 0 and rng is not None:
+            if dropping:
                 drop_rng = jax.random.fold_in(rng, 3)
             attn = sp_attention(q, k, v, causal=self.causal_attention,
                                 dropout_rate=cfg.dropout if train else 0.0,
                                 dropout_rng=drop_rng, impl=cfg.sp_attention,
                                 backend=cfg.attn_backend,
-                                bias=self._train_attn_bias(t))
+                                bias=bias)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
         attn = attn @ p["attn_proj_w"].astype(attn.dtype) + p["attn_proj_b"].astype(attn.dtype)
         return x + self._dropout(attn, rng, train, 0)
+
+    def _packed_attn_ok(self, t: int, hd: int, h: int) -> bool:
+        """Packed-layout Pallas attention eligibility: TPU pallas backend,
+        no live 'seq' axis (sp uses the [B,H,T,D] kernels), and shapes the
+        packed kernel supports. Env override DSTPU_PACKED_ATTN=0 disables
+        (read at TRACE time — set it before the first compile; a cached
+        jitted step keeps whichever path it was traced with)."""
+        import os as _os
+        if _os.environ.get("DSTPU_PACKED_ATTN", "1") == "0":
+            return False
+        from ..ops.flash_attention import _on_tpu
+        from ..ops.pallas.flash_attention_packed import supported
+        from ..ops.seq_parallel import seq_axis_size
+        # auto engages on real TPU; backend 'pallas' also engages on CPU
+        # (interpret mode — the parity-test path)
+        if self.config.attn_backend == "pallas":
+            pass
+        elif self.config.attn_backend != "auto" or not _on_tpu():
+            return False
+        return seq_axis_size() == 1 and supported(t, hd, h, True, None)
 
     def _mlp_sublayer(self, x, p, rng, train):
         """ln2 → fc → gelu → proj → residual (+dropout). Returns (x, aux)."""

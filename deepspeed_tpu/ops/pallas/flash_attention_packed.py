@@ -1,0 +1,289 @@
+"""Packed-layout Pallas flash attention: q/k/v/o in [B, T, H*D].
+
+Round-3 profiling showed ~5 ms/micro of pure relayout copies in the 125M
+step: the model computes qkv as [B, T, 3HD] (lane-aligned, matmul-native)
+but the [B, H, T, D] kernel layout forces six head transposes (q/k/v fwd
++ mirrored bwd) and a duplicate save of the attention output. This kernel
+keeps the tensors in the layout the surrounding matmuls already produce:
+
+- arrays [B, T, H*D]; a grid step owns GH heads as a LANE SLICE of the
+  feature dim (GH*D = 128 lanes for D=64) — blocks stay (sublane, 128·k)
+  tiled, no relayout anywhere.
+- per-head dots are unrolled over the GH static lane slices ([BQ, D] 2D
+  matmuls — what Mosaic lowers batched dots to anyway).
+- lse is emitted [B, T, 128] f32 (head h in lane h; lanes >= H padded) so
+  its blocks satisfy the (8, 128) tiling floor.
+- backward fuses dq+dk+dv in one kernel (dq in f32 VMEM scratch across
+  the sequential k-tile grid dim), same structure as the [B,H,T,D]
+  fused backward in flash_attention.py.
+
+Reference counterpart: csrc/transformer softmax/attention kernels — but
+the DESIGN here is driven by Mosaic tiling (8, 128) rules, not the CUDA
+original. Parity oracle: ops/flash_attention.reference_attention
+(tests/unit/test_pallas_flash_packed.py, interpret mode).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def supported(t: int, d: int, n_head: int, causal: bool, window) -> bool:
+    if d > _LANES or _LANES % d or t % 128:
+        return False
+    gh = _LANES // d
+    if n_head % gh:
+        return False
+    if window is not None and (not causal or window <= 0):
+        return False
+    # resident K/V per gh-group must stay modest (long T uses the
+    # streamed [B,H,T,D] kernels instead)
+    return t * _LANES * 2 <= 2 * 1024 * 1024
+
+
+def _mask(s, q_off, k_off, bq, bk, window):
+    q_pos = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    keep = q_pos >= k_pos
+    if window is not None:
+        keep &= (q_pos - k_pos) < window
+    return jnp.where(keep, s, NEG_INF)
+
+
+# --------------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
+                bq, bk, t, gh, d, window):
+    q_off = pl.program_id(1) * bq
+    nk = pl.cdiv(q_off + bq, bk) if causal else t // bk
+    j0 = (jnp.maximum(q_off - window + 1, 0) // bk
+          if causal and window is not None else 0)
+    q = q_ref[0]                                   # [BQ, GH*D]
+
+    accs, ms, ls = [], [], []
+    for h in range(gh):
+        accs.append(jnp.zeros((bq, d), jnp.float32))
+        ms.append(jnp.full((bq, 1), NEG_INF, jnp.float32))
+        ls.append(jnp.zeros((bq, 1), jnp.float32))
+
+    def body(j, carry):
+        accs, ms, ls = carry
+        k_j = k_ref[0, pl.ds(j * bk, bk), :]       # [BK, GH*D]
+        v_j = v_ref[0, pl.ds(j * bk, bk), :]
+        new_accs, new_ms, new_ls = [], [], []
+        for h in range(gh):
+            qh = q[:, h * d:(h + 1) * d]
+            kh = k_j[:, h * d:(h + 1) * d]
+            vh = v_j[:, h * d:(h + 1) * d]
+            s = jnp.dot(qh, kh.T, preferred_element_type=jnp.float32) * scale
+            if causal:
+                s = _mask(s, q_off, j * bk, bq, bk, window)
+            m_new = jnp.maximum(ms[h], jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(ms[h] - m_new)
+            p = jnp.exp(s - m_new)
+            new_ls.append(ls[h] * alpha + jnp.sum(p, axis=-1, keepdims=True))
+            new_accs.append(accs[h] * alpha + jnp.dot(
+                p.astype(vh.dtype), vh, preferred_element_type=jnp.float32))
+            new_ms.append(m_new)
+        return new_accs, new_ms, new_ls
+
+    accs, ms, ls = lax.fori_loop(j0, nk, body, (accs, ms, ls))
+    outs, lses = [], []
+    for h in range(gh):
+        l = jnp.maximum(ls[h], 1e-30)
+        outs.append((accs[h] / l).astype(o_ref.dtype))
+        lses.append(ms[h] + jnp.log(l))
+    o_ref[0] = jnp.concatenate(outs, axis=-1)
+    lse_ref[0] = jnp.concatenate(
+        lses + [jnp.zeros((bq, _LANES - gh), jnp.float32)], axis=-1)
+
+
+def _fwd(q, k, v, n_head, causal, scale, bq, bk, interpret, window):
+    b, t, hd_total = q.shape
+    d = hd_total // n_head
+    gh = _LANES // d
+    ng = n_head // gh
+    grid = (b * ng, t // bq)
+
+    feat = pl.BlockSpec((1, bq, _LANES),
+                        lambda n, i, ng=ng: (n // ng, i, n % ng))
+    full = pl.BlockSpec((1, t, _LANES),
+                        lambda n, i, ng=ng: (n // ng, 0, n % ng))
+    lse_spec = pl.BlockSpec((1, bq, _LANES),
+                            lambda n, i, ng=ng: (n // ng, i, n % ng))
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, causal=causal, scale=scale, bq=bq,
+                          bk=bk, t=t, gh=gh, d=d, window=window),
+        grid=grid,
+        in_specs=[feat, full, full],
+        out_specs=[feat, lse_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, t, hd_total), q.dtype),
+                   jax.ShapeDtypeStruct((b, t, ng * _LANES), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=int(4 * b * n_head * t * t * d // (2 if causal else 1)),
+            bytes_accessed=4 * b * t * hd_total * q.dtype.itemsize,
+            transcendentals=b * n_head * t * t // (2 if causal else 1)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# -------------------------------------------------------------------- backward
+
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dq_ref, dk_ref, dv_ref, dq_acc_ref, *, causal, scale,
+                bq, bk, t, gh, d, window):
+    j = pl.program_id(1)
+    nk = t // bk
+    k_off = j * bk
+
+    @pl.when(j == 0)
+    def init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    nq = t // bq
+    start = k_off // bq if causal else 0
+    if causal and window is not None:
+        nq = jnp.minimum(nq, pl.cdiv(k_off + bk + window - 1, bq))
+    k_blk = k_ref[0, pl.ds(k_off, bk), :]          # [BK, GH*D]
+    v_blk = v_ref[0, pl.ds(k_off, bk), :]
+
+    def body(i, carry):
+        dks, dvs = carry
+        q_i = q_ref[0, pl.ds(i * bq, bq), :]
+        do_i = do_ref[0, pl.ds(i * bq, bq), :]
+        lse_i = lse_ref[0, pl.ds(i * bq, bq), :]
+        delta_i = delta_ref[0, pl.ds(i * bq, bq), :]
+        new_dks, new_dvs = [], []
+        dq_upds = []
+        for h in range(gh):
+            qh = q_i[:, h * d:(h + 1) * d]
+            kh = k_blk[:, h * d:(h + 1) * d]
+            vh = v_blk[:, h * d:(h + 1) * d]
+            doh = do_i[:, h * d:(h + 1) * d]
+            s = jnp.dot(qh, kh.T, preferred_element_type=jnp.float32) * scale
+            if causal:
+                s = _mask(s, i * bq, k_off, bq, bk, window)
+            p = jnp.exp(s - lse_i[:, h:h + 1])
+            new_dvs.append(dvs[h] + jnp.dot(
+                p.astype(doh.dtype).T, doh,
+                preferred_element_type=jnp.float32))
+            dp = jnp.dot(doh, vh.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta_i[:, h:h + 1]) * scale
+            ds_lp = ds.astype(qh.dtype)
+            new_dks.append(dks[h] + jnp.dot(
+                ds_lp.T, qh, preferred_element_type=jnp.float32))
+            dq_upds.append(jnp.dot(ds_lp, kh,
+                                   preferred_element_type=jnp.float32))
+        dq_acc_ref[pl.ds(i * bq, bq), :] += jnp.concatenate(dq_upds, -1)
+        return new_dks, new_dvs
+
+    dk0 = [jnp.zeros((bk, d), jnp.float32) for _ in range(gh)]
+    dv0 = [jnp.zeros((bk, d), jnp.float32) for _ in range(gh)]
+    dks, dvs = lax.fori_loop(start, nq, body, (dk0, dv0))
+    dk_ref[0, pl.ds(k_off, bk), :] = jnp.concatenate(
+        dks, -1).astype(dk_ref.dtype)
+    dv_ref[0, pl.ds(k_off, bk), :] = jnp.concatenate(
+        dvs, -1).astype(dv_ref.dtype)
+
+    @pl.when(j == nk - 1)
+    def flush():
+        dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, n_head, causal, scale, bq, bk, interpret,
+         window):
+    b, t, hd_total = q.shape
+    d = hd_total // n_head
+    gh = _LANES // d
+    ng = n_head // gh
+    # delta per head: rowsum over that head's lanes of do*o, packed like lse
+    prod = (do.astype(jnp.float32) * o.astype(jnp.float32)).reshape(
+        b, t, n_head, d)
+    delta = prod.sum(-1)                              # [B, T, H]
+    # interleave per group: group g's lanes [g*128 : g*128+gh] hold its heads
+    delta_groups = [jnp.concatenate(
+        [delta[:, :, g * gh:(g + 1) * gh],
+         jnp.zeros((b, t, _LANES - gh), jnp.float32)], -1)
+        for g in range(ng)]
+    delta_packed = jnp.concatenate(delta_groups, -1)  # [B, T, ng*128]
+
+    full = pl.BlockSpec((1, t, _LANES),
+                        lambda n, j, ng=ng: (n // ng, 0, n % ng))
+    out_full = pl.BlockSpec((1, t, _LANES),
+                            lambda n, j, ng=ng: (n // ng, 0, n % ng))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, causal=causal, scale=scale, bq=bq,
+                          bk=bk, t=t, gh=gh, d=d, window=window),
+        grid=(b * ng, t // bk),
+        in_specs=[full, full, full, full, full, full],
+        out_specs=[out_full, out_full, out_full],
+        out_shape=[jax.ShapeDtypeStruct((b, t, hd_total), q.dtype),
+                   jax.ShapeDtypeStruct((b, t, hd_total), k.dtype),
+                   jax.ShapeDtypeStruct((b, t, hd_total), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((t, _LANES), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=int(10 * b * n_head * t * t * d // (2 if causal else 1)),
+            bytes_accessed=7 * b * t * hd_total * q.dtype.itemsize,
+            transcendentals=2 * b * n_head * t * t // (2 if causal else 1)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta_packed)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------ public op
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def packed_flash_attention(q, k, v, n_head, causal=True, softmax_scale=None,
+                           window=None, interpret=False, block=(512, 512)):
+    """Flash attention over packed [B, T, H*D] tensors. Returns the
+    attention output in the SAME packed layout."""
+    out, _ = _pf_fwd(q, k, v, n_head, causal, softmax_scale, window,
+                     interpret, block)
+    return out
+
+
+def _resolve(q, n_head, softmax_scale, block):
+    t, hd_total = q.shape[-2], q.shape[-1]
+    d = hd_total // n_head
+    if t % 128:
+        raise ValueError(
+            f"packed flash attention requires seq length divisible by 128, "
+            f"got {t} (check supported() before calling)")
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    bq = next(bb for bb in (block[0], 256, 128) if t % bb == 0)
+    bk = next(bb for bb in (block[1], 256, 128) if t % bb == 0)
+    return scale, min(t, bq), min(t, bk)
+
+
+def _pf_fwd(q, k, v, n_head, causal, softmax_scale, window, interpret,
+            block):
+    scale, bq, bk = _resolve(q, n_head, softmax_scale, block)
+    out, lse = _fwd(q, k, v, n_head, causal, scale, bq, bk, interpret,
+                    window)
+    return out, (q, k, v, out, lse)
+
+
+def _pf_bwd(n_head, causal, softmax_scale, window, interpret, block,
+            res, g):
+    q, k, v, out, lse = res
+    # smaller blocks than forward: the per-head unrolled temporaries
+    # (s/p/dp/ds in f32) dominate the backward's VMEM stack
+    scale, bq, bk = _resolve(q, n_head, softmax_scale, (256, 256))
+    dq, dk, dv = _bwd(q, k, v, out, lse, g, n_head, causal, scale, bq, bk,
+                      interpret, window)
+    return dq, dk, dv
+
+
+packed_flash_attention.defvjp(_pf_fwd, _pf_bwd)

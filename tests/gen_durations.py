@@ -1,0 +1,44 @@
+"""Regenerate tests/.test_durations.json from a pytest --durations=0 log.
+
+Usage:
+    python -m pytest tests/ -q --durations=0 > /tmp/durations.log
+    python tests/gen_durations.py /tmp/durations.log [budget_seconds]
+
+Tests slower than the per-test budget (default 2.5 s) are listed as
+``slow``; the conftest marks everything else ``smoke``. The budget is
+chosen so the smoke tier stays under ~3 minutes on the 8-device CPU mesh.
+"""
+
+import json
+import os
+import re
+import sys
+
+
+def main(log_path, budget=2.5):
+    slow = []
+    total_fast = 0.0
+    n_fast = 0
+    with open(log_path) as f:
+        for line in f:
+            m = re.match(r"\s*([0-9.]+)s\s+call\s+(\S+)", line)
+            if not m:
+                continue
+            dur, nodeid = float(m.group(1)), m.group(2)
+            nodeid = nodeid.removeprefix("tests/")
+            if dur > budget:
+                slow.append(nodeid)
+            else:
+                total_fast += dur
+                n_fast += 1
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       ".test_durations.json")
+    with open(out, "w") as f:
+        json.dump({"budget_seconds": budget, "slow": sorted(slow)}, f,
+                  indent=1)
+    print(f"{len(slow)} slow tests; {n_fast} measured fast tests "
+          f"({total_fast:.0f}s total fast call time) -> {out}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], float(sys.argv[2]) if len(sys.argv) > 2 else 2.5)
