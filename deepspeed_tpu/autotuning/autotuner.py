@@ -52,11 +52,14 @@ class Autotuner:
     def __init__(self, model_factory: Callable[[], Any], base_config: Dict,
                  batch_factory: Callable[[int], Any] = None,
                  runner: Callable[[Dict], float] = None,
-                 results_dir: Optional[str] = None):
+                 results_dir: Optional[str] = None,
+                 model_shape=None):
         """model_factory: () -> fresh ModelSpec per trial.
         batch_factory: (micro_bs_global) -> one [gas, B, ...] batch.
         runner: override trial execution (tests); default builds a real
-        engine and measures."""
+        engine and measures.
+        model_shape: cost_model.ModelShape for the model-based tuner's
+        analytic prior (pre-prunes OOM configs, ranks the rest)."""
         self.model_factory = model_factory
         self.base_config = dict(base_config)
         at = dict(self.base_config.get("autotuning", {}))
@@ -68,6 +71,10 @@ class Autotuner:
         self.profile_steps = max(
             1, int(at.get("end_profile_step", 5)) - self.warmup_steps)
         self.max_trials = int(at.get("max_trials", 50))
+        # reference autotuner.py tuner_type: gridsearch | random | model
+        self.tuner_type = at.get("tuner_type", "gridsearch")
+        self.hbm_budget = float(at.get("hbm_budget_gb", 15.75)) * 1e9
+        self.model_shape = model_shape
         self.results_dir = results_dir or at.get("results_dir")
         self.batch_factory = batch_factory
         self.runner = runner or self._run_trial
@@ -117,39 +124,64 @@ class Autotuner:
 
     # -- search ----------------------------------------------------------
     def tune(self) -> Dict:
-        """Sweep (stage × micro batch); prune larger micros after an
-        infeasible one per stage; return the best full config."""
+        """Run trials in the order the configured tuner proposes
+        (gridsearch | random | model — reference autotuning/tuner/);
+        failed/OOM trials prune larger micros at the same stage; return
+        the best full config."""
+        from .tuner import make_tuner
+
+        candidates = [(m, s) for s in self.zero_stages
+                      for m in sorted(self.micro_batches)]
+        # the memory prior must see the REAL dp degree (ZeRO shards state
+        # across it) and the offload/remat knobs of the base config
+        try:
+            from ..parallel.topology import get_mesh_manager
+            dp = get_mesh_manager().dp * get_mesh_manager().ep
+        except Exception:  # noqa: BLE001 — no mesh yet: single device
+            dp = 1
+        zo = self.base_config.get("zero_optimization", {}) or {}
+        offload = bool((zo.get("offload_optimizer") or {}).get("device"))
+        tuner = make_tuner(self.tuner_type, candidates,
+                           shape=self.model_shape,
+                           hbm_budget_bytes=self.hbm_budget,
+                           dp=dp, offload_optimizer=offload,
+                           remat=bool(self.base_config.get(
+                               "autotuning", {}).get("remat", False)))
+        if getattr(tuner, "pruned", None):
+            log_dist(f"autotuning: cost model pre-pruned "
+                     f"{len(tuner.pruned)} over-HBM configs: "
+                     f"{tuner.pruned}", ranks=[0])
         best: Optional[Experiment] = None
         trials = 0
-        for stage in self.zero_stages:
-            infeasible_floor = None
-            for micro in sorted(self.micro_batches):
-                if trials >= self.max_trials:
-                    break
-                if infeasible_floor is not None and micro >= infeasible_floor:
-                    continue
-                cfg = self._trial_config(micro, stage)
-                exp = Experiment(cfg)
-                trials += 1
-                try:
-                    exp.metric_val = float(self.runner(cfg))
-                except (MemoryError, RuntimeError, ValueError) as e:
-                    msg = str(e)
-                    exp.error = msg[:500]
-                    if "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower():
-                        infeasible_floor = micro  # prune larger micros
-                    logger.warning(
-                        f"autotuning trial stage={stage} micro={micro} "
-                        f"failed: {msg[:120]}")
-                self.experiments.append(exp)
-                if exp.feasible and (best is None or
-                                     exp.metric_val > best.metric_val):
-                    best = exp
-                log_dist(
-                    f"autotuning: stage={stage} micro={micro} "
-                    f"{self.metric}="
-                    f"{exp.metric_val if exp.feasible else 'FAIL'}",
-                    ranks=[0])
+        while trials < self.max_trials:
+            cand = tuner.next()
+            if cand is None:
+                break
+            micro, stage = cand
+            cfg = self._trial_config(micro, stage)
+            exp = Experiment(cfg)
+            trials += 1
+            oom = False
+            try:
+                exp.metric_val = float(self.runner(cfg))
+            except (MemoryError, RuntimeError, ValueError) as e:
+                msg = str(e)
+                exp.error = msg[:500]
+                oom = ("RESOURCE_EXHAUSTED" in msg or
+                       "memory" in msg.lower())
+                logger.warning(
+                    f"autotuning trial stage={stage} micro={micro} "
+                    f"failed: {msg[:120]}")
+            tuner.update(cand, exp.metric_val, oom=oom)
+            self.experiments.append(exp)
+            if exp.feasible and (best is None or
+                                 exp.metric_val > best.metric_val):
+                best = exp
+            log_dist(
+                f"autotuning: stage={stage} micro={micro} "
+                f"{self.metric}="
+                f"{exp.metric_val if exp.feasible else 'FAIL'}",
+                ranks=[0])
         if best is None:
             raise RuntimeError("autotuning: every trial failed")
         if self.results_dir:

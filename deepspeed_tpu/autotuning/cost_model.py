@@ -1,0 +1,102 @@
+"""Analytic TPU cost model for autotuning.
+
+Capability match for the reference's model-based tuning stack
+(reference autotuning/tuner/cost_model.py — an XGBoost surrogate — and
+tuner/model_based_tuner.py): the surrogate here is TPU-first instead of
+learned-from-scratch — an analytic prior (HBM feasibility from the ZeRO
+stage's sharding math + an MXU-utilization throughput curve) plus an
+incremental least-squares correction fitted on the measured trials. The
+prior lets the tuner prune OOM configs WITHOUT running them (the
+reference burns launcher runs to discover OOM) and rank the rest before
+the first measurement.
+"""
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ModelShape:
+    """What the memory/throughput prior needs to know about the model."""
+    n_params: int
+    hidden: int
+    n_layer: int
+    seq_len: int
+    vocab: int = 50304
+
+
+def estimate_memory_bytes(shape: ModelShape, micro_bs: int, stage: int,
+                          dp: int = 1, offload_optimizer: bool = False,
+                          remat: bool = False,
+                          stash_bytes_per_token: Optional[float] = None
+                          ) -> int:
+    """Per-device HBM bytes for one train step under a ZeRO stage.
+
+    - bf16 params: sharded only at stage 3
+    - f32 master + Adam m/v (12 B/param): sharded from stage 1; absent
+      from the device when offloaded to host
+    - f32 grads: sharded from stage 2
+    - activation stash: measured ~55 B/token/layer/hidden-unit... the
+      calibrated constant below reproduces the 125M/1.3B measurements
+      (lean custom-VJP stash ≈ 170 B per token per layer per sqrt-ish
+      unit; we use bytes ≈ C * micro * seq * hidden * n_layer)
+    """
+    p = shape.n_params
+    params = 2 * p / (dp if stage >= 3 else 1)
+    opt = 0 if offload_optimizer else 12 * p / (dp if stage >= 1 else 1)
+    grads = 4 * p / (dp if stage >= 2 else 1)
+    c = stash_bytes_per_token if stash_bytes_per_token is not None else \
+        (12.0 if remat else 44.0)
+    acts = c * micro_bs * shape.seq_len * shape.hidden * shape.n_layer / 768
+    logits = 4 * micro_bs * shape.seq_len * shape.vocab  # loss workspace
+    return int(params + opt + grads + acts + logits)
+
+
+def predict_throughput(shape: ModelShape, micro_bs: int, stage: int,
+                       dp: int = 1, peak_flops: float = 197e12) -> float:
+    """Samples/sec prior: roofline * an MXU-utilization ramp in micro_bs
+    (small micros underfill the 128x128 systolic array / amortize fixed
+    overheads worse) * a small ZeRO-stage collective tax."""
+    flops_per_sample = 6 * shape.n_params * shape.seq_len + \
+        12 * shape.n_layer * shape.hidden * shape.seq_len ** 2
+    util = 0.55 * (1.0 - math.exp(-micro_bs / 4.0))
+    stage_tax = {0: 1.0, 1: 0.98, 2: 0.95, 3: 0.88}.get(stage, 0.9)
+    eff = peak_flops * util * stage_tax
+    return eff * dp / flops_per_sample
+
+
+class ResidualSurrogate:
+    """Least-squares correction on top of the analytic prior (the role of
+    the reference's XGBoost cost model, sized for tens of trials): fits
+    log(measured / prior) on simple features and re-ranks candidates."""
+
+    def __init__(self):
+        self._x: List[List[float]] = []
+        self._y: List[float] = []
+        self._w: Optional[np.ndarray] = None
+
+    @staticmethod
+    def _features(micro_bs: int, stage: int) -> List[float]:
+        return [1.0, math.log2(micro_bs), stage, stage * math.log2(micro_bs)]
+
+    def update(self, micro_bs: int, stage: int, measured: float,
+               prior: float):
+        if measured <= 0 or prior <= 0:
+            return
+        self._x.append(self._features(micro_bs, stage))
+        self._y.append(math.log(measured / prior))
+        if len(self._x) >= 3:
+            x = np.asarray(self._x)
+            y = np.asarray(self._y)
+            # ridge for stability at tiny sample counts
+            a = x.T @ x + 1e-3 * np.eye(x.shape[1])
+            self._w = np.linalg.solve(a, x.T @ y)
+
+    def predict(self, micro_bs: int, stage: int, prior: float) -> float:
+        if self._w is None:
+            return prior
+        corr = float(np.asarray(self._features(micro_bs, stage)) @ self._w)
+        return prior * math.exp(np.clip(corr, -3.0, 3.0))

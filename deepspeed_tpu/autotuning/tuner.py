@@ -1,0 +1,113 @@
+"""Candidate-selection strategies for the autotuner.
+
+Capability match for the reference tuner hierarchy (reference
+autotuning/tuner/base_tuner.py, index_based_tuner.py:GridSearchTuner/
+RandomTuner, model_based_tuner.py:ModelBasedTuner): each tuner owns the
+candidate ORDER under a trial budget; the Autotuner executes whatever
+they propose next. The model-based tuner uses the analytic TPU prior +
+measured-residual surrogate (cost_model.py) instead of the reference's
+XGBoost, and pre-prunes candidates whose memory estimate exceeds the HBM
+budget — those never cost a trial.
+"""
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+from .cost_model import (ModelShape, ResidualSurrogate,
+                         estimate_memory_bytes, predict_throughput)
+
+Candidate = Tuple[int, int]          # (micro_bs, zero_stage)
+
+
+class BaseTuner:
+    def __init__(self, candidates: List[Candidate]):
+        self.remaining = list(candidates)
+        self.measured: Dict[Candidate, Optional[float]] = {}
+
+    def next(self) -> Optional[Candidate]:
+        return self.remaining.pop(0) if self.remaining else None
+
+    def update(self, cand: Candidate, metric: Optional[float],
+               oom: bool = False):
+        """metric None = failed trial; oom=True additionally prunes
+        larger micros at the same stage (memory-monotonic)."""
+        self.measured[cand] = metric
+        if metric is None and oom:
+            micro, stage = cand
+            self.remaining = [c for c in self.remaining
+                              if not (c[1] == stage and c[0] >= micro)]
+
+
+class GridSearchTuner(BaseTuner):
+    """Exhaustive order (reference index_based_tuner.GridSearchTuner)."""
+
+
+class RandomTuner(BaseTuner):
+    """Shuffled order (reference index_based_tuner.RandomTuner)."""
+
+    def __init__(self, candidates: List[Candidate], seed: int = 0):
+        super().__init__(candidates)
+        random.Random(seed).shuffle(self.remaining)
+
+
+class ModelBasedTuner(BaseTuner):
+    """Prior-ranked exploration with online re-ranking (reference
+    model_based_tuner.ModelBasedTuner). Given a ModelShape:
+    1. drop candidates whose analytic memory estimate exceeds the HBM
+       budget (no trial wasted);
+    2. rank the rest by the throughput prior;
+    3. after each measurement, fit the residual surrogate and re-rank
+       what remains by corrected prediction.
+    Without a ModelShape it degrades to grid order."""
+
+    def __init__(self, candidates: List[Candidate],
+                 shape: Optional[ModelShape] = None,
+                 hbm_budget_bytes: float = 15.75e9,
+                 dp: int = 1, offload_optimizer: bool = False,
+                 remat: bool = False):
+        super().__init__(candidates)
+        self.shape = shape
+        self.surrogate = ResidualSurrogate()
+        self.pruned: List[Candidate] = []
+        self._prior: Dict[Candidate, float] = {}
+        if shape is not None:
+            keep = []
+            for micro, stage in self.remaining:
+                mem = estimate_memory_bytes(
+                    shape, micro, stage, dp=dp,
+                    offload_optimizer=offload_optimizer, remat=remat)
+                if mem > hbm_budget_bytes:
+                    self.pruned.append((micro, stage))
+                    continue
+                self._prior[(micro, stage)] = predict_throughput(
+                    shape, micro, stage, dp=dp)
+                keep.append((micro, stage))
+            self.remaining = keep
+            self._rerank()
+
+    def _rerank(self):
+        if not self._prior:
+            return
+        self.remaining.sort(
+            key=lambda c: -self.surrogate.predict(c[0], c[1],
+                                                  self._prior.get(c, 1.0)))
+
+    def update(self, cand: Candidate, metric: Optional[float],
+               oom: bool = False):
+        super().update(cand, metric, oom=oom)
+        if metric is not None and cand in self._prior:
+            self.surrogate.update(cand[0], cand[1], metric,
+                                  self._prior[cand])
+        self._rerank()
+
+
+def make_tuner(kind: str, candidates: List[Candidate], **kw) -> BaseTuner:
+    kinds = {"gridsearch": GridSearchTuner, "random": RandomTuner,
+             "model": ModelBasedTuner, "model_based": ModelBasedTuner}
+    if kind not in kinds:
+        raise ValueError(f"unknown tuner {kind!r}; known: {sorted(kinds)}")
+    if kinds[kind] is RandomTuner:
+        kw = {k: v for k, v in kw.items() if k == "seed"}
+    elif kinds[kind] is GridSearchTuner:
+        kw = {}
+    return kinds[kind](candidates, **kw)

@@ -187,3 +187,31 @@ def test_elastic_replan_shrinks_world(tmp_path):
     assert proc.returncode == 0, out
     assert "elastic re-plan 4 -> 3" in out, out
     assert "healthy at world 3" in out, out
+
+
+def test_utility_clis(tmp_path):
+    """ds_tpu_elastic prints the elastic plan; ds_tpu_ssh runs the
+    command on hostfile hosts (localhost directly, no ssh needed)."""
+    import json as _json
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cfgp = tmp_path / "ds.json"
+    cfgp.write_text(_json.dumps({"elasticity": {
+        "enabled": True, "max_train_batch_size": 64,
+        "micro_batch_sizes": [1, 2, 4], "min_gpus": 1, "max_gpus": 8,
+        "version": 0.1}}))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_tpu_elastic"),
+         "-c", str(cfgp), "-w", "4"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "final_batch_size" in out.stdout and "valid_chips" in out.stdout
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("localhost slots=1\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_tpu_ssh"),
+         "-H", str(hf), "echo", "cli-ok"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "cli-ok" in out.stdout
