@@ -8,6 +8,7 @@ docs/_posts/2020-05-28-fastest-bert-training.md:36-38).
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -114,5 +115,36 @@ def main():
     }))
 
 
+def _main_with_fallback():
+    """Run the bench in a subprocess so a Mosaic lowering failure in the
+    packed-attention path (validated in interpret mode but not yet on
+    every chip generation) can be retried with DSTPU_PACKED_ATTN=0 —
+    the driver must always get its one JSON line."""
+    import subprocess
+    if os.environ.get("BENCH_INNER"):
+        return main()
+    # respect a user's explicit opt-out; only the retry order is ours
+    attempts = ["0"] if os.environ.get("DSTPU_PACKED_ATTN") == "0" \
+        else ["1", "0"]
+    for packed in attempts:
+        env = dict(os.environ, BENCH_INNER="1", DSTPU_PACKED_ATTN=packed)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=3600)
+        except subprocess.TimeoutExpired:
+            sys.stderr.write("bench: inner run timed out after 3600s\n")
+            continue
+        sys.stderr.write(proc.stderr[-4000:])   # keep warnings visible
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        if proc.returncode == 0 and line:
+            print(line)
+            return
+        if packed == "1":
+            sys.stderr.write("\nbench: retrying with DSTPU_PACKED_ATTN=0\n")
+    raise SystemExit(1)
+
+
 if __name__ == "__main__":
-    main()
+    _main_with_fallback()

@@ -4,7 +4,6 @@ on one chip. Writes benchmarks/moe_top2.json.
 Run on the real chip: python benchmarks/moe_bench.py
 """
 
-import dataclasses
 import json
 import os
 import sys
@@ -15,7 +14,7 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-PEAK = 197e12
+from bench import detect_peak  # noqa: E402 — shared per-generation peak
 
 
 def main():
@@ -25,8 +24,8 @@ def main():
     seq = int(os.environ.get("BENCH_SEQ", 1024))
     micro = int(os.environ.get("BENCH_BS", 8))
     gas = int(os.environ.get("BENCH_GAS", 16))
-    steps = int(os.environ.get("BENCH_STEPS", 4))
-    windows = int(os.environ.get("BENCH_WINDOWS", 2))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", 4)))
+    windows = max(1, int(os.environ.get("BENCH_WINDOWS", 2)))
 
     # GPT-2-small width with 8 experts, top-2 (BASELINE #5); ~340M total
     # params, ~160M active per token
@@ -60,6 +59,7 @@ def main():
         best = min(best, time.perf_counter() - t0)
     tok_s = steps * gas * micro * seq / best
     fpt = model.flops_per_token(seq)          # ACTIVE-param flops
+    peak = detect_peak()
     report = {
         "benchmark": "gpt2_moe_8e_top2_bf16_train",
         "model": "gpt2-small + 8 experts top-2",
@@ -67,7 +67,7 @@ def main():
         "seq": seq, "micro_bs": micro, "gas": gas, "steps": steps,
         "tokens_per_sec": round(tok_s, 1),
         "achieved_active_tflops": round(tok_s * fpt / 1e12, 2),
-        "active_mfu": round(tok_s * fpt / PEAK, 4),
+        "active_mfu": round(tok_s * fpt / peak, 4),
         "final_loss": round(float(loss), 4),
         "note": ("single-chip measurement (ep=1: all experts resident; "
                  "the all-to-all is exercised by the ep2 CPU-mesh tests "

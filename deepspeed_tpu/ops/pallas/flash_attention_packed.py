@@ -98,14 +98,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
         return new_accs, new_ms, new_ls
 
     accs, ms, ls = lax.fori_loop(j0, nk, body, (accs, ms, ls))
-    outs, lses = [], []
+    outs = []
+    lse_out = jnp.zeros((bq, _LANES), jnp.float32)
+    lane = lax.broadcasted_iota(jnp.int32, (bq, _LANES), 1)
     for h in range(gh):
         l = jnp.maximum(ls[h], 1e-30)
         outs.append((accs[h] / l).astype(o_ref.dtype))
-        lses.append(ms[h] + jnp.log(l))
+        # lane-broadcast write of head h's lse (1-lane concats don't
+        # lower on Mosaic; a where over a full [BQ, 128] tile does)
+        lse_out = jnp.where(lane == h, ms[h] + jnp.log(l), lse_out)
     o_ref[0] = jnp.concatenate(outs, axis=-1)
-    lse_ref[0] = jnp.concatenate(
-        lses + [jnp.zeros((bq, _LANES - gh), jnp.float32)], axis=-1)
+    lse_ref[0] = lse_out
 
 
 def _fwd(q, k, v, n_head, causal, scale, bq, bk, interpret, window):
@@ -166,6 +169,7 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do_i = do_ref[0, pl.ds(i * bq, bq), :]
         lse_i = lse_ref[0, pl.ds(i * bq, bq), :]
         delta_i = delta_ref[0, pl.ds(i * bq, bq), :]
+        lane = lax.broadcasted_iota(jnp.int32, (bq, _LANES), 1)
         new_dks, new_dvs = [], []
         dq_upds = []
         for h in range(gh):
@@ -173,15 +177,21 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             kh = k_blk[:, h * d:(h + 1) * d]
             vh = v_blk[:, h * d:(h + 1) * d]
             doh = do_i[:, h * d:(h + 1) * d]
+            # extract head h's lane as [BQ, 1] via masked lane-reduce
+            # (1-lane slices at arbitrary offsets don't lower on Mosaic)
+            lse_h = jnp.max(jnp.where(lane == h, lse_i, -jnp.inf), axis=-1,
+                            keepdims=True)
+            delta_h = jnp.max(jnp.where(lane == h, delta_i, -jnp.inf),
+                              axis=-1, keepdims=True)
             s = jnp.dot(qh, kh.T, preferred_element_type=jnp.float32) * scale
             if causal:
                 s = _mask(s, i * bq, k_off, bq, bk, window)
-            p = jnp.exp(s - lse_i[:, h:h + 1])
+            p = jnp.exp(s - lse_h)
             new_dvs.append(dvs[h] + jnp.dot(
                 p.astype(doh.dtype).T, doh,
                 preferred_element_type=jnp.float32))
             dp = jnp.dot(doh, vh.T, preferred_element_type=jnp.float32)
-            ds = p * (dp - delta_i[:, h:h + 1]) * scale
+            ds = p * (dp - delta_h) * scale
             ds_lp = ds.astype(qh.dtype)
             new_dks.append(dks[h] + jnp.dot(
                 ds_lp.T, qh, preferred_element_type=jnp.float32))
