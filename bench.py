@@ -106,6 +106,8 @@ def main():
         "unit": "fraction_of_peak",
         "vs_baseline": round(mfu / REFERENCE_MFU, 4),
         "detail": {
+            "packed_attention": os.environ.get("DSTPU_PACKED_ATTN", "1")
+            != "0",
             "tokens_per_sec": round(tokens_per_sec, 1),
             "achieved_tflops": round(achieved / 1e12, 2),
             "seq": seq, "micro_bs": micro_bs, "steps": steps,

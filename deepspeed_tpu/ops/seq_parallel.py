@@ -40,25 +40,35 @@ def seq_axis_size() -> int:
 
 
 def ulysses_attention(q, k, v, causal=True, softmax_scale=None,
-                      dropout_rate=0.0, dropout_rng=None, backend="auto"):
+                      dropout_rate=0.0, dropout_rng=None, backend="auto",
+                      bias=None, window=None):
     """q,k,v: [B, H, T, D] with T sharded over 'seq'. Reshard heads↔sequence
     around a full-sequence attention (DeepSpeed-Ulysses; the reference has
-    no equivalent — see module docstring)."""
+    no equivalent — see module docstring). After the all-to-all each device
+    holds FULL sequences of a head subset, so per-head additive bias
+    (ALiBi) and sliding windows work unchanged — the bias head dim simply
+    shards with the heads."""
     # all-to-all #1: gather sequence, scatter heads
     spec_heads = (_BATCH_AXES, SEQ_AXIS, None, None)
     q = maybe_constraint(q, *spec_heads)
     k = maybe_constraint(k, *spec_heads)
     v = maybe_constraint(v, *spec_heads)
+    if bias is not None and bias.ndim == 3:    # [H, T, T] → shard heads
+        bias = maybe_constraint(bias, SEQ_AXIS, None, None)
+    elif bias is not None and bias.ndim == 4 and bias.shape[0] == 1:
+        bias = maybe_constraint(bias, None, SEQ_AXIS, None, None)
     out = flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale,
                           dropout_rate=dropout_rate, dropout_rng=dropout_rng,
-                          backend=backend)
+                          backend=backend, bias=bias, window=window)
     # all-to-all #2: back to sequence-sharded, full heads
     return maybe_constraint(out, _BATCH_AXES, None, SEQ_AXIS, None)
 
 
-def _ring_attention_local(q, k, v, causal, scale, axis_name, sp):
-    """Per-device body: q,k,v [B, H, Tl, D] local shards; returns [B,H,Tl,D].
-    K/V rotate sp times around the ring; online softmax merges blocks."""
+def _ring_attention_local(q, k, v, causal, scale, axis_name, sp,
+                          bias=None, window=None):
+    """Per-device body: q,k,v [B, H, Tl, D] local shards (bias [H, Tl, T]:
+    q rows local, key columns GLOBAL); returns [B,H,Tl,D]. K/V rotate sp
+    times around the ring; online softmax merges blocks."""
     b, h, tl, d = q.shape
     sid = lax.axis_index(axis_name)
     q32 = q.astype(jnp.float32) * scale
@@ -70,10 +80,18 @@ def _ring_attention_local(q, k, v, causal, scale, axis_name, sp):
         src = (sid - i) % sp
         logits = jnp.einsum("bhqd,bhkd->bhqk", q32,
                             k_blk.astype(jnp.float32))
+        if bias is not None:
+            rows = bias.shape[1]               # tl (full bias) or 1 (ALiBi)
+            blk_bias = lax.dynamic_slice(
+                bias, (0, 0, src * tl), (h, rows, tl)).astype(jnp.float32)
+            logits = logits + blk_bias[None]
+        q_pos = sid * tl + jnp.arange(tl)[:, None]
+        k_pos = src * tl + jnp.arange(tl)[None, :]
         if causal:
-            q_pos = sid * tl + jnp.arange(tl)[:, None]
-            k_pos = src * tl + jnp.arange(tl)[None, :]
-            logits = jnp.where((q_pos >= k_pos)[None, None], logits, neg)
+            keep = q_pos >= k_pos
+            if window is not None:
+                keep &= (q_pos - k_pos) < window
+            logits = jnp.where(keep[None, None], logits, neg)
         blk_max = jnp.max(logits, axis=-1)                       # [B,H,Tl]
         new_m = jnp.maximum(m, blk_max)
         # renormalize old accumulators, accumulate this block
@@ -96,57 +114,80 @@ def _ring_attention_local(q, k, v, causal, scale, axis_name, sp):
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, causal=True, softmax_scale=None):
+def ring_attention(q, k, v, causal=True, softmax_scale=None, bias=None,
+                   window=None):
     """q,k,v: [B, H, T, D] with T sharded over 'seq'. O(T/sp) attention
-    memory per device; K/V blocks ride the ICI ring (ppermute)."""
+    memory per device; K/V blocks ride the ICI ring (ppermute). ``bias``
+    [H, T, T] (ALiBi) shards its q-row dim with the ring; every device
+    keeps the full key-column extent and slices the arriving block's
+    columns."""
     mesh = active_mesh()
     sp = seq_axis_size()
     if mesh is None or sp == 1:
         return flash_attention(q, k, v, causal=causal,
-                               softmax_scale=softmax_scale)
+                               softmax_scale=softmax_scale, bias=bias,
+                               window=window)
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     # manual over 'seq' only: specs name just the manual axis, the batch
     # dims stay under auto/GSPMD (dp sharding untouched)
     spec = P(None, None, SEQ_AXIS, None)
     body = functools.partial(_ring_attention_local, causal=causal,
-                             scale=scale, axis_name=SEQ_AXIS, sp=sp)
+                             scale=scale, axis_name=SEQ_AXIS, sp=sp,
+                             window=window)
+    if bias is None:
+        return jax.shard_map(
+            lambda a, b_, c: body(a, b_, c),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names={SEQ_AXIS}, check_vma=False)(q, k, v)
+    if bias.ndim == 4:
+        if bias.shape[0] != 1:
+            raise NotImplementedError(
+                "batch-dependent attention bias under ring attention")
+        bias = bias[0]                         # → [H, Tq|1, Tk]
+    if bias.shape[1] == 1:
+        # ALiBi: key-position-only bias, replicated (cols sliced per block)
+        bias_spec = P(None, None, None)
+    else:
+        bias_spec = P(None, SEQ_AXIS, None)    # q rows local, k cols global
     return jax.shard_map(
-        lambda a, b_, c: body(a, b_, c),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        axis_names={SEQ_AXIS}, check_vma=False)(q, k, v)
+        lambda a, b_, c, bb: body(a, b_, c, bias=bb),
+        mesh=mesh, in_specs=(spec, spec, spec, bias_spec), out_specs=spec,
+        axis_names={SEQ_AXIS}, check_vma=False)(q, k, v, bias)
 
 
 def sp_attention(q, k, v, causal=True, softmax_scale=None, dropout_rate=0.0,
                  dropout_rng=None, impl="ulysses", backend="auto", bias=None,
                  window=None):
     """Dispatch by impl when the 'seq' axis is live; plain flash otherwise.
-    ``bias`` (additive logits bias, e.g. ALiBi) and ``window`` (sliding-
-    window causal) are only supported off the sequence-parallel paths."""
+    ``bias`` (additive logits bias, e.g. ALiBi — [H, T, T]) and ``window``
+    (sliding-window causal) work on BOTH sequence-parallel paths: under
+    Ulysses the bias head dim shards with the heads; under ring the bias
+    q-row dim shards with the ring and arriving key blocks slice their
+    columns. NOTE: Ulysses+bias runs the dense XLA attention on the FULL
+    gathered sequence (the Pallas kernels take no bias) — O(T^2) logits
+    per device; for ALiBi at long T prefer impl='ring', which stays
+    O(T*T/sp)."""
     if impl not in ("ulysses", "ring"):
         raise ValueError(f"sp_attention impl must be 'ulysses' or 'ring', "
                          f"got {impl!r}")
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal=True")
     if seq_axis_size() == 1:
         return flash_attention(q, k, v, causal=causal,
                                softmax_scale=softmax_scale,
                                dropout_rate=dropout_rate,
                                dropout_rng=dropout_rng, backend=backend,
                                bias=bias, window=window)
-    if bias is not None:
-        raise NotImplementedError(
-            "attention bias (ALiBi) is not supported under sequence "
-            "parallelism; run ALiBi models with sp=1")
-    if window is not None:
-        raise NotImplementedError(
-            "sliding-window attention is not supported under sequence "
-            "parallelism; run windowed models with sp=1")
     if impl == "ring":
         if dropout_rate > 0.0:
             raise NotImplementedError(
                 "ring attention does not support attention dropout; use "
                 "sp_attention='ulysses' or dropout=0")
         return ring_attention(q, k, v, causal=causal,
-                              softmax_scale=softmax_scale)
+                              softmax_scale=softmax_scale, bias=bias,
+                              window=window)
     return ulysses_attention(q, k, v, causal=causal,
                              softmax_scale=softmax_scale,
                              dropout_rate=dropout_rate,
-                             dropout_rng=dropout_rng, backend=backend)
+                             dropout_rng=dropout_rng, backend=backend,
+                             bias=bias, window=window)

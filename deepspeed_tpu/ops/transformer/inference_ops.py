@@ -8,6 +8,18 @@ is an explicit pytree the caller threads through jit (functional — no global
 workspace), and cache append is a dynamic_update_slice the compiler keeps
 in-place under donation. The inference engine (inference/engine.py) builds
 its decode loop out of these pieces via the model's apply_with_cache.
+
+Why the decode hot loop is tightly-fused XLA rather than a Pallas kernel
+(the deliberate TPU answer to the reference's fused ``softmax_context``
+CUDA kernel): at T=1 decode is HBM-bandwidth-bound — the step's cost is
+one streaming read of the KV cache plus the weight matmuls, and XLA
+already lowers score→mask→softmax→combine into fused loops over that
+single pass without materializing intermediates in HBM (the [B,H,1,L]
+score tile is KB-scale). A hand kernel would re-buy the same bandwidth
+with added grid overhead at M=1; the places a custom decode kernel DOES
+pay on TPU — paged/blocked caches, speculative multi-token verify — are
+future shapes, not this one. Decode throughput is measured by
+benchmarks/decode.py.
 """
 
 from types import SimpleNamespace

@@ -93,3 +93,64 @@ def test_gpt2_sp_training_matches_sp1(impl):
     e4 = make(4)
     l4 = [float(e4.train_batch(batch=b)) for b in batches]
     np.testing.assert_allclose(l1, l4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("impl", ["ulysses", "ring"])
+def test_alibi_bloom_sp_matches_sp1(impl):
+    """ALiBi (BLOOM) under sequence parallelism: sp=2 == sp=1 (round-2
+    carve-out closed — the bias head dim shards under Ulysses; under ring
+    the bias q rows shard and key blocks slice their columns)."""
+    from deepspeed_tpu.models.bloom import BloomConfig, BloomModel
+
+    cfg = BloomConfig(vocab_size=128, n_positions=64, n_embd=32, n_layer=2,
+                      n_head=4, pad_vocab_to_multiple=32, sp_attention=impl)
+
+    def make(sp):
+        return deepspeed_tpu.initialize(model=BloomModel(cfg), config={
+            "train_batch_size": 16,
+            "train_micro_batch_size_per_gpu": 8 // (8 // sp),
+            "gradient_accumulation_steps": 2,
+            "sequence_parallel_size": sp,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 0})[0]
+
+    rng = np.random.default_rng(1)
+    batches = [{"input_ids": rng.integers(0, 127, (2, 8, 32),
+                                          dtype=np.int32)}
+               for _ in range(2)]
+    e1 = make(1)
+    l1 = [float(e1.train_batch(batch=b)) for b in batches]
+    topology.reset_mesh()
+    e2 = make(2)
+    l2 = [float(e2.train_batch(batch=b)) for b in batches]
+    np.testing.assert_allclose(l1, l2, rtol=2e-4)
+
+
+@pytest.mark.parametrize("impl", ["ulysses", "ring"])
+def test_sliding_window_mistral_sp_matches_sp1(impl):
+    """Sliding-window causal attention (Mistral) under sp=2 == sp=1."""
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig(vocab_size=128, n_positions=64, n_embd=32, n_layer=2,
+                      n_head=4, n_kv_head=4, sliding_window=16,
+                      pad_vocab_to_multiple=32, sp_attention=impl)
+
+    def make(sp):
+        return deepspeed_tpu.initialize(model=LlamaModel(cfg), config={
+            "train_batch_size": 16,
+            "train_micro_batch_size_per_gpu": 8 // (8 // sp),
+            "gradient_accumulation_steps": 2,
+            "sequence_parallel_size": sp,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 0})[0]
+
+    rng = np.random.default_rng(2)
+    batches = [{"input_ids": rng.integers(0, 127, (2, 8, 32),
+                                          dtype=np.int32)}
+               for _ in range(2)]
+    e1 = make(1)
+    l1 = [float(e1.train_batch(batch=b)) for b in batches]
+    topology.reset_mesh()
+    e2 = make(2)
+    l2 = [float(e2.train_batch(batch=b)) for b in batches]
+    np.testing.assert_allclose(l1, l2, rtol=2e-4)
