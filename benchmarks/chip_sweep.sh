@@ -1,0 +1,24 @@
+#!/bin/bash
+# One-shot on-chip measurement sweep (run when the axon tunnel is up).
+# Order: cheapest validation first, headline bench second, then the
+# feature benchmarks. Each step logs to benchmarks/logs/.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/logs
+
+run() {
+  name=$1; shift
+  echo "=== $name: $* ($(date +%H:%M:%S))"
+  timeout "${STEP_TIMEOUT:-1200}" "$@" > "benchmarks/logs/$name.log" 2>&1
+  rc=$?
+  tail -3 "benchmarks/logs/$name.log"
+  echo "=== $name rc=$rc"
+}
+
+run packed_profile python benchmarks/profile_step.py
+run bench python bench.py
+run sparse python benchmarks/sparse_attn.py
+run decode python benchmarks/decode.py
+run moe python benchmarks/moe_bench.py
+run bert python benchmarks/bert_large.py
+echo "sweep done $(date +%H:%M:%S)"
