@@ -63,6 +63,7 @@ def test_forward_matches_dense_masked(name):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name", ["fixed_heads", "bigbird", "causal_fixed"])
 def test_grads_match_dense_masked(name):
     layout = _layouts()[name]

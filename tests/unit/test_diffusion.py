@@ -6,6 +6,9 @@ module_inject/containers/unet.py, vae.py."""
 
 import math
 
+import pytest as _pt
+pytestmark = _pt.mark.slow
+
 import numpy as np
 import pytest
 

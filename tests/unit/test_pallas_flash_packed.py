@@ -63,6 +63,7 @@ def test_grads_match_reference(window):
                                    atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.slow
 def test_model_dispatch_matches_transpose_path(monkeypatch):
     """GPT2Model with attn_backend='pallas' (packed path on CPU interpret)
     == the same model with the packed path disabled."""
@@ -98,6 +99,7 @@ def test_model_dispatch_matches_transpose_path(monkeypatch):
                                    atol=3e-4, rtol=3e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("window", [None, 100])
 def test_multi_tile_blocks_match_reference(window):
     """Force (128, 128) blocks at T=512 so the online-softmax rescale,

@@ -95,6 +95,7 @@ def test_gpt2_sp_training_matches_sp1(impl):
     np.testing.assert_allclose(l1, l4, rtol=2e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("impl", ["ulysses", "ring"])
 def test_alibi_bloom_sp_matches_sp1(impl):
     """ALiBi (BLOOM) under sequence parallelism: sp=2 == sp=1 (round-2
@@ -126,6 +127,7 @@ def test_alibi_bloom_sp_matches_sp1(impl):
     np.testing.assert_allclose(l1, l2, rtol=2e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("impl", ["ulysses", "ring"])
 def test_sliding_window_mistral_sp_matches_sp1(impl):
     """Sliding-window causal attention (Mistral) under sp=2 == sp=1."""
