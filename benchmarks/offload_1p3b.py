@@ -31,8 +31,9 @@ def main():
     micro = int(os.environ.get("OFF_BS", 4))
     gas = int(os.environ.get("OFF_GAS", 4))
     steps = int(os.environ.get("OFF_STEPS", 4))
-    print(f"offload 1.3B: seq={seq} micro={micro} gas={gas} steps={steps}",
-          flush=True)
+    pipelined = os.environ.get("OFF_PIPELINE", "0") == "1"
+    print(f"offload 1.3B: seq={seq} micro={micro} gas={gas} steps={steps} "
+          f"pipelined={pipelined}", flush=True)
 
     cfg = dataclasses.replace(GPT2_1_3B, n_positions=seq, remat=True,
                               remat_policy="dots_with_no_batch_dims_saveable")
@@ -44,7 +45,10 @@ def main():
         "bf16": {"enabled": True},
         "zero_optimization": {
             "stage": 2,
-            "offload_optimizer": {"device": "cpu"},
+            "offload_optimizer": {"device": "cpu",
+                                  # one-step-delayed exchange: host Adam +
+                                  # upload overlap the next step's compute
+                                  "pipeline_read": pipelined},
         },
         "steps_per_print": 0,
     })
@@ -73,6 +77,7 @@ def main():
         "device_state": "bf16 params + f32 grads (optimizer on HOST)",
         "host_optimizer_bytes_gb": round(n_params * 12 / 1e9, 2),
         "seq": seq, "micro_bs": micro, "gas": gas,
+        "pipelined_exchange": pipelined,
         "sec_per_step": round(dt, 3),
         "tokens_per_sec": round(tok_s, 1),
         "achieved_tflops": round(tok_s * fpt / 1e12, 2),

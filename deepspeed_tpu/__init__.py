@@ -75,6 +75,14 @@ def initialize(args=None,
     def _wants_hybrid(cfg):
         return bool(_cfg_dict(cfg).get("hybrid_engine", {}).get("enabled"))
 
+    lcfg = _cfg_dict(config).get("lora", {})
+    if lcfg.get("enabled"):
+        # config-driven LoRA (DS-Chat only_optimize_lora surface): wrap the
+        # model so adapters become ordinary (sharded, checkpointed) leaves
+        from .runtime.lora import LoRAConfig, LoRAModel
+        if not isinstance(model, LoRAModel):
+            model = LoRAModel(model, LoRAConfig.from_dict(lcfg))
+
     if _wants_hybrid(config):
         # reference dispatch: hybrid_engine.enabled → DeepSpeedHybridEngine
         # (__init__.py:141-181)
@@ -122,7 +130,11 @@ def initialize(args=None,
 
 def init_inference(model, config=None, **kwargs):
     """Initialize the inference engine (reference deepspeed.init_inference,
-    __init__.py:251)."""
+    __init__.py:251). ``model`` may be a deepspeed_tpu ModelSpec, an HF
+    torch module (injection policies convert it), or a path to a
+    Megatron-LM / Megatron-DeepSpeed(-MoE) checkpoint directory (the
+    reference's Megatron checkpoint-json serving path,
+    module_inject/containers/megatron_gpt.py + megatron_gpt_moe.py)."""
     from .inference.engine import InferenceEngine
     from .inference.config import DeepSpeedInferenceConfig
     if config is None:
@@ -130,6 +142,10 @@ def init_inference(model, config=None, **kwargs):
     if isinstance(config, dict):
         config = {**config, **kwargs}
         config = DeepSpeedInferenceConfig.from_dict(config)
+    if isinstance(model, str):
+        from .checkpoint.megatron import load_megatron_checkpoint
+        spec, params = load_megatron_checkpoint(model)
+        return InferenceEngine(spec, config, params=params)
     return InferenceEngine(model, config)
 
 

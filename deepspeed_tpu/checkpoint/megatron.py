@@ -103,13 +103,7 @@ def _np(t):
 def _shard_paths(ckpt_dir: str, tag: Optional[str]):
     """-> list of (tp_rank, pp_rank, path), pp_rank -1 for tp-only
     layouts."""
-    if tag is None:
-        latest = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
-        if os.path.exists(latest):
-            with open(latest) as f:
-                it = f.read().strip()
-            tag = "release" if it == "release" else f"iter_{int(it):07d}"
-    root = os.path.join(ckpt_dir, tag) if tag else ckpt_dir
+    root = _resolve_tag_root(ckpt_dir, tag)
 
     def pick(d):
         """One .pt per shard dir: model_optim_rng.pt or an unambiguous
@@ -242,9 +236,13 @@ def load_megatron_checkpoint(ckpt_dir: str, tag: Optional[str] = None,
     n_layer = len(layer_ids)
     v, d = merged["wte"].shape
     hd = d // n_head
-    inner = merged["layers.0.mlp.dense_h_to_4h.weight"].shape[0]
-    if inner % d != 0:
-        raise ValueError(f"ffn size {inner} not a multiple of hidden {d}")
+    is_moe = any(".mlp.deepspeed_moe.gate." in k for k in merged)
+    if is_moe:
+        inner = 4 * d  # ExpertFFN is fixed 4x (checked against shards below)
+    else:
+        inner = merged["layers.0.mlp.dense_h_to_4h.weight"].shape[0]
+        if inner % d != 0:
+            raise ValueError(f"ffn size {inner} not a multiple of hidden {d}")
     cfg = GPT2Config(vocab_size=v, n_positions=merged["wpe"].shape[0],
                      n_embd=d, n_layer=n_layer, n_head=n_head,
                      mlp_ratio=inner // d, pad_vocab_to_multiple=1)
@@ -272,15 +270,23 @@ def load_megatron_checkpoint(ckpt_dir: str, tag: Optional[str] = None,
                                for i in layer_ids]),
         "ln2_bias": np.stack([layer(i, "post_attention_layernorm.bias")
                               for i in layer_ids]),
-        "mlp_fc_w": np.stack([layer(i, "mlp.dense_h_to_4h.weight").T
-                              for i in layer_ids]),
-        "mlp_fc_b": np.stack([layer(i, "mlp.dense_h_to_4h.bias")
-                              for i in layer_ids]),
-        "mlp_proj_w": np.stack([layer(i, "mlp.dense_4h_to_h.weight").T
-                                for i in layer_ids]),
-        "mlp_proj_b": np.stack([layer(i, "mlp.dense_4h_to_h.bias")
-                                for i in layer_ids]),
     }
+    if is_moe:
+        # Megatron gate Linear is [E, M]; our TopKGate wg is [M, E]
+        blocks["moe_gate_wg"] = np.stack(
+            [layer(i, "mlp.deepspeed_moe.gate.wg.weight").T
+             for i in layer_ids])
+    else:
+        blocks.update({
+            "mlp_fc_w": np.stack([layer(i, "mlp.dense_h_to_4h.weight").T
+                                  for i in layer_ids]),
+            "mlp_fc_b": np.stack([layer(i, "mlp.dense_h_to_4h.bias")
+                                  for i in layer_ids]),
+            "mlp_proj_w": np.stack([layer(i, "mlp.dense_4h_to_h.weight").T
+                                    for i in layer_ids]),
+            "mlp_proj_b": np.stack([layer(i, "mlp.dense_4h_to_h.bias")
+                                    for i in layer_ids]),
+        })
     params = {
         "wte": jnp.asarray(merged["wte"]),
         "wpe": jnp.asarray(merged["wpe"]),
@@ -288,4 +294,113 @@ def load_megatron_checkpoint(ckpt_dir: str, tag: Optional[str] = None,
         "ln_f_scale": jnp.asarray(merged["final_layernorm.weight"]),
         "ln_f_bias": jnp.asarray(merged["final_layernorm.bias"]),
     }
+
+    moe = _load_expert_shards(ckpt_dir, tag, layer_ids, merged)
+    if is_moe and moe is None:
+        raise FileNotFoundError(
+            f"checkpoint has deepspeed_moe gate weights but no "
+            f"layer_*_expert_*_mp_rank_* expert shards under "
+            f"{_resolve_tag_root(ckpt_dir, tag)!r} — partial MoE checkpoint")
+    if moe is not None:
+        return _to_moe_model(cfg, params, moe)
     return spec, params
+
+
+def _resolve_tag_root(ckpt_dir: str, tag: Optional[str]):
+    """Resolve the checkpoint root via latest_checkpointed_iteration.txt
+    (shared by main-shard and expert-shard discovery)."""
+    if tag is None:
+        latest = os.path.join(ckpt_dir, "latest_checkpointed_iteration.txt")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                it = f.read().strip()
+            tag = "release" if it == "release" else f"iter_{int(it):07d}"
+    return os.path.join(ckpt_dir, tag) if tag else ckpt_dir
+
+
+def _load_expert_shards(ckpt_dir, tag, layer_ids, merged):
+    """DeepSpeed-MoE expert checkpoints (reference engine.py:2876,
+    _get_expert_ckpt_name :2472: ``layer_<L>_expert_<E>_mp_rank_<TP>_
+    model_states.pt``) → {layer: {expert: {wi, bi, wo, bo}}} or None for
+    dense checkpoints. The Megatron-GPT-MoE container path
+    (module_inject/containers/megatron_gpt_moe.py)."""
+    root = _resolve_tag_root(ckpt_dir, tag)
+    files = glob.glob(os.path.join(
+        root, "layer_*_expert_*_mp_rank_*_model_states.pt"))
+    if not files:
+        return None
+    import torch
+    out: Dict[int, Dict[int, Dict[str, np.ndarray]]] = {}
+    for path in sorted(files):
+        m = re.match(r"layer_(\d+)_expert_(\d+)_mp_rank_(\d+)_model_states"
+                     r"\.pt$", os.path.basename(path))
+        if not m:
+            continue
+        lid, eid = int(m.group(1)), int(m.group(2))
+        state = torch.load(path, map_location="cpu", weights_only=False)
+        flat = {}
+        for k, v in state.items():
+            if k.endswith("dense_h_to_4h.weight"):
+                flat["wi"] = _np(v).T
+            elif k.endswith("dense_h_to_4h.bias"):
+                flat["bi"] = _np(v)
+            elif k.endswith("dense_4h_to_h.weight"):
+                flat["wo"] = _np(v).T
+            elif k.endswith("dense_4h_to_h.bias"):
+                flat["bo"] = _np(v)
+        if len(flat) != 4:
+            raise ValueError(
+                f"expert shard {path} missing FFN weights (got "
+                f"{sorted(flat)})")
+        out.setdefault(lid, {})[eid] = flat
+    moe_layers = sorted(out)
+    if moe_layers != list(layer_ids):
+        raise ValueError(
+            f"MoE checkpoints cover layers {moe_layers} but the model has "
+            f"layers {list(layer_ids)}: interleaved dense/MoE stacks are "
+            f"not supported by GPT2MoEModel (every layer is MoE)")
+    return out
+
+
+def _to_moe_model(cfg, params, moe):
+    """Rebuild (GPT2MoEModel, params) from the dense skeleton + expert
+    shards: dense MLP leaves drop, gate comes from the main shard's
+    deepspeed_moe.gate key, experts stack [L, E, ...]."""
+    import jax.numpy as jnp
+    from ..models.gpt2_moe import GPT2MoEConfig, GPT2MoEModel
+
+    layers = sorted(moe)
+    n_exp = len(moe[layers[0]])
+    for lid in layers:
+        if len(moe[lid]) != n_exp:
+            raise ValueError(
+                f"layer {lid} has {len(moe[lid])} experts, expected {n_exp}")
+    ff = moe[layers[0]][0]["wi"].shape[-1]
+    if ff != 4 * cfg.n_embd:
+        raise ValueError(
+            f"expert FFN width {ff} != 4x hidden {cfg.n_embd} — "
+            f"GPT2MoEModel's ExpertFFN is fixed at 4x")
+    blocks = dict(params["blocks"])
+    gate = blocks.pop("moe_gate_wg", None)
+    if gate is None:
+        raise KeyError(
+            "expert shards present but no deepspeed_moe gate weights in the "
+            "main shards (expected layers.N.mlp.deepspeed_moe.gate.wg."
+            "weight)")
+    for k in ("mlp_fc_w", "mlp_fc_b", "mlp_proj_w", "mlp_proj_b"):
+        blocks.pop(k, None)
+    stack = lambda name: jnp.asarray(np.stack(
+        [np.stack([moe[l][e][name] for e in sorted(moe[l])])
+         for l in layers]))
+    blocks["moe"] = {
+        "gate": {"wg": gate},
+        "experts": {"wi": stack("wi"), "bi": stack("bi"),
+                    "wo": stack("wo"), "bo": stack("bo")},
+    }
+    mcfg = GPT2MoEConfig(
+        vocab_size=cfg.vocab_size, n_positions=cfg.n_positions,
+        n_embd=cfg.n_embd, n_layer=cfg.n_layer, n_head=cfg.n_head,
+        num_experts=n_exp, pad_vocab_to_multiple=1)
+    out = dict(params)
+    out["blocks"] = blocks
+    return GPT2MoEModel(mcfg), out

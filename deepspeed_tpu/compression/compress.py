@@ -90,6 +90,9 @@ _TRANSFORMS = [
     ("weight_quantization", quantize_leaf),   # quant LAST (after masks)
 ]
 
+#: techniques with a schedule_offset (param transforms + activation quant)
+_SCHEDULED = [n for n, _ in _TRANSFORMS] + ["activation_quantization"]
+
 
 class CompressionScheduler:
     """Step scheduler (reference compression/scheduler.py): a technique is
@@ -104,7 +107,7 @@ class CompressionScheduler:
 
     def _update(self):
         changed = False
-        for name, _ in _TRANSFORMS:
+        for name in _SCHEDULED:
             tc: TechniqueConfig = getattr(self.config, name)
             live = bool(tc and tc.enabled and
                         self.global_step >= tc.schedule_offset)
@@ -157,9 +160,27 @@ class CompressedModel(ModelSpec):
             params = jax.tree.map(leaf, paths, params)
         return params
 
-    def apply(self, params, batch, rng=None, train=True):
+    def _act_bits(self, force_all: bool = False):
+        """Activation-quant bits when live (reference basic_layer.py
+        QuantAct), else None. The inner model applies it at block inputs
+        (GPT2Model.apply act_bits kwarg)."""
+        tc = self.compression_config.activation_quantization
+        if tc is None or not tc.enabled:
+            return None
+        if not force_all and not self.compression_scheduler.is_live(
+                "activation_quantization"):
+            return None
+        for group in tc.groups:
+            return int(group.params.get("bits",
+                                        group.params.get("target_bits", 8)))
+        return 8
+
+    def apply(self, params, batch, rng=None, train=True, **kwargs):
+        bits = self._act_bits()
+        if bits is not None:
+            kwargs["act_bits"] = bits
         return self.inner.apply(self.compress_params(params), batch,
-                                rng=rng, train=train)
+                                rng=rng, train=train, **kwargs)
 
     # inference surfaces see the SAME compressed weights as training —
     # otherwise serve/train behavior silently diverges
@@ -188,26 +209,99 @@ def init_compression(model, deepspeed_config, mpu=None) -> CompressedModel:
         with open(deepspeed_config) as f:
             deepspeed_config = json.load(f)
     config = CompressionConfig.parse(deepspeed_config)
-    # honesty about unimplemented blocks: accepted-and-ignored config is
-    # worse than an error
-    from ..utils.logging import logger
     if config.activation_quantization and \
             config.activation_quantization.enabled:
-        logger.warning(
-            "compression: activation_quantization is NOT implemented "
-            "(requires model-internal hooks); the block is ignored")
+        import inspect
+        sig = inspect.signature(model.apply).parameters
+        if "act_bits" not in sig and not any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in sig.values()):
+            raise ValueError(
+                f"activation_quantization enabled but "
+                f"{type(model).__name__}.apply() does not accept "
+                f"'act_bits' — this model cannot honor the setting")
     if config.layer_reduction.get("enabled"):
-        logger.warning("compression: layer_reduction is NOT implemented; "
-                       "the block is ignored")
-    implemented = [n for n, _ in _TRANSFORMS
-                   if getattr(config, n) and getattr(config, n).enabled]
-    if not implemented:
-        log_dist("init_compression: no implemented technique enabled; "
-                 "model unchanged", ranks=[0])
+        model = reduce_student_model(model, config)
+    enabled = [n for n in _SCHEDULED
+               if getattr(config, n) and getattr(config, n).enabled]
+    if not enabled and not config.layer_reduction.get("enabled"):
+        log_dist("init_compression: no technique enabled; model unchanged",
+                 ranks=[0])
         return model
     wrapped = CompressedModel(model, config)
-    log_dist(f"init_compression: techniques={implemented}", ranks=[0])
+    log_dist(f"init_compression: techniques={enabled} "
+             f"layer_reduction={config.layer_reduction.get('enabled', False)}",
+             ranks=[0])
     return wrapped
+
+
+def _teacher_layer_list(lr: Dict[str, Any], n_teacher: int) -> List[int]:
+    keep = int(lr.get("keep_number_layer", n_teacher))
+    layers = lr.get("teacher_layer")
+    if layers is None:
+        # reference default: evenly spaced teacher layers
+        stride = max(1, n_teacher // keep)
+        layers = list(range(0, n_teacher, stride))[:keep]
+    layers = [int(i) for i in layers]
+    if len(layers) != keep:
+        raise ValueError(
+            f"layer_reduction: teacher_layer has {len(layers)} entries but "
+            f"keep_number_layer={keep}")
+    bad = [i for i in layers if not 0 <= i < n_teacher]
+    if bad:
+        raise ValueError(f"layer_reduction: teacher_layer ids {bad} outside "
+                         f"the teacher's {n_teacher} layers")
+    return layers
+
+
+def reduce_student_model(model, config) -> Any:
+    """Layer reduction (reference compress.py:167 + helper.py): a student
+    with keep_number_layer layers of the teacher architecture. With this
+    repo's stacked [L, ...] leaves the depth change is one config field."""
+    import dataclasses
+    lr = config.layer_reduction if isinstance(config, CompressionConfig) \
+        else CompressionConfig.parse(config).layer_reduction
+    inner = model.inner if isinstance(model, CompressedModel) else model
+    mcfg = inner.config
+    keep = int(lr.get("keep_number_layer", mcfg.n_layer))
+    if keep == mcfg.n_layer:
+        return model
+    student = type(inner)(dataclasses.replace(mcfg, n_layer=keep))
+    log_dist(f"layer_reduction: student n_layer={keep} "
+             f"(teacher {mcfg.n_layer})", ranks=[0])
+    return student
+
+
+def student_initialization(student_model, teacher_params, deepspeed_config):
+    """Distillation init (reference compress.py:167
+    ``student_initialization``): copy the configured teacher layers into
+    the student's stacked blocks — a single take() on the layer axis — and
+    every non-layer module (embeddings, final LN, head) verbatim."""
+    if hasattr(deepspeed_config, "_param_dict"):
+        deepspeed_config = deepspeed_config._param_dict
+    config = CompressionConfig.parse(deepspeed_config)
+    lr = config.layer_reduction
+    if not lr.get("enabled"):
+        raise ValueError("student_initialization requires "
+                         "compression_training.layer_reduction.enabled")
+    inner = student_model.inner \
+        if isinstance(student_model, CompressedModel) else student_model
+    bkey = "blocks"
+    n_teacher = next(iter(
+        jax.tree.leaves(teacher_params[bkey]))).shape[0]
+    layers = _teacher_layer_list(lr, n_teacher)
+    idx = jnp.asarray(layers, jnp.int32)
+    out = {k: v for k, v in teacher_params.items() if k != bkey}
+    out[bkey] = jax.tree.map(lambda x: jnp.take(x, idx, axis=0),
+                             teacher_params[bkey])
+    want = inner.config.n_layer
+    if len(layers) != want:
+        raise ValueError(
+            f"student has n_layer={want} but layer_reduction selects "
+            f"{len(layers)} teacher layers")
+    log_dist(f"student_initialization: teacher layers {layers} -> student",
+             ranks=[0])
+    return out
 
 
 def redundancy_clean(model, deepspeed_config=None):

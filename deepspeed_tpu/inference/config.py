@@ -98,6 +98,21 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
             self.moe = DeepSpeedMoEConfig(enabled=self.moe)
         if isinstance(self.quant, dict):
             self.quant = QuantizationConfig.from_dict(self.quant)
+        elif isinstance(self.quant, bool):
+            self.quant = QuantizationConfig(enabled=self.quant)
+        if self.dtype is jnp.int8:
+            # reference semantics (inference/engine.py dtype=torch.int8):
+            # int8 means weight-only quantized serving; activations/compute
+            # stay in bf16
+            if self.quant is None:
+                self.quant = QuantizationConfig(enabled=True)
+            self.quant.enabled = True
+            self.dtype = jnp.bfloat16
+        if self.quant is not None and self.quant.enabled and \
+                self.quant.bits != 8:
+            raise ConfigError(
+                f"weight-only quantized serving supports bits=8 "
+                f"(got {self.quant.bits})")
         if self.enable_cuda_graph:
             logger.warning("enable_cuda_graph is a no-op on TPU: XLA programs "
                            "are already captured/replayed whole")

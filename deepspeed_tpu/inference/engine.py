@@ -78,16 +78,33 @@ class InferenceEngine:
         rng = jax.random.PRNGKey(config.seed)
         param_shapes = jax.eval_shape(model.init, rng)
         self.param_shardings = self.planner.param_shardings(param_shapes)
+        # int8 weight-only serving (reference GroupQuantizer at injection,
+        # module_inject/replace_module.py:140): block weights become
+        # QuantizedWeight pytree nodes; fp-layout shardings are kept for
+        # checkpoint loads, which land in fp then quantize.
+        self._quant = (config.quant
+                       if config.quant is not None and config.quant.enabled
+                       else None)
+        self._fp_shardings = self.param_shardings
+        self._fp_template = param_shapes
+        if self._quant is not None:
+            from .quantization import quantized_shardings
+            self.param_shardings = quantized_shardings(self._fp_shardings,
+                                                       param_shapes)
         self._recast_fn = None
         with self.mesh:
             if params is not None:
                 self.params = self.recast(params)
             else:
                 self.params = jax.jit(
-                    lambda r: jax.tree.map(self._cast_leaf, model.init(r)),
+                    lambda r: self._finalize_tree(
+                        jax.tree.map(self._cast_leaf, model.init(r))),
                     out_shardings=self.param_shardings)(rng)
         if config.checkpoint:
             self.load_checkpoint(config.checkpoint)
+        if self._quant is not None:
+            from .quantization import describe
+            log_dist(describe(self.params), ranks=[0])
 
         self._cache_rules = (model.cache_partition_rules()
                              if hasattr(model, "cache_partition_rules") else [])
@@ -104,14 +121,27 @@ class InferenceEngine:
             return x.astype(self.dtype)
         return x
 
+    def _finalize_tree(self, params):
+        """Apply weight-only quantization when configured (jit-safe)."""
+        if self._quant is None:
+            return params
+        from .quantization import quantize_tree
+        return quantize_tree(params, self._quant.group_size,
+                             self._quant.bits)
+
     def recast(self, params):
-        """Cast/re-shard a params tree into the serving layout — compiled
-        ONCE; the hybrid engine refreshes through this after every
+        """Cast/re-shard a params tree into the serving layout (quantizing
+        when int8 serving is on) — compiled per input structure; the hybrid
+        engine refreshes fp training params through this after every
         optimizer step."""
+        from .quantization import is_quantized
         if self._recast_fn is None:
-            self._recast_fn = jax.jit(
-                lambda p: jax.tree.map(self._cast_leaf, p),
-                out_shardings=self.param_shardings)
+            def rc(p):
+                p = jax.tree.map(
+                    lambda x: x if is_quantized(x) else self._cast_leaf(x),
+                    p, is_leaf=is_quantized)
+                return self._finalize_tree(p)
+            self._recast_fn = jax.jit(rc, out_shardings=self.param_shardings)
         with self.mesh:
             return self._recast_fn(params)
 
@@ -129,12 +159,17 @@ class InferenceEngine:
 
     def load_checkpoint(self, load_dir, tag=None):
         """Load a deepspeed_tpu training checkpoint (any source mp/dp layout
-        — universal reshard-on-load) into the serving shardings."""
+        — universal reshard-on-load) into the serving shardings. Checkpoints
+        are fp; int8 serving quantizes after the reshard."""
         from ..runtime.checkpointing import load_params_for_inference
         with self.mesh:
-            self.params = load_params_for_inference(
-                load_dir, tag=tag, like=self.params,
-                shardings=self.param_shardings, cast=self._cast_leaf)
+            params = load_params_for_inference(
+                load_dir, tag=tag, like=self._fp_template,
+                shardings=self._fp_shardings, cast=self._cast_leaf)
+            if self._quant is not None:
+                params = jax.jit(self._finalize_tree,
+                                 out_shardings=self.param_shardings)(params)
+            self.params = params
         return load_dir
 
     # ---------------------------------------------------------------- forward

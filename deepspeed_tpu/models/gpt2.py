@@ -187,7 +187,7 @@ class GPT2Model(ModelSpec):
 
     # ----------------------------------------------------------------- block
     def _attn_sublayer(self, x, p, rng, train, attn_fn=None, start_pos=0,
-                       positions=None):
+                       positions=None, extra=None):
         """ln1 → qkv → flash attention → proj → residual (+dropout).
 
         ``attn_fn(q, k, v) -> attn`` overrides the attention inner — the
@@ -199,7 +199,8 @@ class GPT2Model(ModelSpec):
         ln1 = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_epsilon)
         qkv = ln1 @ p["qkv_w"].astype(ln1.dtype) + p["qkv_b"].astype(ln1.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        bias = None if attn_fn is not None else self._train_attn_bias(t)
+        bias = None if attn_fn is not None else self._train_attn_bias_ex(
+            t, extra)
         dropping = train and cfg.dropout > 0 and rng is not None
         if (attn_fn is None and bias is None and not dropping and
                 self.causal_attention and self._packed_attn_ok(t, hd, h)):
@@ -262,28 +263,60 @@ class GPT2Model(ModelSpec):
         out = hmid @ p["mlp_proj_w"].astype(hmid.dtype) + p["mlp_proj_b"].astype(hmid.dtype)
         return x + self._dropout(out, rng, train, 1), jnp.float32(0.0)
 
-    def _block(self, x, layer_params, rng, train):
+    def _block(self, x, layer_params, rng, train, extra=None):
         """One decoder block. Returns (x, aux_loss) — aux is nonzero only for
-        MoE variants."""
-        x = self._attn_sublayer(x, layer_params, rng, train)
+        MoE variants. ``extra``: this layer's slice of _layer_extras()."""
+        x = self._attn_sublayer(x, layer_params, rng, train, extra=extra)
         return self._mlp_sublayer(x, layer_params, rng, train)
 
     def _decode_block(self, x, layer_params, attn_fn, start_pos,
-                      positions=None):
+                      positions=None, extra=None):
         """One block on the KV-cache decode path (no dropout/rng)."""
         x = self._attn_sublayer(x, layer_params, None, False, attn_fn=attn_fn,
-                                start_pos=start_pos, positions=positions)
+                                start_pos=start_pos, positions=positions,
+                                extra=extra)
         x, _ = self._mlp_sublayer(x, layer_params, None, False)
         return x
+
+    # ---- per-layer constants (scanned alongside the stacked params) ----
+    def _layer_extras(self):
+        """Optional [L, ...] array of per-layer constants scanned alongside
+        the blocks subtree (NOT parameters: no grads, no optimizer state).
+        Families with layer-dependent attention (GPT-Neo's alternating
+        local/global) return a flag vector; base models return None."""
+        return None
+
+    def _train_attn_bias_ex(self, t, extra):
+        """Layer-aware training attention bias; base defers to the
+        layer-independent hook."""
+        return self._train_attn_bias(t)
+
+    def _decode_attn_mask_ex(self, q_pos, k_pos, extra):
+        """Layer-aware decode keep-mask; base defers to the
+        layer-independent hook."""
+        return self._decode_attn_mask(q_pos, k_pos)
 
     def _dropout(self, x, rng, train, salt):
         return _token_dropout(x, rng, train, salt, self.config.dropout)
 
     # --------------------------------------------------------------- forward
-    def hidden_states(self, params, input_ids, rng=None, train=True):
+    def hidden_states(self, params, input_ids, rng=None, train=True,
+                      pld_theta=None, ltd_keep=None, act_bits=None):
         """Transformer stack up to the final LN. Returns (x [B,T,D],
         aux_loss, wte in compute dtype) — the loss path projects to vocab
-        CHUNK-WISE (never materializing [B,T,V])."""
+        CHUNK-WISE (never materializing [B,T,V]).
+
+        ``pld_theta``: progressive-layer-drop keep anneal (traced scalar;
+        reference engine.py:1667 injects it into forward kwargs) — layer i
+        runs with probability 1 - (i+1)/L*(1-theta), identity otherwise (the
+        PLD paper trains without 1/p rescaling since theta anneals to its
+        target). ``ltd_keep``: random-LTD token budget (static int;
+        reference data_routing/basic_layer.py:14) — each block runs on a
+        random sorted subset of ltd_keep tokens, the rest bypass via the
+        residual. Both are train-time-only and need an rng.
+        ``act_bits``: activation fake-quant at block inputs (static int;
+        the compression library's QuantAct, reference
+        compression/basic_layer.py — block granularity here)."""
         cfg = self.config
         # compute dtype follows the param dtype: the engine casts fp32 masters
         # to bf16/fp16 before apply (mixed-precision contract); cfg.dtype is
@@ -291,11 +324,46 @@ class GPT2Model(ModelSpec):
         compute_dtype = self._compute_dtype(params)
         x = self._embed(params, input_ids)
         x = self._dropout(x, rng, train, 2)
+        use_wrappers = train and rng is not None
+        t = x.shape[1]
+        extras = self._layer_extras()
 
-        def body(carry, layer_params):
+        def body(carry, xs):
+            layer_params, extra = xs if extras is not None else (xs, None)
             h, i, aux = carry
             layer_rng = None if rng is None else jax.random.fold_in(rng, i)
-            h, l_aux = self._block(h, layer_params, layer_rng, train)
+
+            def blk(hh):
+                if act_bits is not None:
+                    from ..ops.quantizer_ops import fake_quantize
+                    hh = fake_quantize(hh, bits=act_bits)
+                return self._block(hh, layer_params, layer_rng, train,
+                                   extra=extra)
+
+            run = blk
+            if use_wrappers and ltd_keep is not None and ltd_keep < t:
+                from ..ops.random_ltd_ops import (sample_token_indices,
+                                                  token_gather, token_scatter)
+
+                def run(hh, _blk=run):
+                    idx = sample_token_indices(
+                        jax.random.fold_in(layer_rng, 1001),
+                        ltd_keep, hh.shape[0], t)
+                    out, l_aux = _blk(token_gather(hh, idx))
+                    return token_scatter(hh, out, idx), l_aux
+
+            if use_wrappers and pld_theta is not None:
+                from ..runtime.progressive_layer_drop import \
+                    keep_prob_for_layer
+
+                def run(hh, _run=run):
+                    keep_p = keep_prob_for_layer(pld_theta, i, cfg.n_layer)
+                    coin = jax.random.bernoulli(
+                        jax.random.fold_in(layer_rng, 1002), keep_p)
+                    return lax.cond(coin, _run,
+                                    lambda v: (v, jnp.float32(0.0)), hh)
+
+            h, l_aux = run(h)
             return (h, i + 1, aux + l_aux), None
 
         body_fn = body
@@ -303,8 +371,10 @@ class GPT2Model(ModelSpec):
             from ..runtime.activation_checkpointing.checkpointing import \
                 get_policy
             body_fn = jax.checkpoint(body, policy=get_policy(cfg.remat_policy))
+        xs = params["blocks"] if extras is None else (params["blocks"],
+                                                      extras)
         (x, _, aux_total), _ = lax.scan(body_fn, (x, 0, jnp.float32(0.0)),
-                                        params["blocks"])
+                                        xs)
 
         x = self._final_norm(params, x)
         return x, aux_total / cfg.n_layer, \
@@ -429,12 +499,15 @@ class GPT2Model(ModelSpec):
             logits = logits + head_b
         return self._lm_loss(logits, batch)
 
-    def apply(self, params, batch, rng=None, train=True):
+    def apply(self, params, batch, rng=None, train=True, pld_theta=None,
+              ltd_keep=None, act_bits=None):
         """Next-token LM loss. batch: {'input_ids': [B,T]} (+ optional
-        'labels' [B,T])."""
+        'labels' [B,T]). pld_theta/ltd_keep/act_bits: see hidden_states."""
         input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
         x, aux, wte = self.hidden_states(params, input_ids, rng=rng,
-                                         train=train)
+                                         train=train, pld_theta=pld_theta,
+                                         ltd_keep=ltd_keep,
+                                         act_bits=act_bits)
         loss = self._head_loss_from_hidden(
             x, wte, batch, head_b=self._head_bias(params, wte.dtype))
         w = self.aux_loss_weight()
@@ -532,16 +605,29 @@ class GPT2Model(ModelSpec):
         # attention mask over the cache: key position <= query position
         q_pos = start_pos + jnp.arange(t)[:, None]
         k_pos = jnp.arange(max_len)[None, :]
-        mask = self._decode_attn_mask(q_pos, k_pos)[None, None]
+        extras = self._layer_extras()
+        pad_valid = None
         if pad_counts is not None:     # left-pad columns are never valid keys
-            valid = jnp.arange(max_len)[None, :] >= pad_counts[:, None]
-            mask = mask & valid[:, None, None, :]
+            pad_valid = jnp.arange(max_len)[None, :] >= pad_counts[:, None]
+        base_mask = None
+        if extras is None:             # layer-independent: compute once
+            base_mask = self._decode_attn_mask(q_pos, k_pos)[None, None]
+            if pad_valid is not None:
+                base_mask = base_mask & pad_valid[:, None, None, :]
         bias = self._decode_attn_bias(q_pos, k_pos)  # [H, T, max_len] | None
 
         from ..ops.flash_attention import reference_attention
 
         def body(x, xs):
-            layer_params, k_cache, v_cache = xs
+            if extras is None:
+                (layer_params, k_cache, v_cache), extra = xs, None
+                mask = base_mask
+            else:
+                layer_params, k_cache, v_cache, extra = xs
+                mask = self._decode_attn_mask_ex(q_pos, k_pos,
+                                                 extra)[None, None]
+                if pad_valid is not None:
+                    mask = mask & pad_valid[:, None, None, :]
             new_kv = {}
 
             def cached_attn(q, k, v):
@@ -559,11 +645,13 @@ class GPT2Model(ModelSpec):
                                            bias=bias)
 
             return self._decode_block(x, layer_params, cached_attn,
-                                      start_pos, positions=positions), \
+                                      start_pos, positions=positions,
+                                      extra=extra), \
                 (new_kv["k"], new_kv["v"])
 
-        x, (new_k, new_v) = lax.scan(
-            body, x, (params["blocks"], cache["k"], cache["v"]))
+        xs = (params["blocks"], cache["k"], cache["v"]) if extras is None \
+            else (params["blocks"], cache["k"], cache["v"], extras)
+        x, (new_k, new_v) = lax.scan(body, x, xs)
         x = self._final_norm(params, x)
         logits = x @ self._unembed_weight(params, compute_dtype).T
         head_b = self._head_bias(params, logits.dtype)

@@ -76,11 +76,12 @@ class GPT2MoEModel(GPT2Model):
         return x + self._dropout(y, rng, train, 1), l_aux
 
     def _decode_block(self, x, layer_params, attn_fn, start_pos,
-                      positions=None):
+                      positions=None, extra=None):
         """KV-cache decode block: attention from the base class, MoE FFN
         through the capacity-free serving path."""
         x = self._attn_sublayer(x, layer_params, None, False, attn_fn=attn_fn,
-                                start_pos=start_pos, positions=positions)
+                                start_pos=start_pos, positions=positions,
+                                extra=extra)
         x, _ = self._mlp_sublayer(x, layer_params, None, False, serve=True)
         return x
 

@@ -1,0 +1,69 @@
+"""GPT-Neo family: GPT-2 skeleton + alternating global/local attention.
+
+Capability match for the reference GPT-Neo injection container
+(module_inject/containers/gptneo.py HFGPTNEOLayerPolicy — round-3 missing
+#5). Architectural deltas vs GPT-2, mapped onto the shared stacked-layer
+skeleton:
+
+  - alternating attention: even layers attend globally, odd layers through
+    a causal sliding window (``window_size``, default 256). The per-layer
+    flag rides the ``_layer_extras`` scan channel, so one compiled block
+    serves both layer kinds (a traced select on the mask/bias).
+  - no q/k/v biases (out_proj keeps one) and NO 1/sqrt(d) attention
+    scaling — the injection policy folds sqrt(head_dim) into the q weight
+    so the shared scaled-attention kernels compute Neo's unscaled product.
+"""
+
+import dataclasses
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from .gpt2 import GPT2Config, GPT2Model
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoConfig(GPT2Config):
+    local_window: int = 256
+    #: per-layer kinds ("global" | "local"); empty = HF default alternation
+    attention_layers: Tuple[str, ...] = ()
+
+    def resolved_attention_layers(self):
+        if self.attention_layers:
+            if len(self.attention_layers) != self.n_layer:
+                raise ValueError(
+                    f"attention_layers has {len(self.attention_layers)} "
+                    f"entries for n_layer={self.n_layer}")
+            return self.attention_layers
+        return tuple("global" if i % 2 == 0 else "local"
+                     for i in range(self.n_layer))
+
+
+class GPTNeoModel(GPT2Model):
+
+    def __init__(self, config: GPTNeoConfig):
+        super().__init__(config)
+
+    def _layer_extras(self):
+        kinds = self.config.resolved_attention_layers()
+        if all(k == "global" for k in kinds):
+            return None  # degenerate: plain GPT-2 attention
+        return jnp.asarray([1.0 if k == "local" else 0.0 for k in kinds],
+                           jnp.float32)
+
+    def _train_attn_bias_ex(self, t, extra):
+        if extra is None:
+            return None
+        q = jnp.arange(t)[:, None]
+        k = jnp.arange(t)[None, :]
+        outside = (q - k) >= self.config.local_window
+        # extra is this layer's traced local-flag: 0 -> zero bias (global)
+        return (extra * jnp.where(outside, -1e9, 0.0))[None].astype(
+            jnp.float32)
+
+    def _decode_attn_mask_ex(self, q_pos, k_pos, extra):
+        base = k_pos <= q_pos
+        if extra is None:
+            return base
+        inside = (q_pos - k_pos) < self.config.local_window
+        return base & (inside | (extra <= 0))

@@ -210,12 +210,12 @@ class GPTNeoXModel(GPT2Model):
         ln2 = _layer_norm(h, p["ln2_scale"], p["ln2_bias"], eps)
         return h + self._dropout(self._mlp_branch(ln2, p), rng, train, 1)
 
-    def _block(self, x, layer_params, rng, train):
+    def _block(self, x, layer_params, rng, train, extra=None):
         return self._block_impl(x, layer_params, rng, train, None, 0), \
             jnp.float32(0.0)
 
     def _decode_block(self, x, layer_params, attn_fn, start_pos,
-                      positions=None):
+                      positions=None, extra=None):
         return self._block_impl(x, layer_params, None, False, attn_fn,
                                 start_pos, positions=positions)
 

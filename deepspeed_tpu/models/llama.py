@@ -144,7 +144,7 @@ class LlamaModel(GPT2Model):
 
     # ----------------------------------------------------------------- block
     def _attn_sublayer(self, x, p, rng, train, attn_fn=None, start_pos=0,
-                       positions=None):
+                       positions=None, extra=None):
         cfg = self.config
         b, t, d = x.shape
         h, hk, hd = cfg.n_head, cfg.kv_head_count, cfg.head_dim

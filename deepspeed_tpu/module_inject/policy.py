@@ -420,6 +420,70 @@ def gpt_neox_policy(model) -> Tuple[Any, Any]:
     return spec, params
 
 
+@register_policy("GPTNeoForCausalLM", "GPTNeoModel")
+def gpt_neo_policy(model) -> Tuple[Any, Any]:
+    """HF GPT-Neo → GPTNeoModel params (reference
+    module_inject/containers/gptneo.py HFGPTNEOLayerPolicy). Quirks handled:
+    separate q/k/v Linears WITHOUT bias (out_proj keeps one), alternating
+    global/local attention from config.attention_layers, and Neo's
+    UNSCALED q·k — folded into the q weight as q *= sqrt(head_dim) so the
+    shared scaled-attention kernel reproduces it."""
+    import math
+
+    import jax.numpy as jnp
+    from ..models.gpt_neo import GPTNeoConfig, GPTNeoModel
+
+    hf = model.transformer if hasattr(model, "transformer") else model
+    hf_cfg = model.config
+    kinds = tuple(hf_cfg.attention_layers)  # e.g. ("global","local",...)
+    cfg = GPTNeoConfig(
+        vocab_size=hf_cfg.vocab_size,
+        n_positions=hf_cfg.max_position_embeddings,
+        n_embd=hf_cfg.hidden_size,
+        n_layer=hf_cfg.num_layers,
+        n_head=hf_cfg.num_heads,
+        layer_norm_epsilon=hf_cfg.layer_norm_epsilon,
+        activation="gelu",  # gelu_new == tanh-approx gelu (our default)
+        local_window=getattr(hf_cfg, "window_size", 256),
+        attention_layers=kinds,
+        pad_vocab_to_multiple=1,
+    )
+    spec = GPTNeoModel(cfg)
+    d = cfg.n_embd
+    qscale = math.sqrt(cfg.head_dim)
+
+    def qkv_w(h):
+        a = h.attn.attention
+        return np.concatenate([_lin_w(a.q_proj) * qscale, _lin_w(a.k_proj),
+                               _lin_w(a.v_proj)], axis=-1)
+
+    stack = lambda field: np.stack([field(h) for h in hf.h])
+    blocks = {
+        "ln1_scale": stack(lambda h: _np(h.ln_1.weight)),
+        "ln1_bias": stack(lambda h: _np(h.ln_1.bias)),
+        "qkv_w": stack(qkv_w),
+        "qkv_b": np.zeros((cfg.n_layer, 3 * d), np.float32),  # Neo: no bias
+        "attn_proj_w": stack(lambda h: _lin_w(h.attn.attention.out_proj)),
+        "attn_proj_b": stack(lambda h: _np(h.attn.attention.out_proj.bias)),
+        "ln2_scale": stack(lambda h: _np(h.ln_2.weight)),
+        "ln2_bias": stack(lambda h: _np(h.ln_2.bias)),
+        "mlp_fc_w": stack(lambda h: _lin_w(h.mlp.c_fc)),
+        "mlp_fc_b": stack(lambda h: _np(h.mlp.c_fc.bias)),
+        "mlp_proj_w": stack(lambda h: _lin_w(h.mlp.c_proj)),
+        "mlp_proj_b": stack(lambda h: _np(h.mlp.c_proj.bias)),
+    }
+    params = {
+        "wte": _np(hf.wte.weight),
+        "wpe": _np(hf.wpe.weight),
+        "blocks": {k: jnp.asarray(v) for k, v in blocks.items()},
+        "ln_f_scale": _np(hf.ln_f.weight),
+        "ln_f_bias": _np(hf.ln_f.bias),
+    }
+    params = {k: (jnp.asarray(v) if not isinstance(v, dict) else v)
+              for k, v in params.items()}
+    return spec, params
+
+
 @register_policy("GPTJForCausalLM")
 def gptj_policy(model) -> Tuple[Any, Any]:
     """HF GPT-J → stacked-layer GPTNeoXModel params in its GPT-J flavor

@@ -212,7 +212,15 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
             good_steps=jnp.int32(sc.get("good_steps", 0)),
             hysteresis=jnp.int32(sc.get("hysteresis", 2)))
     if offload is not None:
-        engine.params = offload.device_params()
+        runner = getattr(engine, "_param_runner", None)
+        if runner is not None:
+            # offload_param: only resident leaves return to device; the
+            # paged blocks re-derive from the restored masters
+            with engine.mesh:
+                engine.params = runner.resident_params()
+            runner._invalidate_pages()
+        else:
+            engine.params = offload.device_params()
     log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
     return ckpt_dir, client_state
 
@@ -222,7 +230,14 @@ def save_16bit_model(engine, save_dir, save_filename="pytorch_model.msgpack"):
     :3194 / _zero3_consolidated_16bit_state_dict :3127): gather everything,
     cast to the compute dtype, single file."""
     dtype = engine._compute_dtype or jnp.float32
-    params_host = _gather_to_host(engine, engine.params)
+    if hasattr(engine, "_drain_offload_pipeline"):
+        engine._drain_offload_pipeline()  # in-flight delayed grads
+    if getattr(engine, "_param_runner", None) is not None:
+        # offload_param: device params are resident-only; the host masters
+        # are the complete tree
+        params_host = engine._offload.masters_tree(copy=False)
+    else:
+        params_host = _gather_to_host(engine, engine.params)
     params16 = jax.tree.map(
         lambda x: x.astype(dtype)
         if jnp.issubdtype(x.dtype, jnp.floating) else x, params_host)

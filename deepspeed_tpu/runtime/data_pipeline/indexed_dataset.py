@@ -4,9 +4,16 @@ Capability match for the reference mmap indexed dataset
 (runtime/data_pipeline/data_sampling/indexed_dataset.py:617
 ``MMapIndexedDataset`` + builder): token sequences stored as one flat binary
 stream plus an index of per-document sizes, read back through np.memmap with
-zero copies. The on-disk format here is our own (simpler: one header, sizes
-and offsets as little-endian int64 arrays) — reading the reference's Megatron
-format is a non-goal; WRITING data for this framework is the use case.
+zero copies. TWO on-disk index formats are supported transparently (sniffed
+by magic):
+
+  - ``DSTPUIDX`` — our own (one header, sizes and element offsets as
+    little-endian int64 arrays).
+  - ``MMIDIDX`` — the Megatron/reference format
+    (data_sampling/indexed_dataset.py:372: 9-byte magic, u64 version=1, u8
+    dtype code, u64 len, u64 doc_count, then int32 sizes, int64 byte
+    pointers, int64 doc_idx), so EXISTING preprocessed .bin/.idx corpora
+    load directly. The builder writes it with ``fmt="mmidx"``.
 
 Files: <path>.bin (payload), <path>.idx (header + sizes + offsets).
 """
@@ -18,11 +25,22 @@ from typing import Sequence
 import numpy as np
 
 _MAGIC = b"DSTPUIDX"
+_MEG_MAGIC = b"MMIDIDX\x00\x00"
 _VERSION = 1
 
 _DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
            6: np.float32, 7: np.float64, 8: np.uint16, 9: np.uint32}
 _DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+# the reference/Megatron code table differs at 6 (float64, not float32)
+_MEG_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+               5: np.int64, 6: np.float64, 7: np.float64, 8: np.uint16,
+               9: np.uint32, 10: np.uint64}
+_MEG_DTYPE_CODES = {np.dtype(np.uint8): 1, np.dtype(np.int8): 2,
+                    np.dtype(np.int16): 3, np.dtype(np.int32): 4,
+                    np.dtype(np.int64): 5, np.dtype(np.float64): 6,
+                    np.dtype(np.uint16): 8, np.dtype(np.uint32): 9,
+                    np.dtype(np.uint64): 10}
 
 
 def data_file_path(prefix):
@@ -35,13 +53,18 @@ def index_file_path(prefix):
 
 class MMapIndexedDatasetBuilder:
 
-    def __init__(self, path_prefix: str, dtype=np.int32):
+    def __init__(self, path_prefix: str, dtype=np.int32, fmt: str = "dstpu"):
         self.prefix = path_prefix
         self.dtype = np.dtype(dtype)
-        if self.dtype not in _DTYPE_CODES:
-            raise ValueError(f"unsupported dtype {dtype}")
+        if fmt not in ("dstpu", "mmidx"):
+            raise ValueError(f"fmt must be 'dstpu' or 'mmidx', got {fmt}")
+        self.fmt = fmt
+        codes = _MEG_DTYPE_CODES if fmt == "mmidx" else _DTYPE_CODES
+        if self.dtype not in codes:
+            raise ValueError(f"unsupported dtype {dtype} for {fmt}")
         self._bin = open(data_file_path(path_prefix), "wb")
         self.sizes = []
+        self._doc_marks = [0]
 
     def add_item(self, tokens: Sequence):
         arr = np.ascontiguousarray(np.asarray(tokens), dtype=self.dtype)
@@ -50,9 +73,17 @@ class MMapIndexedDatasetBuilder:
 
     def add_document(self, tokens):
         self.add_item(tokens)
+        self.end_document()
+
+    def end_document(self):
+        """Megatron semantics: mark a document boundary after the sequences
+        added so far (doc_idx records sequence indices)."""
+        self._doc_marks.append(len(self.sizes))
 
     def finalize(self):
         self._bin.close()
+        if self.fmt == "mmidx":
+            return self._finalize_mmidx()
         sizes = np.asarray(self.sizes, dtype=np.int64)
         offsets = np.zeros(len(sizes) + 1, dtype=np.int64)
         np.cumsum(sizes, out=offsets[1:])
@@ -62,6 +93,26 @@ class MMapIndexedDatasetBuilder:
                                 _DTYPE_CODES[self.dtype], len(sizes)))
             f.write(sizes.tobytes())
             f.write(offsets.tobytes())
+
+    def _finalize_mmidx(self):
+        """Write the reference MMIDIDX layout byte-for-byte
+        (data_sampling/indexed_dataset.py:372-416)."""
+        sizes = np.asarray(self.sizes, dtype=np.int64)
+        pointers = np.zeros(len(sizes), dtype=np.int64)
+        if len(sizes) > 1:
+            np.cumsum(sizes[:-1] * self.dtype.itemsize, out=pointers[1:])
+        doc_idx = np.asarray(
+            self._doc_marks if len(self._doc_marks) > 1 else [0, len(sizes)],
+            dtype=np.int64)
+        with open(index_file_path(self.prefix), "wb") as f:
+            f.write(_MEG_MAGIC)
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<B", _MEG_DTYPE_CODES[self.dtype]))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(doc_idx)))
+            f.write(sizes.astype(np.int32).tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(doc_idx.tobytes(order="C"))
 
     def __enter__(self):
         return self
@@ -75,18 +126,48 @@ class MMapIndexedDataset:
 
     def __init__(self, path_prefix: str):
         self.prefix = path_prefix
+        self.doc_idx = None
         with open(index_file_path(path_prefix), "rb") as f:
-            magic = f.read(len(_MAGIC))
-            if magic != _MAGIC:
-                raise ValueError(f"{index_file_path(path_prefix)}: bad magic")
-            version, code, n = struct.unpack("<HHI", f.read(8))
-            if version != _VERSION:
-                raise ValueError(f"unsupported index version {version}")
-            self.dtype = np.dtype(_DTYPES[code])
-            self.sizes = np.frombuffer(f.read(8 * n), dtype=np.int64)
-            self.offsets = np.frombuffer(f.read(8 * (n + 1)), dtype=np.int64)
+            head = f.read(9)
+            if head == _MEG_MAGIC:
+                self._read_mmidx_index(f)
+            elif head[:len(_MAGIC)] == _MAGIC:
+                f.seek(len(_MAGIC))
+                version, code, n = struct.unpack("<HHI", f.read(8))
+                if version != _VERSION:
+                    raise ValueError(f"unsupported index version {version}")
+                self.dtype = np.dtype(_DTYPES[code])
+                self.sizes = np.frombuffer(f.read(8 * n), dtype=np.int64)
+                self.offsets = np.frombuffer(f.read(8 * (n + 1)),
+                                             dtype=np.int64)
+            else:
+                raise ValueError(
+                    f"{index_file_path(path_prefix)}: unrecognized magic "
+                    f"{head!r} (neither DSTPUIDX nor Megatron MMIDIDX)")
         self._data = np.memmap(data_file_path(path_prefix), dtype=self.dtype,
                                mode="r")
+
+    def _read_mmidx_index(self, f):
+        """Reference/Megatron MMIDIDX reader
+        (data_sampling/indexed_dataset.py:419-455): existing preprocessed
+        corpora load without conversion."""
+        (version,) = struct.unpack("<Q", f.read(8))
+        if version != 1:
+            raise ValueError(f"unsupported MMIDIDX version {version}")
+        (code,) = struct.unpack("<B", f.read(1))
+        self.dtype = np.dtype(_MEG_DTYPES[code])
+        (n,) = struct.unpack("<Q", f.read(8))
+        (doc_count,) = struct.unpack("<Q", f.read(8))
+        self.sizes = np.frombuffer(f.read(4 * n),
+                                   dtype=np.int32).astype(np.int64)
+        pointers = np.frombuffer(f.read(8 * n), dtype=np.int64)
+        self.doc_idx = np.frombuffer(f.read(8 * doc_count), dtype=np.int64)
+        # pointers are BYTE offsets; internal API uses element offsets
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        offsets[:n] = pointers // self.dtype.itemsize
+        offsets[n] = (pointers[-1] // self.dtype.itemsize +
+                      self.sizes[-1]) if n else 0
+        self.offsets = offsets
 
     def __len__(self):
         return len(self.sizes)

@@ -152,14 +152,35 @@ class DeepSpeedEngine:
         rng = jax.random.PRNGKey(cfg.seed)
         param_shapes = jax.eval_shape(model.init, rng)
         self.param_shapes = param_shapes
+        # frozen-leaf protocol (LoRA base freeze): the optimizer must not
+        # touch these leaves at all — stop_gradient alone would still let
+        # decoupled weight decay erode them
+        self._frozen_mask = (model.frozen_param_mask(param_shapes)
+                             if hasattr(model, "frozen_param_mask")
+                             else None)
         self._pre_init_validate()
         self.param_shardings = self.planner.param_shardings(param_shapes)
         zoff = zcfg.offload_optimizer
+        zpar = zcfg.offload_param
         self._offload = None
+        self._param_runner = None
         offload_active = (zoff is not None and
                           getattr(zoff, "device", "none") != "none" and
                           self.optimizer is not None)
-        with self.mesh:
+        if zpar is not None and getattr(zpar, "device", "none") != "none":
+            # ZeRO-Infinity param offload: weights page through HBM layer
+            # by layer; no full-size tree ever materializes on device
+            # (runtime/zero/param_offload.py). Config validation guarantees
+            # stage 3 + offload_optimizer here.
+            from .zero.param_offload import ParamOffloadRunner
+            self._param_runner = ParamOffloadRunner(self, rng)
+            self._offload = self._param_runner.host_opt
+            with self.mesh:
+                self.params = self._param_runner.resident_params()
+            self.opt_state = None
+            self.opt_state_shardings = None
+        else:
+          with self.mesh:
             params_f32 = jax.jit(model.init,
                                  out_shardings=self.param_shardings)(rng)
             if offload_active:
@@ -169,7 +190,8 @@ class DeepSpeedEngine:
                 from .zero.offload import HostOffloadOptimizer
                 self._offload = HostOffloadOptimizer(
                     self.optimizer.name, self.optimizer.defaults, params_f32,
-                    self.param_shardings, self._compute_dtype, zoff)
+                    self.param_shardings, self._compute_dtype, zoff,
+                    frozen_mask=self._frozen_mask)
                 if self._compute_dtype is not None:
                     cast = jax.jit(
                         lambda p: _cast_tree(p, self._compute_dtype),
@@ -192,6 +214,12 @@ class DeepSpeedEngine:
                 else:
                     self.opt_state = None
                     self.opt_state_shardings = None
+        # one-step-delayed optimizer exchange (offload_optimizer.pipeline_*)
+        self._offload_pending = None
+        self._offload_pipelined = (offload_active and
+                                   self._param_runner is None and
+                                   zoff is not None and
+                                   getattr(zoff, "pipeline", False))
         self.grad_shardings = self.planner.grad_shardings(param_shapes)
         self.scaler_state = init_loss_scale_state(cfg.fp16 if cfg.fp16.enabled else None)
         self._base_rng = jax.random.PRNGKey(cfg.seed + 1)
@@ -245,6 +273,64 @@ class DeepSpeedEngine:
                     f"and set curriculum_learning.data_analysis_path, or "
                     f"wire a DeepSpeedDataSampler with metric_values "
                     f"through deepspeed_io(data_sampler=...)")
+
+        # ---- progressive layer drop (reference engine.py:1667 injects
+        #      theta into forward kwargs) ----
+        self.progressive_layer_drop = None
+        pld = dict(cfg.progressive_layer_drop or {})
+        if pld.get("enabled"):
+            self._require_fwd_kwarg("pld_theta", "progressive_layer_drop")
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=float(pld.get("theta", 0.5)),
+                gamma=float(pld.get("gamma", 0.001)))
+
+        # ---- random-LTD (reference data_routing/basic_layer.py:14 wraps
+        #      layers; here the model's layer scan consumes ltd_keep) ----
+        self.random_ltd_scheduler = None
+        routing = dict(de.get("data_routing") or {})
+        rl = dict(routing.get("random_ltd") or {})
+        if de.get("enabled") and routing.get("enabled") and rl.get("enabled"):
+            self._require_fwd_kwarg("ltd_keep", "random_ltd")
+            from .data_pipeline.random_ltd import RandomLTDScheduler
+            self.random_ltd_scheduler = RandomLTDScheduler(rl)
+
+        # ---- MoQ (quantize_training): schedule-driven precision drop on
+        #      the master weights, optionally gated by Hessian eigenvalues
+        #      (reference engine.py:1995-2008) ----
+        self.quantizer = None
+        self.eigenvalue = None
+        qt = dict((cfg._param_dict or {}).get("quantize_training") or {})
+        if qt.get("enabled"):
+            from .config_utils import ConfigError
+            if self._offload is not None:
+                raise ConfigError(
+                    "quantize_training (MoQ) is not supported together with "
+                    "ZeRO-Offload (masters live host-side)")
+            from .quantize import Quantizer
+            bits = dict(qt.get("quantize_bits") or {})
+            sched = dict(qt.get("quantize_schedule") or {})
+            algo = dict(qt.get("quantize_algo") or {})
+            self.quantizer = Quantizer(
+                q_target_bits=int(bits.get("target_bits", 8)),
+                q_start_bits=int(bits.get("start_bits", 16)),
+                q_period=int(sched.get("quantize_period", 100)),
+                q_offset=int(sched.get("schedule_offset", 100)),
+                q_groups=int(qt.get("quantize_groups", 1)),
+                q_type=algo.get("q_type", "symmetric"),
+                q_rounding=algo.get("rounding", "nearest"),
+                q_verbose=bool(qt.get("quantize_verbose", False)))
+            self._moq_modules = tuple(qt.get("modules", ("",)))
+            eig = dict(qt.get("eigenvalue") or {})
+            if eig.get("enabled"):
+                from .eigenvalue import Eigenvalue
+                self.eigenvalue = Eigenvalue(
+                    verbose=bool(eig.get("verbose", False)),
+                    max_iter=int(eig.get("max_iter", 20)),
+                    tol=float(eig.get("tol", 1e-2)),
+                    stability=float(eig.get("stability", 1e-6)))
+        self._last_eig_batch = None
+        self._last_modifiers = (None, None)
 
         # ---- activation checkpointing: JSON block -> remat policy on the
         #      model (reference checkpointing.py:789 configure()) ----
@@ -301,6 +387,24 @@ class DeepSpeedEngine:
         """Hook for subclasses to validate model/mesh compatibility after
         param shapes are known but before params materialize."""
 
+    def _require_fwd_kwarg(self, name: str, feature: str):
+        """Accepted config = active config: a feature that needs the model's
+        cooperation must raise, not silently no-op, when the model cannot
+        honor it."""
+        import inspect
+        from .config_utils import ConfigError
+        try:
+            sig = inspect.signature(self.module.apply).parameters
+        except (TypeError, ValueError):  # pragma: no cover - exotic models
+            sig = {}
+        accepts = name in sig or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD for p in sig.values())
+        if not accepts:
+            raise ConfigError(
+                f"config enables {feature} but "
+                f"{type(self.module).__name__}.apply() does not accept "
+                f"'{name}' — this model cannot honor the setting")
+
     # ------------------------------------------------------------------
     # compiled step functions
     # ------------------------------------------------------------------
@@ -311,12 +415,19 @@ class DeepSpeedEngine:
         spec = P(None, *base) if leading_gas else base
         return NamedSharding(self.mesh, spec)
 
-    def _micro_loss(self, params, mb, rng, train=True, precast=False):
+    def _micro_loss(self, params, mb, rng, train=True, precast=False,
+                    pld_theta=None, ltd_keep=None):
         """Loss of one micro batch. ``precast=True`` means ``params`` is
         already in compute dtype (the train path hoists the cast out of the
-        gas scan)."""
+        gas scan). pld_theta (traced) / ltd_keep (static) are the
+        progressive-layer-drop and random-LTD forward kwargs."""
         pc = params if precast else _cast_tree(params, self._compute_dtype)
-        out = self.module.apply(pc, mb, rng=rng, train=train)
+        kwargs = {}
+        if pld_theta is not None:
+            kwargs["pld_theta"] = pld_theta
+        if ltd_keep is not None:
+            kwargs["ltd_keep"] = ltd_keep
+        out = self.module.apply(pc, mb, rng=rng, train=train, **kwargs)
         loss = out[0] if isinstance(out, tuple) else out
         return loss.astype(jnp.float32)
 
@@ -342,7 +453,13 @@ class DeepSpeedEngine:
 
         def do_update(args):
             p, s = args
-            return self.optimizer.update(grads, s, p, lr)
+            new_p, new_s = self.optimizer.update(grads, s, p, lr)
+            if self._frozen_mask is not None:
+                # static mask: XLA dead-code-eliminates frozen leaves' math
+                new_p = jax.tree.map(
+                    lambda frz, old, new: old if frz else new,
+                    self._frozen_mask, p, new_p)
+            return new_p, new_s
 
         def skip(args):
             return args
@@ -357,11 +474,19 @@ class DeepSpeedEngine:
         return new_params, new_opt, new_scaler, finite, grad_norm
 
     def _compile_fns(self):
+        if self._param_runner is not None:
+            # the param-offload runner owns its own per-stage jits; the
+            # whole-tree step fns below would require full params on device
+            self._train_step_fn = self._grad_step_fn = None
+            self._micro_grad_fn = self._acc_fn = self._apply_fn = None
+            self._eval_fn = None
+            return
         mesh = self.mesh
         rep = NamedSharding(mesh, P())
 
         # --- shared gradient-accumulation body (scan over gas micros) ---
-        def accum_grads(params, scaler_state, batch, rng):
+        def accum_grads(params, scaler_state, batch, rng, pld_theta=None,
+                        ltd_keep=None):
             gas = jax.tree.leaves(batch)[0].shape[0]
             scale = scaler_state.scale
 
@@ -373,7 +498,9 @@ class DeepSpeedEngine:
             pc = _cast_tree(params, self._compute_dtype)
 
             def scaled_loss(pc_, mb, r):
-                return self._micro_loss(pc_, mb, r, precast=True) * scale
+                return self._micro_loss(pc_, mb, r, precast=True,
+                                        pld_theta=pld_theta,
+                                        ltd_keep=ltd_keep) * scale
 
             grad_fn = jax.value_and_grad(scaled_loss)
             grad_specs = jax.tree.map(lambda s: s.spec, self.grad_shardings)
@@ -406,56 +533,77 @@ class DeepSpeedEngine:
                     (batch, jnp.arange(gas)))
             return lsum, gsum, gas
 
-        # --- fused train_batch step: accumulate + in-jit optimizer update ---
-        def train_step(params, opt_state, scaler_state, batch, lr, rng):
-            lsum, gsum, gas = accum_grads(params, scaler_state, batch, rng)
-            new_params, new_opt, new_scaler, finite, grad_norm = \
-                self._apply_update(params, opt_state, scaler_state, gsum, lr,
-                                   denom=jnp.float32(gas))
-            metrics = {
-                "loss": lsum / (gas * scaler_state.scale),
-                "grad_norm": grad_norm,
-                "loss_scale": scaler_state.scale,
-                "overflow": ~finite,
-            }
-            return new_params, new_opt, new_scaler, metrics
+        # --- fused train_batch step: accumulate + in-jit optimizer update.
+        # pld_theta is a traced arg (changes every step); ltd_keep is
+        # STATIC — each reached token budget compiles once (the same
+        # trade the seqlen curriculum makes), cached in _train_step_cache.
+        def make_train_step(ltd_keep):
+            def train_step(params, opt_state, scaler_state, batch, lr, rng,
+                           pld_theta):
+                lsum, gsum, gas = accum_grads(params, scaler_state, batch,
+                                              rng, pld_theta, ltd_keep)
+                new_params, new_opt, new_scaler, finite, grad_norm = \
+                    self._apply_update(params, opt_state, scaler_state, gsum,
+                                       lr, denom=jnp.float32(gas))
+                metrics = {
+                    "loss": lsum / (gas * scaler_state.scale),
+                    "grad_norm": grad_norm,
+                    "loss_scale": scaler_state.scale,
+                    "overflow": ~finite,
+                }
+                return new_params, new_opt, new_scaler, metrics
 
-        self._train_step_fn = jax.jit(
-            train_step,
-            in_shardings=(self.param_shardings, self.opt_state_shardings,
-                          None, self._batch_sharding(True), None, None),
-            out_shardings=(self.param_shardings, self.opt_state_shardings,
-                           None, None),
-            donate_argnums=(0, 1, 2)) \
+            return jax.jit(
+                train_step,
+                in_shardings=(self.param_shardings, self.opt_state_shardings,
+                              None, self._batch_sharding(True), None, None,
+                              None),
+                out_shardings=(self.param_shardings,
+                               self.opt_state_shardings, None, None),
+                donate_argnums=(0, 1, 2))
+
+        self._make_train_step = make_train_step
+        self._train_step_cache = {}
+        self._train_step_fn = make_train_step(None) \
             if self.optimizer is not None and self._offload is None else None
 
         # --- offload path: grads-only step; host SIMD Adam applies them ---
-        def grad_step(params, scaler_state, batch, rng):
-            lsum, gsum, gas = accum_grads(params, scaler_state, batch, rng)
-            return lsum / (gas * scaler_state.scale), gsum
+        def make_grad_step(ltd_keep):
+            def grad_step(params, scaler_state, batch, rng, pld_theta):
+                lsum, gsum, gas = accum_grads(params, scaler_state, batch,
+                                              rng, pld_theta, ltd_keep)
+                return lsum / (gas * scaler_state.scale), gsum
 
-        self._grad_step_fn = jax.jit(
-            grad_step,
-            in_shardings=(self.param_shardings, None,
-                          self._batch_sharding(True), None),
-            out_shardings=(rep, self.grad_shardings)) \
+            return jax.jit(
+                grad_step,
+                in_shardings=(self.param_shardings, None,
+                              self._batch_sharding(True), None, None),
+                out_shardings=(rep, self.grad_shardings))
+
+        self._make_grad_step = make_grad_step
+        self._grad_step_fn = make_grad_step(None) \
             if self._offload is not None else None
 
         # --- micro grad (forward/backward API path) ---
-        def micro_grad(params, mb, rng, scale):
-            def scaled_loss(p):
-                return self._micro_loss(p, mb, rng) * scale
-            loss, g = jax.value_and_grad(scaled_loss)(params)
-            g = jax.tree.map(lambda x: x.astype(jnp.float32), g)
-            g = lax.with_sharding_constraint(
-                g, jax.tree.map(lambda s: s.spec, self.grad_shardings))
-            return loss, g
+        def make_micro_grad(ltd_keep):
+            def micro_grad(params, mb, rng, scale, pld_theta):
+                def scaled_loss(p):
+                    return self._micro_loss(p, mb, rng, pld_theta=pld_theta,
+                                            ltd_keep=ltd_keep) * scale
+                loss, g = jax.value_and_grad(scaled_loss)(params)
+                g = jax.tree.map(lambda x: x.astype(jnp.float32), g)
+                g = lax.with_sharding_constraint(
+                    g, jax.tree.map(lambda s: s.spec, self.grad_shardings))
+                return loss, g
 
-        self._micro_grad_fn = jax.jit(
-            micro_grad,
-            in_shardings=(self.param_shardings, self._batch_sharding(False),
-                          None, None),
-            out_shardings=(rep, self.grad_shardings))
+            return jax.jit(
+                micro_grad,
+                in_shardings=(self.param_shardings,
+                              self._batch_sharding(False), None, None, None),
+                out_shardings=(rep, self.grad_shardings))
+
+        self._make_micro_grad = make_micro_grad
+        self._micro_grad_fn = make_micro_grad(None)
 
         def acc_grads(acc, g):
             return jax.tree.map(jnp.add, acc, g)
@@ -553,14 +701,23 @@ class DeepSpeedEngine:
     def forward(self, batch, train=True):
         """Compute the micro-batch loss. The grads for this batch are
         produced lazily in backward()."""
+        if self._param_runner is not None:
+            raise RuntimeError(
+                "offload_param supports the train_batch()/eval_batch() API "
+                "only (the forward/backward/step micro API would re-page "
+                "every layer per call)")
         self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = self._apply_curriculum(batch, min_ndim=2)
         self._pending_batch = self._to_device_batch(batch)
         rng = jax.random.fold_in(self._base_rng, self.micro_steps)
         scale = self.scaler_state.scale
+        theta, keep = self._step_modifiers() if train else (None, None)
+        fn = self._micro_grad_fn if keep is None else \
+            self._train_step_cache.setdefault(
+                ("micro", keep), self._make_micro_grad(keep))
         with self.mesh:
-            loss, grads = self._micro_grad_fn(self.params, self._pending_batch,
-                                              rng, scale)
+            loss, grads = fn(self.params, self._pending_batch, rng, scale,
+                             theta)
         self._pending_grads = grads
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss / scale
@@ -606,11 +763,57 @@ class DeepSpeedEngine:
         self.timers(STEP_GLOBAL_TIMER).stop()
         return metrics
 
-    def _offload_apply(self, grads, denom):
+    def _pipelined_offload_step(self, fn, batch, rng, theta, gas):
+        """One-step-delayed optimizer exchange (reference
+        swap_tensor/pipelined_optimizer_swapper.py; round-3 weak #4): the
+        grad step for THIS batch is dispatched async, then the host applies
+        the PREVIOUS batch's grads (Adam on the masters) and uploads fresh
+        params while the device computes. Params used by step N therefore
+        reflect grads through step N-2 — the standard delayed-param-update
+        staleness, opted into via offload_optimizer.pipeline_read/write."""
+        with self.mesh:
+            loss, gsum = fn(self.params, self.scaler_state, batch, rng,
+                            theta)
+        # start this step's grad d2h immediately so it lands during the
+        # next step's host work
+        for g in jax.tree.leaves(gsum):
+            try:
+                g.copy_to_host_async()
+            except AttributeError:
+                pass
+        pend = self._offload_pending
+        # the grads were produced under the CURRENT loss scale; by the time
+        # they apply (next call) update_loss_scale may have moved it
+        self._offload_pending = {"gsum": gsum, "denom": gas, "loss": loss,
+                                 "scale": float(self.scaler_state.scale)}
+        if pend is None:
+            # first step: nothing to apply yet (params lag one step)
+            return {"loss": loss, "grad_norm": 0.0, "overflow": False,
+                    "loss_scale": float(self.scaler_state.scale),
+                    "pipelined_skip": True}
+        metrics = self._offload_apply(pend["gsum"], denom=pend["denom"],
+                                      scale=pend["scale"])
+        metrics["loss"] = pend["loss"]
+        return metrics
+
+    def _drain_offload_pipeline(self):
+        """Apply any in-flight delayed grads (checkpoint/export/eval
+        boundaries need the masters caught up)."""
+        pend = getattr(self, "_offload_pending", None)
+        if pend is None:
+            return
+        self._offload_pending = None
+        self._offload_apply(pend["gsum"], denom=pend["denom"],
+                            scale=pend["scale"])
+
+    def _offload_apply(self, grads, denom, scale=None):
         """Host-side optimizer step (ZeRO-Offload): unscale/clip/step on the
-        CPU SIMD path, refresh the device's compute-dtype params."""
+        CPU SIMD path, refresh the device's compute-dtype params.
+        ``scale``: the loss scale the grads were PRODUCED under (pipelined
+        mode applies them one step later, when the live scale may differ)."""
         cfg = self._config
-        scale = float(self.scaler_state.scale)
+        if scale is None:
+            scale = float(self.scaler_state.scale)
         lr = float(self.get_lr()[0])
         new_params, info = self._offload.step(
             grads, lr, unscale=1.0 / (denom * scale),
@@ -638,32 +841,54 @@ class DeepSpeedEngine:
         if batch is None:
             batch = self._next_gas_batch(data_iter)
         batch = self._apply_curriculum(batch)
+        if self._param_runner is not None:
+            self.tput_timer.start()
+            metrics = self._param_runner.train_batch(batch)
+            self.micro_steps += cfg.gradient_accumulation_steps
+            self._post_step(metrics)
+            self.tput_timer.stop(global_step=True)
+            return metrics["loss"]
         batch = self._to_device_batch(batch)
         self.tput_timer.start()
         rng = jax.random.fold_in(self._base_rng, self.global_steps)
         self._maybe_profile_flops(batch, rng)
+        theta, keep = self._step_modifiers()
+        if self.eigenvalue is not None:
+            self._last_eig_batch = (jax.tree.map(lambda x: x[0], batch), rng)
         if self._offload is not None:
             # denom = the batch's ACTUAL gas dim (accum_grads derives gas the
             # same way), not the config value — they can legitimately differ
             gas = jax.tree.leaves(batch)[0].shape[0]
-            with self.mesh:
-                loss, gsum = self._grad_step_fn(self.params, self.scaler_state,
-                                                batch, rng)
-            metrics = self._offload_apply(gsum, denom=float(gas))
-            metrics["loss"] = loss
+            fn = self._grad_step_fn if keep is None else \
+                self._train_step_cache.setdefault(
+                    ("grad", keep), self._make_grad_step(keep))
+            if self._offload_pipelined:
+                metrics = self._pipelined_offload_step(fn, batch, rng, theta,
+                                                       float(gas))
+            else:
+                with self.mesh:
+                    loss, gsum = fn(self.params, self.scaler_state, batch,
+                                    rng, theta)
+                metrics = self._offload_apply(gsum, denom=float(gas))
+                metrics["loss"] = loss
         else:
             lr = jnp.float32(self.get_lr()[0])
+            fn = self._train_step_fn if keep is None else \
+                self._train_step_cache.setdefault(
+                    ("train", keep), self._make_train_step(keep))
             with self.mesh:
                 (self.params, self.opt_state, self.scaler_state,
-                 metrics) = self._train_step_fn(self.params, self.opt_state,
-                                                self.scaler_state, batch, lr,
-                                                rng)
+                 metrics) = fn(self.params, self.opt_state,
+                               self.scaler_state, batch, lr, rng, theta)
         self.micro_steps += cfg.gradient_accumulation_steps
         self._post_step(metrics)
         self.tput_timer.stop(global_step=True)
         return metrics["loss"]
 
     def eval_batch(self, batch):
+        if self._param_runner is not None:
+            return self._param_runner.eval_batch(batch)
+        self._drain_offload_pipeline()
         batch = self._to_device_batch(batch)
         with self.mesh:
             return self._eval_fn(self.params, batch)
@@ -711,9 +936,10 @@ class DeepSpeedEngine:
         if prof_fn is None:
             return
         lr = jnp.float32(self.get_lr()[0])
-        args = (self.params, self.scaler_state, batch, rng) \
+        args = (self.params, self.scaler_state, batch, rng, None) \
             if self._offload is not None else \
-            (self.params, self.opt_state, self.scaler_state, batch, lr, rng)
+            (self.params, self.opt_state, self.scaler_state, batch, lr, rng,
+             None)
         profiler = FlopsProfiler(fpcfg)
         with self.mesh:
             prof = profiler.profile(prof_fn, *args)
@@ -752,9 +978,56 @@ class DeepSpeedEngine:
     def _to_device_batch(self, batch):
         return jax.tree.map(jnp.asarray, batch)
 
+    def _step_modifiers(self):
+        """Per-step forward modifiers: (pld_theta traced scalar | None,
+        ltd_keep static int | None). Stored for _post_step logging."""
+        theta = None
+        if self.progressive_layer_drop is not None:
+            theta = jnp.float32(self.progressive_layer_drop.update_state(
+                self.global_steps))
+        keep = None
+        if self.random_ltd_scheduler is not None:
+            keep = int(self.random_ltd_scheduler.get_current_seq(
+                self.global_steps))
+        self._last_modifiers = (theta, keep)
+        return theta, keep
+
+    def _maybe_moq_step(self):
+        """MoQ precision schedule (reference engine.py:1995-2008): at a
+        potential switch boundary, optionally compute per-subtree Hessian
+        eigenvalues to gate the drop, then project the masters through the
+        new precision's fake-quant."""
+        q = self.quantizer
+        if q is None:
+            return
+        due = (q.current_bits > q.target_bits and
+               self.global_steps >= q._next_switch)
+        eigs = None
+        if due and self.eigenvalue is not None and \
+                self._last_eig_batch is not None:
+            mb, rng = self._last_eig_batch
+            def loss_fn(p):
+                return self._micro_loss(p, mb, rng, train=False)
+            with self.mesh:
+                eigs = self.eigenvalue.compute_layer_eigenvalues(
+                    loss_fn, self.params, rng)
+        if not q.update(self.global_steps, eigs):
+            return
+        key = ("moq", q.current_bits)
+        if key not in self._cached_fns:
+            self._cached_fns[key] = jax.jit(
+                lambda p, r: q.quantize(p, modules=self._moq_modules, rng=r),
+                out_shardings=self.param_shardings, donate_argnums=0)
+        with self.mesh:
+            # disjoint from the per-step stream (which folds global_steps)
+            moq_rng = jax.random.fold_in(self._base_rng,
+                                         2**30 + self.global_steps)
+            self.params = self._cached_fns[key](self.params, moq_rng)
+
     def _post_step(self, metrics):
         self._emit_flops_report(metrics)
         self.global_steps += 1
+        self._maybe_moq_step()
         # compression scheduler (reference engine.py:1955): a technique
         # going live changes the traced program — recompile once
         sched = getattr(self.module, "compression_scheduler", None)
@@ -776,6 +1049,17 @@ class DeepSpeedEngine:
             if self._config.fp16.enabled:
                 events.append(("Train/Samples/loss_scale",
                                float(metrics["loss_scale"]), self.global_samples))
+            theta, keep = self._last_modifiers
+            if theta is not None:
+                events.append(("Train/Samples/pld_theta", float(theta),
+                               self.global_samples))
+            if keep is not None:
+                events.append(("Train/Samples/random_ltd_effective_seq",
+                               keep, self.global_samples))
+            if self.quantizer is not None:
+                events.append(("Train/Samples/moq_bits",
+                               self.quantizer.current_bits,
+                               self.global_samples))
             self.monitor.write_events(events)
         if (self._config.steps_per_print and
                 self.global_steps % self._config.steps_per_print == 0):
@@ -846,6 +1130,7 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True, exclude_frozen_parameters=False):
+        self._drain_offload_pipeline()
         from .checkpointing import save_checkpoint
         return save_checkpoint(self, save_dir, tag=tag,
                                client_state=client_state,
@@ -854,6 +1139,7 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
+        self._offload_pending = None  # in-flight delayed grads are stale
         from .checkpointing import load_checkpoint
         out = load_checkpoint(self, load_dir, tag=tag,
                               load_optimizer_states=load_optimizer_states,
@@ -873,6 +1159,7 @@ class DeepSpeedEngine:
         utils/zero_to_fp32.py, as a live call). Under ZeRO-Offload the fp32
         masters live on the host — return those (device params are bf16)."""
         if self._offload is not None:
+            self._drain_offload_pipeline()
             return self._offload.masters_tree()
         rep = jax.tree.map(lambda _: NamedSharding(self.mesh, P()),
                            self.param_shardings)

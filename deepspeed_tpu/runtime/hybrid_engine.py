@@ -18,9 +18,11 @@ via eval()/train(), and routes ZeRO-3 generation through per-layer gathers
     train mode only if params changed mid-accumulation (matching the
     reference's guard rails, inference/engine.py:588-style).
 
-LoRA fuse/unfuse (:120-146) is a torch-module mutation with no analogue
-here: a functional model bakes adapters into its apply, so there is
-nothing to fuse — documented divergence, not a missing path.
+LoRA fuse/unfuse (:120-146): with a ``runtime.lora.LoRAModel`` actor the
+serving reshard MERGES the adapters into base-shaped weights (one jitted
+W + (alpha/r)·a@b per refresh) and generation runs the BASE model — the
+reference's fuse-before-generate with zero per-step adapter cost; unfuse
+is free because the training tree is never mutated.
 """
 
 import jax
@@ -69,6 +71,19 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         return self
 
     # -- generation ------------------------------------------------------
+    def _serving_model_and_params(self):
+        """(model, params) for serving. A LoRA actor fuses here: adapters
+        merge into base-shaped weights and the BASE model serves them
+        (reference hybrid_engine.py:120-146 fuse_lora-before-generate)."""
+        from .lora import LoRAModel
+        params = self._live_params()
+        if isinstance(self.module, LoRAModel):
+            with self.mesh:
+                merged = jax.jit(lambda p: self.module.merge(
+                    p, freeze_base=False))(params)
+            return self.module.base, merged
+        return self.module, params
+
     def _serving_engine(self):
         from ..inference.config import DeepSpeedInferenceConfig
         from ..inference.engine import InferenceEngine
@@ -81,8 +96,9 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 "max_tokens": self._he_max_tokens,
                 "tensor_parallel": {"tp_size": self._he_tp},
             })
+            model, params = self._serving_model_and_params()
             self._gen_engine = InferenceEngine(
-                self.module, icfg, params=self._live_params(),
+                model, icfg, params=params,
                 mesh_manager=self.mesh_manager)
             self._mark_serving_fresh()
         elif self._serving_stale():
@@ -110,9 +126,10 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     def _refresh_serving_params(self):
         """Re-shard/cast the live training params into the serving layout —
         the reference's ZeRO-3 gather-for-generation (:333) as ONE jitted
-        resharding."""
+        resharding (LoRA adapters merge in the same pass)."""
         eng = self._gen_engine
-        eng.params = eng.recast(self._live_params())
+        _, params = self._serving_model_and_params()
+        eng.params = eng.recast(params)
         self._mark_serving_fresh()
 
     def generate(self, input_ids, **kwargs):

@@ -1,0 +1,174 @@
+"""Functional LoRA — low-rank adapters for RLHF actor training.
+
+Capability match for the reference hybrid-engine LoRA path
+(runtime/hybrid_engine.py:120-146 ``fuse_lora``/``unfuse_lora`` around
+generation; DS-Chat's ``only_optimize_lora`` freezes the base). The torch
+implementation mutates Linear modules and fuses W += a@b in place before
+decode; functionally the same design is cleaner:
+
+  - params = {"base": <frozen base tree>, "lora": {<leaf path>: {a, b}}} —
+    adapters are ordinary pytree leaves, so ZeRO sharding, checkpointing,
+    and the tensor-fragment API see them like any weight.
+  - ``apply`` merges W_eff = stop_grad(W) + (alpha/r)·a@b and runs the base
+    model: gradients flow ONLY into the adapters (the only_optimize_lora
+    contract), and XLA hoists the merge out of the decode scan.
+  - the hybrid engine's serving reshard calls ``merge`` and serves the BASE
+    model on base-shaped weights — fuse_lora as a one-shot jitted
+    resharding instead of an in-place mutation, unfuse is a no-op because
+    the training tree never changed.
+
+Stacked [L, ...] block leaves get batched adapters ([L, in, r] @ [L, r,
+out]), so the layer scan slices them coherently.
+"""
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+
+DEFAULT_TARGETS = ("qkv_w", "attn_proj_w", "mlp_fc_w", "mlp_proj_w",
+                   "q_proj", "k_proj", "v_proj", "o_proj",
+                   "gate_w", "up_w", "down_w")
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    r: int = 8
+    alpha: float = 16.0
+    target_modules: Sequence[str] = DEFAULT_TARGETS
+    freeze_base: bool = True
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d or {})
+        d.pop("enabled", None)
+        if d.pop("dropout", 0.0):
+            raise ValueError(
+                "lora.dropout is not supported by the merge-based adapter "
+                "(input-side dropout has no merged form); set it to 0")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown lora config keys: {sorted(unknown)}")
+        return cls(**d)
+
+
+class LoRAModel:
+    """ModelSpec wrapper adding LoRA adapters to a base model."""
+
+    def __init__(self, base, lora_config: LoRAConfig = None):
+        self.base = base
+        self.lora_config = lora_config or LoRAConfig()
+        if self.lora_config.r < 1:
+            raise ValueError(f"lora r must be >= 1, got {self.lora_config.r}")
+
+    @property
+    def config(self):
+        return self.base.config
+
+    # ------------------------------------------------------------- params
+    def _target_paths(self, shapes):
+        flat = jax.tree_util.tree_flatten_with_path(shapes)[0]
+        out = []
+        for kp, leaf in flat:
+            if getattr(leaf, "ndim", 0) < 2:
+                continue
+            path = "/".join(str(getattr(k, "key", k)) for k in kp)
+            if any(path.endswith(t) for t in self.lora_config.target_modules):
+                out.append((path, tuple(leaf.shape)))
+        if not out:
+            raise ValueError(
+                f"no parameters match lora target_modules="
+                f"{tuple(self.lora_config.target_modules)}")
+        return out
+
+    def init(self, rng):
+        base_params = self.base.init(rng)
+        cfg = self.lora_config
+        lora = {}
+        for i, (path, shape) in enumerate(self._target_paths(base_params)):
+            key = jax.random.fold_in(jax.random.fold_in(rng, 7102), i)
+            *lead, fan_in, fan_out = shape
+            # standard LoRA init: a ~ N(0, 1/r), b = 0 → merged == base at
+            # step 0 (the adapter starts as an exact no-op)
+            lora[path] = {
+                "a": jax.random.normal(key, (*lead, fan_in, cfg.r),
+                                       jnp.float32) / max(1, cfg.r),
+                "b": jnp.zeros((*lead, cfg.r, fan_out), jnp.float32),
+            }
+        log_dist(f"LoRA: r={cfg.r} alpha={cfg.alpha} adapters on "
+                 f"{len(lora)} weights (base "
+                 f"{'frozen' if cfg.freeze_base else 'trainable'})",
+                 ranks=[0])
+        return {"base": base_params, "lora": lora}
+
+    # -------------------------------------------------------------- merge
+    def merge(self, params, freeze_base=None):
+        """Base-shaped tree with adapters folded in: W + (alpha/r)·a@b.
+        With freeze_base (training default) the base side is
+        stop_gradient-ed, so grads reach only the adapters."""
+        cfg = self.lora_config
+        if freeze_base is None:
+            freeze_base = cfg.freeze_base
+        scale = cfg.alpha / cfg.r
+        lora = params["lora"]
+
+        def leaf(kp, w):
+            path = "/".join(str(getattr(k, "key", k)) for k in kp)
+            base_w = jax.lax.stop_gradient(w) if freeze_base else w
+            ab = lora.get(path)
+            if ab is None:
+                return base_w
+            delta = (ab["a"].astype(w.dtype) @ ab["b"].astype(w.dtype))
+            return base_w + scale * delta
+
+        return jax.tree_util.tree_map_with_path(leaf, params["base"])
+
+    def frozen_param_mask(self, param_shapes):
+        """Engine protocol: pytree of bools marking leaves the optimizer
+        must NOT mutate. stop_gradient zeroes base grads, but decoupled
+        weight decay would still erode the frozen base without this."""
+        if not self.lora_config.freeze_base:
+            return None
+        return {"base": jax.tree.map(lambda _: True, param_shapes["base"]),
+                "lora": jax.tree.map(lambda _: False, param_shapes["lora"])}
+
+    def adapter_state(self, params):
+        """The adapter subtree alone (adapter-only checkpoint payload)."""
+        return params["lora"]
+
+    def load_adapter_state(self, params, lora_state):
+        return {"base": params["base"], "lora": lora_state}
+
+    # ----------------------------------------------------- model protocol
+    def apply(self, params, batch, rng=None, train=True, **kwargs):
+        return self.base.apply(self.merge(params), batch, rng=rng,
+                               train=train, **kwargs)
+
+    def logits(self, params, input_ids, rng=None, train=False, **kwargs):
+        return self.base.logits(self.merge(params), input_ids, rng=rng,
+                                train=train, **kwargs)
+
+    def init_kv_cache(self, *args, **kwargs):
+        return self.base.init_kv_cache(*args, **kwargs)
+
+    def apply_with_cache(self, params, input_ids, cache, start_pos,
+                         **kwargs):
+        return self.base.apply_with_cache(self.merge(params), input_ids,
+                                          cache, start_pos, **kwargs)
+
+    def partition_rules(self):
+        """Base rules apply (paths are suffix-matched regexes, so the
+        'base/' prefix is transparent); adapters replicate (small)."""
+        return (self.base.partition_rules()
+                if hasattr(self.base, "partition_rules") else [])
+
+    def cache_partition_rules(self):
+        return (self.base.cache_partition_rules()
+                if hasattr(self.base, "cache_partition_rules") else [])
+
+    def flops_per_token(self, *args, **kwargs):
+        return self.base.flops_per_token(*args, **kwargs)

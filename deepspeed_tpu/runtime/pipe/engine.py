@@ -62,6 +62,15 @@ class PipelineEngine(DeepSpeedEngine):
         super().__init__(*args, **kwargs)
 
     def _pre_init_validate(self):
+        cfg = self._config
+        routing = dict(dict(cfg.data_efficiency or {}).get("data_routing")
+                       or {})
+        if dict(cfg.progressive_layer_drop or {}).get("enabled") or \
+                dict(routing.get("random_ltd") or {}).get("enabled"):
+            raise ValueError(
+                "progressive_layer_drop / random_ltd are not supported "
+                "under pipeline parallelism (the pipeline stage functions "
+                "bypass the model's forward kwargs)")
         if self._interpreted:
             return
         blocks = self.param_shapes[self._pspec["blocks_key"]]
@@ -187,7 +196,12 @@ class PipelineEngine(DeepSpeedEngine):
         mesh = self.mesh
         rep = NamedSharding(mesh, P())
 
-        def train_step(params, opt_state, scaler_state, batch, lr, rng):
+        # pld_theta/random-ltd modifiers are not supported by the compiled
+        # pipeline (the stage functions bypass the model's forward kwargs);
+        # configs enabling them raise in __init__ — the arg exists only to
+        # match the base train_batch calling convention.
+        def train_step(params, opt_state, scaler_state, batch, lr, rng,
+                       pld_theta=None):
             scale = scaler_state.scale
 
             def scaled_loss(p):
@@ -210,7 +224,8 @@ class PipelineEngine(DeepSpeedEngine):
         self._train_step_fn = jax.jit(
             train_step,
             in_shardings=(self.param_shardings, self.opt_state_shardings,
-                          None, self._batch_sharding(True), None, None),
+                          None, self._batch_sharding(True), None, None,
+                          None),
             out_shardings=(self.param_shardings, self.opt_state_shardings,
                            None, None),
             donate_argnums=(0, 1, 2)) if self.optimizer is not None else None

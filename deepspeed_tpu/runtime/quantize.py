@@ -37,21 +37,27 @@ class Quantizer:
         self.current_bits = q_start_bits
         self._next_switch = q_offset
         self._cur_period = self.period
+        self._postponed = 0
+        self.max_postpones = 3
 
     def update(self, global_step: int,
                eigenvalues: Optional[Dict[str, float]] = None) -> bool:
         """Advance the precision schedule; True if bits changed. With
         eigenvalues, the switch is postponed while curvature is above the
-        median (the reference's eigenvalue-gated switching)."""
+        median (the reference's eigenvalue-gated switching) — but at most
+        ``max_postpones`` consecutive times, so heterogeneous models (where
+        the spread across blocks never narrows) still reach target bits."""
         if self.current_bits <= self.target_bits or \
                 global_step < self._next_switch:
             return False
-        if eigenvalues:
+        if eigenvalues and self._postponed < self.max_postpones:
             vals = sorted(eigenvalues.values())
             median = vals[len(vals) // 2]
             if max(vals) > 2.0 * max(median, 1e-12):
+                self._postponed += 1
                 self._next_switch = global_step + self._cur_period
                 return False
+        self._postponed = 0
         self.current_bits = max(self.target_bits, self.current_bits // 2)
         self._cur_period *= 2  # reference: doubling periods between drops
         self._next_switch = global_step + self._cur_period

@@ -178,8 +178,16 @@ class HostOffloadOptimizer:
     address; under SPMD multi-host the masters would shard over processes the
     same way grads do (future work, noted in docs)."""
 
+    #: placeholder leaf returned in place of a device array for host-only
+    #: leaves (ZeRO-Infinity offload_param: the paged blocks never get a
+    #: full device copy — runtime/zero/param_offload.py uploads per-layer
+    #: pages instead). A sentinel, not None: None would change the pytree
+    #: structure under jax.tree.unflatten.
+    HOST_RESIDENT = "<host-resident>"
+
     def __init__(self, name: str, defaults: dict, params_device,
-                 param_shardings, compute_dtype, offload_cfg):
+                 param_shardings, compute_dtype, offload_cfg,
+                 host_only_mask=None, frozen_mask=None):
         assert supports_offload(name), \
             f"offload_optimizer supports adam/adamw/adagrad/lion, got {name}"
         self.name = name.lower()
@@ -196,6 +204,19 @@ class HostOffloadOptimizer:
 
         leaves, self.treedef = jax.tree.flatten(params_device)
         self.shardings = jax.tree.leaves(param_shardings)
+        # host-only leaves (offload_param): masters/moments are kept and
+        # stepped here like any other leaf, but no whole-leaf device array
+        # is ever produced — step()/device_params() return HOST_RESIDENT.
+        self.host_only = (jax.tree.leaves(host_only_mask)
+                          if host_only_mask is not None
+                          else [False] * len(leaves))
+        assert len(self.host_only) == len(leaves)
+        # frozen leaves (LoRA base): the step must not touch them — with
+        # zero grads Adam's update is 0, but decoupled weight decay is not
+        self.frozen = (jax.tree.leaves(frozen_mask)
+                       if frozen_mask is not None
+                       else [False] * len(leaves))
+        assert len(self.frozen) == len(leaves)
         self.shapes = [tuple(x.shape) for x in leaves]
         # device params live in the COMPUTE dtype (bf16) — that is the HBM
         # saving; floating leaves get compute_dtype, others keep their own
@@ -206,7 +227,10 @@ class HostOffloadOptimizer:
             for x in leaves]
         self.sizes = [int(np.prod(s or (1,))) for s in self.shapes]
         for x in leaves:
-            x.copy_to_host_async()
+            try:
+                x.copy_to_host_async()
+            except AttributeError:  # host-initialized numpy leaves
+                pass
         # np.array(copy=True): np.asarray on a jax.Array is a READ-ONLY view
         # of jax-owned memory — the native kernel writes through raw
         # pointers, so the host must own these buffers.
@@ -218,7 +242,10 @@ class HostOffloadOptimizer:
                        np.dtype(self._bf16).itemsize == 2 and
                        str(np.dtype(compute_dtype)) == "bfloat16"
                        if self._bf16 is not None else False)
-        self._w16 = ([np.empty(n, self._bf16) for n in self.sizes]
+        # no whole-leaf bf16 buffer for host-only leaves: pages are
+        # converted slice-by-slice by the param-offload runner
+        self._w16 = ([np.empty(n, self._bf16) if not ho else None
+                      for n, ho in zip(self.sizes, self.host_only)]
                      if self._out16 else None)
 
         dev = offload_cfg.device
@@ -242,32 +269,38 @@ class HostOffloadOptimizer:
         else:
             m, v = self.store.get_ram(i)
         w16 = self._w16[i] if self._out16 else None
+        wd = 0.0 if self.frozen[i] else self.weight_decay
         if self.name in _ADAM_FAMILY:
             self.ops.adam_step(w, grad_flat, m, v, self.step_count, lr,
                                self.beta1, self.beta2, self.eps,
-                               weight_decay=self.weight_decay,
+                               weight_decay=wd,
                                decoupled=self.decoupled,
                                bias_correction=self.bias_correction, w16=w16)
         elif self.name == "adagrad":
-            self.ops.adagrad_step(w, grad_flat, v, lr, self.eps,
-                                  self.weight_decay)
+            self.ops.adagrad_step(w, grad_flat, v, lr, self.eps, wd)
             if w16 is not None:
                 self.ops.fp32_to_bf16(w, w16)
         elif self.name == "lion":
             self.ops.lion_step(w, grad_flat, m, lr, self.beta1, self.beta2,
-                               self.weight_decay)
+                               wd)
             if w16 is not None:
                 self.ops.fp32_to_bf16(w, w16)
         if self.store.nvme:
             self.store.writeback(i)
+        if self.host_only[i]:
+            return self.HOST_RESIDENT
         out = w16 if w16 is not None else w
         return jax.device_put(out.reshape(self.shapes[i]).astype(
             self.dtypes[i], copy=False), self.shardings[i])
 
     def step(self, grads_device, lr, unscale: float = 1.0,
-             clip: float = 0.0, check_finite: bool = False):
+             clip: float = 0.0, check_finite: bool = False,
+             grads_preowned: bool = False):
         """One optimizer step. grads_device: pytree of device arrays (scaled
-        by `1/unscale`). Returns (new_params_device, info dict)."""
+        by `1/unscale`). Returns (new_params_device, info dict).
+        ``grads_preowned``: numpy fp32 leaves are the caller's to mutate —
+        skip the defensive copy (the param-offload runner hands over
+        multi-GB host accumulation buffers)."""
         g_leaves = jax.tree.leaves(grads_device)
         assert len(g_leaves) == len(self.masters)
         for g in g_leaves:
@@ -276,8 +309,11 @@ class HostOffloadOptimizer:
             except AttributeError:
                 pass
         # owned copies (see masters note): scale_/clip mutate in place
-        host_grads = [np.array(g, dtype=np.float32, copy=True).reshape(-1)
-                      for g in g_leaves]
+        host_grads = [
+            g.reshape(-1) if (grads_preowned and isinstance(g, np.ndarray)
+                              and g.dtype == np.float32)
+            else np.array(g, dtype=np.float32, copy=True).reshape(-1)
+            for g in g_leaves]
 
         if unscale != 1.0:
             for g in host_grads:
@@ -346,9 +382,13 @@ class HostOffloadOptimizer:
             [np.asarray(a, np.float32) for a in aslist(state["v"])])
 
     def device_params(self):
-        """Push current masters to device in the param dtype/sharding."""
+        """Push current masters to device in the param dtype/sharding.
+        Host-only leaves stay host-side (HOST_RESIDENT placeholder)."""
         leaves = []
         for i, w in enumerate(self.masters):
+            if self.host_only[i]:
+                leaves.append(self.HOST_RESIDENT)
+                continue
             if self._out16:
                 w16 = self._w16[i]
                 self.ops.fp32_to_bf16(w, w16)

@@ -51,6 +51,84 @@ def test_weight_quantization_transforms_matching_leaves():
     assert changed > 0 and unchanged > 0
 
 
+def _aq_config(offset=0, bits=8):
+    return {"compression_training": {"activation_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": offset},
+        "different_groups": {"aq1": {"params": {"bits": bits},
+                                     "modules": ["*"]}}}}}
+
+
+def _lr_config(keep=1, teacher_layer=None):
+    lr = {"enabled": True, "keep_number_layer": keep}
+    if teacher_layer is not None:
+        lr["teacher_layer"] = teacher_layer
+    return {"compression_training": {"layer_reduction": lr}}
+
+
+def test_activation_quantization_changes_forward():
+    """QuantAct (reference basic_layer.py): enabling the block measurably
+    changes the loss; 2-bit activations must hurt more than 8-bit."""
+    model = GPT2Model(TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    ids = {"input_ids": (np.arange(32, dtype=np.int32) * 7).reshape(2, 16)
+           % 255}
+    plain = float(jax.jit(lambda p: model.apply(p, ids, train=False))(params))
+    m8 = init_compression(GPT2Model(TINY), _aq_config(bits=8))
+    m2 = init_compression(GPT2Model(TINY), _aq_config(bits=2))
+    l8 = float(jax.jit(lambda p: m8.apply(p, ids, train=False))(params))
+    l2 = float(jax.jit(lambda p: m2.apply(p, ids, train=False))(params))
+    assert l8 != plain
+    assert abs(l2 - plain) > abs(l8 - plain)
+
+
+def test_activation_quantization_respects_schedule_offset():
+    m = init_compression(GPT2Model(TINY), _aq_config(offset=5))
+    assert m._act_bits() is None          # not live at step 0
+    m.compression_scheduler.step(5)
+    assert m._act_bits() == 8
+
+
+def test_activation_quantization_unsupported_model_raises():
+    class NoActModel(GPT2Model):
+        def apply(self, params, batch, rng=None, train=True):
+            return super().apply(params, batch, rng=rng, train=train)
+    with pytest.raises(ValueError, match="act_bits"):
+        init_compression(NoActModel(TINY), _aq_config())
+
+
+def test_layer_reduction_student_initialization():
+    """Reference compress.py:167: student layers copy the selected teacher
+    layers; non-layer modules copy verbatim."""
+    from deepspeed_tpu.compression.compress import student_initialization
+    teacher = GPT2Model(TINY)
+    tp = teacher.init(jax.random.PRNGKey(0))
+    cfg = _lr_config(keep=1, teacher_layer=[1])
+    student = init_compression(GPT2Model(TINY), cfg)
+    assert student.inner.config.n_layer == 1
+    sp = student_initialization(student, tp, cfg)
+    np.testing.assert_array_equal(np.asarray(sp["wte"]),
+                                  np.asarray(tp["wte"]))
+    np.testing.assert_array_equal(
+        np.asarray(sp["blocks"]["qkv_w"][0]),
+        np.asarray(tp["blocks"]["qkv_w"][1]))
+    # the student forward runs
+    ids = {"input_ids": np.arange(16, dtype=np.int32).reshape(1, 16) % 255}
+    loss = float(jax.jit(
+        lambda p: student.apply(p, ids, train=False))(sp))
+    assert np.isfinite(loss)
+
+
+def test_layer_reduction_bad_selection_raises():
+    from deepspeed_tpu.compression.compress import student_initialization
+    teacher = GPT2Model(TINY)
+    tp = teacher.init(jax.random.PRNGKey(0))
+    student = init_compression(GPT2Model(TINY),
+                               _lr_config(keep=1, teacher_layer=[1]))
+    with pytest.raises(ValueError, match="outside"):
+        student_initialization(student, tp,
+                               _lr_config(keep=1, teacher_layer=[7]))
+
+
 def test_sparse_pruning_ratio():
     from deepspeed_tpu.compression.compress import sparse_prune_leaf
     rng = np.random.default_rng(0)

@@ -69,6 +69,60 @@ def test_indexed_dataset_roundtrip(tmp_path):
     np.testing.assert_array_equal(ds[-1], docs[-1])
 
 
+def test_mmidx_reads_reference_format_fixture(tmp_path):
+    """A byte-for-byte Megatron MMIDIDX fixture (written with raw struct,
+    mirroring reference data_sampling/indexed_dataset.py:372-416) must load
+    without conversion — the component's value is reading EXISTING
+    preprocessed corpora (round-3 weak #5)."""
+    import struct
+    prefix = str(tmp_path / "meg")
+    docs = [np.arange(n, dtype=np.int32) * 2 for n in (4, 9, 2)]
+    with open(prefix + ".bin", "wb") as f:
+        for d in docs:
+            f.write(d.tobytes(order="C"))
+    sizes = np.array([len(d) for d in docs], np.int32)
+    pointers = np.zeros(len(docs), np.int64)
+    pointers[1:] = np.cumsum(sizes[:-1].astype(np.int64) * 4)
+    doc_idx = np.array([0, 1, 3], np.int64)
+    with open(prefix + ".idx", "wb") as f:
+        f.write(b"MMIDIDX\x00\x00")
+        f.write(struct.pack("<Q", 1))
+        f.write(struct.pack("<B", 4))          # dtype code 4 = int32
+        f.write(struct.pack("<Q", len(sizes)))
+        f.write(struct.pack("<Q", len(doc_idx)))
+        f.write(sizes.tobytes(order="C"))
+        f.write(pointers.tobytes(order="C"))
+        f.write(doc_idx.tobytes(order="C"))
+
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 3 and ds.dtype == np.int32
+    for i, d in enumerate(docs):
+        np.testing.assert_array_equal(ds[i], d)
+    np.testing.assert_array_equal(ds.doc_idx, doc_idx)
+    np.testing.assert_array_equal(ds.get(1, offset=3, length=2),
+                                  docs[1][3:5])
+
+
+def test_mmidx_builder_roundtrip(tmp_path):
+    """Our builder's fmt='mmidx' output is reference-layout on disk and
+    reads back through the sniffing reader."""
+    import struct
+    prefix = str(tmp_path / "megw")
+    docs = [np.arange(n, dtype=np.int32) for n in (5, 1, 7)]
+    with MMapIndexedDatasetBuilder(prefix, dtype=np.int32,
+                                   fmt="mmidx") as b:
+        for d in docs:
+            b.add_document(d)
+    raw = open(prefix + ".idx", "rb").read()
+    assert raw[:9] == b"MMIDIDX\x00\x00"
+    assert struct.unpack("<Q", raw[9:17]) == (1,)
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 3 and ds.total_tokens == 13
+    for i, d in enumerate(docs):
+        np.testing.assert_array_equal(ds[i], d)
+    np.testing.assert_array_equal(ds.doc_idx, [0, 1, 2, 3])
+
+
 def test_indexed_dataset_bad_magic(tmp_path):
     prefix = str(tmp_path / "bad")
     (tmp_path / "bad.idx").write_bytes(b"NOTMAGIC" + b"\0" * 16)

@@ -1,0 +1,158 @@
+"""GPT-Neo and Megatron-GPT(+MoE) serving (round-3 missing #5).
+
+Closes the injection-container matrix: reference
+module_inject/containers/gptneo.py, megatron_gpt.py, megatron_gpt_moe.py.
+Done-criterion from the verdict: injection from a synthetic Megatron
+checkpoint through generate().
+"""
+
+import os
+import types
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.inference.engine import InferenceEngine
+
+from .test_megatron_ckpt import (_full_tensors, _write_ckpt, D, H, L, T, V)
+
+
+# ------------------------------------------------------------- GPT-Neo
+
+def _tiny_hf_neo():
+    transformers = pytest.importorskip("transformers")
+    cfg = transformers.GPTNeoConfig(
+        vocab_size=256, max_position_embeddings=64, hidden_size=32,
+        num_layers=2, num_heads=4, attention_types=[[["global", "local"], 1]],
+        window_size=8, intermediate_size=None,
+        embed_dropout=0.0, attention_dropout=0.0, resid_dropout=0.0)
+    torch.manual_seed(0)
+    return transformers.GPTNeoForCausalLM(cfg).eval()
+
+
+def test_gpt_neo_injection_logits_parity():
+    hf = _tiny_hf_neo()
+    icfg = DeepSpeedInferenceConfig.from_dict({"dtype": "float32"})
+    eng = InferenceEngine(hf, icfg)
+    # seq > window so the local layers' sliding mask actually binds
+    ids = ((np.arange(24) * 7) % 255).astype(np.int32)[None, :]
+    ours = np.asarray(eng(ids), np.float32)
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(ids).long()).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=1e-3)
+
+
+def test_gpt_neo_generate_matches_hf_greedy():
+    hf = _tiny_hf_neo()
+    icfg = DeepSpeedInferenceConfig.from_dict(
+        {"dtype": "float32", "max_tokens": 64})
+    eng = InferenceEngine(hf, icfg)
+    prompt = ((np.arange(12) * 11) % 255).astype(np.int32)[None, :]
+    ours = np.asarray(eng.generate(prompt, max_new_tokens=6))
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.from_numpy(prompt).long(), max_new_tokens=6,
+            do_sample=False).numpy()
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_gpt_neo_local_mask_binds():
+    """The alternating local window must CHANGE the logits vs all-global
+    (guards against a policy that maps local layers as global)."""
+    from deepspeed_tpu.models.gpt_neo import GPTNeoConfig, GPTNeoModel
+    import jax
+
+    base = dict(vocab_size=256, n_positions=64, n_embd=32, n_layer=2,
+                n_head=4, local_window=4, pad_vocab_to_multiple=1)
+    m_alt = GPTNeoModel(GPTNeoConfig(
+        **base, attention_layers=("global", "local")))
+    m_glob = GPTNeoModel(GPTNeoConfig(
+        **base, attention_layers=("global", "global")))
+    params = m_alt.init(jax.random.PRNGKey(0))
+    ids = ((np.arange(16) * 3) % 255).astype(np.int32)[None, :]
+    la = np.asarray(jax.jit(lambda p: m_alt.logits(p, ids))(params))
+    lg = np.asarray(jax.jit(lambda p: m_glob.logits(p, ids))(params))
+    assert not np.allclose(la, lg, atol=1e-5)
+    # ...and the decode path agrees with the train-path logits
+    cache = m_alt.init_kv_cache(1, 32, dtype=np.float32)
+    ld, _ = jax.jit(
+        lambda p, c: m_alt.apply_with_cache(p, ids, c, 0))(params, cache)
+    np.testing.assert_allclose(la, np.asarray(ld), atol=1e-4, rtol=1e-4)
+
+
+# -------------------------------------------------- Megatron-GPT serving
+
+def test_megatron_checkpoint_serves_through_generate(tmp_path):
+    rng = np.random.default_rng(3)
+    full = _full_tensors(rng)
+    # small weights so random logits stay sane
+    full = {k: (v * 0.05 if v.ndim else v) for k, v in full.items()}
+    _write_ckpt(str(tmp_path), full, tp=2, pp=1, version=2.0)
+
+    eng = deepspeed_tpu.init_inference(
+        str(tmp_path), {"dtype": "float32", "max_tokens": 64})
+    prompt = ((np.arange(8) * 5) % (V - 1)).astype(np.int32)[None, :]
+    out = np.asarray(eng.generate(prompt, max_new_tokens=4))
+    assert out.shape == (1, 12)
+    logits = np.asarray(eng(prompt), np.float32)
+    assert np.all(np.isfinite(logits))
+
+
+# ---------------------------------------------- Megatron-DeepSpeed MoE
+
+def _write_moe_ckpt(path, rng, n_exp=4):
+    """Synthetic Megatron-DeepSpeed MoE checkpoint: dense shards carry the
+    gate (layers.N.mlp.deepspeed_moe.gate.wg.weight) and NO dense MLP;
+    experts live in layer_<L>_expert_<E>_mp_rank_00_model_states.pt
+    (reference engine.py:2876 / _get_expert_ckpt_name :2472)."""
+    full = _full_tensors(rng)
+    full = {k: v * 0.05 for k, v in full.items()}
+    for i in range(L):
+        for k in list(full):
+            if k.startswith(f"layers.{i}.mlp."):
+                del full[k]
+        full[f"layers.{i}.mlp.deepspeed_moe.gate.wg.weight"] = \
+            (rng.standard_normal((n_exp, D)) * 0.05).astype(np.float32)
+    _write_ckpt(str(path), full, tp=1, pp=1, version=2.0)
+    ff = 4 * D
+    experts = {}
+    for i in range(L):
+        for e in range(n_exp):
+            state = {
+                "prefix.dense_h_to_4h.weight": torch.from_numpy(
+                    (rng.standard_normal((ff, D)) * 0.05).astype(np.float32)),
+                "prefix.dense_h_to_4h.bias": torch.zeros(ff),
+                "prefix.dense_4h_to_h.weight": torch.from_numpy(
+                    (rng.standard_normal((D, ff)) * 0.05).astype(np.float32)),
+                "prefix.dense_4h_to_h.bias": torch.zeros(D),
+            }
+            experts[(i, e)] = state
+            torch.save(state, os.path.join(
+                str(path), f"layer_{i}_expert_{e}_mp_rank_00_"
+                           f"model_states.pt"))
+    return experts
+
+
+def test_megatron_moe_checkpoint_serves(tmp_path):
+    from deepspeed_tpu.checkpoint.megatron import load_megatron_checkpoint
+    from deepspeed_tpu.models.gpt2_moe import GPT2MoEModel
+
+    rng = np.random.default_rng(5)
+    experts = _write_moe_ckpt(tmp_path, rng)
+    spec, params = load_megatron_checkpoint(str(tmp_path))
+    assert isinstance(spec, GPT2MoEModel)
+    assert spec.config.num_experts == 4
+    # expert weights landed where the fixture put them (layer 1, expert 2)
+    want = experts[(1, 2)]["prefix.dense_h_to_4h.weight"].numpy().T
+    np.testing.assert_allclose(
+        np.asarray(params["blocks"]["moe"]["experts"]["wi"][1][2]), want)
+
+    eng = InferenceEngine(spec, DeepSpeedInferenceConfig.from_dict(
+        {"dtype": "float32", "max_tokens": 64}), params=params)
+    prompt = ((np.arange(8) * 5) % (V - 1)).astype(np.int32)[None, :]
+    out = np.asarray(eng.generate(prompt, max_new_tokens=4))
+    assert out.shape == (1, 12)

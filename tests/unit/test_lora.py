@@ -1,0 +1,118 @@
+"""LoRA + hybrid engine (round-3 missing #3).
+
+Reference anchors: runtime/hybrid_engine.py:120-146 (fuse/unfuse LoRA
+around generation), DS-Chat's only_optimize_lora (base frozen during RLHF
+actor updates). Done-criteria from the round-3 verdict: LoRA-only grads,
+generate() parity merged vs unmerged, adapter checkpoint round-trip.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.runtime.lora import LoRAConfig, LoRAModel
+
+TINY = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                  n_head=4, pad_vocab_to_multiple=8)
+
+
+def config(**over):
+    cfg = {"train_batch_size": 16, "train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 2,
+           # nonzero weight_decay: a frozen base must survive DECOUPLED
+           # decay too, not just zero grads (stop_gradient alone fails this)
+           "optimizer": {"type": "adamw",
+                         "params": {"lr": 1e-2, "weight_decay": 0.1}},
+           "zero_optimization": {"stage": 2}, "steps_per_print": 0,
+           "lora": {"enabled": True, "r": 4, "alpha": 8.0}}
+    cfg.update(over)
+    return cfg
+
+
+def train_some(engine, steps=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return [float(engine.train_batch(batch={
+        "input_ids": rng.integers(0, 255, (2, 8, 32), dtype=np.int32)}))
+        for _ in range(steps)]
+
+
+def snapshot(tree):
+    return jax.tree.map(lambda x: np.asarray(x, np.float32).copy(), tree)
+
+
+def test_lora_only_grads_base_frozen():
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(TINY),
+                                               config=config())
+    assert isinstance(engine.module, LoRAModel)
+    base0 = snapshot(engine.params["base"])
+    losses = train_some(engine)
+    assert np.all(np.isfinite(losses))
+    for a, b in zip(jax.tree.leaves(base0),
+                    jax.tree.leaves(snapshot(engine.params["base"]))):
+        np.testing.assert_array_equal(a, b)  # base bit-identically frozen
+    moved = sum(float(np.abs(np.asarray(x, np.float32)).sum())
+                for subtree in engine.params["lora"].values()
+                for x in jax.tree.leaves(subtree))
+    assert moved > 0, "adapters never received gradients"
+
+
+def test_lora_initial_merge_is_identity():
+    model = LoRAModel(GPT2Model(TINY), LoRAConfig(r=4))
+    params = model.init(jax.random.PRNGKey(0))
+    merged = jax.jit(lambda p: model.merge(p, freeze_base=False))(params)
+    for a, b in zip(jax.tree.leaves(params["base"]),
+                    jax.tree.leaves(merged)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_lora_generate_parity_merged_vs_unmerged():
+    cfg = config(hybrid_engine={"enabled": True, "max_out_tokens": 64})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(TINY),
+                                               config=cfg)
+    train_some(engine, steps=2)
+    prompt = (np.arange(16, dtype=np.int32).reshape(1, 16) * 5) % 255
+    # serving path: adapters FUSED into base-shaped weights
+    fused_logits = np.asarray(engine.forward_logits(prompt), np.float32)
+    # unmerged path: the LoRA model's own logits at serving dtype
+    cast = jax.tree.map(
+        lambda x: x.astype("bfloat16")
+        if x.dtype == np.float32 else x, engine.params)
+    unmerged = np.asarray(jax.jit(
+        lambda p: engine.module.logits(p, prompt))(cast), np.float32)
+    assert np.abs(fused_logits - unmerged).max() < 0.1
+    out = engine.generate(prompt, max_new_tokens=4)
+    assert np.asarray(out).shape == (1, 20)
+
+
+def test_lora_adapter_checkpoint_roundtrip(tmp_path):
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(TINY),
+                                               config=config())
+    train_some(engine, steps=2)
+    adapters = snapshot(engine.module.adapter_state(engine.params))
+    engine.save_checkpoint(str(tmp_path))
+
+    from deepspeed_tpu.parallel import topology as _topo
+    _topo.reset_mesh()
+    engine2, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(TINY),
+                                                config=config())
+    engine2.load_checkpoint(str(tmp_path))
+    restored = snapshot(engine2.module.adapter_state(engine2.params))
+    for a, b in zip(jax.tree.leaves(adapters), jax.tree.leaves(restored)):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    # trajectories continue identically
+    l1 = train_some(engine, steps=1, seed=9)
+    l2 = train_some(engine2, steps=1, seed=9)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_lora_config_contract():
+    with pytest.raises(ValueError, match="dropout"):
+        LoRAConfig.from_dict({"r": 4, "dropout": 0.1})
+    with pytest.raises(ValueError, match="unknown lora config keys"):
+        LoRAConfig.from_dict({"rank": 4})
+    with pytest.raises(ValueError, match="target_modules"):
+        LoRAModel(GPT2Model(TINY),
+                  LoRAConfig(target_modules=("nope",))).init(
+            jax.random.PRNGKey(0))

@@ -130,6 +130,36 @@ def test_onebit_lamb_runs():
     assert np.all(np.isfinite(np.asarray(u["w"])))
 
 
+def test_onebit_lamb_trust_ratio_separates_it_from_adam():
+    """What makes LAMB lamb (round-3 weak #7): the layer-wise trust ratio
+    ||w||/||update|| scales each tensor's step with its parameter norm —
+    identical grads on params of different scale produce proportionally
+    different updates, unlike (onebit-)Adam whose update is
+    norm-independent."""
+    from deepspeed_tpu.runtime.fp16.onebit.adam import scale_by_onebit_adam
+    from deepspeed_tpu.runtime.fp16.onebit.lamb import scale_by_onebit_lamb
+
+    params = {"small": jnp.full((16, 16), 0.1),
+              "big": jnp.full((16, 16), 10.0)}
+    grads = {"small": jnp.full((16, 16), 0.01),
+             "big": jnp.full((16, 16), 0.01)}
+
+    lamb = scale_by_onebit_lamb(freeze_step=100)
+    s = lamb.init(params)
+    u, s = lamb.update(grads, s, params)
+    r_lamb = (float(jnp.linalg.norm(u["big"])) /
+              float(jnp.linalg.norm(u["small"])))
+    assert r_lamb > 10, f"no trust-ratio scaling: ratio {r_lamb}"
+
+    adam = scale_by_onebit_adam(freeze_step=100)
+    sa = adam.init(params)
+    ua, sa = adam.update(grads, sa, params)
+    r_adam = (float(jnp.linalg.norm(ua["big"])) /
+              float(jnp.linalg.norm(ua["small"])))
+    assert abs(r_adam - 1.0) < 0.1, f"adam should be norm-independent: " \
+                                    f"{r_adam}"
+
+
 # ------------------------------------------------------------------- engine
 @pytest.mark.parametrize("opt", ["OneBitAdam", "OneBitLamb", "ZeroOneAdam"])
 def test_engine_trains_with_onebit_optimizers(opt):

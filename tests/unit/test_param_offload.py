@@ -1,0 +1,133 @@
+"""ZeRO-Infinity parameter offload (zero_optimization.offload_param).
+
+Capability match for the reference param swapper
+(deepspeed/runtime/swap_tensor/partitioned_param_swapper.py:36,
+runtime/zero/stage3.py:463): weights page through HBM layer by layer, so a
+model whose bf16 weights exceed device memory still trains. Pattern follows
+tests/unit/test_offload.py: offload is a *placement* change, so the paged
+trajectory must match the resident-weights baseline.
+"""
+
+import glob
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.runtime.config_utils import ConfigError
+
+TINY = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                  n_head=4, pad_vocab_to_multiple=8)
+
+
+def config(param_device="cpu", opt_device="cpu", stage=3, **over):
+    cfg = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-3, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": stage},
+        "steps_per_print": 0,
+    }
+    if param_device:
+        cfg["zero_optimization"]["offload_param"] = {"device": param_device}
+    if opt_device:
+        cfg["zero_optimization"]["offload_optimizer"] = {"device": opt_device}
+    cfg.update(over)
+    return cfg
+
+
+def batches(n=3, gas=2, global_micro=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"input_ids": rng.integers(0, 255, (gas, global_micro, 16),
+                                       dtype=np.int32)} for _ in range(n)]
+
+
+def run_steps(cfg, bs=None, model_cfg=TINY):
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(model_cfg),
+                                               config=cfg)
+    losses = [float(engine.train_batch(batch=b)) for b in bs or batches()]
+    return engine, losses
+
+
+def test_param_offload_matches_resident_baseline():
+    """Same trajectory as offload_optimizer-only (weights on device)."""
+    _, base = run_steps(config(param_device=None, stage=1))
+    engine, paged = run_steps(config())
+    assert "blocks" not in engine.params, \
+        "paged blocks must never be device-resident"
+    np.testing.assert_allclose(base, paged, rtol=2e-4, atol=2e-5,
+                               err_msg="param offload diverges from baseline")
+
+
+def test_param_offload_nvme_pages(tmp_path):
+    cfg = config(param_device="nvme")
+    cfg["zero_optimization"]["offload_param"].update(
+        nvme_path=str(tmp_path), buffer_count=2)
+    _, nvme_losses = run_steps(cfg)
+    pages = glob.glob(str(tmp_path / "ds_param_swap_*" / "page_*.bin"))
+    assert len(pages) == TINY.n_layer, f"expected per-layer page files: {pages}"
+    _, cpu_losses = run_steps(config())
+    np.testing.assert_allclose(nvme_losses, cpu_losses, rtol=1e-6)
+
+
+def test_param_offload_bf16_trains():
+    cfg = config(bf16={"enabled": True})
+    cfg["gradient_clipping"] = 1.0
+    _, losses = run_steps(cfg, bs=batches(n=6))
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_param_offload_eval_and_checkpoint_roundtrip(tmp_path):
+    engine, losses = run_steps(config())
+    probe = {"input_ids": np.arange(8 * 16, dtype=np.int32).reshape(8, 16)
+             % 255}
+    ev = float(engine.eval_batch(probe))
+    engine.save_checkpoint(str(tmp_path))
+
+    from deepspeed_tpu.parallel import topology as _topo
+    _topo.reset_mesh()
+    engine2, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(TINY),
+                                                config=config())
+    engine2.load_checkpoint(str(tmp_path))
+    ev2 = float(engine2.eval_batch(probe))
+    np.testing.assert_allclose(ev, ev2, rtol=1e-6)
+    # training continues bit-identically from the restored masters
+    nxt = batches(seed=7, n=1)[0]
+    l1 = float(engine.train_batch(batch=nxt))
+    l2 = float(engine2.train_batch(batch=nxt))
+    np.testing.assert_allclose(l1, l2, rtol=1e-6)
+
+
+def test_param_offload_micro_api_raises():
+    engine, _ = run_steps(config(), bs=batches(n=1))
+    with pytest.raises(RuntimeError, match="train_batch"):
+        engine.forward({"input_ids": np.zeros((8, 16), np.int32)})
+
+
+# ---------------------------------------------------------------------------
+# accepted-config = active-config contract (round-3 weak #6)
+# ---------------------------------------------------------------------------
+
+def test_offload_param_requires_stage3():
+    with pytest.raises(ConfigError, match="stage=3"):
+        run_steps(config(stage=2), bs=batches(n=1))
+
+
+def test_offload_param_requires_offload_optimizer():
+    with pytest.raises(ConfigError, match="offload_optimizer"):
+        run_steps(config(opt_device=None), bs=batches(n=1))
+
+
+def test_offload_param_rejects_fp16():
+    with pytest.raises(ConfigError, match="fp16"):
+        run_steps(config(fp16={"enabled": True}), bs=batches(n=1))
+
+
+def test_offload_param_rejects_model_parallel():
+    with pytest.raises(ConfigError, match="data-parallel"):
+        run_steps(config(tensor_parallel_size=2, train_batch_size=8),
+                  bs=batches(n=1))

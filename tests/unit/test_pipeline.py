@@ -213,6 +213,39 @@ def test_heterogeneous_pipeline_on_pp2_mesh():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
+def test_heterogeneous_pipeline_on_pp4_mesh():
+    """Interpreted-mode parity beyond pp2 (round-3 weak #8): 8 mixed-width
+    stages over a pp=4 mesh match the pp=1 sequential engine."""
+    from deepspeed_tpu.parallel import topology, initialize_mesh
+
+    specs = [LayerSpec(Linear, 8, 32), LayerSpec(Linear, 32, 16),
+             LayerSpec(Linear, 16, 16), LayerSpec(Linear, 16, 24),
+             LayerSpec(Linear, 24, 16), LayerSpec(Linear, 16, 16),
+             LayerSpec(Linear, 16, 16), LayerSpec(Linear, 16, 8)]
+    rng = np.random.default_rng(2)
+    batch = {"inputs": rng.normal(size=(4, 8, 8)).astype(np.float32),
+             "targets": rng.normal(size=(4, 8, 8)).astype(np.float32)}
+    common = {"train_batch_size": 32, "gradient_accumulation_steps": 4,
+              "optimizer": {"type": "SGD", "params": {"lr": 0.1}},
+              "steps_per_print": 0}
+
+    e1 = deepspeed_tpu.initialize(model=PipelineModule(specs, loss_fn=_mse),
+                                  config=common)[0]
+    l_seq = [float(e1.train_batch(batch=batch)) for _ in range(2)]
+
+    topology.reset_mesh()
+    mm = initialize_mesh(pp=4, dp=2)
+    e4 = deepspeed_tpu.initialize(
+        model=PipelineModule(specs, loss_fn=_mse),
+        config=dict(common, pipeline_parallel_size=4), mesh_manager=mm)[0]
+    assert len(e4._stage_shardings) == 4
+    l_pp = [float(e4.train_batch(batch=batch)) for _ in range(2)]
+    np.testing.assert_allclose(l_seq, l_pp, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(e1.params), jax.tree.leaves(e4.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_heterogeneous_pp2_with_tied_layers():
     from deepspeed_tpu.parallel import topology, initialize_mesh
     specs = [TiedLayerSpec("emb", Linear, 8, 8), LayerSpec(Linear, 8, 8),

@@ -1,0 +1,155 @@
+"""Engine wiring of progressive layer drop, random-LTD, and MoQ.
+
+Round-3 missing #4: these subsystems existed as libraries but no config key
+drove them. These tests pin the accepted=active contract: enabling each key
+measurably changes training, and enabling it on a model that cannot honor
+it raises. Reference anchors: engine.py:1667 (PLD theta into forward),
+data_routing/basic_layer.py:14 (random-LTD layer wrapper),
+engine.py:1995-2008 (eigenvalue-gated MoQ).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.runtime.config_utils import ConfigError
+from deepspeed_tpu.runtime.quantize import Quantizer
+
+TINY = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                  n_head=4, pad_vocab_to_multiple=8)
+
+
+def base_config(**over):
+    cfg = {"train_batch_size": 16, "train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 2,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 0}, "steps_per_print": 0}
+    cfg.update(over)
+    return cfg
+
+
+def run(cfg, steps=3, seqlen=32, model=None):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model or GPT2Model(TINY), config=cfg)
+    rng = np.random.default_rng(0)
+    losses = [float(engine.train_batch(batch={
+        "input_ids": rng.integers(0, 255, (2, 8, seqlen), dtype=np.int32)}))
+        for _ in range(steps)]
+    return engine, losses
+
+
+class NoKwargsModel(GPT2Model):
+    """A model that does not accept the subsystem forward kwargs."""
+
+    def apply(self, params, batch, rng=None, train=True):
+        return super().apply(params, batch, rng=rng, train=train)
+
+
+# ---------------------------------------------------------------- PLD
+
+def test_pld_theta_anneals_and_trains():
+    engine, losses = run(base_config(progressive_layer_drop={
+        "enabled": True, "theta": 0.5, "gamma": 0.1}))
+    assert np.all(np.isfinite(losses))
+    theta = engine.progressive_layer_drop.current_theta
+    assert 0.5 < theta < 1.0, f"theta schedule never advanced: {theta}"
+    assert engine._last_modifiers[0] is not None
+
+
+def test_pld_changes_the_forward():
+    """With theta ~0 almost every layer drops: the loss trajectory must
+    differ from the dense baseline (enabling the key changes training)."""
+    _, dense = run(base_config())
+    _, dropped = run(base_config(progressive_layer_drop={
+        "enabled": True, "theta": 0.05, "gamma": 10.0}))
+    assert not np.allclose(dense, dropped, atol=1e-6)
+
+
+def test_pld_unsupported_model_raises():
+    with pytest.raises(ConfigError, match="pld_theta"):
+        run(base_config(progressive_layer_drop={"enabled": True}),
+            model=NoKwargsModel(TINY))
+
+
+# ---------------------------------------------------------- random-LTD
+
+def ltd_config(min_value=16, max_value=32, require_steps=2):
+    return base_config(data_efficiency={
+        "enabled": True,
+        "data_routing": {"enabled": True, "random_ltd": {
+            "enabled": True,
+            "random_ltd_schedule": {
+                "min_value": min_value, "max_value": max_value,
+                "schedule_config": {"seq_per_step": 16,
+                                    "require_steps": require_steps}}}}})
+
+
+def test_random_ltd_ramps_effective_seq():
+    engine, losses = run(ltd_config(), steps=4)
+    assert np.all(np.isfinite(losses))
+    # ramp: 16 kept tokens at step 0 -> full 32 after require_steps=2
+    assert engine._last_modifiers[1] == 32
+    assert engine.random_ltd_scheduler.get_current_seq(0) == 16
+    assert engine.random_ltd_scheduler.is_fully_ramped(2)
+
+
+def test_random_ltd_changes_the_forward():
+    _, dense = run(base_config())
+    _, ltd = run(ltd_config(min_value=16, max_value=32, require_steps=100),
+                 steps=3)
+    assert not np.allclose(dense, ltd, atol=1e-6)
+
+
+def test_random_ltd_unsupported_model_raises():
+    with pytest.raises(ConfigError, match="ltd_keep"):
+        run(ltd_config(), model=NoKwargsModel(TINY))
+
+
+# ----------------------------------------------------------------- MoQ
+
+def moq_config(eigenvalue=False, offset=1, period=1):
+    qt = {"enabled": True,
+          "quantize_bits": {"start_bits": 16, "target_bits": 8},
+          "quantize_schedule": {"quantize_period": period,
+                                "schedule_offset": offset}}
+    if eigenvalue:
+        qt["eigenvalue"] = {"enabled": True, "max_iter": 2, "tol": 0.1}
+    return base_config(quantize_training=qt)
+
+
+def test_moq_precision_switch_changes_params():
+    engine, losses = run(moq_config(), steps=3)
+    assert np.all(np.isfinite(losses))
+    assert engine.quantizer.current_bits == 8, "precision never dropped"
+    # the masters carry the fake-quant projection: int8 grid alignment
+    w = np.asarray(jax.tree.leaves(engine.params)[2], np.float32)
+    assert np.isfinite(w).all()
+
+
+def test_moq_update_eigenvalue_gate_is_bounded():
+    q = Quantizer(q_start_bits=16, q_target_bits=8, q_period=1, q_offset=0)
+    spread = {"a": 0.1, "b": 0.1, "c": 100.0}  # max >> median: postpone
+    step, switches = 0, []
+    for _ in range(12):
+        if q.update(step, spread):
+            switches.append(step)
+        step = q._next_switch
+    assert switches, "bounded gate must eventually allow the switch"
+    assert q._postponed == 0
+
+
+@pytest.mark.slow
+def test_moq_eigenvalue_gated_switch_end_to_end():
+    engine, losses = run(moq_config(eigenvalue=True), steps=6)
+    assert np.all(np.isfinite(losses))
+    assert engine.quantizer.current_bits == 8
+
+
+def test_moq_rejects_offload():
+    cfg = moq_config()
+    cfg["zero_optimization"] = {
+        "stage": 1, "offload_optimizer": {"device": "cpu"}}
+    with pytest.raises(ConfigError, match="Offload"):
+        run(cfg, steps=1)
