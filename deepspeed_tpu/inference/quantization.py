@@ -94,15 +94,22 @@ def quantize_leaf(w, group_size: int = 64, bits: int = 8) -> QuantizedWeight:
 def _default_predicate(path, leaf) -> bool:
     """Quantize matmul-shaped floating weights of the transformer blocks —
     the reference GroupQuantizer scope (replace_module.py:140 quantizes
-    fused layer weights, not embeddings). Excluded: 1-D leaves
-    (norms/biases); token/position embeddings (wte doubles as the logit
+    fused layer weights, not embeddings/norms/biases). Stacked [L, ...]
+    leaves make per-layer vectors LOOK 2-D, so the filter requires a real
+    matrix (both trailing dims substantial) AND rejects norm/bias names.
+    Also excluded: token/position embeddings (wte doubles as the logit
     head, the most quantization-sensitive matmul, and wpe is indexed with
     dynamic_slice before any dtype cast)."""
     if getattr(leaf, "ndim", 0) < 2:
         return False
     if not jnp.issubdtype(leaf.dtype, jnp.floating):
         return False
+    if min(leaf.shape[-1], leaf.shape[-2]) < 16:
+        return False  # [L, d] norm/bias stacks, tiny projections
     names = [str(getattr(k, "key", k)) for k in path]
+    last = names[-1] if names else ""
+    if last.endswith(("_b", "bias", "scale", "norm", "gamma", "beta")):
+        return False
     skip = ("wpe", "wte", "embed", "position", "lm_head")
     return not any(s in n for n in names for s in skip)
 

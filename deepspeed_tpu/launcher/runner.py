@@ -42,8 +42,13 @@ def parse_args(argv=None):
                    help="cap on node count from the hostfile")
     p.add_argument("--master_addr", default=None)
     p.add_argument("--master_port", type=int, default=29500)
-    p.add_argument("--launcher", default="ssh", choices=["ssh", "pdsh"],
-                   help="multinode transport")
+    p.add_argument("--launcher", default="ssh",
+                   choices=["ssh", "pdsh", "openmpi", "mpich", "mvapich",
+                            "slurm"],
+                   help="multinode transport (reference "
+                        "multinode_runner.py set; MPI/SLURM transports "
+                        "fan out one SPMD process per node themselves and "
+                        "ranks bootstrap from OMPI_*/SLURM_* env)")
     p.add_argument("--launcher_args", default="",
                    help="extra args for ssh/pdsh")
     p.add_argument("--nproc_per_node", type=int, default=1,
@@ -169,6 +174,18 @@ def main(argv=None):
 
     hosts = list(resources)
     master = args.master_addr or hosts[0]
+    if args.launcher in ("openmpi", "mpich", "mvapich", "slurm"):
+        # mpirun/srun own the fan-out: emit ONE local command; remote
+        # ranks bootstrap from the transport env (comm.init_distributed
+        # discovery)
+        from .multinode_runner import get_runner
+        env = dict(os.environ, MASTER_ADDR=master,
+                   MASTER_PORT=str(args.master_port))
+        runner = get_runner(args.launcher, args, resources)
+        cmd = runner.get_cmd(env)
+        logger.info(f"cmd = {' '.join(map(shlex.quote, cmd))}")
+        return subprocess.call(cmd, env=env)
+
     env_fwd = {k: v for k, v in os.environ.items()
                if k.startswith(("DSTPU_", "JAX_", "XLA_", "TPU_",
                                 "PYTHON", "LIBTPU"))}

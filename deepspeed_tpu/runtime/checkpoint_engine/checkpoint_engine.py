@@ -84,6 +84,13 @@ class OrbaxCheckpointEngine(CheckpointEngine):
 
 
 def get_checkpoint_engine(config) -> CheckpointEngine:
+    nebula = dict((getattr(config, "_param_dict", None) or {}).get(
+        "nebula") or {})
+    if nebula.get("enabled"):
+        # reference dispatch (engine.py _get_checkpoint_engine): the
+        # nebula block selects the async/tiered engine
+        from .nebula_checkpoint_engine import NebulaCheckpointEngine
+        return NebulaCheckpointEngine(nebula)
     if getattr(config, "checkpoint_config", None) and \
             getattr(config.checkpoint_config, "async_save", False):
         try:

@@ -92,6 +92,15 @@ class DeepSpeedEngine:
         self._config = DeepSpeedConfig(config, mpu=mpu, world_size=len(devices))
         cfg = self._config
 
+        if getattr(cfg, "sparse_gradients_enabled", False):
+            # accepted = active: this build has no sparse grad path (XLA
+            # embedding-gather grads are dense, and dense ICI all-reduce
+            # beats allgather-based sparse reduction at TPU vocab scales —
+            # runtime/sparse_tensor.py stays available as a host utility)
+            from .config_utils import ConfigError
+            raise ConfigError(
+                "sparse_gradients is not supported on TPU; remove the key "
+                "(gradients of embedding gathers are dense under XLA)")
         ep = cfg.expert_parallel_size
         if cfg.data_parallel_size % ep != 0:
             raise ValueError(f"ep={ep} must divide dp={cfg.data_parallel_size}")
@@ -338,6 +347,24 @@ class DeepSpeedEngine:
             import dataclasses as _dc
             from .activation_checkpointing.checkpointing import configure
             pol = configure(deepspeed_config=cfg)
+            if pol == "offload_dots":
+                # XLA host-offload remat: single-accelerator scope today —
+                # the SPMD partitioner rejects the placement annotation on
+                # multi-device meshes, and the CPU test backend has no
+                # lowering for it at all
+                if devices[0].platform != "tpu":
+                    logger.warning(
+                        "cpu_checkpointing: host-offload remat has no CPU-"
+                        "backend lowering; falling back to "
+                        "dots_with_no_batch_dims_saveable for this run")
+                    pol = "dots_with_no_batch_dims_saveable"
+                elif len(devices) > 1:
+                    from .config_utils import ConfigError
+                    raise ConfigError(
+                        "activation_checkpointing.cpu_checkpointing is "
+                        "single-chip scope: XLA's SPMD partitioner cannot "
+                        "yet shard host-offloaded remat residuals; drop "
+                        "the flag or run on one chip")
             mcfg = getattr(self.module, "config", None)
             if mcfg is not None and hasattr(mcfg, "remat"):
                 updates = {"remat": True}

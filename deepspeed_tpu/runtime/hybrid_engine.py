@@ -51,6 +51,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._gen_engine = None
         self._gen_params_step = -1
         self._gen_src = None         # the params tree the serving copy mirrors
+        self._lora_merge_fn = None   # jitted adapter fuse (compiled once)
         if not (hasattr(self.module, "init_kv_cache") and
                 hasattr(self.module, "apply_with_cache")):
             raise ValueError(
@@ -78,9 +79,11 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         from .lora import LoRAModel
         params = self._live_params()
         if isinstance(self.module, LoRAModel):
+            if self._lora_merge_fn is None:  # compile the fuse ONCE
+                self._lora_merge_fn = jax.jit(
+                    lambda p: self.module.merge(p, freeze_base=False))
             with self.mesh:
-                merged = jax.jit(lambda p: self.module.merge(
-                    p, freeze_base=False))(params)
+                merged = self._lora_merge_fn(params)
             return self.module.base, merged
         return self.module, params
 

@@ -55,7 +55,11 @@ def test_int8_logits_parity_and_memory(model_and_params):
     e_q = make_engine(model, params, quant={"group_size": 32})
     n_q = sum(1 for x in jax.tree.leaves(e_q.params, is_leaf=is_quantized)
               if is_quantized(x))
-    assert n_q == 6 * TINY.n_layer  # 2D block weights (stacked leaves)
+    # exactly the 4 stacked matmul weights (qkv, attn_proj, mlp_fc,
+    # mlp_proj); stacked [L, d] norm/bias leaves must NOT be quantized
+    assert n_q == 4
+    for name in ("ln1_scale", "ln1_bias", "qkv_b", "mlp_fc_b"):
+        assert not is_quantized(e_q.params["blocks"][name]), name
 
     ids = (np.arange(32, dtype=np.int32).reshape(2, 16) * 7) % 255
     lb = np.asarray(e_bf(ids), np.float32)

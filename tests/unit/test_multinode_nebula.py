@@ -1,0 +1,139 @@
+"""Multinode runner command construction + Nebula-style checkpoint engine.
+
+Reference anchors: deepspeed/launcher/multinode_runner.py (OpenMPI :107,
+MPICH :160, SLURM, MVAPICH) and nebula_checkpoint_engine.py /
+nebula/config.py (async writes, persistent tier, version retention) —
+round-3 missing #8 and inventory row 58.
+"""
+
+import argparse
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.launcher.multinode_runner import (MPICHRunner,
+                                                     MVAPICHRunner,
+                                                     OpenMPIRunner,
+                                                     PDSHRunner, SlurmRunner,
+                                                     get_runner)
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+WORLD = {"worker-1": 4, "worker-2": 4}
+
+
+def make_args(**over):
+    ns = argparse.Namespace(
+        hostfile="/job/hostfile", include="", exclude="", num_nodes=-1,
+        launcher_args="", user_script="train.py",
+        user_args=["--epochs", "2"], module=False, no_python=False)
+    for k, v in over.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_openmpi_cmdline():
+    cmd = OpenMPIRunner(make_args(), WORLD).get_cmd(
+        {"MASTER_ADDR": "worker-1", "JAX_PLATFORMS": "tpu", "HOME": "/x"})
+    assert cmd[:3] == ["mpirun", "-n", "2"]
+    assert "-hostfile" in cmd and "/job/hostfile" in cmd
+    joined = " ".join(cmd)
+    assert "-x JAX_PLATFORMS=tpu" in joined
+    assert "-x MASTER_ADDR=worker-1" in joined
+    assert "HOME" not in joined  # only the jax/TPU namespace forwards
+    assert cmd[-3:] == ["train.py", "--epochs", "2"]
+
+
+def test_mpich_and_mvapich_cmdlines():
+    cmd = MPICHRunner(make_args(), WORLD).get_cmd({"DSTPU_X": "1"})
+    assert cmd[:3] == ["mpirun", "-n", "2"]
+    assert "-hosts" in cmd and "worker-1,worker-2" in cmd
+    assert ["-genv", "DSTPU_X", "1"] == cmd[cmd.index("-genv"):
+                                            cmd.index("-genv") + 3]
+
+    cmd = MVAPICHRunner(make_args(), WORLD).get_cmd({})
+    assert cmd[:3] == ["mpirun", "-np", "2"]
+    joined = " ".join(cmd)
+    assert "-env MV2_SMP_USE_CMA=0" in joined  # MV2 runtime knobs set
+
+
+def test_slurm_cmdline_and_include_contract():
+    cmd = SlurmRunner(make_args(launcher_args="--partition=tpu"),
+                      WORLD).get_cmd({"MASTER_PORT": "29500"})
+    assert cmd[:3] == ["srun", "-n", "2"]
+    assert "--partition=tpu" in cmd
+    assert any(a.startswith("--export=ALL,MASTER_PORT=29500")
+               for a in cmd)
+    with pytest.raises(ValueError, match="comma node list"):
+        SlurmRunner(make_args(include="a@b"), WORLD).get_cmd({})
+
+
+def test_pdsh_cmdline_and_registry():
+    cmd = PDSHRunner(make_args(), WORLD).get_cmd({"JAX_PLATFORMS": "cpu"})
+    assert cmd[0] == "pdsh" and "worker-1,worker-2" in cmd
+    assert "JAX_PLATFORMS=cpu" in cmd[-1]
+    with pytest.raises(ValueError, match="unknown launcher"):
+        get_runner("bogus", make_args(), WORLD)
+
+
+def test_module_flag_shapes_user_cmd():
+    cmd = OpenMPIRunner(make_args(module=True), WORLD).get_cmd({})
+    assert cmd[-4:-3] == ["-m"]
+    cmd = OpenMPIRunner(make_args(no_python=True), WORLD).get_cmd({})
+    assert "python" not in cmd[-3]
+
+
+# ------------------------------------------------------------- nebula
+
+TINY = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                  n_head=4, pad_vocab_to_multiple=8)
+
+
+def _engine(tmp_path, **cfg_over):
+    cfg = {"train_batch_size": 16, "train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 2,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 0}, "steps_per_print": 0,
+           "nebula": {"enabled": True,
+                      "persistent_storage_path": str(tmp_path / "tier2"),
+                      "num_of_version_in_retention": 2}}
+    cfg.update(cfg_over)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(TINY),
+                                               config=cfg)
+    return engine
+
+
+def test_nebula_engine_async_save_and_persistent_fallback(tmp_path):
+    engine = _engine(tmp_path)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 255, (2, 8, 32), dtype=np.int32)}
+    float(engine.train_batch(batch=batch))
+    probe = {"input_ids": np.arange(8 * 16, dtype=np.int32).reshape(8, 16)
+             % 255}
+    ev = float(engine.eval_batch(probe))
+    engine.save_checkpoint(str(tmp_path / "primary"))
+    tag = open(tmp_path / "primary" / "latest").read().strip()
+    # commit sealed the version into the persistent tier
+    tier2 = tmp_path / "tier2" / tag
+    assert (tier2 / "model_states.msgpack").exists()
+
+    # primary model states lost -> load falls back to the persistent copy
+    os.remove(tmp_path / "primary" / tag / "model_states.msgpack")
+    from deepspeed_tpu.parallel import topology as _topo
+    _topo.reset_mesh()
+    engine2 = _engine(tmp_path)
+    engine2.load_checkpoint(str(tmp_path / "primary"))
+    np.testing.assert_allclose(ev, float(engine2.eval_batch(probe)),
+                               rtol=1e-6)
+
+
+def test_nebula_version_retention(tmp_path):
+    engine = _engine(tmp_path)
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        float(engine.train_batch(batch={
+            "input_ids": rng.integers(0, 255, (2, 8, 32), dtype=np.int32)}))
+        engine.save_checkpoint(str(tmp_path / "primary"), tag=f"v{i}")
+    kept = sorted(os.listdir(tmp_path / "tier2"))
+    assert kept == ["v1", "v2"], kept  # retention=2 keeps the newest two

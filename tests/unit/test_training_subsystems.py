@@ -153,3 +153,10 @@ def test_moq_rejects_offload():
         "stage": 1, "offload_optimizer": {"device": "cpu"}}
     with pytest.raises(ConfigError, match="Offload"):
         run(cfg, steps=1)
+
+
+def test_sparse_gradients_key_raises():
+    """sparse_gradients parsed-but-ignored was the round-3 silent-config
+    pattern; on TPU it cannot be honored (dense XLA grads) so it raises."""
+    with pytest.raises(ConfigError, match="sparse_gradients"):
+        run(base_config(sparse_gradients=True), steps=1)
