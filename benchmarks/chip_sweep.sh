@@ -18,7 +18,12 @@ run() {
 run packed_profile python benchmarks/profile_step.py
 run bench python bench.py
 run sparse python benchmarks/sparse_attn.py
-run decode python benchmarks/decode.py
+run decode python benchmarks/decode.py            # bf16 + int8 A/B
 run moe python benchmarks/moe_bench.py
 run bert python benchmarks/bert_large.py
+# round-4 additions
+STEP_TIMEOUT=2400 run ladder_1p3b_z3 python benchmarks/baseline_ladder.py 1p3b_zero3
+run offload_serial env OFF_STEPS=3 python benchmarks/offload_1p3b.py
+run offload_pipelined env OFF_STEPS=3 OFF_PIPELINE=1 python benchmarks/offload_1p3b.py
+STEP_TIMEOUT=5400 run infinity_8b env DSTPU_HOST_INIT=fast python benchmarks/infinity_8b.py --steps 2
 echo "sweep done $(date +%H:%M:%S)"

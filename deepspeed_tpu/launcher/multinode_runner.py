@@ -73,10 +73,13 @@ class OpenMPIRunner(MultiNodeRunner):
 
     def get_cmd(self, environment):
         total = len(self.hosts)
-        # one SPMD process per NODE: without the ppr mapping Open MPI's
-        # fill-by-slot default would stack every rank on the first host
-        cmd = ["mpirun", "-n", str(total), "-hostfile",
-               self.args.hostfile, "--map-by", "ppr:1:node",
+        # one SPMD process per NODE, over the FILTERED host list (the raw
+        # hostfile would resurrect --exclude'd hosts); without the ppr
+        # mapping Open MPI's fill-by-slot default would stack every rank
+        # on the first host
+        cmd = ["mpirun", "-n", str(total),
+               "--host", ",".join(self.hosts),
+               "--map-by", "ppr:1:node",
                "--mca", "btl", "^openib",
                "--mca", "btl_tcp_if_include", "eth0"]
         for k, v in self.export_envs(environment).items():
@@ -125,7 +128,7 @@ class MVAPICHRunner(MPICHRunner):
         env.setdefault("MV2_DEBUG_SHOW_BACKTRACE", "1")
         total = len(self.hosts)
         cmd = ["mpirun", "-np", str(total), "-ppn", "1",
-               "-hostfile", self.args.hostfile]
+               "-hosts", ",".join(self.hosts)]
         for k, v in self.export_envs(env).items():
             cmd += ["-env", f"{k}={v}"]
         cmd += shlex.split(self.args.launcher_args)
@@ -154,7 +157,18 @@ class SlurmRunner(MultiNodeRunner):
         if getattr(args, "num_nodes", -1) > 0:
             cmd.append(f"--nodes={args.num_nodes}")
         cmd += shlex.split(args.launcher_args)
-        exports = self.export_envs(environment)
+        exports = {}
+        for k, v in self.export_envs(environment).items():
+            if "," in v or " " in v:
+                # srun's --export list is comma-delimited with no quoting
+                # mechanism (LIBTPU_INIT_ARGS is conventionally
+                # comma-separated) — forwarding would corrupt the list
+                logger.warning(
+                    f"slurm runner: not forwarding {k} (value contains "
+                    f"','/' '); set it via --launcher_args "
+                    f"'--export=...' or in the remote environment")
+                continue
+            exports[k] = v
         if exports:
             cmd.append("--export=ALL," + ",".join(
                 f"{k}={v}" for k, v in exports.items()))

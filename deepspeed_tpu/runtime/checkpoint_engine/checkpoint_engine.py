@@ -83,14 +83,22 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         return True
 
 
+_NEBULA_ENGINES = {}
+
+
 def get_checkpoint_engine(config) -> CheckpointEngine:
     nebula = dict((getattr(config, "_param_dict", None) or {}).get(
         "nebula") or {})
     if nebula.get("enabled"):
         # reference dispatch (engine.py _get_checkpoint_engine): the
-        # nebula block selects the async/tiered engine
-        from .nebula_checkpoint_engine import NebulaCheckpointEngine
-        return NebulaCheckpointEngine(nebula)
+        # nebula block selects the async/tiered engine. One engine (and
+        # one writer thread) per distinct config — get_checkpoint_engine
+        # is called on every save/load and must not leak threads.
+        key = tuple(sorted((k, str(v)) for k, v in nebula.items()))
+        if key not in _NEBULA_ENGINES:
+            from .nebula_checkpoint_engine import NebulaCheckpointEngine
+            _NEBULA_ENGINES[key] = NebulaCheckpointEngine(nebula)
+        return _NEBULA_ENGINES[key]
     if getattr(config, "checkpoint_config", None) and \
             getattr(config.checkpoint_config, "async_save", False):
         try:

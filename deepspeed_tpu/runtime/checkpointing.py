@@ -116,10 +116,12 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
             json.dump(engine_state, f, indent=2, default=str)
         with open(os.path.join(ckpt_dir, "ds_config.json"), "w") as f:
             json.dump(engine._config._param_dict, f, indent=2, default=str)
-        if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
+    # seal BEFORE advancing 'latest': an async write failure raises here
+    # and the pointer keeps naming the previous good checkpoint
     ckpt_engine.commit(tag)
+    if is_writer and save_latest:
+        with open(os.path.join(save_dir, "latest"), "w") as f:
+            f.write(str(tag))
     from .. import comm as dist
     dist.barrier()
     log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
@@ -244,8 +246,10 @@ def save_16bit_model(engine, save_dir, save_filename="pytorch_model.msgpack"):
     ckpt_engine = get_checkpoint_engine(engine._config)
     if jax.process_index() == 0:
         os.makedirs(save_dir, exist_ok=True)
+    ckpt_engine.create(save_filename)
     if jax.process_index() == 0 or ckpt_engine.collective:
         ckpt_engine.save(params16, os.path.join(save_dir, save_filename))
+    ckpt_engine.commit(save_filename)  # async engines: wait + surface errors
     return os.path.join(save_dir, save_filename)
 
 

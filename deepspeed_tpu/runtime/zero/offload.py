@@ -303,6 +303,13 @@ class HostOffloadOptimizer:
         multi-GB host accumulation buffers)."""
         g_leaves = jax.tree.leaves(grads_device)
         assert len(g_leaves) == len(self.masters)
+        if len(jax.devices()) > 1 and jax.devices()[0].platform == "cpu":
+            # in-process CPU collectives (the virtual test mesh) deadlock
+            # when the host-fetch allgather of dp-sharded grads overlaps
+            # the still-executing grad program; real TPU runtimes pipeline
+            # these fine
+            jax.block_until_ready([g for g in g_leaves
+                                   if hasattr(g, "block_until_ready")])
         for g in g_leaves:
             try:
                 g.copy_to_host_async()

@@ -37,8 +37,11 @@ def test_openmpi_cmdline():
     cmd = OpenMPIRunner(make_args(), WORLD).get_cmd(
         {"MASTER_ADDR": "worker-1", "JAX_PLATFORMS": "tpu", "HOME": "/x"})
     assert cmd[:3] == ["mpirun", "-n", "2"]
-    assert "-hostfile" in cmd and "/job/hostfile" in cmd
     joined = " ".join(cmd)
+    # FILTERED host list (not the raw hostfile: --exclude must stick) and
+    # one process per node
+    assert "--host worker-1,worker-2" in joined
+    assert "--map-by ppr:1:node" in joined
     assert "-x JAX_PLATFORMS=tpu" in joined
     assert "-x MASTER_ADDR=worker-1" in joined
     assert "HOME" not in joined  # only the jax/TPU namespace forwards
@@ -55,7 +58,16 @@ def test_mpich_and_mvapich_cmdlines():
     cmd = MVAPICHRunner(make_args(), WORLD).get_cmd({})
     assert cmd[:3] == ["mpirun", "-np", "2"]
     joined = " ".join(cmd)
+    assert "-ppn 1" in joined and "worker-1,worker-2" in joined
     assert "-env MV2_SMP_USE_CMA=0" in joined  # MV2 runtime knobs set
+
+
+def test_slurm_export_skips_comma_values():
+    cmd = SlurmRunner(make_args(), WORLD).get_cmd(
+        {"LIBTPU_INIT_ARGS": "--a=1,--b=2", "MASTER_PORT": "29500"})
+    joined = " ".join(cmd)
+    assert "LIBTPU_INIT_ARGS" not in joined  # comma value would corrupt
+    assert "MASTER_PORT=29500" in joined
 
 
 def test_slurm_cmdline_and_include_contract():

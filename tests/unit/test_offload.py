@@ -141,6 +141,19 @@ def test_offload_bf16_trains():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.parametrize("stage", [2, 3])
+def test_offload_with_sharded_grads(stage):
+    """offload under ZeRO-2/3: the host fetch of dp-SHARDED grads is an
+    allgather — on the in-process CPU test mesh it must not overlap the
+    running grad program (deadlock regression; real TPU pipelines this)."""
+    cfg = config(offload_device="cpu")
+    cfg["zero_optimization"]["stage"] = stage
+    if stage == 3:
+        cfg["zero_optimization"]["stage3_param_persistence_threshold"] = 0
+    _, losses = run_steps(cfg, n_steps=2)
+    assert np.all(np.isfinite(losses))
+
+
 def test_pipelined_offload_one_step_delay_and_drain():
     """offload_optimizer.pipeline_read/write (reference
     swap_tensor/pipelined_optimizer_swapper.py): the host Adam for step N
