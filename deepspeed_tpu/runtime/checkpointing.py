@@ -119,9 +119,11 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     # seal BEFORE advancing 'latest': an async write failure raises here
     # and the pointer keeps naming the previous good checkpoint
     ckpt_engine.commit(tag)
-    if is_writer and save_latest:
-        with open(os.path.join(save_dir, "latest"), "w") as f:
-            f.write(str(tag))
+    if is_writer:
+        _emit_zero_to_fp32_script(save_dir)
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(str(tag))
     from .. import comm as dist
     dist.barrier()
     log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
@@ -279,6 +281,66 @@ def load_params_for_inference(load_dir, tag=None, like=None, shardings=None,
         params = _restore_like(shardings, params)
     log_dist(f"loaded inference params from {ckpt_dir}", ranks=[0])
     return params
+
+
+_ZERO_TO_FP32 = '''#!/usr/bin/env python
+"""Standalone fp32 export for this checkpoint directory (the reference
+copies utils/zero_to_fp32.py into every checkpoint, engine.py:3107 — same
+contract here: run it next to the shards, get one consolidated file).
+
+Usage: python zero_to_fp32.py [checkpoint_dir] [output_file]
+"""
+import os
+import sys
+
+
+def main():
+    here = os.path.dirname(os.path.abspath(__file__))
+    ckpt_dir = sys.argv[1] if len(sys.argv) > 1 else here
+    out = sys.argv[2] if len(sys.argv) > 2 else \\
+        os.path.join(ckpt_dir, "fp32_model.msgpack")
+    latest = os.path.join(ckpt_dir, "latest")
+    if os.path.isfile(latest):
+        with open(latest) as f:
+            ckpt_dir = os.path.join(ckpt_dir, f.read().strip())
+    if not os.path.isfile(os.path.join(ckpt_dir, "model_states.msgpack")):
+        tags = sorted(d for d in os.listdir(ckpt_dir)
+                      if os.path.isfile(os.path.join(
+                          ckpt_dir, d, "model_states.msgpack")))
+        if not tags:
+            sys.exit(f"no model_states.msgpack under {ckpt_dir}; pass the "
+                     f"tag directory explicitly")
+        print(f"no 'latest' pointer; using newest tag {tags[-1]}")
+        ckpt_dir = os.path.join(ckpt_dir, tags[-1])
+    try:
+        from deepspeed_tpu.runtime.checkpointing import \\
+            get_fp32_state_dict_from_checkpoint
+    except ModuleNotFoundError:
+        sys.path.insert(0, os.getcwd())  # run from the repo root
+        from deepspeed_tpu.runtime.checkpointing import \\
+            get_fp32_state_dict_from_checkpoint
+    from flax import serialization
+    params = get_fp32_state_dict_from_checkpoint(ckpt_dir)
+    with open(out, "wb") as f:
+        f.write(serialization.msgpack_serialize(params))
+    print(f"wrote consolidated fp32 params to {out}")
+
+
+if __name__ == "__main__":
+    main()
+'''
+
+
+def _emit_zero_to_fp32_script(save_dir):
+    """Reference parity (engine.py:3107): every checkpoint dir carries a
+    self-contained fp32 consolidation script."""
+    path = os.path.join(save_dir, "zero_to_fp32.py")
+    try:
+        with open(path, "w") as f:
+            f.write(_ZERO_TO_FP32)
+        os.chmod(path, 0o755)
+    except OSError as e:  # the checkpoint itself is intact
+        logger.warning(f"could not write zero_to_fp32.py: {e}")
 
 
 def get_fp32_state_dict_from_checkpoint(ckpt_dir):

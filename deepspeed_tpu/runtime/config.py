@@ -199,6 +199,12 @@ class DeepSpeedConfig:
                                                C.SPARSE_GRADIENTS_DEFAULT)
         self.communication_data_type = pd.get(C.COMMUNICATION_DATA_TYPE, None)
         self.gradient_accumulation_dtype = pd.get(C.GRADIENT_ACCUMULATION_DTYPE, None)
+        if self.gradient_accumulation_dtype is not None and \
+                str(self.gradient_accumulation_dtype) not in (
+                    "fp32", "float32", "bf16", "bfloat16"):
+            raise ConfigError(
+                f"gradient_accumulation_dtype must be fp32|bf16, got "
+                f"{self.gradient_accumulation_dtype}")
         self.wall_clock_breakdown = pd.get(C.WALL_CLOCK_BREAKDOWN,
                                            C.WALL_CLOCK_BREAKDOWN_DEFAULT)
         self.memory_breakdown = pd.get(C.MEMORY_BREAKDOWN, False)

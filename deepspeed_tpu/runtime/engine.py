@@ -130,6 +130,12 @@ class DeepSpeedEngine:
         else:
             self._compute_dtype = None  # fp32 end-to-end
         self._dynamic_scale = cfg.fp16.enabled and cfg.fp16.dynamic_loss_scale
+        # gradient_accumulation_dtype (reference data_types block;
+        # validated at config parse): f32 default; bf16 halves the
+        # accumulation buffer at ~3 digits of grad-sum precision
+        gad = str(cfg.gradient_accumulation_dtype)
+        self._grad_acc_dtype = jnp.bfloat16 if gad in ("bf16", "bfloat16") \
+            else jnp.float32
 
         # ---- optimizer (engine.py:1157 _configure_optimizer) ----
         self.optimizer: Optional[Optimizer] = None
@@ -400,6 +406,26 @@ class DeepSpeedEngine:
         self._cached_fns: Dict[Any, Any] = {}
         self._compile_fns()
 
+        # keys with reference semantics that XLA/GSPMD supersedes: say so
+        # once instead of silently swallowing them
+        for key, why in (
+                ("prescale_gradients", "gradients accumulate/reduce in "
+                 "fp32 here, so pre-division for fp16 reduce safety is "
+                 "moot"),
+                ("communication_data_type", "GSPMD picks collective dtypes "
+                 "from the tensors at the insertion point"),
+                ("disable_allgather", "XLA owns the gather/broadcast "
+                 "choice under SPMD")):
+            if (cfg._param_dict or {}).get(key) not in (None, False):
+                log_dist(f"config '{key}' is superseded on TPU: {why}",
+                         ranks=[0])
+        if cfg.load_universal_checkpoint:
+            log_dist("load_universal_checkpoint: checkpoints here are "
+                     "universal by construction (global arrays reshard on "
+                     "load); the flag is honored trivially", ranks=[0])
+        if cfg.dump_state:
+            log_dist(self._dump_state(), ranks=[0])
+
         n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(param_shapes))
         log_dist(
             f"DeepSpeedEngine initialized: params={n_params/1e6:.1f}M "
@@ -542,15 +568,16 @@ class DeepSpeedEngine:
                     grad_specs)
             else:
                 zeros = jax.tree.map(
-                    lambda s: jnp.zeros(s.shape, jnp.float32),
+                    lambda s: jnp.zeros(s.shape, self._grad_acc_dtype),
                     self.param_shapes)
 
                 def body(carry, xs):
                     gacc, lacc = carry
                     mb, i = xs
                     loss, g = grad_fn(pc, mb, jax.random.fold_in(rng, i))
-                    g = jax.tree.map(lambda a, b: a + b.astype(jnp.float32),
-                                     gacc, g)
+                    g = jax.tree.map(
+                        lambda a, b: a + b.astype(self._grad_acc_dtype),
+                        gacc, g)
                     # pin ZeRO-2/3 reduce-scatter per micro-step
                     g = lax.with_sharding_constraint(g, grad_specs)
                     return (g, lacc + loss), None
@@ -1100,10 +1127,50 @@ class DeepSpeedEngine:
                 self.global_steps % self._config.steps_per_print == 0:
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                              STEP_GLOBAL_TIMER])
+        if self._config.memory_breakdown and \
+                self._config.steps_per_print and \
+                self.global_steps % self._config.steps_per_print == 0:
+            self._log_memory_breakdown()
+
+    def _log_memory_breakdown(self):
+        """memory_breakdown (reference see_memory_usage): per-device HBM
+        in-use/peak from the runtime allocator; the CPU test backend
+        reports no stats."""
+        stats = jax.local_devices()[0].memory_stats() or {}
+        if stats:
+            log_dist(
+                f"memory: in_use="
+                f"{stats.get('bytes_in_use', 0) / 2**30:.2f}GiB "
+                f"peak={stats.get('peak_bytes_in_use', 0) / 2**30:.2f}GiB "
+                f"limit={stats.get('bytes_limit', 0) / 2**30:.2f}GiB",
+                ranks=[0])
+        else:
+            log_dist("memory: no allocator stats on this backend",
+                     ranks=[0])
 
     # ------------------------------------------------------------------
     # introspection / properties (reference engine property surface)
     # ------------------------------------------------------------------
+    def _dump_state(self) -> str:
+        """dump_state (reference engine dump): a one-shot engine summary
+        for debugging config resolution."""
+        cfg = self._config
+        lines = ["engine state dump:"]
+        for k in ("train_batch_size", "train_micro_batch_size_per_gpu",
+                  "gradient_accumulation_steps", "gradient_clipping",
+                  "steps_per_print"):
+            lines.append(f"  {k} = {getattr(cfg, k)}")
+        lines.append(f"  zero_stage = {self.zero_stage}")
+        lines.append(f"  compute_dtype = {self._compute_dtype or 'float32'}")
+        lines.append(f"  grad_accumulation_dtype = {self._grad_acc_dtype}")
+        lines.append(f"  mesh = pp{self.mesh_manager.pp}/"
+                     f"dp{self.mesh_manager.dp}/ep{self.mesh_manager.ep}/"
+                     f"sp{self.mesh_manager.sp}/tp{self.mesh_manager.tp}")
+        lines.append(f"  optimizer = "
+                     f"{self.optimizer.name if self.optimizer else None} "
+                     f"offload={'on' if self._offload else 'off'}")
+        return "\n".join(lines)
+
     def get_lr(self):
         if self.lr_scheduler is not None:
             return self.lr_scheduler.get_last_lr()

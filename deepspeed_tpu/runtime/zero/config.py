@@ -103,7 +103,8 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     ignore_unused_parameters: bool = True
     legacy_stage1: bool = False
     round_robin_gradients: bool = False
-    # zero++ style knobs (quantized collectives; see ops/quantized_collectives)
+    # zero++ style knobs: declared for schema compatibility but REJECTED in
+    # validate() — compressed dp comm is the 1-bit optimizer family here
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
     mics_shard_size: int = -1
@@ -152,6 +153,13 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
                 getattr(o, "device", None)
             return dev or OffloadDeviceEnum.none
 
+        if self.zero_quantized_weights or self.zero_quantized_gradients:
+            raise ConfigError(
+                "zero_quantized_weights/gradients (ZeRO++ knobs, post-dating "
+                "the reference version) are not wired into the dp gradient "
+                "reduction; for compressed communication use the 1-bit "
+                "optimizer family (optimizer.type: OneBitAdam/OneBitLamb/"
+                "ZeroOneAdam — ops/compressed_collectives.py)")
         if _device(self.offload_param) != OffloadDeviceEnum.none:
             if int(self.stage) != ZeroStageEnum.weights:
                 raise ConfigError(

@@ -136,3 +136,28 @@ def test_fp16_scaler_state_roundtrip(tmp_path):
     e2 = make_engine(c)
     e2.load_checkpoint(tmp_path)
     assert e2.cur_scale == e1.cur_scale
+
+
+@pytest.mark.slow
+def test_zero_to_fp32_script_emitted(tmp_path):
+    """Reference parity (engine.py:3107): every checkpoint dir carries a
+    standalone zero_to_fp32.py; running it next to the shards produces one
+    consolidated fp32 file."""
+    import os
+    import subprocess
+    import sys
+    engine = make_engine(cfg(stage=2))
+    for b in batches(1):
+        engine.train_batch(batch=b)
+    engine.save_checkpoint(str(tmp_path))
+    script = tmp_path / "zero_to_fp32.py"
+    assert script.exists()
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    proc = subprocess.run([sys.executable, str(script)], env=env,
+                          cwd=repo, capture_output=True, text=True,
+                          timeout=240)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert (tmp_path / "fp32_model.msgpack").stat().st_size > 1000
