@@ -1,0 +1,171 @@
+"""HLO collective audit: prove the ZeRO/TP/SP sharding designs lower to
+the intended collectives.
+
+The reference implements its communication schedule by hand (IPG-bucket
+reduce-scatter in stage_1_and_2.py:894, coalesced allgather in
+partition_parameters.py:874); here the schedule is GSPMD's, so the
+verifiable artifact is the compiled HLO itself. This audit compiles the
+REAL train step for each parallelism config on a virtual 8-device mesh and
+records every collective op with its payload bytes — the "sharding is
+right by construction" evidence that doesn't need hardware.
+
+Run (CPU): JAX_PLATFORMS=cpu python benchmarks/hlo_audit.py
+Writes benchmarks/hlo_audit.json.
+"""
+
+import json
+import os
+import re
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+                "s8": 1, "u8": 1, "pred": 1, "f64": 8, "s64": 8, "u64": 8}
+
+_COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather", "all-to-all",
+                "collective-permute")
+
+
+def _collect(hlo_text: str):
+    """{op: {count, bytes}} over the compiled module (fusion-internal
+    shapes included via the op's result shape)."""
+    out = {}
+    # single-result form only ('= f32[...] all-reduce('); tuple results
+    # ('= (f32[...], ...) all-reduce(') are handled by pat_tuple below —
+    # anchoring at '= <dtype>[' keeps the two disjoint
+    pat = re.compile(
+        r"=\s*(\w+)\[([\d,]*)\]\S*\s+(" +
+        "|".join(_COLLECTIVES) + r")\(")
+    for m in pat.finditer(hlo_text):
+        dtype, dims, op = m.group(1), m.group(2), m.group(3)
+        numel = int(np.prod([int(d) for d in dims.split(",") if d] or [1]))
+        rec = out.setdefault(op, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += numel * _DTYPE_BYTES.get(dtype, 4)
+    # tuple-result collectives (all-reduce of N tensors) print as
+    # `(f32[...], f32[...]) all-reduce(` — catch those too
+    pat_tuple = re.compile(
+        r"=\s*\(([^)]+)\)\s+(" + "|".join(_COLLECTIVES) + r")\(")
+    for m in pat_tuple.finditer(hlo_text):
+        shapes, op = m.group(1), m.group(2)
+        rec = out.setdefault(op, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        for sm in re.finditer(r"(\w+)\[([\d,]*)\]", shapes):
+            numel = int(np.prod([int(d) for d in
+                                 sm.group(2).split(",") if d] or [1]))
+            rec["bytes"] += numel * _DTYPE_BYTES.get(sm.group(1), 4)
+    return out
+
+
+def audit(name, mesh_kw, config_over, n_devices=8):
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.parallel import topology, initialize_mesh
+
+    topology.reset_mesh()
+    mm = initialize_mesh(devices=jax.devices("cpu")[:n_devices], **mesh_kw)
+    cfg = GPT2Config(vocab_size=512, n_positions=256, n_embd=256, n_layer=4,
+                     n_head=8, pad_vocab_to_multiple=128)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "steps_per_print": 0,
+    }
+    config.update(config_over)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(cfg),
+                                               config=config,
+                                               mesh_manager=mm)
+    rng = np.random.default_rng(0)
+    gbs = 2 * engine.dp_world_size
+    batch = engine._to_device_batch({"input_ids": rng.integers(
+        0, 500, (2, gbs, 128), dtype=np.int32)})
+    with engine.mesh:
+        lowered = engine._train_step_fn.lower(
+            engine.params, engine.opt_state, engine.scaler_state, batch,
+            jnp.float32(1e-3), jax.random.PRNGKey(0), None)
+        hlo = lowered.compile().as_text()
+    stats = _collect(hlo)
+    print(f"{name}: " + ", ".join(
+        f"{op} x{v['count']} ({v['bytes']/2**20:.1f} MiB)"
+        for op, v in sorted(stats.items())) if stats else f"{name}: none")
+    return stats
+
+
+def main():
+    cases = {
+        # pure dp, ZeRO-0: grads MEAN over dp -> all-reduce, nothing else
+        "dp8_zero0": ({"dp": 8}, {"zero_optimization": {"stage": 0}}),
+        # ZeRO-2: grads land dp-SHARDED -> reduce-scatter; updated params
+        # re-gather -> all-gather
+        "dp8_zero2": ({"dp": 8}, {"zero_optimization": {"stage": 2}}),
+        # ZeRO-3: params dp-sharded too -> all-gather in the layer scan
+        # (fwd AND bwd), grads reduce-scatter
+        "dp8_zero3": ({"dp": 8}, {"zero_optimization": {
+            "stage": 3, "stage3_param_persistence_threshold": 0}}),
+        # TP: per-layer partial sums -> all-reduce (or equivalent
+        # reduce-scatter+all-gather pairs) inside every block
+        "tp2_dp4_zero1": ({"tp": 2, "dp": 4},
+                          {"tensor_parallel_size": 2,
+                           "zero_optimization": {"stage": 1}}),
+        # SP (Ulysses): head<->sequence all-to-all around attention
+        "sp2_dp4_zero3": ({"sp": 2, "dp": 4},
+                          {"sequence_parallel_size": 2,
+                           "zero_optimization": {
+                               "stage": 3,
+                               "stage3_param_persistence_threshold": 0}}),
+    }
+    report = {}
+    for name, (mesh_kw, over) in cases.items():
+        report[name] = audit(name, mesh_kw, over)
+
+    # Design-intent assertions per strategy. Backend note: the CPU SPMD
+    # lowering expresses reduce-scatter as all-reduce + dynamic-slice (no
+    # fused reduce-scatter HLO on this backend); the TPU backend emits the
+    # fused op from the SAME programs — so "grads reduce" is asserted as
+    # either form, while gather structure is backend-stable.
+    def reduces(stats):
+        return "reduce-scatter" in stats or "all-reduce" in stats
+
+    a = report["dp8_zero0"]
+    assert reduces(a), "zero0: dp grad mean must reduce"
+    assert a.get("all-gather", {}).get("bytes", 0) < 2**20, \
+        "zero0 should not gather params"
+    z2 = report["dp8_zero2"]
+    assert reduces(z2), "zero2: grads must reduce"
+    assert z2.get("all-gather", {}).get("count", 0) >= 1, \
+        "zero2: updated sharded params must re-gather"
+    z3 = report["dp8_zero3"]
+    assert reduces(z3), "zero3: grads must reduce"
+    assert z3.get("all-gather", {}).get("count", 0) >= 2, \
+        "zero3: param gathers must appear in the compiled step"
+    tp = report["tp2_dp4_zero1"]
+    assert reduces(tp), "tp: block partial sums must reduce"
+    sp = report["sp2_dp4_zero3"]
+    assert "all-to-all" in sp, "sp(Ulysses): head<->seq all-to-all missing"
+    report["_note"] = (
+        "CPU SPMD lowers reduce-scatter as all-reduce+dynamic-slice; the "
+        "TPU backend emits the fused op from the same programs")
+
+    out = os.path.join(REPO, "benchmarks", "hlo_audit.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"HLO AUDIT OK -> {out}")
+
+
+if __name__ == "__main__":
+    main()

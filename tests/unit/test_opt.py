@@ -77,7 +77,6 @@ def test_opt_pipeline_parallel_matches_single_stage():
     """BASELINE config 4's shape (OPT + pipeline parallelism): the compiled
     ppermute 1F1B over an OPT stack matches the pp=1 trajectory — family
     coverage beyond GPT-2 for the pipeline engine."""
-    import deepspeed_tpu
     from deepspeed_tpu.parallel import topology
 
     cfg4 = OPTConfig(vocab_size=256, n_positions=64, n_embd=64, n_layer=4,
