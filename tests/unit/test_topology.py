@@ -53,3 +53,25 @@ def test_batch_sharding_spec():
     spec = mm.batch_spec()
     assert spec[0] == ("data", "expert")
     assert spec[1] == "seq"
+
+
+@pytest.mark.slow
+def test_multichip_dryrun_at_16_virtual_devices():
+    """Scale generality beyond the driver's 8-device check: the SAME
+    4-sweep dryrun (pp2xtp2xdp4 zero1, sp2/dp8 zero3, ep2 MoE zero2,
+    LLaMA tp2/dp8 zero2) compiles and runs at 16 virtual devices."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env.update(JAX_PLATFORMS="cpu", DSTPU_ACCELERATOR="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=16")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "__graft_entry__.py"),
+         "--dryrun", "16"],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=1500)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "OK" in proc.stdout
+    assert "pp=2/tp=2/dp=4" in proc.stdout, proc.stdout
