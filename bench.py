@@ -117,7 +117,9 @@ def main():
     }))
 
 
-AXON_PROBE_ADDR = ("127.0.0.1", 8103)
+# AXON_PROBE_PORT is the single source of truth for the tunnel port — also
+# read by benchmarks/chip_sweep.sh
+AXON_PROBE_ADDR = ("127.0.0.1", int(os.environ.get("AXON_PROBE_PORT", "8103")))
 
 
 def _tunnel_ok(timeout=3.0):

@@ -6,6 +6,20 @@ set -u
 cd "$(dirname "$0")/.."
 mkdir -p benchmarks/logs
 
+# Fail fast during a tunnel outage instead of burning STEP_TIMEOUT per step
+# on hung jax inits (any backend init hangs forever while port 8103 refuses).
+PROBE_PORT="${AXON_PROBE_PORT:-8103}"   # same env var bench.py reads
+timeout 5 bash -c "exec 3<>/dev/tcp/127.0.0.1/${PROBE_PORT}" 2>/dev/null
+probe_rc=$?
+if [ $probe_rc -ne 0 ]; then
+  if [ $probe_rc -eq 124 ]; then
+    echo "chip_sweep: axon tunnel probe timed out (port ${PROBE_PORT} hangs — half-open tunnel?) — aborting" >&2
+  else
+    echo "chip_sweep: axon tunnel down (port ${PROBE_PORT} refused) — aborting" >&2
+  fi
+  exit 3
+fi
+
 run() {
   name=$1; shift
   echo "=== $name: $* ($(date +%H:%M:%S))"

@@ -172,8 +172,15 @@ def main():
         args.out = os.path.join(REPO, "benchmarks",
                                 f"convergence{suffix}.json")
     if args.cpu:
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+        # file-path load: the package __init__ chain must not run before
+        # the axon plugin is deregistered (outage-hermetic)
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "_dstpu_hermetic",
+            os.path.join(REPO, "deepspeed_tpu", "utils", "hermetic.py"))
+        hermetic = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(hermetic)
+        hermetic.force_cpu()
     import jax
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         jax.config.update("jax_default_device", jax.devices("cpu")[0])

@@ -23,12 +23,16 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# file-path load: importing via the package would run the whole
+# deepspeed_tpu/__init__ chain before the axon plugin is deregistered
+import importlib.util  # noqa: E402
+
+_spec = importlib.util.spec_from_file_location(
+    "_dstpu_hermetic",
+    os.path.join(REPO, "deepspeed_tpu", "utils", "hermetic.py"))
+hermetic = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(hermetic)
+hermetic.force_cpu(device_count=8)
 
 _DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
                 "s8": 1, "u8": 1, "pred": 1, "f64": 8, "s64": 8, "u64": 8}

@@ -7,6 +7,7 @@ whether its ops actually load — the honest version of the reference's
 installed/compatible table.
 """
 
+import os
 import shutil
 import subprocess
 import sys
@@ -63,13 +64,24 @@ def main():
             print(f"  {tool:<18} {path or RED_NO}")
 
     print("devices:")
+    # Backend init in a bounded subprocess: during an axon tunnel outage
+    # initialization hangs forever (it does not raise), so an in-process
+    # try/except would hang the report.
     try:
-        import jax
-        for d in jax.devices():
-            print(f"  {d.id}: {d.device_kind} ({d.platform})")
-        print(f"  process {jax.process_index()}/{jax.process_count()}")
-    except Exception as e:  # no backend in this environment
-        print(f"  jax backend unavailable: {e}")
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax\n"
+             "for d in jax.devices():\n"
+             "    print(f'  {d.id}: {d.device_kind} ({d.platform})')\n"
+             "print(f'  process {jax.process_index()}/{jax.process_count()}')"],
+            capture_output=True, text=True,
+            timeout=float(os.environ.get("BENCH_PROBE_INIT_TIMEOUT", 180)))
+        if probe.returncode == 0:
+            print(probe.stdout, end="")
+        else:
+            print(f"  jax backend unavailable: {probe.stderr.strip()[-200:]}")
+    except subprocess.TimeoutExpired:
+        print("  jax backend unavailable: init timed out (tunnel down?)")
 
     print("op compatibility:")
     for name, ok in op_compatibility():

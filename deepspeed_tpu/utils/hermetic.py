@@ -1,0 +1,80 @@
+"""Outage-hermetic CPU bootstrap.
+
+The axon TPU plugin registers itself at interpreter startup through a
+``sitecustomize.py`` on ``PYTHONPATH``. During a tunnel outage the plugin's
+backend *initialization* (not its import) hangs forever — even with
+``JAX_PLATFORMS=cpu``, because ``register()`` pins ``jax_platforms`` via jax
+config, which overrides the env var. Any CPU-only entrypoint (tests,
+benchmarks on the virtual mesh, report CLIs) must therefore deregister the
+plugin before the first device use, in-process, instead of relying on env
+vars alone.
+
+This is the repo-wide version of the guard that ``__graft_entry__.py``
+applies via a subprocess; here it works in-process so ``pytest tests/unit``
+runs with the rig's default ``PYTHONPATH`` and the tunnel down.
+
+Call :func:`force_cpu` before anything touches ``jax.devices()``. It is
+idempotent and a no-op in clean environments (no axon plugin registered).
+"""
+
+import os
+import re
+
+
+def strip_axon_pythonpath(env=None):
+    """Remove axon plugin site dirs from PYTHONPATH (for child processes).
+
+    The plugin dir is recognised by its ``sitecustomize.py`` +
+    ``axon/register`` layout rather than a hardcoded path.
+    """
+    env = os.environ if env is None else env
+    parts = env.get("PYTHONPATH", "").split(os.pathsep)
+    kept = []
+    for p in parts:
+        if not p:
+            continue
+        if (os.path.exists(os.path.join(p, "sitecustomize.py"))
+                and os.path.isdir(os.path.join(p, "axon"))):
+            continue
+        kept.append(p)
+    if kept:
+        env["PYTHONPATH"] = os.pathsep.join(kept)
+    else:
+        env.pop("PYTHONPATH", None)
+    return env
+
+
+def force_cpu(device_count=None):
+    """Pin this process (and its children) to the XLA CPU backend.
+
+    Must run before the first jax backend initialization. Safe whether or
+    not jax is already imported (the axon sitecustomize imports jax at
+    interpreter startup, so "before import jax" is not a usable contract).
+
+    device_count: if given, ensure XLA_FLAGS carries
+    ``--xla_force_host_platform_device_count=<n>`` for the virtual mesh —
+    a count already present in XLA_FLAGS wins (so
+    ``XLA_FLAGS=...device_count=16 pytest ...`` reproduces a 16-device
+    mesh in-process). Returns the jax module.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+    strip_axon_pythonpath()
+    if device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if not re.search(r"--xla_force_host_platform_device_count=\d+", flags):
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={device_count}"
+            ).strip()
+
+    import jax
+    from jax._src import xla_bridge as xb
+
+    factories = getattr(xb, "_backend_factories", None)
+    if factories is not None:
+        factories.pop("axon", None)
+    # register() pins jax_platforms through config (overriding the env
+    # var); reset it so the CPU backend is actually selected.
+    jax.config.update("jax_platforms", "cpu")
+    return jax

