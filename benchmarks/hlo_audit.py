@@ -72,7 +72,7 @@ def _collect(hlo_text: str):
     return out
 
 
-def audit(name, mesh_kw, config_over, n_devices=8):
+def audit(name, mesh_kw, config_over, n_devices=8, with_flops=False):
     import jax
     import jax.numpy as jnp
     import deepspeed_tpu
@@ -102,49 +102,82 @@ def audit(name, mesh_kw, config_over, n_devices=8):
         lowered = engine._train_step_fn.lower(
             engine.params, engine.opt_state, engine.scaler_state, batch,
             jnp.float32(1e-3), jax.random.PRNGKey(0), None)
-        hlo = lowered.compile().as_text()
+        compiled = lowered.compile()
+        hlo = compiled.as_text()
     stats = _collect(hlo)
+    if with_flops:
+        # Analytic roofline: compiled-step FLOPs from XLA's own cost model
+        # vs total collective payload. bytes_per_gflop is the scale-free
+        # number that catches an accidental resharding (dropping a grad
+        # out-sharding ~doubles it) with no TPU in the loop.
+        try:
+            cost = compiled.cost_analysis()
+            cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+            flops = float(cost.get("flops", 0.0))
+        except Exception as e:
+            print(f"WARNING: cost_analysis unavailable ({e!r}) — "
+                  f"bytes/GFLOP roofline gate is DISABLED for {name}",
+                  file=sys.stderr)
+            flops = 0.0
+        total_bytes = sum(v["bytes"] for v in stats.values())
+        stats = dict(stats)
+        stats["_roofline"] = {
+            "step_flops": flops,
+            "collective_bytes": total_bytes,
+            "bytes_per_gflop": (total_bytes / (flops / 1e9)) if flops else None,
+        }
+    shown = {k: v for k, v in stats.items() if not k.startswith("_")}
     print(f"{name}: " + ", ".join(
         f"{op} x{v['count']} ({v['bytes']/2**20:.1f} MiB)"
-        for op, v in sorted(stats.items())) if stats else f"{name}: none")
+        for op, v in sorted(shown.items())) if shown else f"{name}: none")
     return stats
 
 
-def main():
-    cases = {
-        # pure dp, ZeRO-0: grads MEAN over dp -> all-reduce, nothing else
-        "dp8_zero0": ({"dp": 8}, {"zero_optimization": {"stage": 0}}),
-        # ZeRO-2: grads land dp-SHARDED -> reduce-scatter; updated params
-        # re-gather -> all-gather
-        "dp8_zero2": ({"dp": 8}, {"zero_optimization": {"stage": 2}}),
-        # ZeRO-3: params dp-sharded too -> all-gather in the layer scan
-        # (fwd AND bwd), grads reduce-scatter
-        "dp8_zero3": ({"dp": 8}, {"zero_optimization": {
-            "stage": 3, "stage3_param_persistence_threshold": 0}}),
-        # TP: per-layer partial sums -> all-reduce (or equivalent
-        # reduce-scatter+all-gather pairs) inside every block
-        "tp2_dp4_zero1": ({"tp": 2, "dp": 4},
-                          {"tensor_parallel_size": 2,
-                           "zero_optimization": {"stage": 1}}),
-        # SP (Ulysses): head<->sequence all-to-all around attention
-        "sp2_dp4_zero3": ({"sp": 2, "dp": 4},
-                          {"sequence_parallel_size": 2,
-                           "zero_optimization": {
-                               "stage": 3,
-                               "stage3_param_persistence_threshold": 0}}),
-    }
-    report = {}
-    for name, (mesh_kw, over) in cases.items():
-        report[name] = audit(name, mesh_kw, over)
+CASES = {
+    # pure dp, ZeRO-0: grads MEAN over dp -> all-reduce, nothing else
+    "dp8_zero0": ({"dp": 8}, {"zero_optimization": {"stage": 0}}),
+    # ZeRO-2: grads land dp-SHARDED -> reduce-scatter; updated params
+    # re-gather -> all-gather
+    "dp8_zero2": ({"dp": 8}, {"zero_optimization": {"stage": 2}}),
+    # ZeRO-3: params dp-sharded too -> all-gather in the layer scan
+    # (fwd AND bwd), grads reduce-scatter
+    "dp8_zero3": ({"dp": 8}, {"zero_optimization": {
+        "stage": 3, "stage3_param_persistence_threshold": 0}}),
+    # TP: per-layer partial sums -> all-reduce (or equivalent
+    # reduce-scatter+all-gather pairs) inside every block
+    "tp2_dp4_zero1": ({"tp": 2, "dp": 4},
+                      {"tensor_parallel_size": 2,
+                       "zero_optimization": {"stage": 1}}),
+    # SP (Ulysses): head<->sequence all-to-all around attention
+    "sp2_dp4_zero3": ({"sp": 2, "dp": 4},
+                      {"sequence_parallel_size": 2,
+                       "zero_optimization": {
+                           "stage": 3,
+                           "stage3_param_persistence_threshold": 0}}),
+}
 
-    # Design-intent assertions per strategy. Backend note: the CPU SPMD
-    # lowering expresses reduce-scatter as all-reduce + dynamic-slice (no
-    # fused reduce-scatter HLO on this backend); the TPU backend emits the
-    # fused op from the SAME programs — so "grads reduce" is asserted as
-    # either form, while gather structure is backend-stable.
-    def reduces(stats):
-        return "reduce-scatter" in stats or "all-reduce" in stats
+BASELINE_PATH = os.path.join(REPO, "benchmarks", "hlo_audit_baseline.json")
 
+# Gate tolerances (also used by tests/unit/test_hlo_gate.py). Counts are
+# exact-ish (XLA may split/merge a collective across minor versions); bytes
+# catch the silent killers — an accidental resharding roughly doubles
+# gather traffic, far outside these bands.
+COUNT_SLACK = 2
+BYTES_RTOL = 0.25
+
+
+def reduces(stats):
+    """Backend note: the CPU SPMD lowering expresses reduce-scatter as
+    all-reduce + dynamic-slice (no fused reduce-scatter HLO on this
+    backend); the TPU backend emits the fused op from the SAME programs —
+    so "grads reduce" is asserted as either form, while gather structure
+    is backend-stable."""
+    return "reduce-scatter" in stats or "all-reduce" in stats
+
+
+def check_intent(report):
+    """Design-intent assertions per strategy (shape of the collective
+    schedule, independent of exact counts)."""
     a = report["dp8_zero0"]
     assert reduces(a), "zero0: dp grad mean must reduce"
     assert a.get("all-gather", {}).get("bytes", 0) < 2**20, \
@@ -161,6 +194,59 @@ def main():
     assert reduces(tp), "tp: block partial sums must reduce"
     sp = report["sp2_dp4_zero3"]
     assert "all-to-all" in sp, "sp(Ulysses): head<->seq all-to-all missing"
+
+
+def check_against_baseline(name, stats, baseline):
+    """Tolerance comparison of one config's collectives vs the checked-in
+    baseline. Returns a list of violation strings (empty = pass)."""
+    problems = []
+    base = baseline.get(name)
+    if base is None:
+        return [f"{name}: no baseline entry — regenerate {BASELINE_PATH}"]
+    ops = {k for k in base if not k.startswith("_")} | \
+          {k for k in stats if not k.startswith("_")}
+    for op in sorted(ops):
+        b = base.get(op, {"count": 0, "bytes": 0})
+        s = stats.get(op, {"count": 0, "bytes": 0})
+        if abs(s["count"] - b["count"]) > COUNT_SLACK:
+            problems.append(
+                f"{name}.{op}: count {s['count']} vs baseline {b['count']} "
+                f"(slack {COUNT_SLACK})")
+        denom = max(b["bytes"], 1)
+        if abs(s["bytes"] - b["bytes"]) / denom > BYTES_RTOL and \
+                abs(s["bytes"] - b["bytes"]) > 2**18:
+            problems.append(
+                f"{name}.{op}: bytes {s['bytes']} vs baseline {b['bytes']} "
+                f"(rtol {BYTES_RTOL})")
+    b_roof = (base.get("_roofline") or {}).get("bytes_per_gflop")
+    s_roof = (stats.get("_roofline") or {}).get("bytes_per_gflop")
+    if b_roof and s_roof and s_roof > b_roof * (1 + BYTES_RTOL):
+        problems.append(
+            f"{name}: bytes/GFLOP {s_roof:.0f} vs baseline {b_roof:.0f} — "
+            f"collective traffic grew relative to compute")
+    return problems
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite hlo_audit_baseline.json from this run "
+                         "(do this deliberately, with the diff reviewed)")
+    args = ap.parse_args()
+
+    if not args.update_baseline and not os.path.exists(BASELINE_PATH):
+        # fail fast, and never self-baseline silently: a gate that
+        # baselines the very tree under test passes any regression
+        print(f"ERROR: {BASELINE_PATH} missing — a gate run cannot "
+              f"baseline itself. Re-run with --update-baseline "
+              f"deliberately and review the diff.", file=sys.stderr)
+        raise SystemExit(1)
+
+    report = {}
+    for name, (mesh_kw, over) in CASES.items():
+        report[name] = audit(name, mesh_kw, over, with_flops=True)
+    check_intent(report)
     report["_note"] = (
         "CPU SPMD lowers reduce-scatter as all-reduce+dynamic-slice; the "
         "TPU backend emits the fused op from the same programs")
@@ -168,6 +254,20 @@ def main():
     out = os.path.join(REPO, "benchmarks", "hlo_audit.json")
     with open(out, "w") as f:
         json.dump(report, f, indent=1)
+
+    if args.update_baseline:
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"baseline written -> {BASELINE_PATH}")
+    else:
+        with open(BASELINE_PATH) as f:
+            baseline = json.load(f)
+        problems = []
+        for name in CASES:
+            problems += check_against_baseline(name, report[name], baseline)
+        if problems:
+            print("HLO AUDIT REGRESSIONS:\n  " + "\n  ".join(problems))
+            raise SystemExit(1)
     print(f"HLO AUDIT OK -> {out}")
 
 
