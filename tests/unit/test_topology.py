@@ -58,8 +58,9 @@ def test_batch_sharding_spec():
 @pytest.mark.slow
 def test_multichip_dryrun_at_16_virtual_devices():
     """Scale generality beyond the driver's 8-device check: the SAME
-    4-sweep dryrun (pp2xtp2xdp4 zero1, sp2/dp8 zero3, ep2 MoE zero2,
-    LLaMA tp2/dp8 zero2) compiles and runs at 16 virtual devices."""
+    6-sweep dryrun (pp2xtp2xdp4 zero1, sp2/dp8 zero3, ep2 MoE zero2,
+    LLaMA tp2/dp8 zero2, tp2 serving parity, hybrid+LoRA RLHF flip)
+    compiles and runs at 16 virtual devices."""
     import os
     import subprocess
     import sys
@@ -75,3 +76,4 @@ def test_multichip_dryrun_at_16_virtual_devices():
     assert proc.returncode == 0, proc.stderr[-1500:]
     assert "OK" in proc.stdout
     assert "pp=2/tp=2/dp=4" in proc.stdout, proc.stdout
+    assert "6 sweeps OK" in proc.stdout, proc.stdout
