@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 
 from ..models.api import ModelSpec, param_path_tree
-from ..ops.quantizer_ops import fake_quantize
+from ..ops.quantizer_ops import (binary_quantize, fake_quantize,
+                                 ternary_quantize)
 from ..utils.logging import log_dist
 from .config import CompressionConfig, TechniqueConfig
 
@@ -35,23 +36,45 @@ def _match(path: str, patterns: List[str]) -> bool:
 
 
 # ---------------------------------------------------------------- transforms
+def _keep_topk_mask(norms, ratio: float, dtype):
+    """1/0 mask keeping the ``ratio`` highest-norm entries (k clamped to
+    [1, n] so dense_ratio >= 1 keeps everything instead of wrapping the
+    sort index negative)."""
+    n = norms.shape[0]
+    k = min(n, max(1, int(round(n * ratio))))
+    thresh = jnp.sort(norms)[n - k]
+    return (norms >= thresh).astype(dtype)
+
+
 def quantize_leaf(w, params: Dict[str, Any]):
-    """QAT fake-quant (LinearLayer_Compress weight quantization)."""
+    """QAT fake-quant (LinearLayer_Compress weight quantization), incl.
+    the reference's 1-bit binary and 2-bit ternary regimes
+    (basic_layer.py:90-100 dispatch; utils.py Binary/TernaryQuantizer)
+    and Embedding_Compress's token-wise grouping (basic_layer.py:102:
+    ``quantization_groups: "token_wise"`` -> one group per embedding row)."""
     bits = int(params.get("target_bits", params.get("bits", 8)))
-    groups = int(params.get("quantization_groups", params.get("groups", 1)))
+    groups = params.get("quantization_groups", params.get("groups", 1))
+    if groups == "token_wise":
+        groups = int(w.shape[0]) if w.ndim >= 2 else 1
+    groups = int(groups)
     sym = params.get("quantization_type", "symmetric") != "asymmetric"
     if w.size % max(groups, 1) != 0:
         groups = 1
+    if bits <= 2 and not sym:
+        raise ValueError("only symmetric quantization is supported for "
+                         "binary/ternary weights")
+    if bits == 1:
+        return binary_quantize(w, groups=groups)
+    if bits == 2:
+        return ternary_quantize(w, groups=groups)
     return fake_quantize(w, groups=groups, bits=bits, symmetric=sym)
 
 
 def sparse_prune_leaf(w, params: Dict[str, Any]):
     """Unstructured magnitude pruning at `dense_ratio` kept weights."""
     ratio = float(params.get("dense_ratio", 0.5))
-    k = max(1, int(round(w.size * ratio)))
-    flat = jnp.abs(w.reshape(-1))
-    thresh = jnp.sort(flat)[w.size - k]
-    return jnp.where(jnp.abs(w) >= thresh, w, jnp.zeros_like(w))
+    mask = _keep_topk_mask(jnp.abs(w.reshape(-1)), ratio, w.dtype)
+    return w * mask.reshape(w.shape)
 
 
 def row_prune_leaf(w, params: Dict[str, Any]):
@@ -60,10 +83,8 @@ def row_prune_leaf(w, params: Dict[str, Any]):
         return w
     ratio = float(params.get("dense_ratio", 0.5))
     rows = w.shape[0]
-    k = max(1, int(round(rows * ratio)))
     norms = jnp.sum(jnp.abs(w.reshape(rows, -1)), axis=1)
-    thresh = jnp.sort(norms)[rows - k]
-    mask = (norms >= thresh).astype(w.dtype)
+    mask = _keep_topk_mask(norms, ratio, w.dtype)
     return w * mask.reshape((rows,) + (1,) * (w.ndim - 1))
 
 
@@ -74,19 +95,32 @@ def head_prune_leaf(w, params: Dict[str, Any]):
     if heads <= 1 or w.ndim < 2 or w.shape[-1] % heads != 0:
         return w
     ratio = float(params.get("dense_ratio", 0.5))
-    keep = max(1, int(round(heads * ratio)))
     hd = w.shape[-1] // heads
     blocks = w.reshape(w.shape[:-1] + (heads, hd))
     norms = jnp.sum(jnp.abs(blocks.reshape(-1, heads, hd)), axis=(0, 2))
-    thresh = jnp.sort(norms)[heads - keep]
-    mask = (norms >= thresh).astype(w.dtype)
+    mask = _keep_topk_mask(norms, ratio, w.dtype)
     return (blocks * mask[:, None]).reshape(w.shape)
+
+
+def channel_prune_leaf(w, params: Dict[str, Any]):
+    """Conv output-channel pruning (reference basic_layer.py:404
+    Conv2dLayer_Compress.enable_channel_pruning: L1 norm per output
+    channel). Our conv kernels are HWIO, so the output channel is the LAST
+    axis — norms reduce over (kh, kw, in) and the mask broadcasts on -1.
+    Non-4D leaves (biases, norm scales matched by a broad pattern) pass
+    through untouched."""
+    if w.ndim != 4:
+        return w
+    ratio = float(params.get("dense_ratio", 0.5))
+    norms = jnp.sum(jnp.abs(w), axis=(0, 1, 2))
+    return w * _keep_topk_mask(norms, ratio, w.dtype)
 
 
 _TRANSFORMS = [
     ("sparse_pruning", sparse_prune_leaf),
     ("row_pruning", row_prune_leaf),
     ("head_pruning", head_prune_leaf),
+    ("channel_pruning", channel_prune_leaf),
     ("weight_quantization", quantize_leaf),   # quant LAST (after masks)
 ]
 
@@ -132,6 +166,7 @@ class CompressedModel(ModelSpec):
         self.compression_config = config
         self.compression_scheduler = CompressionScheduler(config)
         self.config = getattr(inner, "config", None)
+        self._zero_match_warned = set()
 
     def init(self, rng):
         return self.inner.init(rng)
@@ -148,16 +183,29 @@ class CompressedModel(ModelSpec):
             if not live:
                 continue
 
+            applied = []
+
             def leaf(path, w):
                 if not hasattr(w, "ndim") or not jnp.issubdtype(
                         w.dtype, jnp.floating):
                     return w
                 for group in tc.groups:
                     if _match(path, group.modules):
-                        return fn(w, group.params)
+                        out = fn(w, group.params)
+                        if out is not w:   # transforms return w unchanged
+                            applied.append(path)  # when inapplicable
+                        return out
                 return w
 
             params = jax.tree.map(leaf, paths, params)
+            if not applied and name not in self._zero_match_warned:
+                # accepted = active: an enabled technique whose patterns
+                # match no applicable leaf would otherwise be silently inert
+                self._zero_match_warned.add(name)
+                log_dist(f"compression: '{name}' is enabled but transformed "
+                         f"ZERO leaves — check different_groups modules "
+                         f"patterns against the model's param paths",
+                         ranks=[0])
         return params
 
     def _act_bits(self, force_all: bool = False):

@@ -38,6 +38,14 @@ class TechniqueConfig:
                    shared=shared, groups=groups)
 
 
+#: technique block names in the ``compression_training`` config section
+#: (reference compression/constants.py: WEIGHT_QUANTIZATION ..
+#: CHANNEL_PRUNING:160)
+TECHNIQUE_BLOCKS = ("weight_quantization", "activation_quantization",
+                    "sparse_pruning", "row_pruning", "head_pruning",
+                    "channel_pruning")
+
+
 @dataclasses.dataclass
 class CompressionConfig:
     weight_quantization: TechniqueConfig = None
@@ -45,25 +53,35 @@ class CompressionConfig:
     sparse_pruning: TechniqueConfig = None
     row_pruning: TechniqueConfig = None
     head_pruning: TechniqueConfig = None
+    channel_pruning: TechniqueConfig = None
     layer_reduction: Dict[str, Any] = None
 
     @classmethod
     def parse(cls, ds_config: Dict[str, Any]) -> "CompressionConfig":
         block = (ds_config or {}).get("compression_training", {}) or {}
-        return cls(
-            weight_quantization=TechniqueConfig.parse(
-                block.get("weight_quantization", {})),
-            activation_quantization=TechniqueConfig.parse(
-                block.get("activation_quantization", {})),
-            sparse_pruning=TechniqueConfig.parse(
-                block.get("sparse_pruning", {})),
-            row_pruning=TechniqueConfig.parse(
-                block.get("row_pruning", {})),
-            head_pruning=TechniqueConfig.parse(
-                block.get("head_pruning", {})),
-            layer_reduction=dict(block.get("layer_reduction", {}) or {}))
+        unknown = set(block) - set(TECHNIQUE_BLOCKS) - {"layer_reduction"}
+        if unknown:
+            # accepted = active: an unknown technique block would be
+            # silently inert, which reads as "compression on" to the user
+            raise ValueError(
+                f"unknown compression_training blocks {sorted(unknown)}; "
+                f"known: {sorted(TECHNIQUE_BLOCKS) + ['layer_reduction']}")
+        kwargs = {name: TechniqueConfig.parse(block.get(name, {}))
+                  for name in TECHNIQUE_BLOCKS}
+        wq = kwargs["weight_quantization"]
+        for g in wq.groups:
+            bits = int(g.params.get("target_bits", g.params.get("bits", 8)))
+            if bits <= 2 and g.params.get(
+                    "quantization_type", "symmetric") == "asymmetric":
+                # validate at parse time, not when the technique goes live
+                # at schedule_offset hours into a run
+                raise ValueError(
+                    f"weight_quantization group '{g.name}': only symmetric "
+                    f"quantization is supported for binary/ternary "
+                    f"({bits}-bit) weights")
+        return cls(layer_reduction=dict(block.get("layer_reduction", {}) or {}),
+                   **kwargs)
 
     def any_enabled(self) -> bool:
-        return any(t is not None and t.enabled for t in (
-            self.weight_quantization, self.activation_quantization,
-            self.sparse_pruning, self.row_pruning, self.head_pruning))
+        return any(getattr(self, n) is not None and getattr(self, n).enabled
+                   for n in TECHNIQUE_BLOCKS)

@@ -96,6 +96,29 @@ def fake_quantize(x, groups: int = 1, bits: int = 8, symmetric: bool = True,
     return x + jax.lax.stop_gradient(deq - x)
 
 
+def binary_quantize(x, groups: int = 1):
+    """1-bit weight quantization with straight-through gradients
+    (reference compression/utils.py:189 BinaryQuantizer): per-group
+    alpha = mean(|x|), value = alpha * sign(x)."""
+    xg = x.reshape(groups, -1).astype(jnp.float32)
+    alpha = jnp.mean(jnp.abs(xg), axis=1, keepdims=True)
+    deq = (alpha * jnp.sign(xg)).reshape(x.shape).astype(x.dtype)
+    return x + jax.lax.stop_gradient(deq - x)
+
+
+def ternary_quantize(x, groups: int = 1):
+    """2-bit {-a, 0, +a} quantization with straight-through gradients
+    (reference compression/utils.py:148 TernaryQuantizer): per-group
+    threshold 0.7 * mean(|x|); alpha = mean(|x|) over surviving weights."""
+    xg = x.reshape(groups, -1).astype(jnp.float32)
+    thres = 0.7 * jnp.mean(jnp.abs(xg), axis=1, keepdims=True)
+    mask = (jnp.abs(xg) > thres).astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+    alpha = jnp.sum(jnp.abs(xg) * mask, axis=1, keepdims=True) / denom
+    deq = (alpha * jnp.sign(xg) * mask).reshape(x.shape).astype(x.dtype)
+    return x + jax.lax.stop_gradient(deq - x)
+
+
 def quantization_error(x, groups=1, bits=8, symmetric=True):
     """Mean-squared quantization error (MoQ precision-switch diagnostics)."""
     return jnp.mean(jnp.square(
@@ -106,4 +129,6 @@ def quantization_error(x, groups=1, bits=8, symmetric=True):
 def get_ops(backend: str = "tpu"):
     return SimpleNamespace(quantize=quantize, dequantize=dequantize,
                            fake_quantize=fake_quantize,
+                           binary_quantize=binary_quantize,
+                           ternary_quantize=ternary_quantize,
                            quantization_error=quantization_error)

@@ -303,3 +303,147 @@ def test_sparse_tensor_roundtrip_and_add():
     summed = st.add(SparseTensor.from_dense(other))
     np.testing.assert_array_equal(summed.to_dense(), dense + other)
     assert summed.sparse_size() < dense.size + other.size
+
+
+# ----------------------------------------- round-5: conv/embedding/1-2 bit
+# (reference basic_layer.py:404 Conv2dLayer_Compress, :65 Embedding_Compress,
+#  utils.py:148/189 Ternary/BinaryQuantizer; round-4 verdict missing #3)
+
+def test_binary_quantization_numerics_and_ste():
+    from deepspeed_tpu.ops.quantizer_ops import binary_quantize
+    w = jnp.asarray(np.random.default_rng(0).standard_normal((4, 8)),
+                    jnp.float32)
+    q = np.asarray(binary_quantize(w, groups=4))
+    for g in range(4):
+        row = q.reshape(4, 8)[g]
+        alpha = np.abs(np.asarray(w).reshape(4, 8)[g]).mean()
+        np.testing.assert_allclose(np.abs(row), alpha, rtol=1e-6)
+    # straight-through: gradient of sum(q) w.r.t. w is ~identity, not zero
+    g = jax.grad(lambda x: jnp.sum(binary_quantize(x, groups=4)))(w)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def test_ternary_quantization_numerics():
+    from deepspeed_tpu.ops.quantizer_ops import ternary_quantize
+    w = jnp.asarray(np.random.default_rng(1).standard_normal(64), jnp.float32)
+    q = np.asarray(ternary_quantize(w, groups=1))
+    vals = np.unique(np.round(q, 6))
+    assert len(vals) <= 3 and 0.0 in vals, f"not ternary: {vals}"
+    thres = 0.7 * np.abs(np.asarray(w)).mean()
+    np.testing.assert_array_equal(q == 0.0, np.abs(np.asarray(w)) <= thres)
+
+
+def _wq_modules_config(modules, bits=8, groups=1):
+    return {"compression_training": {"weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"g": {
+            "params": {"target_bits": bits, "quantization_groups": groups},
+            "modules": modules}}}}}
+
+
+def test_embedding_token_wise_quantization():
+    """Embedding compression: token-wise grouping quantizes each row with
+    its own scale, so a row of tiny weights is NOT flattened to zero by a
+    row of huge ones (the failure mode of one global group)."""
+    model = init_compression(GPT2Model(TINY),
+                             _wq_modules_config(["wte"], bits=8,
+                                                groups="token_wise"))
+    params = model.init(jax.random.PRNGKey(0))
+    # make row 0 tiny and row 1 huge
+    wte = np.array(params["wte"], np.float32)
+    wte[0] *= 1e-3
+    wte[1] *= 1e3
+    params = dict(params, wte=jnp.asarray(wte))
+    cp = model.compress_params(params)
+    q = np.asarray(cp["wte"], np.float32)
+    # the tiny row survives with its own scale (global grouping would
+    # round it entirely to zero against the 1e3 row)
+    assert np.abs(q[0]).max() > 0, "token-wise scale lost the tiny row"
+    rel = np.abs(q[0] - wte[0]) / (np.abs(wte[0]).max() + 1e-12)
+    assert rel.max() < 0.02, "row-0 quantization error too large"
+
+
+def test_channel_pruning_conv_model():
+    """Channel pruning on a real HWIO conv forward (models/diffusion._conv):
+    pruned output channels are exactly zero in the kernel AND dead in the
+    activation map."""
+    from deepspeed_tpu.compression.compress import CompressedModel
+    from deepspeed_tpu.compression.config import CompressionConfig
+    from deepspeed_tpu.models.diffusion import _conv
+
+    cfgd = {"compression_training": {"channel_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"cp": {"params": {"dense_ratio": 0.5},
+                                    "modules": ["conv"]}}}}}
+
+    class TinyConvSpec:
+        config = None
+
+        def init(self, rng):
+            k = jax.random.normal(rng, (3, 3, 4, 8), jnp.float32)
+            return {"conv_w": k, "conv_b": jnp.zeros((8,), jnp.float32)}
+
+        def apply(self, params, batch, rng=None, train=True, **kw):
+            return _conv(batch, params["conv_w"], params["conv_b"])
+
+        def partition_rules(self):
+            return []
+
+    model = CompressedModel(TinyConvSpec(),
+                            CompressionConfig.parse(cfgd))
+    params = model.init(jax.random.PRNGKey(0))
+    cp = model.compress_params(params)
+    kq = np.asarray(cp["conv_w"])
+    dead = [c for c in range(8) if (kq[..., c] == 0).all()]
+    assert len(dead) == 4, f"expected 4 pruned channels, got {len(dead)}"
+    # bias untouched (1-D leaf passes through)
+    np.testing.assert_array_equal(np.asarray(cp["conv_b"]),
+                                  np.asarray(params["conv_b"]))
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((1, 8, 8, 4)),
+                    jnp.float32)
+    out = np.asarray(model.apply(params, x))
+    assert np.isfinite(out).all()
+    for c in dead:
+        assert (out[..., c] == 0).all(), f"pruned channel {c} still alive"
+
+
+def test_unknown_compression_block_raises():
+    with pytest.raises(ValueError, match="unknown compression_training"):
+        CompressionConfig.parse({"compression_training": {
+            "weight_quantization": {"shared_parameters": {"enabled": True}},
+            "channle_pruning": {}}})
+
+
+def test_zero_match_technique_logs(monkeypatch):
+    from deepspeed_tpu.compression import compress as compress_mod
+    messages = []
+    monkeypatch.setattr(compress_mod, "log_dist",
+                        lambda msg, **kw: messages.append(msg))
+    model = init_compression(GPT2Model(TINY),
+                             _wq_modules_config(["no_such_module"]))
+    params = model.init(jax.random.PRNGKey(0))
+    model.compress_params(params)
+    assert any("ZERO leaves" in m for m in messages), messages
+    # warned once, not per call
+    model.compress_params(params)
+    assert sum("ZERO leaves" in m for m in messages) == 1
+
+
+def test_binary_asymmetric_rejected_at_parse():
+    with pytest.raises(ValueError, match="symmetric"):
+        CompressionConfig.parse({"compression_training": {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True,
+                                      "schedule_offset": 10000},
+                "different_groups": {"g": {
+                    "params": {"target_bits": 1,
+                               "quantization_type": "asymmetric"},
+                    "modules": ["attn"]}}}}})
+
+
+def test_dense_ratio_above_one_keeps_everything():
+    from deepspeed_tpu.compression.compress import channel_prune_leaf
+    w = jnp.asarray(np.random.default_rng(0).standard_normal((3, 3, 4, 8)),
+                    jnp.float32)
+    out = np.asarray(channel_prune_leaf(w, {"dense_ratio": 1.5}))
+    np.testing.assert_array_equal(out, np.asarray(w))
