@@ -265,17 +265,25 @@ class GPT2Model(ModelSpec):
 
     def _block(self, x, layer_params, rng, train, extra=None):
         """One decoder block. Returns (x, aux_loss) — aux is nonzero only for
-        MoE variants. ``extra``: this layer's slice of _layer_extras()."""
-        x = self._attn_sublayer(x, layer_params, rng, train, extra=extra)
-        return self._mlp_sublayer(x, layer_params, rng, train)
+        MoE variants. ``extra``: this layer's slice of _layer_extras().
+
+        named_scope phases feed the flops profiler's per-phase attribution
+        (and label the XLA fusions in device traces) — they cost nothing at
+        runtime."""
+        with jax.named_scope("attn"):
+            x = self._attn_sublayer(x, layer_params, rng, train, extra=extra)
+        with jax.named_scope("mlp"):
+            return self._mlp_sublayer(x, layer_params, rng, train)
 
     def _decode_block(self, x, layer_params, attn_fn, start_pos,
                       positions=None, extra=None):
         """One block on the KV-cache decode path (no dropout/rng)."""
-        x = self._attn_sublayer(x, layer_params, None, False, attn_fn=attn_fn,
-                                start_pos=start_pos, positions=positions,
-                                extra=extra)
-        x, _ = self._mlp_sublayer(x, layer_params, None, False)
+        with jax.named_scope("attn"):
+            x = self._attn_sublayer(x, layer_params, None, False,
+                                    attn_fn=attn_fn, start_pos=start_pos,
+                                    positions=positions, extra=extra)
+        with jax.named_scope("mlp"):
+            x, _ = self._mlp_sublayer(x, layer_params, None, False)
         return x
 
     # ---- per-layer constants (scanned alongside the stacked params) ----
@@ -322,7 +330,8 @@ class GPT2Model(ModelSpec):
         # to bf16/fp16 before apply (mixed-precision contract); cfg.dtype is
         # the fallback for direct use.
         compute_dtype = self._compute_dtype(params)
-        x = self._embed(params, input_ids)
+        with jax.named_scope("embed"):
+            x = self._embed(params, input_ids)
         x = self._dropout(x, rng, train, 2)
         use_wrappers = train and rng is not None
         t = x.shape[1]
@@ -384,10 +393,11 @@ class GPT2Model(ModelSpec):
                return_aux_loss=False):
         x, aux, wte = self.hidden_states(params, input_ids, rng=rng,
                                          train=train)
-        logits = x @ wte.T
-        head_b = self._head_bias(params, logits.dtype)
-        if head_b is not None:
-            logits = logits + head_b
+        with jax.named_scope("head"):
+            logits = x @ wte.T
+            head_b = self._head_bias(params, logits.dtype)
+            if head_b is not None:
+                logits = logits + head_b
         if return_aux_loss:
             return logits, aux
         return logits
@@ -508,8 +518,9 @@ class GPT2Model(ModelSpec):
                                          train=train, pld_theta=pld_theta,
                                          ltd_keep=ltd_keep,
                                          act_bits=act_bits)
-        loss = self._head_loss_from_hidden(
-            x, wte, batch, head_b=self._head_bias(params, wte.dtype))
+        with jax.named_scope("head"):
+            loss = self._head_loss_from_hidden(
+                x, wte, batch, head_b=self._head_bias(params, wte.dtype))
         w = self.aux_loss_weight()
         return loss + w * aux if w else loss
 

@@ -198,17 +198,21 @@ class GPTNeoXModel(GPT2Model):
         cfg = self.config
         eps = cfg.layer_norm_epsilon
         ln1 = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], eps)
-        attn = self._attn_branch(ln1, p, rng, train, attn_fn, start_pos,
-                                 positions=positions)
+        with jax.named_scope("attn"):
+            attn = self._attn_branch(ln1, p, rng, train, attn_fn, start_pos,
+                                     positions=positions)
         if cfg.use_parallel_residual:
-            mlp_in = ln1 if cfg.shared_ln else \
-                _layer_norm(x, p["ln2_scale"], p["ln2_bias"], eps)
-            mlp = self._mlp_branch(mlp_in, p)
+            with jax.named_scope("mlp"):
+                mlp_in = ln1 if cfg.shared_ln else \
+                    _layer_norm(x, p["ln2_scale"], p["ln2_bias"], eps)
+                mlp = self._mlp_branch(mlp_in, p)
             return x + self._dropout(attn, rng, train, 0) + \
                 self._dropout(mlp, rng, train, 1)
         h = x + self._dropout(attn, rng, train, 0)
-        ln2 = _layer_norm(h, p["ln2_scale"], p["ln2_bias"], eps)
-        return h + self._dropout(self._mlp_branch(ln2, p), rng, train, 1)
+        with jax.named_scope("mlp"):
+            ln2 = _layer_norm(h, p["ln2_scale"], p["ln2_bias"], eps)
+            mlp = self._mlp_branch(ln2, p)
+        return h + self._dropout(mlp, rng, train, 1)
 
     def _block(self, x, layer_params, rng, train, extra=None):
         return self._block_impl(x, layer_params, rng, train, None, 0), \

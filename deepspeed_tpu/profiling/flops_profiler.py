@@ -17,6 +17,7 @@ engine.py:1646-1664 start/stop wiring).
 """
 
 import math
+import re
 from typing import Any, Dict, Optional
 
 import jax
@@ -65,9 +66,33 @@ _REDUCE = {"reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
            "cumlogsumexp", "cummax"}
 
 
+#: model phases recognised in named_scope stacks (models/gpt2.py _block
+#: et al. annotate these; reference profiler.py:239 prints the torch
+#: module tree — the phase tree is the jax equivalent, since there is no
+#: module hierarchy at trace time, only the name stack)
+PHASES = ("embed", "attn", "mlp", "moe", "head")
+
+
+#: token-boundary match: under autodiff the stack segments are wrapped
+#: ('jvp(attn)', 'transpose(jvp(mlp))'), and raw substring search would
+#: misfire on identifiers like 'num_heads'/'embedding'
+_PHASE_RE = re.compile(
+    r"(?<![A-Za-z0-9_])(" + "|".join(PHASES) + r")(?![A-Za-z0-9_])")
+
+
+def _phase_of(eqn) -> str:
+    try:
+        stack = str(eqn.source_info.name_stack)
+    except Exception:
+        return "other"
+    m = _PHASE_RE.search(stack)
+    return m.group(1) if m else "other"
+
+
 def jaxpr_flops(jaxpr, breakdown: Optional[Dict[str, int]] = None,
-                mult: int = 1) -> int:
-    """Analytic FLOPs of a (closed) jaxpr; scans multiply by length."""
+                mult: int = 1, phases: Optional[Dict[str, int]] = None) -> int:
+    """Analytic FLOPs of a (closed) jaxpr; scans multiply by length.
+    ``phases`` collects per-named-scope-phase totals (embed/attn/mlp/...)."""
     if hasattr(jaxpr, "jaxpr"):
         jaxpr = jaxpr.jaxpr
     total = 0
@@ -86,35 +111,47 @@ def jaxpr_flops(jaxpr, breakdown: Optional[Dict[str, int]] = None,
         elif name == "scan":
             length = eqn.params.get("length", 1)
             total += jaxpr_flops(eqn.params["jaxpr"], breakdown,
-                                 mult * length)
+                                 mult * length, phases)
             continue
         elif name == "while":
             # trip count unknown at trace time: count one iteration
-            total += jaxpr_flops(eqn.params["body_jaxpr"], breakdown, mult)
+            total += jaxpr_flops(eqn.params["body_jaxpr"], breakdown, mult,
+                                 phases)
             continue
         elif name == "cond":
             branches = eqn.params.get("branches", ())
             if branches:  # one branch executes: take the max, and merge
                 #           only ITS breakdown (totals must match the table)
-                per_branch = [({}, b) for b in branches]
-                flops_per = [(jaxpr_flops(b, bd, mult), bd)
-                             for bd, b in per_branch]
-                best_flops, best_bd = max(flops_per, key=lambda t: t[0])
+                flops_per = []
+                for b in branches:
+                    bd, ph = {}, {}
+                    flops_per.append((jaxpr_flops(b, bd, mult, ph), bd, ph))
+                best_flops, best_bd, best_ph = max(flops_per,
+                                                   key=lambda t: t[0])
                 total += best_flops
                 if breakdown is not None:
                     for k, v in best_bd.items():
                         breakdown[k] = breakdown.get(k, 0) + v
+                if phases is not None:
+                    for k, v in best_ph.items():
+                        phases[k] = phases.get(k, 0) + v
             continue
         elif "jaxpr" in eqn.params:  # pjit / remat / custom_vjp call, etc.
-            total += jaxpr_flops(eqn.params["jaxpr"], breakdown, mult)
+            total += jaxpr_flops(eqn.params["jaxpr"], breakdown, mult,
+                                 phases)
             continue
         elif "call_jaxpr" in eqn.params:
-            total += jaxpr_flops(eqn.params["call_jaxpr"], breakdown, mult)
+            total += jaxpr_flops(eqn.params["call_jaxpr"], breakdown, mult,
+                                 phases)
             continue
         flops *= inner_mult
         total += flops
-        if breakdown is not None and flops:
-            breakdown[name] = breakdown.get(name, 0) + flops
+        if flops:
+            if breakdown is not None:
+                breakdown[name] = breakdown.get(name, 0) + flops
+            if phases is not None:
+                ph = _phase_of(eqn)
+                phases[ph] = phases.get(ph, 0) + flops
     return total
 
 
@@ -147,12 +184,20 @@ class FlopsProfiler:
             except Exception:
                 pass
         closed = jax.make_jaxpr(lambda *a, **k: fn(*a, **k))(*args, **kwargs)
-        total = jaxpr_flops(closed, breakdown)
+        phases: Dict[str, int] = {}
+        total = jaxpr_flops(closed, breakdown, phases=phases)
         return {"flops": total, "macs": total // 2,
-                "xla_flops": xla_flops, "per_primitive": breakdown}
+                "xla_flops": xla_flops, "per_primitive": breakdown,
+                "per_phase": phases}
 
     def report(self, prof: Dict[str, Any], params: Optional[int] = None,
-               latency_s: Optional[float] = None, top: int = 10) -> str:
+               latency_s: Optional[float] = None, top: int = 10,
+               wall_fractions: Optional[Dict[str, float]] = None) -> str:
+        """Reference-style tree report (profiler.py:239 prints the torch
+        module tree; the phase tree is the jax equivalent). When a device
+        trace is available, pass ``wall_fractions`` from
+        :func:`wall_fractions_from_trace` for MEASURED per-phase wall —
+        otherwise the wall column is flops-proportional and labelled so."""
         lines = ["-" * 60, "deepspeed_tpu flops profiler", "-" * 60]
         if params is not None:
             lines.append(f"params:               {_num_to_string(params)}")
@@ -166,6 +211,29 @@ class FlopsProfiler:
             lines.append(
                 f"achieved:             "
                 f"{_num_to_string(prof['flops'] / latency_s)}FLOPS")
+        per_phase = prof.get("per_phase") or {}
+        if per_phase:
+            wall_src = "measured" if wall_fractions else "flops-proportional"
+            lines.append(f"model tree (phases; wall = {wall_src}):")
+            order = [p for p in PHASES if p in per_phase] + \
+                sorted(k for k in per_phase if k not in PHASES)
+            for ph in order:
+                fl = per_phase[ph]
+                pct = 100.0 * fl / max(1, prof["flops"])
+                if wall_fractions is not None and ph not in wall_fractions:
+                    # never mix units: a phase the trace didn't see prints
+                    # n/a instead of smuggling in its flops fraction
+                    wall_col = "  n/a wall"
+                    wf = None
+                else:
+                    wf = (wall_fractions or {}).get(
+                        ph, fl / max(1, prof["flops"]))
+                    wall_col = f"{100 * wf:5.1f}% wall"
+                line = (f"  {ph:<10} {_num_to_string(fl):>12}  "
+                        f"{pct:5.1f}% flops  {wall_col}")
+                if latency_s and wf is not None:
+                    line += f"  ({wf * latency_s * 1e3:7.2f} ms)"
+                lines.append(line)
         items = sorted(prof["per_primitive"].items(), key=lambda kv: -kv[1])
         lines.append("top primitives:")
         for name, fl in items[:top]:
@@ -173,6 +241,49 @@ class FlopsProfiler:
             lines.append(f"  {name:<28} {_num_to_string(fl):>12}  {pct:5.1f}%")
         lines.append("-" * 60)
         return "\n".join(lines)
+
+
+def wall_fractions_from_trace(trace_dir: str) -> Dict[str, float]:
+    """Measured per-phase wall fractions from a ``jax.profiler`` trace.
+
+    XLA op/fusion names carry the named_scope stack of their constituent
+    HLOs, so device self-time can be attributed to the same phases the
+    analytic tree uses. Returns {} when no trace is found (callers fall
+    back to flops-proportional wall)."""
+    import glob
+    import gzip
+    import json
+    import os
+
+    files = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not files:
+        return {}
+    with gzip.open(sorted(files)[-1], "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    tid_names = {(e["pid"], e["tid"]): e["args"]["name"] for e in events
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    per_phase: Dict[str, float] = {}
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or \
+                tid_names.get((e["pid"], e["tid"])) != "XLA Ops":
+            continue
+        dur = float(e.get("dur", 0.0))
+        # fusion names don't always carry the scope; the event metadata
+        # (args: long_name / tf_op / hlo metadata) usually does. Token-
+        # boundary match (first occurrence wins) so 'num_heads'/'embedding'
+        # don't misattribute time to 'head'/'embed'.
+        hay = e.get("name", "") + " " + " ".join(
+            str(v) for v in (e.get("args") or {}).values())
+        m = _PHASE_RE.search(hay)
+        phase = m.group(1) if m else "other"
+        per_phase[phase] = per_phase.get(phase, 0.0) + dur
+        total += dur
+    if total <= 0:
+        return {}
+    return {ph: d / total for ph, d in per_phase.items()}
 
 
 def get_model_profile(model, batch, rng=None) -> Dict[str, Any]:
