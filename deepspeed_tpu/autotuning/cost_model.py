@@ -143,10 +143,18 @@ def model_shape_from_profile(model, batch, seq_len: Optional[int] = None,
     phases = prof.get("per_phase") or {}
     attn = phases.get("attn", 0)
     cfg = getattr(model, "config", None)
+    hidden = getattr(cfg, "n_embd", None)
+    n_layer = getattr(cfg, "n_layer", None)
+    if not hidden or not n_layer:
+        # silently fabricating hidden=0 would zero the activation-stash
+        # term in estimate_memory_bytes and admit OOM candidates
+        raise ValueError(
+            f"{type(model).__name__}.config must expose n_embd/n_layer for "
+            f"the memory prior; construct ModelShape explicitly instead")
     return ModelShape(
         n_params=int(prof["params"]),
-        hidden=int(getattr(cfg, "n_embd", 0) or 0),
-        n_layer=int(getattr(cfg, "n_layer", 1) or 1),
+        hidden=int(hidden),
+        n_layer=int(n_layer),
         seq_len=seq_len,
         vocab=int(getattr(cfg, "vocab_size", 50304) or 50304),
         fwd_flops_per_sample=prof["flops"] / n_samples,

@@ -198,6 +198,8 @@ class FlopsProfiler:
         trace is available, pass ``wall_fractions`` from
         :func:`wall_fractions_from_trace` for MEASURED per-phase wall —
         otherwise the wall column is flops-proportional and labelled so."""
+        if not wall_fractions:
+            wall_fractions = None   # {} = no trace found: honest fallback
         lines = ["-" * 60, "deepspeed_tpu flops profiler", "-" * 60]
         if params is not None:
             lines.append(f"params:               {_num_to_string(params)}")
@@ -273,13 +275,17 @@ def wall_fractions_from_trace(trace_dir: str) -> Dict[str, float]:
         dur = float(e.get("dur", 0.0))
         # fusion names don't always carry the scope; the event metadata
         # (args: long_name / tf_op / hlo metadata) usually does. Token-
-        # boundary match (first occurrence wins) so 'num_heads'/'embedding'
-        # don't misattribute time to 'head'/'embed'.
+        # boundary match so 'num_heads'/'embedding' don't misattribute to
+        # 'head'/'embed'; XLA fuses across scope boundaries, so a fusion
+        # matching several phases splits its time evenly between them
+        # rather than crediting whichever token appears first.
         hay = e.get("name", "") + " " + " ".join(
             str(v) for v in (e.get("args") or {}).values())
-        m = _PHASE_RE.search(hay)
-        phase = m.group(1) if m else "other"
-        per_phase[phase] = per_phase.get(phase, 0.0) + dur
+        found = sorted(set(_PHASE_RE.findall(hay)))
+        if not found:
+            found = ["other"]
+        for ph in found:
+            per_phase[ph] = per_phase.get(ph, 0.0) + dur / len(found)
         total += dur
     if total <= 0:
         return {}
