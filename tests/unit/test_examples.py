@@ -39,6 +39,7 @@ def _run_example(extra, layers=1, timeout=420):
                           text=True, timeout=timeout)
 
 
+@pytest.mark.smoke   # pinned: CI smoke must always run one example e2e
 def test_gpt2_125m_example_trains_on_cpu_mesh():
     proc = _run_example(["--model", "gpt2-125m", "--deepspeed_config",
                          os.path.join(CONFIG_DIR, "gpt2_125m_zero0.json")])

@@ -57,6 +57,7 @@ def _audit_and_check(name, baseline):
     return stats
 
 
+@pytest.mark.smoke   # pinned: the collective gate must stay in CI smoke
 @pytest.mark.parametrize("name", SMOKE_CASES)
 def test_collective_schedule_smoke(name, baseline):
     _audit_and_check(name, baseline)
