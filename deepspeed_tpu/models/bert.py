@@ -43,6 +43,11 @@ class BertModel(ModelSpec):
 
     def __init__(self, config: BertConfig = BERT_BASE):
         self.config = config
+        # attention override hook: attn_override(q, k, v, mask) -> attn,
+        # q/k/v [B,H,T,D]. Set by SparseAttentionUtils model surgery
+        # (reference sparse_attention_utils.py:81 replaces the torch
+        # BertSelfAttention module; here the function is the module)
+        self.attn_override = None
 
     # ------------------------------------------------------------------ init
     def init(self, rng):
@@ -95,13 +100,20 @@ class BertModel(ModelSpec):
         q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
-        drop_rng = None
-        if train and cfg.dropout > 0 and rng is not None:
-            drop_rng = jax.random.fold_in(rng, 3)
-        attn = flash_attention(q, k, v, causal=False, mask=mask,
-                               dropout_rate=cfg.dropout if train else 0.0,
-                               dropout_rng=drop_rng,
-                               backend=cfg.attn_backend)
+        self._ever_traced = True
+        if self.attn_override is not None:
+            # overrides forgo attention-probability dropout (the residual
+            # dropouts below still apply) — the hook signature carries no
+            # rng by design
+            attn = self.attn_override(q, k, v, mask)
+        else:
+            drop_rng = None
+            if train and cfg.dropout > 0 and rng is not None:
+                drop_rng = jax.random.fold_in(rng, 3)
+            attn = flash_attention(q, k, v, causal=False, mask=mask,
+                                   dropout_rate=cfg.dropout if train else 0.0,
+                                   dropout_rng=drop_rng,
+                                   backend=cfg.attn_backend)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d)
         attn = attn @ p["attn_out_w"].astype(x.dtype) + \
             p["attn_out_b"].astype(x.dtype)

@@ -1,0 +1,159 @@
+"""Sparse-attention model surgery (reference
+ops/sparse_attention/sparse_attention_utils.py:14 ``SparseAttentionUtils``).
+
+The reference patches torch BERT/RoBERTa modules in place: extend position
+embeddings, swap BertSelfAttention for SparseSelfAttention, pad inputs to
+the block size. The TPU-native translation operates on the param pytree
+(embedding extension is an array op, not a Parameter mutation) and on the
+model's ``attn_override`` hook (the attention *function* is the module
+here). Like the reference, surgery supports bidirectional encoders (BERT
+family) — causal models use block-sparse layouts through their own config
+(``ops/pallas/block_sparse_attention.py``), where within-block causal
+masking is handled by the kernel.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from .sparse_attention_ops import (SparsityConfig, FixedSparsityConfig,
+                                   layout_to_mask, sparse_attention)
+from ..utils.logging import log_dist
+
+
+class SparseAttentionUtils:
+    """Utility surface matching the reference class name & methods."""
+
+    @staticmethod
+    def extend_position_embedding(model, params, max_position):
+        """Tile the position table to a longer horizon (reference
+        :14 ``extend_position_embedding`` repeats the weight rows).
+        Returns (model, params) with ``wpe`` extended and the model config
+        updated; the model object is rebuilt, not mutated."""
+        wpe = params["wpe"]
+        original = wpe.shape[0]
+        if max_position <= original:
+            raise ValueError(f"max_position={max_position} must exceed the "
+                             f"current table ({original})")
+        multiples = -(-max_position // original)
+        extended = jnp.tile(wpe, (multiples, 1))[:max_position]
+        params = dict(params, wpe=extended)
+        new_model = type(model)(dataclasses.replace(
+            model.config, n_positions=max_position))
+        new_model.attn_override = getattr(model, "attn_override", None)
+        log_dist(f"extended position embeddings {original} -> {max_position}",
+                 ranks=[0])
+        return new_model, params
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position):
+        """Reference :64 — works on any HF tokenizer."""
+        tokenizer.model_max_length = max_position
+        tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+            model, max_position=None, sparsity_config=None, params=None):
+        """Swap the model's attention for block-sparse attention
+        (reference :81). Supports bidirectional encoders exposing the
+        ``attn_override`` hook (BertModel family). Pass ``params`` (with
+        ``max_position``) to also extend the position table in one call.
+
+        Returns the patched model, or (model, params) when params given."""
+        if sparsity_config is None:
+            sparsity_config = FixedSparsityConfig(
+                num_heads=model.config.n_head)
+        if getattr(model, "causal_attention", False) or \
+                not hasattr(model, "attn_override"):
+            raise ValueError(
+                f"{type(model).__name__} does not support sparse-attention "
+                f"surgery; supported: bidirectional encoders with the "
+                f"attn_override hook (BertModel family) — the reference "
+                f"supports bert/roberta only "
+                f"(sparse_attention_utils.py:110)")
+        if params is not None and max_position is not None and \
+                max_position > params["wpe"].shape[0]:
+            model, params = SparseAttentionUtils.extend_position_embedding(
+                model, params, max_position)
+
+        layouts = {}
+
+        def sparse_attn(q, k, v, mask):
+            t = q.shape[-2]
+            if t % sparsity_config.block:
+                raise ValueError(
+                    f"seq {t} not a multiple of block "
+                    f"{sparsity_config.block}; use pad_to_block_size")
+            if t not in layouts:
+                layouts[t] = sparsity_config.make_layout(t)
+            if mask is None:
+                return sparse_attention(q, k, v, layouts[t],
+                                        sparsity_config.block)
+            # padding mask: merge the block layout with the [B,1,1,T] key
+            # mask on the dense path (the reference merges key_padding_mask
+            # inside SparseSelfAttention the same way)
+            from .flash_attention import reference_attention
+            lm = jnp.asarray(layout_to_mask(layouts[t],
+                                            sparsity_config.block))[None]
+            return reference_attention(q, k, v, causal=False,
+                                       mask=jnp.logical_and(lm, mask))
+
+        if getattr(model, "_ever_traced", False):
+            # jitted executables compiled before surgery keep their dense
+            # attention — the hook is read at trace time
+            log_dist("WARNING: sparse-attention surgery installed after the "
+                     "model already ran/traced; any jitted step compiled "
+                     "earlier (e.g. a deepspeed_tpu engine built before "
+                     "this call) keeps DENSE attention. Install surgery "
+                     "before building the engine.", ranks=[0])
+        model.attn_override = sparse_attn
+        log_dist(f"sparse attention installed: "
+                 f"{type(sparsity_config).__name__} block="
+                 f"{sparsity_config.block}", ranks=[0])
+        return model if params is None else (model, params)
+
+    @staticmethod
+    def pad_to_block_size(block_size, input_ids, attention_mask=None,
+                          token_type_ids=None, position_ids=None,
+                          inputs_embeds=None, pad_token_id=0,
+                          model_embeddings=None):
+        """Pad sequence inputs to a multiple of the sparsity block
+        (reference :143). Returns (pad_len, input_ids, attention_mask,
+        token_type_ids, position_ids, inputs_embeds) — padded positions
+        carry attention_mask 0 so they can't leak into real tokens."""
+        t = (input_ids if input_ids is not None else inputs_embeds).shape[1]
+        pad_len = (-t) % block_size
+        if pad_len == 0:
+            return (0, input_ids, attention_mask, token_type_ids,
+                    position_ids, inputs_embeds)
+
+        def pad(x, value=0):
+            if x is None:
+                return None
+            widths = [(0, 0), (0, pad_len)] + \
+                [(0, 0)] * (np.ndim(x) - 2)
+            return jnp.pad(jnp.asarray(x), widths, constant_values=value)
+
+        if attention_mask is None and input_ids is not None:
+            attention_mask = jnp.ones(input_ids.shape[:2], jnp.int32)
+        input_ids = pad(input_ids, pad_token_id)
+        attention_mask = pad(attention_mask, 0)
+        token_type_ids = pad(token_type_ids, 0)
+        position_ids = pad(position_ids, 0)
+        if inputs_embeds is not None and model_embeddings is not None:
+            pad_embed = jnp.asarray(model_embeddings)[pad_token_id]
+            tail = jnp.broadcast_to(
+                pad_embed, (inputs_embeds.shape[0], pad_len,
+                            inputs_embeds.shape[2]))
+            inputs_embeds = jnp.concatenate([inputs_embeds, tail], axis=1)
+        return (pad_len, input_ids, attention_mask, token_type_ids,
+                position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len, sequence_output):
+        """Reference :193 — strip the pad tail after the forward."""
+        if pad_len:
+            return sequence_output[:, :-pad_len]
+        return sequence_output

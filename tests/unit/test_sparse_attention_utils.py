@@ -1,0 +1,115 @@
+"""Sparse-attention model surgery (round-4 verdict missing #6; reference
+ops/sparse_attention/sparse_attention_utils.py:14)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.bert import BertConfig, BertModel
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.ops.sparse_attention_ops import (FixedSparsityConfig,
+                                                    SparsityConfig)
+from deepspeed_tpu.ops.sparse_attention_utils import SparseAttentionUtils
+
+TINY = BertConfig(vocab_size=128, n_positions=64, n_embd=64, n_layer=2,
+                  n_head=4, pad_vocab_to_multiple=1, dtype="float32",
+                  dropout=0.0)
+
+
+def _model_and_params(cfg=TINY):
+    model = BertModel(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _ids(b=2, t=32, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).integers(
+        0, 128, (b, t), dtype=np.int32))
+
+
+def test_full_layout_surgery_matches_dense():
+    """An all-true layout must reproduce dense attention exactly — the
+    surgery changes the attention ROUTE, not its math."""
+    model, params = _model_and_params()
+    ids = _ids()
+    dense = np.asarray(model.encode(params, ids, train=False))
+    SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+        model, sparsity_config=SparsityConfig(num_heads=4, block=16))
+    sparse = np.asarray(model.encode(params, ids, train=False))
+    np.testing.assert_allclose(sparse, dense, atol=2e-5)
+
+
+def test_sparse_layout_surgery_runs_and_differs():
+    from deepspeed_tpu.ops.sparse_attention_ops import BigBirdSparsityConfig
+    model, params = _model_and_params()
+    ids = _ids(t=64)
+    dense = np.asarray(model.encode(params, ids, train=False))
+    cfg = BigBirdSparsityConfig(num_heads=4, block=16, num_random_blocks=0,
+                                num_sliding_window_blocks=1,
+                                num_global_blocks=1)
+    assert not cfg.make_layout(64).all(), "layout must actually be sparse"
+    SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+        model, sparsity_config=cfg)
+    sparse = np.asarray(model.encode(params, ids, train=False))
+    assert np.isfinite(sparse).all()
+    assert np.abs(sparse - dense).max() > 1e-4, \
+        "window-only layout should change long-range attention"
+
+
+def test_surgery_respects_padding_mask():
+    """Padded keys must stay invisible after surgery: logits for real
+    tokens are identical whether or not pad tokens are appended."""
+    model, params = _model_and_params()
+    ids = _ids(t=32)
+    SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+        model, sparsity_config=SparsityConfig(num_heads=4, block=16))
+    out_short = np.asarray(model.encode(
+        params, ids, attention_mask=jnp.ones((2, 32), jnp.int32),
+        train=False))
+    (pad_len, padded_ids, padded_mask, _, _, _) = \
+        SparseAttentionUtils.pad_to_block_size(
+            48, ids, attention_mask=jnp.ones((2, 32), jnp.int32))
+    assert pad_len == 16
+    out_padded = np.asarray(model.encode(params, padded_ids,
+                                         attention_mask=padded_mask,
+                                         train=False))
+    unpadded = SparseAttentionUtils.unpad_sequence_output(pad_len, out_padded)
+    np.testing.assert_allclose(unpadded, out_short, atol=2e-5)
+
+
+def test_pad_to_block_size_noop_when_aligned():
+    ids = _ids(t=32)
+    pad_len, out_ids, *_ = SparseAttentionUtils.pad_to_block_size(16, ids)
+    assert pad_len == 0 and out_ids is ids
+
+
+def test_extend_position_embedding():
+    model, params = _model_and_params()
+    model2, params2 = SparseAttentionUtils.extend_position_embedding(
+        model, params, 128)
+    assert model2.config.n_positions == 128
+    assert params2["wpe"].shape == (128, TINY.n_embd)
+    np.testing.assert_allclose(np.asarray(params2["wpe"][64:128]),
+                               np.asarray(params["wpe"][:64]))
+    out = model2.encode(params2, _ids(t=96), train=False)
+    assert np.isfinite(np.asarray(out)).all()
+    with pytest.raises(ValueError, match="must exceed"):
+        SparseAttentionUtils.extend_position_embedding(model, params, 64)
+
+
+def test_causal_model_surgery_rejected():
+    gpt2 = GPT2Model(GPT2Config(vocab_size=128, n_positions=64, n_embd=64,
+                                n_layer=2, n_head=4))
+    with pytest.raises(ValueError, match="surgery"):
+        SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+            gpt2)
+
+
+def test_unaligned_seq_raises_with_guidance():
+    model, params = _model_and_params()
+    SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+        model, sparsity_config=SparsityConfig(num_heads=4, block=16))
+    with pytest.raises(ValueError, match="pad_to_block_size"):
+        model.encode(params, _ids(t=24), train=False)
