@@ -254,7 +254,14 @@ def sparse_attention(q, k, v, layout, block, softmax_scale=None,
 
 
 class SparseSelfAttention:
-    """Module-style wrapper (reference sparse_self_attention.py surface)."""
+    """Module-style wrapper (reference sparse_self_attention.py surface).
+
+    ``key_padding_mask`` ([B,1,1,T] or [B,T] bool/int, 1 = keep) merges
+    with the block layout on the dense-masked path — the Pallas
+    block-skipping kernel takes no per-batch mask, so padded batches pay
+    the dense fallback (the reference merges key_padding_mask into its
+    attention scores the same way; kernel-level padding masks are a
+    future optimization)."""
 
     def __init__(self, sparsity_config, softmax_scale=None):
         self.config = sparsity_config
@@ -262,13 +269,28 @@ class SparseSelfAttention:
         self._layouts = {}
 
     def layout(self, seq_len):
+        if seq_len % self.config.block:
+            raise ValueError(
+                f"seq {seq_len} not a multiple of block "
+                f"{self.config.block}; use "
+                f"SparseAttentionUtils.pad_to_block_size")
         if seq_len not in self._layouts:
             self._layouts[seq_len] = self.config.make_layout(seq_len)
         return self._layouts[seq_len]
 
-    def __call__(self, q, k, v):
-        return sparse_attention(q, k, v, self.layout(q.shape[-2]),
-                                self.config.block, self.softmax_scale)
+    def __call__(self, q, k, v, key_padding_mask=None):
+        lay = self.layout(q.shape[-2])
+        if key_padding_mask is None:
+            return sparse_attention(q, k, v, lay, self.config.block,
+                                    self.softmax_scale)
+        from .flash_attention import reference_attention
+        if key_padding_mask.ndim == 2:
+            key_padding_mask = key_padding_mask[:, None, None, :]
+        lm = jnp.asarray(layout_to_mask(lay, self.config.block))[None]
+        return reference_attention(
+            q, k, v, causal=False,
+            mask=jnp.logical_and(lm, key_padding_mask.astype(bool)),
+            softmax_scale=self.softmax_scale)
 
 
 def get_ops(backend: str = "tpu"):

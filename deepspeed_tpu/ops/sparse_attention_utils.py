@@ -17,8 +17,8 @@ import dataclasses
 import jax.numpy as jnp
 import numpy as np
 
-from .sparse_attention_ops import (SparsityConfig, FixedSparsityConfig,
-                                   layout_to_mask, sparse_attention)
+from .sparse_attention_ops import (FixedSparsityConfig, SparseSelfAttention,
+                                   SparsityConfig)
 from ..utils.logging import log_dist
 
 
@@ -42,6 +42,8 @@ class SparseAttentionUtils:
         new_model = type(model)(dataclasses.replace(
             model.config, n_positions=max_position))
         new_model.attn_override = getattr(model, "attn_override", None)
+        if getattr(model, "_ever_traced", False):
+            new_model._ever_traced = True   # keep the stale-jit warning live
         log_dist(f"extended position embeddings {original} -> {max_position}",
                  ranks=[0])
         return new_model, params
@@ -78,27 +80,11 @@ class SparseAttentionUtils:
             model, params = SparseAttentionUtils.extend_position_embedding(
                 model, params, max_position)
 
-        layouts = {}
+        sa = SparseSelfAttention(sparsity_config)   # one layout cache +
+        #                                             padding-mask merge
 
         def sparse_attn(q, k, v, mask):
-            t = q.shape[-2]
-            if t % sparsity_config.block:
-                raise ValueError(
-                    f"seq {t} not a multiple of block "
-                    f"{sparsity_config.block}; use pad_to_block_size")
-            if t not in layouts:
-                layouts[t] = sparsity_config.make_layout(t)
-            if mask is None:
-                return sparse_attention(q, k, v, layouts[t],
-                                        sparsity_config.block)
-            # padding mask: merge the block layout with the [B,1,1,T] key
-            # mask on the dense path (the reference merges key_padding_mask
-            # inside SparseSelfAttention the same way)
-            from .flash_attention import reference_attention
-            lm = jnp.asarray(layout_to_mask(layouts[t],
-                                            sparsity_config.block))[None]
-            return reference_attention(q, k, v, causal=False,
-                                       mask=jnp.logical_and(lm, mask))
+            return sa(q, k, v, key_padding_mask=mask)
 
         if getattr(model, "_ever_traced", False):
             # jitted executables compiled before surgery keep their dense
@@ -136,8 +122,11 @@ class SparseAttentionUtils:
                 [(0, 0)] * (np.ndim(x) - 2)
             return jnp.pad(jnp.asarray(x), widths, constant_values=value)
 
-        if attention_mask is None and input_ids is not None:
-            attention_mask = jnp.ones(input_ids.shape[:2], jnp.int32)
+        if attention_mask is None:
+            # always materialize the mask once padding happens — for
+            # inputs_embeds-only calls too, or the pad rows would attend
+            src = input_ids if input_ids is not None else inputs_embeds
+            attention_mask = jnp.ones(src.shape[:2], jnp.int32)
         input_ids = pad(input_ids, pad_token_id)
         attention_mask = pad(attention_mask, 0)
         token_type_ids = pad(token_type_ids, 0)

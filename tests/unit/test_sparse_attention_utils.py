@@ -113,3 +113,14 @@ def test_unaligned_seq_raises_with_guidance():
         model, sparsity_config=SparsityConfig(num_heads=4, block=16))
     with pytest.raises(ValueError, match="pad_to_block_size"):
         model.encode(params, _ids(t=24), train=False)
+
+
+def test_pad_inputs_embeds_only_gets_mask():
+    """inputs_embeds-only calls must still get a zero mask on pad rows."""
+    e = jnp.ones((2, 24, 8), jnp.float32)
+    (pad_len, _, mask, _, _, padded) = SparseAttentionUtils.pad_to_block_size(
+        16, None, inputs_embeds=e, model_embeddings=np.zeros((4, 8)))
+    assert pad_len == 8
+    assert mask is not None and mask.shape == (2, 32)
+    assert np.asarray(mask)[:, 24:].sum() == 0
+    assert padded.shape == (2, 32, 8)
