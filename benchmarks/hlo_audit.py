@@ -81,8 +81,17 @@ def audit(name, mesh_kw, config_over, n_devices=8, with_flops=False):
 
     topology.reset_mesh()
     mm = initialize_mesh(devices=jax.devices("cpu")[:n_devices], **mesh_kw)
-    cfg = GPT2Config(vocab_size=512, n_positions=256, n_embd=256, n_layer=4,
-                     n_head=8, pad_vocab_to_multiple=128)
+    if mesh_kw.get("ep", 1) > 1:
+        from deepspeed_tpu.models.gpt2_moe import (GPT2MoEConfig,
+                                                   GPT2MoEModel)
+        cfg = GPT2MoEConfig(vocab_size=512, n_positions=256, n_embd=256,
+                            n_layer=4, n_head=8, pad_vocab_to_multiple=128,
+                            num_experts=2 * mesh_kw["ep"], top_k=1)
+        model_cls = GPT2MoEModel
+    else:
+        cfg = GPT2Config(vocab_size=512, n_positions=256, n_embd=256,
+                         n_layer=4, n_head=8, pad_vocab_to_multiple=128)
+        model_cls = GPT2Model
     config = {
         "train_micro_batch_size_per_gpu": 2,
         "gradient_accumulation_steps": 2,
@@ -91,7 +100,7 @@ def audit(name, mesh_kw, config_over, n_devices=8, with_flops=False):
         "steps_per_print": 0,
     }
     config.update(config_over)
-    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(cfg),
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model_cls(cfg),
                                                config=config,
                                                mesh_manager=mm)
     rng = np.random.default_rng(0)
@@ -154,6 +163,10 @@ CASES = {
                        "zero_optimization": {
                            "stage": 3,
                            "stage3_param_persistence_threshold": 0}}),
+    # EP (MoE): expert-dispatch all-to-all in every MoE layer
+    "ep2_dp4_zero2_moe": ({"ep": 2, "dp": 4},
+                          {"expert_parallel_size": 2,
+                           "zero_optimization": {"stage": 2}}),
 }
 
 BASELINE_PATH = os.path.join(REPO, "benchmarks", "hlo_audit_baseline.json")
@@ -194,6 +207,9 @@ def check_intent(report):
     assert reduces(tp), "tp: block partial sums must reduce"
     sp = report["sp2_dp4_zero3"]
     assert "all-to-all" in sp, "sp(Ulysses): head<->seq all-to-all missing"
+    moe = report["ep2_dp4_zero2_moe"]
+    assert "all-to-all" in moe, "moe(ep): expert-dispatch all-to-all missing"
+    assert reduces(moe), "moe: grads must reduce"
 
 
 def check_against_baseline(name, stats, baseline):
