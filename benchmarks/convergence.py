@@ -163,6 +163,9 @@ def run_features(args):
         "curves": curves,
     }
     out = args.out
+    parent = os.path.dirname(out)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     with open(out, "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps({k: v for k, v in report.items() if k != "curves"},

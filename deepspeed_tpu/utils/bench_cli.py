@@ -83,13 +83,60 @@ def run_matmul(trials):
 
 
 def main(argv=None):
+    import os
     p = argparse.ArgumentParser(description="deepspeed_tpu micro-bench")
     p.add_argument("--sizes-mb", default="1,16,64",
                    help="comma list of allreduce payloads")
     p.add_argument("--trials", type=int, default=10)
     p.add_argument("--skip-collectives", action="store_true")
     p.add_argument("--skip-matmul", action="store_true")
+    p.add_argument("--cpu", action="store_true",
+                   help="run on an 8-device virtual CPU mesh")
     args = p.parse_args(argv)
+    cpu = (args.cpu or
+           os.environ.get("JAX_PLATFORMS", "").lower().startswith("cpu") or
+           os.environ.get("DSTPU_ACCELERATOR", "").lower() == "cpu")
+    if cpu:
+        from .hermetic import force_cpu
+        force_cpu(device_count=8)   # idempotent if bin/ds_tpu_bench already
+        #                             ran it before the package import
+    else:
+        # fail-fast contract (bench.py _probe_backend_or_exit): bounded TCP
+        # probe, then an actual backend init in a timeout-bounded
+        # subprocess — a listening port does not guarantee a live backend
+        import socket
+        import subprocess
+        import sys
+        port = int(os.environ.get("AXON_PROBE_PORT", "8103"))
+        deadline = time.time() + float(os.environ.get("BENCH_PROBE_BUDGET",
+                                                      30))
+        up = False
+        while not up and time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port),
+                                         timeout=3).close()
+                up = True
+            except OSError:
+                time.sleep(5)
+        reason = None
+        if not up:
+            reason = (f"axon tunnel down (port {port} refused); "
+                      f"use --cpu for the virtual mesh")
+        else:
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c",
+                     "import jax; print(jax.devices()[0].platform)"],
+                    env=dict(os.environ), capture_output=True, text=True,
+                    timeout=float(os.environ.get("BENCH_PROBE_INIT_TIMEOUT",
+                                                 180)))
+                if proc.returncode != 0:
+                    reason = "jax backend init failed: " + proc.stderr[-300:]
+            except subprocess.TimeoutExpired:
+                reason = "jax backend init timed out (tunnel half-dead)"
+        if reason:
+            print(json.dumps({"error": reason}))
+            return 2
     out = {"collectives": [], "compute": None}
     if not args.skip_collectives:
         sizes = [float(s) for s in args.sizes_mb.split(",") if s]
