@@ -135,42 +135,25 @@ def _tunnel_ok(timeout=3.0):
 
 def _probe_backend_or_exit():
     """Fail fast with one parseable JSON record instead of hanging to the
-    driver's rc=124 (round-3 failure mode). Two gates:
-    1. bounded TCP retries on the tunnel port;
-    2. a short-timeout subprocess that actually initialises the jax
-       backend (a listening port does not guarantee a live backend).
+    driver's rc=124 (round-3 failure mode). The probe contract (bounded
+    TCP retries, then a short-timeout subprocess backend init that
+    refuses a silent CPU fallback) lives in
+    deepspeed_tpu/utils/tunnel_probe.py, shared with ds_tpu_bench.
     Skipped when the bench is explicitly pointed at CPU.
     """
-    import subprocess
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu" or \
             os.environ.get("DSTPU_ACCELERATOR", "").lower() == "cpu":
         return
-    deadline = time.time() + float(os.environ.get("BENCH_PROBE_BUDGET", 120))
-    up = _tunnel_ok()
-    while not up and time.time() < deadline:
-        time.sleep(10)
-        up = _tunnel_ok()
-    if up:
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
-                env=dict(os.environ), capture_output=True, text=True,
-                timeout=float(os.environ.get("BENCH_PROBE_INIT_TIMEOUT", 180)))
-            platform = proc.stdout.strip().splitlines()[-1] \
-                if proc.stdout.strip() else ""
-            if proc.returncode == 0 and platform not in ("cpu", ""):
-                return
-            if proc.returncode == 0:
-                reason = (f"jax fell back to '{platform or 'unknown'}' "
-                          f"backend — refusing to publish CPU time as "
-                          f"TPU MFU")
-            else:
-                reason = "jax backend init failed: " + proc.stderr[-500:]
-        except subprocess.TimeoutExpired:
-            reason = "jax backend init timed out (tunnel half-dead)"
-    else:
-        reason = "axon tunnel down (port 8103 refused for probe budget)"
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_dstpu_tunnel_probe",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "deepspeed_tpu", "utils", "tunnel_probe.py"))
+    probe = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(probe)
+    reason = probe.probe_backend()
+    if reason is None:
+        return
     print(json.dumps({
         "metric": "gpt2_125m_bf16_train_mfu", "value": None,
         "unit": "fraction_of_peak", "vs_baseline": None,

@@ -37,7 +37,7 @@ if os.environ.get("DSTPU_ACCELERATOR", "cpu") == "cpu":
     hermetic.force_cpu(device_count=8)
 
 
-def build_compiled_engine(pp, n_layer, d, seq, micro, gas):
+def build_compiled_engine(pp, n_layer, d, seq, micro, gas, bf16=True):
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
     from deepspeed_tpu.parallel import topology
@@ -51,7 +51,7 @@ def build_compiled_engine(pp, n_layer, d, seq, micro, gas):
         "pipeline_parallel_size": pp,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
         "zero_optimization": {"stage": 0},
-        "bf16": {"enabled": True},
+        "bf16": {"enabled": bf16},
         "steps_per_print": 0,
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(cfg),
@@ -59,7 +59,7 @@ def build_compiled_engine(pp, n_layer, d, seq, micro, gas):
     return engine
 
 
-def build_interpreted_engine(pp, n_layer, d, seq, micro, gas):
+def build_interpreted_engine(pp, n_layer, d, seq, micro, gas, bf16=True):
     import jax.numpy as jnp
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
@@ -70,6 +70,10 @@ def build_interpreted_engine(pp, n_layer, d, seq, micro, gas):
                      n_layer=n_layer, n_head=8, pad_vocab_to_multiple=128,
                      dropout=0.0)
     inner = GPT2Model(cfg)
+    # the interpreted engine feeds fp32 masters straight into layer.apply
+    # (no compute-dtype cast like the compiled path), so the compute dtype
+    # is set here — bf16 for the throughput comparison, fp32 for parity
+    compute_dt = jnp.bfloat16 if bf16 else jnp.float32
 
     # the same GPT-2 math expressed as a heterogeneous layer list (what
     # the interpreted mode exists for)
@@ -79,10 +83,9 @@ def build_interpreted_engine(pp, n_layer, d, seq, micro, gas):
             return {"wte": p["wte"], "wpe": p["wpe"]}
 
         def apply(self, p, ids, rng=None, train=True):
-            dt = jnp.bfloat16
             t = ids.shape[-1]
-            return (p["wte"].astype(dt)[ids] +
-                    p["wpe"][:t].astype(dt)[None])
+            return (p["wte"].astype(compute_dt)[ids] +
+                    p["wpe"][:t].astype(compute_dt)[None])
 
     class Block:
         def __init__(self, i):
@@ -127,7 +130,7 @@ def build_interpreted_engine(pp, n_layer, d, seq, micro, gas):
         "pipeline_parallel_size": pp,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
         "zero_optimization": {"stage": 0},
-        "bf16": {"enabled": True},
+        "bf16": {"enabled": bf16},
         "steps_per_print": 0,
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=module, config=config)
@@ -147,6 +150,56 @@ def measure(engine, gas, rows, seq, steps=4, key="input_ids"):
         loss = float(engine.train_batch(batch=batch()))
     dt = (time.perf_counter() - t0) / steps
     return dt, loss
+
+
+def copy_params_compiled_to_interpreted(c_params, i_params, n_layer):
+    """Map the compiled engine's stacked tree onto the interpreted
+    PipelineModule's per-layer list (same math, different layout), so both
+    engines run IDENTICAL weights for the parity check."""
+    import jax.numpy as jnp
+    blocks = c_params["blocks"]
+    out_layers = []
+    for li, layer in enumerate(i_params["layers"]):
+        if li == 0:
+            out_layers.append({"wte": c_params["wte"],
+                               "wpe": c_params["wpe"]})
+        elif li == n_layer + 1:
+            out_layers.append({"wte": c_params["wte"],
+                               "ln_f_scale": c_params["ln_f_scale"],
+                               "ln_f_bias": c_params["ln_f_bias"]})
+        else:
+            i = li - 1
+            out_layers.append({k: jnp.asarray(v)[i]
+                               for k, v in blocks.items()})
+    return dict(i_params, layers=out_layers)
+
+
+def parity_check(pp=4, n_layer=4, d=128, seq=128, micro=1, gas=4):
+    """One-step LOSS parity between the compiled 1F1B program and the
+    host-interpreted instruction stream, with the SAME weights — the
+    real-shape upgrade of the tiny interpreted-vs-sequential parity test
+    (round-4 verdict weak #7). fp32 so the two execution orders agree to
+    numerical noise."""
+    import numpy as np
+
+    import jax
+    c_eng = build_compiled_engine(pp, n_layer, d, seq, micro, gas,
+                                  bf16=False)
+    # depth-proof host COPY (np.array, not asarray — on the CPU backend
+    # asarray can be a zero-copy view that donation then invalidates)
+    c_params = jax.tree.map(lambda x: np.array(x), c_eng.params)
+    rows = c_eng.train_micro_batch_size_per_gpu * c_eng.dp_world_size
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 500, (gas, rows, seq),
+                                       dtype=np.int32)}
+    c_loss = float(c_eng.train_batch(batch=batch))
+
+    i_eng = build_interpreted_engine(pp, n_layer, d, seq, micro, gas,
+                                      bf16=False)
+    i_eng.params = copy_params_compiled_to_interpreted(
+        c_params, i_eng.params, n_layer)
+    i_loss = float(i_eng.train_batch(batch={"inputs": batch["input_ids"]}))
+    return c_loss, i_loss
 
 
 def main():

@@ -101,41 +101,15 @@ def main(argv=None):
         force_cpu(device_count=8)   # idempotent if bin/ds_tpu_bench already
         #                             ran it before the package import
     else:
-        # fail-fast contract (bench.py _probe_backend_or_exit): bounded TCP
-        # probe, then an actual backend init in a timeout-bounded
-        # subprocess — a listening port does not guarantee a live backend
-        import socket
-        import subprocess
-        import sys
-        port = int(os.environ.get("AXON_PROBE_PORT", "8103"))
-        deadline = time.time() + float(os.environ.get("BENCH_PROBE_BUDGET",
-                                                      30))
-        up = False
-        while not up and time.time() < deadline:
-            try:
-                socket.create_connection(("127.0.0.1", port),
-                                         timeout=3).close()
-                up = True
-            except OSError:
-                time.sleep(5)
-        reason = None
-        if not up:
-            reason = (f"axon tunnel down (port {port} refused); "
-                      f"use --cpu for the virtual mesh")
-        else:
-            try:
-                proc = subprocess.run(
-                    [sys.executable, "-c",
-                     "import jax; print(jax.devices()[0].platform)"],
-                    env=dict(os.environ), capture_output=True, text=True,
-                    timeout=float(os.environ.get("BENCH_PROBE_INIT_TIMEOUT",
-                                                 180)))
-                if proc.returncode != 0:
-                    reason = "jax backend init failed: " + proc.stderr[-300:]
-            except subprocess.TimeoutExpired:
-                reason = "jax backend init timed out (tunnel half-dead)"
+        # shared fail-fast contract (utils/tunnel_probe.py, same as
+        # bench.py): bounded TCP retry, then a bounded backend init that
+        # refuses a silent CPU fallback. Default budget shortened for an
+        # interactive CLI.
+        from .tunnel_probe import probe_backend
+        reason = probe_backend(budget=30)
         if reason:
-            print(json.dumps({"error": reason}))
+            print(json.dumps({"error": reason +
+                              "; use --cpu for the virtual mesh"}))
             return 2
     out = {"collectives": [], "compute": None}
     if not args.skip_collectives:

@@ -1,0 +1,60 @@
+"""Shared axon-tunnel fail-fast probe.
+
+One implementation of the contract bench.py pioneered (bounded TCP retry,
+then a timeout-bounded subprocess that actually initialises the jax
+backend — a listening port does not guarantee a live backend, and a
+backend that silently fell back to CPU must not publish CPU time as TPU
+numbers). Used by bench.py and ds_tpu_bench; standalone-importable (no
+package deps, no jax import in this module).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+
+def tunnel_ok(timeout=3.0):
+    port = int(os.environ.get("AXON_PROBE_PORT", "8103"))
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def probe_backend(budget=None, init_timeout=None, retry_sleep=10):
+    """Returns None when a live non-CPU backend answers, else a
+    human-readable reason string. Env overrides: BENCH_PROBE_BUDGET
+    (seconds of TCP retries, default 120), BENCH_PROBE_INIT_TIMEOUT
+    (backend-init subprocess bound, default 180)."""
+    port = int(os.environ.get("AXON_PROBE_PORT", "8103"))
+    budget = float(os.environ.get("BENCH_PROBE_BUDGET",
+                                  120 if budget is None else budget))
+    init_timeout = float(os.environ.get(
+        "BENCH_PROBE_INIT_TIMEOUT", 180 if init_timeout is None else
+        init_timeout))
+    deadline = time.time() + budget
+    up = tunnel_ok()
+    while not up and time.time() < deadline:
+        time.sleep(retry_sleep)
+        up = tunnel_ok()
+    if not up:
+        return f"axon tunnel down (port {port} refused for probe budget)"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            env=dict(os.environ), capture_output=True, text=True,
+            timeout=init_timeout)
+    except subprocess.TimeoutExpired:
+        return "jax backend init timed out (tunnel half-dead)"
+    platform = proc.stdout.strip().splitlines()[-1] \
+        if proc.stdout.strip() else ""
+    if proc.returncode != 0:
+        return "jax backend init failed: " + proc.stderr[-500:]
+    if platform in ("cpu", ""):
+        return (f"jax fell back to '{platform or 'unknown'}' backend — "
+                f"refusing to publish CPU time as TPU numbers")
+    return None
