@@ -3,5 +3,12 @@ from .comm import (ReduceOp, init_distributed, is_initialized, get_rank,
                    destroy_process_group, all_reduce, all_gather,
                    reduce_scatter, all_to_all, broadcast, ppermute,
                    send_recv_next, send_recv_prev, axis_index, axis_size,
-                   log_summary)
+                   log_summary,
+                   # reference-name compatibility surface
+                   all_gather_into_tensor, allgather_fn,
+                   reduce_scatter_tensor, reduce_scatter_fn,
+                   all_to_all_single, reduce, gather, scatter, new_group,
+                   get_global_rank, monitored_barrier, isend, irecv, send,
+                   recv, has_all_gather_into_tensor,
+                   has_reduce_scatter_tensor)
 from .logging import CommsLogger, get_comms_logger, configure_comms_logger

@@ -259,8 +259,9 @@ def broadcast(x, src: int = 0, axis_name="data"):
     which is why this is also how GSPMD itself materializes broadcasts."""
     _log("broadcast", x, axis_name)
     idx = lax.axis_index(axis_name)
-    mask = (idx == src).astype(x.dtype)
-    return lax.psum(x * mask, axis_name)
+    # where, not multiply: non-src members may hold NaN/inf placeholders
+    # (torch broadcast ignores their buffers entirely)
+    return lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)), axis_name)
 
 
 def ppermute(x, perm: Sequence, axis_name="pipe"):
@@ -278,6 +279,135 @@ def send_recv_next(x, axis_name="pipe"):
 def send_recv_prev(x, axis_name="pipe"):
     n = axis_size(axis_name)
     return ppermute(x, [(i, (i - 1) % n) for i in range(n)], axis_name)
+
+
+# --------------------------------------------------------------------------
+# Reference-name compatibility surface (deepspeed.comm parity). torch's
+# in/out-tensor contracts are functional under XLA (return the result);
+# rank-rooted ops are SUPERSETS — every member gets the root's result,
+# which costs the same as the rooted op on a ring and is how GSPMD itself
+# lowers them.
+# --------------------------------------------------------------------------
+
+def all_gather_into_tensor(x, axis_name="data", axis: int = 0):
+    """reference comm.py all_gather_into_tensor (functional: returns the
+    gathered tensor instead of writing into an output buffer)."""
+    return all_gather(x, axis_name, axis=axis)
+
+
+# reference allgather_fn dispatches to all_gather_into_tensor when the
+# backend has it; XLA always does
+allgather_fn = all_gather_into_tensor
+
+
+def reduce_scatter_tensor(x, axis_name="data", axis: int = 0,
+                          op: str = ReduceOp.SUM):
+    return reduce_scatter(x, axis_name, axis=axis, op=op)
+
+
+reduce_scatter_fn = reduce_scatter_tensor
+
+
+def all_to_all_single(x, axis_name="expert", split_axis: int = 0,
+                      concat_axis: int = 0):
+    return all_to_all(x, axis_name, split_axis=split_axis,
+                      concat_axis=concat_axis)
+
+
+def reduce(x, dst: int = 0, axis_name="data", op: str = ReduceOp.SUM):
+    """Rooted reduce; under SPMD every member receives the result (torch
+    leaves non-dst outputs undefined — this is a superset)."""
+    del dst
+    return all_reduce(x, axis_name=axis_name, op=op)
+
+
+def gather(x, dst: int = 0, axis_name="data", axis: int = 0):
+    """Rooted gather; superset semantics (all members get the result)."""
+    del dst
+    return all_gather(x, axis_name, axis=axis)
+
+
+def scatter(x, src: int = 0, axis_name="data", axis: int = 0):
+    """Member i receives src's i-th shard along ``axis``. Non-src members'
+    inputs are fully ignored (broadcast uses where-masking, so NaN/inf
+    placeholders are fine). Logged once, by the inner broadcast."""
+    full = broadcast(x, src=src, axis_name=axis_name)
+    n = lax.axis_size(axis_name)
+    if full.shape[axis] % n:
+        raise ValueError(f"scatter: dim {axis} ({full.shape[axis]}) must "
+                         f"divide by axis size {n}")
+    chunk = full.shape[axis] // n
+    return lax.dynamic_slice_in_dim(full, lax.axis_index(axis_name) * chunk,
+                                    chunk, axis)
+
+
+def new_group(ranks):
+    """Reference new_group returns a torch process group. XLA collectives
+    are mesh-axis-scoped instead: build the mesh with the axes you need
+    (parallel/topology.initialize_mesh) and pass the axis name to the
+    collectives. The returned rank list works as the ``group`` argument of
+    :func:`get_global_rank`."""
+    logger.info("comm.new_group: XLA collectives are mesh-axis-scoped; "
+                "use initialize_mesh axes for device collectives. "
+                "Returning the rank list for host-plane rank mapping.")
+    return list(ranks)
+
+
+def get_global_rank(group=None, group_rank: int = 0) -> int:
+    """Map a group-local rank to a global rank (reference comm.py
+    get_global_rank). ``group``: a rank list from :func:`new_group`, or
+    None for the world group."""
+    if group is None:
+        return group_rank
+    return list(group)[group_rank]
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+    """Barrier with logging (reference monitored_barrier; the hang
+    diagnostics live in the launcher's failure detection here).
+    ``timeout``: datetime.timedelta or seconds, forwarded to the barrier."""
+    del group, wait_all_ranks
+    logger.info(f"monitored_barrier enter (rank {get_rank()})")
+    if timeout is None:
+        barrier()
+    else:
+        seconds = timeout.total_seconds() if hasattr(
+            timeout, "total_seconds") else float(timeout)
+        barrier(timeout_ms=int(seconds * 1000))
+    logger.info(f"monitored_barrier exit (rank {get_rank()})")
+
+
+def _no_host_p2p(name, alternative):
+    raise ValueError(
+        f"comm.{name} is not supported on TPU: XLA owns collective "
+        f"scheduling inside compiled programs, so host-driven "
+        f"point-to-point has no mapping. Use {alternative} inside the "
+        f"compiled step (see runtime/pipe/engine.py for the pipeline "
+        f"exchange pattern).")
+
+
+def isend(tensor, dst, **kw):
+    _no_host_p2p("isend", "comm.ppermute / send_recv_next")
+
+
+def irecv(tensor, src, **kw):
+    _no_host_p2p("irecv", "comm.ppermute / send_recv_prev")
+
+
+def send(tensor, dst, **kw):
+    _no_host_p2p("send", "comm.ppermute / send_recv_next")
+
+
+def recv(tensor, src, **kw):
+    _no_host_p2p("recv", "comm.ppermute / send_recv_prev")
+
+
+def has_all_gather_into_tensor() -> bool:
+    return True
+
+
+def has_reduce_scatter_tensor() -> bool:
+    return True
 
 
 def axis_index(axis_name):
