@@ -1,0 +1,128 @@
+"""deepspeed_tpu.zero — the user-facing ZeRO namespace.
+
+Reference surface: ``deepspeed.zero.Init`` (construct a model with
+params partitioned from birth, zero/partition_parameters.py:601),
+``deepspeed.zero.GatheredParameters`` (temporarily materialize full
+params for host access/mutation, partition_parameters.py:2014), and
+``register_external_parameter``.
+
+TPU-native translation:
+
+- **Init**: partitioned-from-birth is the DEFAULT here — ModelSpec.init
+  is a pure function the engine jit-compiles with sharded out_shardings,
+  so full parameters never materialize on one device at any stage (the
+  thing zero.Init exists to prevent in torch, where nn.Module.__init__
+  eagerly allocates). The context manager is kept for source
+  compatibility: it validates its arguments and is otherwise a
+  documented no-op.
+- **GatheredParameters**: real work — gathers the engine's sharded
+  leaves to host numpy (jax global arrays reassemble across the mesh),
+  yields them for inspection/mutation, and on exit writes mutations back
+  through the engine's param shardings.
+"""
+
+import contextlib
+
+import numpy as np
+
+from .utils.logging import log_dist
+
+_INIT_KEYS = {"module", "data_parallel_group", "mem_efficient_linear",
+              "remote_device", "pin_memory", "config_dict_or_path",
+              "config", "enabled", "dtype", "mpu", "param_dict",
+              "sequence_data_parallel_group"}
+
+
+class Init:
+    """Source-compatible ``with deepspeed_tpu.zero.Init(): ...`` context.
+
+    Params here are jit-initialized INTO their shardings at engine build
+    (runtime/engine.py out_shardings on the init fn), so there is no
+    eager full-size allocation for this context to intercept — entering
+    it is a no-op by design, kept so reference training scripts port
+    unchanged. Unknown kwargs raise (accepted = active)."""
+
+    def __init__(self, **kwargs):
+        unknown = set(kwargs) - _INIT_KEYS
+        if unknown:
+            raise ValueError(f"zero.Init: unknown arguments {sorted(unknown)}")
+        self.enabled = kwargs.get("enabled", True)
+        if self.enabled:
+            log_dist("zero.Init: params are jit-initialized sharded-from-"
+                     "birth on TPU; context is a compatibility no-op",
+                     ranks=[0])
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+@contextlib.contextmanager
+def GatheredParameters(target, modifier_rank=None, fwd_module=None,
+                       enabled=True):
+    """Materialize full params on host; optionally write mutations back.
+
+    ``target``: a DeepSpeedEngine (any ZeRO stage, incl. ZeRO-Offload /
+    Infinity — the gather reads the authoritative fp32 masters and the
+    write-back follows the same protocol as checkpoint load, refreshing
+    device params and invalidating param pages), or a bare params pytree
+    (read-only: like the reference with ``modifier_rank=None``, mutations
+    are NOT synchronized — pass the engine to write back).
+
+    ``modifier_rank``: None = read-only gather (reference default). Any
+    int = write mutations back on exit; SPMD has no per-rank divergence,
+    so every value behaves like rank 0."""
+    import jax
+
+    is_engine = hasattr(target, "params") and hasattr(target, "_config")
+    if not enabled:
+        yield target.params if is_engine else target
+        return
+    if not is_engine:
+        if modifier_rank is not None:
+            raise ValueError(
+                "zero.GatheredParameters: write-back (modifier_rank set) "
+                "needs the ENGINE, not a bare params tree — jax arrays "
+                "are immutable, so there is no in-place mutation to sync")
+        yield jax.tree.map(lambda x: np.array(x), target)
+        return
+
+    offload = getattr(target, "_offload", None)
+    if offload is not None:
+        host = offload.masters_tree(copy=True)
+    else:
+        host = jax.tree.map(lambda x: np.array(x), target.params)
+    yield host
+    if modifier_rank is None:
+        return   # read-only contract, like the reference default
+    if offload is not None:
+        # same write-back protocol as checkpoint load
+        # (runtime/checkpointing.py:172-227): masters are authoritative;
+        # device params re-derive from them
+        for i, w in enumerate(jax.tree.leaves(host)):
+            offload.masters[i][...] = np.asarray(w, np.float32).reshape(-1)
+        runner = getattr(target, "_param_runner", None)
+        if runner is not None:
+            with target.mesh:
+                target.params = runner.resident_params()
+            runner._invalidate_pages()
+        else:
+            target.params = offload.device_params()
+    else:
+        with target.mesh:
+            target.params = jax.device_put(host, target.param_shardings)
+    log_dist("zero.GatheredParameters: host mutations resharded into the "
+             "engine", ranks=[0])
+
+
+def register_external_parameter(module, parameter):
+    """Reference: tells ZeRO-3 about params accessed outside the module
+    tree so the prefetcher gathers them (partition_parameters.py:294).
+    Unnecessary here — every array a jitted step touches is visible to
+    XLA's dataflow, so there is nothing to register. No-op kept for
+    source compatibility."""
+    del module, parameter
+    log_dist("zero.register_external_parameter: no-op on TPU (XLA sees "
+             "every traced array; nothing to prefetch manually)", ranks=[0])
