@@ -1,2 +1,7 @@
 from .logging import logger, log_dist, warning_once
 from .timer import SynchronizedWallClockTimer, ThroughputTimer
+from .memory import see_memory_usage, memory_stats
+from .tensor_fragment import (safe_get_full_fp32_param,
+                              safe_set_full_fp32_param, safe_get_full_grad,
+                              safe_get_full_optimizer_state,
+                              list_param_paths)

@@ -1,0 +1,49 @@
+"""Memory reporting utils (reference runtime/utils.py:775
+``see_memory_usage``: CUDA allocated/reserved + host RSS). TPU version
+reads the XLA runtime allocator's per-device stats plus host memory from
+/proc; usable anywhere (no engine needed)."""
+
+import os
+
+from .logging import logger
+
+
+def _host_mem_gib():
+    try:
+        with open(f"/proc/{os.getpid()}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 2**20
+    except OSError:
+        pass
+    return None
+
+
+def memory_stats(device=None):
+    """{device stats...} from the XLA allocator (empty on backends that
+    report none, e.g. CPU)."""
+    import jax
+    if device is None:
+        device = jax.local_devices()[0]
+    return device.memory_stats() or {}
+
+
+def see_memory_usage(message, force=False):
+    """Log device + host memory (reference signature; ``force`` bypasses
+    nothing here — logging is cheap without CUDA synchronization, so the
+    arg is accepted for compatibility and ignored)."""
+    del force
+    stats = memory_stats()
+    parts = [message]
+    if stats:
+        parts.append(
+            f"device: in_use {stats.get('bytes_in_use', 0) / 2**30:.2f}GiB "
+            f"peak {stats.get('peak_bytes_in_use', 0) / 2**30:.2f}GiB "
+            f"limit {stats.get('bytes_limit', 0) / 2**30:.2f}GiB")
+    else:
+        parts.append("device: no allocator stats on this backend")
+    rss = _host_mem_gib()
+    if rss is not None:
+        parts.append(f"host RSS {rss:.2f}GiB")
+    logger.info(" | ".join(parts))
+    return stats

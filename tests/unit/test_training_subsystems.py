@@ -160,3 +160,10 @@ def test_sparse_gradients_key_raises():
     pattern; on TPU it cannot be honored (dense XLA grads) so it raises."""
     with pytest.raises(ConfigError, match="sparse_gradients"):
         run(base_config(sparse_gradients=True), steps=1)
+
+
+def test_see_memory_usage_reports():
+    from deepspeed_tpu.utils import see_memory_usage, memory_stats
+    stats = see_memory_usage("unit test probe")
+    assert isinstance(stats, dict)          # {} on the CPU backend
+    assert isinstance(memory_stats(), dict)
