@@ -1,0 +1,30 @@
+"""runtime.utils — functional ports of the reference's commonly-imported
+helpers (reference deepspeed/runtime/utils.py: see_memory_usage:775,
+clip_grad_norm_:340, get_global_norm:`global_norm` family).
+
+jax arrays are immutable, so the torch in-place contracts become
+functional: ``clip_grad_norm_`` RETURNS the clipped tree (name kept for
+source familiarity; the trailing underscore is a torch-ism)."""
+
+import jax
+import jax.numpy as jnp
+
+from .engine import _global_norm
+from ..utils.memory import memory_stats, see_memory_usage  # noqa: F401
+
+
+def get_global_norm(tree):
+    """L2 norm over every leaf of a pytree (grads or params)."""
+    return _global_norm(tree)
+
+
+def get_grad_norm(grads):
+    return _global_norm(grads)
+
+
+def clip_grad_norm_(grads, max_norm: float):
+    """Functional clip-by-global-norm: returns (clipped_grads, norm).
+    Same math as the engine's in-jit clipping (engine.py _clip_grads)."""
+    norm = _global_norm(grads)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: g * factor, grads), norm

@@ -167,3 +167,17 @@ def test_see_memory_usage_reports():
     stats = see_memory_usage("unit test probe")
     assert isinstance(stats, dict)          # {} on the CPU backend
     assert isinstance(memory_stats(), dict)
+
+
+def test_runtime_utils_clip_and_norm():
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.utils import (clip_grad_norm_,
+                                             get_global_norm)
+    tree = {"a": jnp.asarray([3.0, 4.0]), "b": jnp.zeros(2)}
+    assert abs(float(get_global_norm(tree)) - 5.0) < 1e-6
+    clipped, norm = clip_grad_norm_(tree, 1.0)
+    assert abs(float(norm) - 5.0) < 1e-6
+    assert abs(float(get_global_norm(clipped)) - 1.0) < 1e-4
+    # under the clip threshold: unchanged
+    same, _ = clip_grad_norm_(tree, 10.0)
+    np.testing.assert_allclose(np.asarray(same["a"]), [3.0, 4.0])
