@@ -40,4 +40,7 @@ STEP_TIMEOUT=2400 run ladder_1p3b_z3 python benchmarks/baseline_ladder.py 1p3b_z
 run offload_serial env OFF_STEPS=3 python benchmarks/offload_1p3b.py
 run offload_pipelined env OFF_STEPS=3 OFF_PIPELINE=1 python benchmarks/offload_1p3b.py
 STEP_TIMEOUT=5400 run infinity_8b env DSTPU_HOST_INIT=fast python benchmarks/infinity_8b.py --steps 2
+# round-5 addition (single-chip: world=1 collectives + matmul roofline;
+# pipeline_modes needs >=4 devices and stays a CPU-mesh/pod benchmark)
+run comm_micro python bin/ds_tpu_bench --sizes-mb 1,16,64
 echo "sweep done $(date +%H:%M:%S)"
