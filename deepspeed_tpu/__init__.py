@@ -150,6 +150,16 @@ def init_inference(model, config=None, **kwargs):
     return InferenceEngine(model, config)
 
 
+def init_serving(model, config=None, serving_config=None, **kwargs):
+    """Initialize online continuous-batching serving (serving/engine.py):
+    an InferenceEngine via ``init_inference(model, config)`` wrapped in a
+    ServingEngine (``serving_config``: dict or ServingConfig — slot pool,
+    admission queue, deadlines, metrics). Returns the ServingEngine."""
+    from .serving import ServingEngine
+    engine = init_inference(model, config=config, **kwargs)
+    return ServingEngine(engine, serving_config)
+
+
 def add_config_arguments(parser):
     """Add --deepspeed / --deepspeed_config argparse flags (reference
     __init__.py:228)."""

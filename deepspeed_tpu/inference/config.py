@@ -74,6 +74,9 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     replace_with_kernel_inject: bool = False
     mp_size: int = 1                       # deprecated alias for tp_size
     seed: int = 0
+    # LRU cap on the compiled-program cache (forward/generate shape
+    # buckets); 0 disables eviction. Slot-serving programs are exempt.
+    compiled_cache_size: int = 64
 
     ALIASES = {"max_out_tokens": "max_tokens"}
 
@@ -120,3 +123,6 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
             self.kernel_inject = self.replace_with_kernel_inject = True
         if self.max_tokens < 1:
             raise ConfigError("max_tokens must be >= 1")
+        if self.compiled_cache_size < 0:
+            raise ConfigError("compiled_cache_size must be >= 0 "
+                              "(0 disables eviction)")

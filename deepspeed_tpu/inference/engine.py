@@ -20,6 +20,7 @@ short-circuit semantics matching HF generate defaults.
 """
 
 import math
+from collections import OrderedDict
 from typing import Any, Dict, Optional
 
 import jax
@@ -38,6 +39,18 @@ from .config import DeepSpeedInferenceConfig
 
 def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
+
+
+def _sample_tokens(logits, temps, key, vocab):
+    """Per-row greedy/temperature sampling shared by the slot prefill and
+    fused decode programs. logits [S, V_padded]; temps [S]. Greedy rows
+    (temps <= 0) reproduce generate()'s sample() exactly: fp32 argmax over
+    the real vocab — the serving-vs-generate token-parity contract."""
+    last = logits[:, :vocab].astype(jnp.float32)
+    greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    scaled = last / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)
 
 
 class InferenceEngine:
@@ -108,7 +121,14 @@ class InferenceEngine:
 
         self._cache_rules = (model.cache_partition_rules()
                              if hasattr(model, "cache_partition_rules") else [])
-        self._fns: Dict[Any, Any] = {}
+        # Compiled-program cache, LRU-capped at config.compiled_cache_size:
+        # shape buckets accumulate across a serving process's lifetime
+        # (every distinct (batch, prompt, new_tokens) is an entry) and each
+        # holds a compiled executable. Slot-serving programs live in
+        # _slot_fns, exempt from eviction — the continuous-batching decode
+        # step must compile exactly once per pool shape.
+        self._fns: "OrderedDict[Any, Any]" = OrderedDict()
+        self._slot_fns: Dict[Any, Any] = {}
         n_params = sum(int(np.prod(s.shape))
                        for s in jax.tree.leaves(param_shapes))
         log_dist(f"InferenceEngine initialized: params={n_params/1e6:.1f}M "
@@ -157,6 +177,26 @@ class InferenceEngine:
                                       rules=self._cache_rules)
         return planner.param_shardings(cache_shapes)
 
+    def _fn_get(self, key):
+        """LRU lookup in the compiled-program cache."""
+        fn = self._fns.get(key)
+        if fn is not None:
+            self._fns.move_to_end(key)
+        return fn
+
+    def _fn_put(self, key, fn):
+        """Insert into the compiled-program cache, evicting the least
+        recently used entries past config.compiled_cache_size."""
+        self._fns[key] = fn
+        self._fns.move_to_end(key)
+        cap = getattr(self._config, "compiled_cache_size", 0) or 0
+        while cap > 0 and len(self._fns) > cap:
+            old_key, _ = self._fns.popitem(last=False)
+            logger.debug(
+                f"InferenceEngine: evicting compiled program {old_key} "
+                f"(compiled_cache_size={cap})")
+        return fn
+
     def load_checkpoint(self, load_dir, tag=None):
         """Load a deepspeed_tpu training checkpoint (any source mp/dp layout
         — universal reshard-on-load) into the serving shardings. Checkpoints
@@ -177,16 +217,17 @@ class InferenceEngine:
         """Full-sequence logits (scoring path, no cache)."""
         input_ids = jnp.asarray(input_ids)
         key = ("fwd", input_ids.shape)
-        if key not in self._fns:
+        fn = self._fn_get(key)
+        if fn is None:
             def fwd(params, ids):
                 logits, _ = self.module.logits(params, ids, train=False,
                                                return_aux_loss=True)
                 return logits
-            self._fns[key] = jax.jit(
+            fn = self._fn_put(key, jax.jit(
                 fwd, in_shardings=(self.param_shardings,
-                                   self._batch_sharding(input_ids.shape[0])))
+                                   self._batch_sharding(input_ids.shape[0]))))
         with self.mesh:
-            return self._fns[key](self.params, input_ids)
+            return fn(self.params, input_ids)
 
     __call__ = forward
 
@@ -267,21 +308,22 @@ class InferenceEngine:
         key = ("gen", b, t, max_new_tokens, float(temperature), top_k,
                float(top_p), eos_token_id, num_beams, pad_counts is not None,
                float(length_penalty))
-        if key not in self._fns:
+        fn = self._fn_get(key)
+        if fn is None:
             if num_beams > 1:
-                self._fns[key] = self._build_beam_generate(
+                fn = self._build_beam_generate(
                     b, t, cache_len, max_new_tokens, num_beams, eos_token_id,
                     length_penalty)
             else:
-                self._fns[key] = self._build_generate(
+                fn = self._build_generate(
                     b, t, cache_len, max_new_tokens, temperature, top_k,
                     top_p, eos_token_id, padded=pad_counts is not None)
+            self._fn_put(key, fn)
         with self.mesh:
             if num_beams > 1:
-                return self._fns[key](self.params, input_ids,
-                                      jax.random.PRNGKey(seed))
-            return self._fns[key](self.params, input_ids,
-                                  jax.random.PRNGKey(seed), pad_counts)
+                return fn(self.params, input_ids, jax.random.PRNGKey(seed))
+            return fn(self.params, input_ids, jax.random.PRNGKey(seed),
+                      pad_counts)
 
     def _build_generate(self, b, t, cache_len, max_new_tokens, temperature,
                         top_k, top_p, eos_token_id, padded=False):
@@ -455,6 +497,140 @@ class InferenceEngine:
 
         return jax.jit(run, in_shardings=(
             self.param_shardings, self._batch_sharding(b), None))
+
+    # ------------------------------------------------- slot-serving protocol
+    # Entry points for the continuous-batching serving layer
+    # (deepspeed_tpu/serving/): a fixed pool of decode slots — batch rows of
+    # one statically-shaped KV cache — so admission/retirement of requests
+    # never changes a compiled shape. Three programs: prefill-into-slot
+    # (one per pow2 prompt bucket), the fused all-slot decode step (compiles
+    # EXACTLY once per (num_slots, max_len)), and pool init. All are exempt
+    # from the _fns LRU: evicting the decode step would silently recompile
+    # the serving hot path.
+
+    def _pool_shardings(self, num_slots: int, max_len: int):
+        """Cache-rule shardings for the slot pool, with any mesh axis that
+        does not divide its dimension dropped to replication (num_slots is
+        operator-chosen and rarely divides the dp axes; heads-over-'model'
+        TP is the sharding that matters for serving)."""
+        shapes = jax.eval_shape(
+            lambda: self.module.init_kv_cache(num_slots, max_len,
+                                              dtype=self.dtype))
+        shardings = self._cache_shardings(shapes)
+
+        def axis_size(ax):
+            names = ax if isinstance(ax, (tuple, list)) else (ax,)
+            size = 1
+            for n in names:
+                size *= self.mesh.shape[n]
+            return size
+
+        def fix(sh, leaf):
+            spec = tuple(sh.spec) + (None,) * (len(leaf.shape) - len(sh.spec))
+            kept = tuple(ax if ax is not None and dim % axis_size(ax) == 0
+                         else None
+                         for ax, dim in zip(spec, leaf.shape))
+            return NamedSharding(self.mesh, P(*kept))
+
+        return jax.tree.map(fix, shardings, shapes)
+
+    def init_slot_pool(self, num_slots: int, max_len: int):
+        """Allocate the slot-pool KV cache [L, num_slots, H, max_len, hd],
+        once, at static shape."""
+        key = ("slot_pool", num_slots, max_len)
+        fn = self._slot_fns.get(key)
+        if fn is None:
+            fn = self._slot_fns[key] = jax.jit(
+                lambda: self.module.init_kv_cache(num_slots, max_len,
+                                                  dtype=self.dtype),
+                out_shardings=self._pool_shardings(num_slots, max_len))
+        with self.mesh:
+            return fn()
+
+    def slot_prefill(self, pool, slot: int, prompt, temperature: float = 0.0,
+                     key=None):
+        """Prefill ``prompt`` (1-D int array) into ``pool`` slot ``slot`` and
+        sample the first generated token. The prompt is right-padded to a
+        pow2 bucket (one compile per bucket; pad K/V beyond the prompt is
+        masked until overwritten by decode writes). Returns
+        (new_pool, first_token:int)."""
+        model = self.module
+        vocab = model.config.vocab_size
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        t = prompt.shape[0]
+        max_len = int(jax.tree.leaves(pool)[0].shape[-2])
+        if not 0 < t <= max_len:
+            raise ValueError(f"prompt length {t} not in [1, {max_len}]")
+        bucket = min(_next_pow2(t), max_len)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :t] = prompt
+        num_slots = int(jax.tree.leaves(pool)[0].shape[1])
+        fkey = ("slot_prefill", bucket, max_len)
+        fn = self._slot_fns.get(fkey)
+        if fn is None:
+            pool_shardings = self._pool_shardings(num_slots, max_len)
+
+            def pf(params, ids, pool, slot_idx, last_idx, temp, key):
+                mini = model.init_kv_cache(1, max_len, dtype=self.dtype)
+                logits, mini = model.apply_with_cache(params, ids, mini,
+                                                      jnp.int32(0))
+                pool = jax.tree.map(
+                    lambda pc, mc: lax.dynamic_update_slice(
+                        pc, mc.astype(pc.dtype), (0, slot_idx, 0, 0, 0)),
+                    pool, mini)
+                last = jnp.take(logits[0], last_idx, axis=0)
+                tok = _sample_tokens(last[None], temp[None], key, vocab)[0]
+                return pool, tok
+
+            fn = self._slot_fns[fkey] = jax.jit(pf, in_shardings=(
+                self.param_shardings, None, pool_shardings, None, None, None,
+                None), out_shardings=(pool_shardings, None))
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        with self.mesh:
+            pool, tok = fn(self.params, jnp.asarray(ids), pool,
+                           jnp.int32(slot), jnp.int32(t - 1),
+                           jnp.float32(temperature), key)
+        return pool, int(tok)
+
+    def slot_decode_step(self, pool, toks, positions, temps, key=None):
+        """One fused decode step over ALL slots: feed token ``toks[s]`` at
+        cache column ``positions[s]`` and sample the next token per slot
+        (greedy where temps[s] <= 0). Inactive slots pass dummy inputs and
+        their outputs are ignored by the scheduler. Returns
+        (new_pool, next_tokens [S])."""
+        model = self.module
+        vocab = model.config.vocab_size
+        num_slots = int(jax.tree.leaves(pool)[0].shape[1])
+        max_len = int(jax.tree.leaves(pool)[0].shape[-2])
+        fkey = ("slot_decode", num_slots, max_len)
+        fn = self._slot_fns.get(fkey)
+        if fn is None:
+            pool_shardings = self._pool_shardings(num_slots, max_len)
+
+            def dec(params, pool, toks, positions, temps, key):
+                logits, pool = model.decode_with_slots(
+                    params, toks[:, None], pool, positions)
+                nxt = _sample_tokens(logits[:, -1], temps, key, vocab)
+                return pool, nxt
+
+            fn = self._slot_fns[fkey] = jax.jit(dec, in_shardings=(
+                self.param_shardings, pool_shardings, None, None, None, None),
+                out_shardings=(pool_shardings, None))
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        with self.mesh:
+            pool, nxt = fn(self.params, pool,
+                           jnp.asarray(toks, jnp.int32),
+                           jnp.asarray(positions, jnp.int32),
+                           jnp.asarray(temps, jnp.float32), key)
+        return pool, np.asarray(nxt)
+
+    def slot_decode_executables(self, num_slots: int, max_len: int) -> int:
+        """Number of compiled executables behind the fused decode step —
+        the serving tests assert this stays at 1 (compile-once decode)."""
+        fn = self._slot_fns.get(("slot_decode", num_slots, max_len))
+        return 0 if fn is None else fn._cache_size()
 
     # ------------------------------------------------------------- properties
     @property

@@ -81,8 +81,9 @@ def match_rule(path: str, rules: Sequence[Tuple[str, Tuple]]):
 
 def param_path_tree(params):
     """Pytree of '/'-joined key paths, same structure as params."""
-    paths = []
-    leaves, treedef = jax.tree.flatten_with_path(params)
+    # jax.tree_util spelling: jax.tree.flatten_with_path is a late alias
+    # absent from older jax releases still found on serving hosts
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
 
     def path_str(kp):
         parts = []

@@ -670,6 +670,74 @@ class GPT2Model(ModelSpec):
             logits = logits + head_b
         return logits, {"k": new_k, "v": new_v}
 
+    def decode_with_slots(self, params, input_ids, cache, positions):
+        """One decode token per batch row with PER-ROW cache positions — the
+        continuous-batching serving step (deepspeed_tpu/serving/): each row
+        of ``cache`` is an independent decode SLOT at its own sequence
+        length, so one compiled program advances every in-flight request by
+        one token regardless of when each was admitted.
+
+        input_ids [S, 1]; positions [S] (traced): row s's token K/V is
+        written at cache column positions[s] and attends columns
+        <= positions[s]. Unlike apply_with_cache's scalar ``start_pos``
+        (shared dynamic_update_slice column), the per-row write is a masked
+        select over the column axis — static shapes, no gather/scatter, so
+        the step compiles exactly once per (S, max_len). Returns
+        (logits [S, 1, V], new_cache)."""
+        b, t = input_ids.shape
+        if t != 1:
+            raise ValueError(f"decode_with_slots is single-token: got T={t}")
+        max_len = cache["k"].shape[-2]
+        compute_dtype = self._compute_dtype(params)
+        pos2d = positions[:, None]                       # [S, 1]
+        x = self._embed(params, input_ids, positions=pos2d)
+        k_pos = jnp.arange(max_len)[None, :]             # [1, max_len]
+        extras = self._layer_extras()
+        base_mask = None
+        if extras is None:
+            base_mask = self._decode_attn_mask(pos2d, k_pos)[:, None, None, :]
+        bias = self._decode_attn_bias(pos2d, k_pos)
+        write = (k_pos == pos2d)[:, None, :, None]       # [S, 1, max_len, 1]
+
+        from ..ops.flash_attention import reference_attention
+
+        def body(x, xs):
+            if extras is None:
+                (layer_params, k_cache, v_cache), extra = xs, None
+                mask = base_mask
+            else:
+                layer_params, k_cache, v_cache, extra = xs
+                mask = self._decode_attn_mask_ex(pos2d, k_pos,
+                                                 extra)[:, None, None, :]
+            new_kv = {}
+
+            def cached_attn(q, k, v):
+                kc = jnp.where(write, k.astype(k_cache.dtype), k_cache)
+                vc = jnp.where(write, v.astype(v_cache.dtype), v_cache)
+                new_kv["k"], new_kv["v"] = kc, vc
+                kq, vq = kc.astype(q.dtype), vc.astype(q.dtype)
+                if q.shape[1] != kq.shape[1]:        # GQA: repeat kv heads
+                    rep = q.shape[1] // kq.shape[1]
+                    kq = jnp.repeat(kq, rep, axis=1)
+                    vq = jnp.repeat(vq, rep, axis=1)
+                return reference_attention(q, kq, vq, causal=False, mask=mask,
+                                           bias=bias)
+
+            return self._decode_block(x, layer_params, cached_attn,
+                                      jnp.int32(0), positions=pos2d,
+                                      extra=extra), \
+                (new_kv["k"], new_kv["v"])
+
+        xs = (params["blocks"], cache["k"], cache["v"]) if extras is None \
+            else (params["blocks"], cache["k"], cache["v"], extras)
+        x, (new_k, new_v) = lax.scan(body, x, xs)
+        x = self._final_norm(params, x)
+        logits = x @ self._unembed_weight(params, compute_dtype).T
+        head_b = self._head_bias(params, logits.dtype)
+        if head_b is not None:
+            logits = logits + head_b
+        return logits, {"k": new_k, "v": new_v}
+
     def cache_partition_rules(self):
         """Sharding for the KV cache: heads over 'model' (TP), batch over the
         dp axes."""

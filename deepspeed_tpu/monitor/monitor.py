@@ -22,6 +22,9 @@ class Monitor:
     def write_events(self, event_list):
         raise NotImplementedError
 
+    def close(self):
+        """Release sink resources (file handles, writers). Idempotent."""
+
 
 class CsvMonitor(Monitor):
     """reference monitor/csv_monitor.py"""
@@ -51,6 +54,14 @@ class CsvMonitor(Monitor):
             writer.writerow([step, value])
             f.flush()
 
+    def close(self):
+        for f, _ in self._files.values():
+            try:
+                f.close()
+            except OSError as e:
+                logger.warning(f"CsvMonitor: close failed: {e}")
+        self._files = {}
+
 
 class TensorBoardMonitor(Monitor):
     """reference monitor/tensorboard.py — uses torch's SummaryWriter if
@@ -77,6 +88,11 @@ class TensorBoardMonitor(Monitor):
             self.summary_writer.add_scalar(tag, value, step)
         self.summary_writer.flush()
 
+    def close(self):
+        if self.summary_writer is not None:
+            self.summary_writer.close()
+            self.summary_writer = None
+
 
 class WandbMonitor(Monitor):
     """reference monitor/wandb.py"""
@@ -100,6 +116,14 @@ class WandbMonitor(Monitor):
         for tag, value, step in event_list:
             self._wandb.log({tag: value}, step=step)
 
+    def close(self):
+        if self._wandb is not None:
+            try:
+                self._wandb.finish()
+            except Exception as e:
+                logger.warning(f"wandb finish failed: {e}")
+            self._wandb = None
+
 
 class MonitorMaster(Monitor):
     """reference monitor/monitor.py:29 — owns all sinks."""
@@ -117,3 +141,9 @@ class MonitorMaster(Monitor):
         for sink in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
             if sink.enabled:
                 sink.write_events(event_list)
+
+    def close(self):
+        """Close every sink (the serving engine's drain path calls this;
+        CSV handles would otherwise leak for the process lifetime)."""
+        for sink in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            sink.close()

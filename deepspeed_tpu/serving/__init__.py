@@ -1,0 +1,20 @@
+"""Continuous-batching serving subsystem (docs/serving.md).
+
+The layer above InferenceEngine that the static-batch reference
+(DeepSpeed v0.9.1) does not have: slot-based KV cache (kv_slots),
+iteration-level scheduler (scheduler), the ServingEngine facade (engine),
+serving config (config), and TTFT/latency/utilization metrics (metrics).
+"""
+
+from .config import ServingConfig
+from .engine import ServingEngine
+from .kv_slots import SlotPool
+from .metrics import ServingMetrics
+from .scheduler import (ContinuousBatchingScheduler, QueueFull, Request,
+                        RequestState, SamplingParams)
+
+__all__ = [
+    "ServingConfig", "ServingEngine", "SlotPool", "ServingMetrics",
+    "ContinuousBatchingScheduler", "QueueFull", "Request", "RequestState",
+    "SamplingParams",
+]

@@ -1,0 +1,66 @@
+"""Serving config.
+
+``ServingConfig`` follows the ``DeepSpeedConfigModel`` pattern of
+deepspeed_tpu/inference/config.py: a dataclass with ``from_dict`` JSON
+mapping, alias warnings, strict unknown-key rejection, and ``validate()``.
+The monitor sink sub-blocks reuse ``MonitorSinkConfig`` from the training
+config so a serving JSON can carry the same ``csv_monitor`` /
+``tensorboard`` / ``wandb`` sections as a training JSON.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+from ..runtime.config import MonitorSinkConfig
+from ..runtime.config_utils import ConfigError, DeepSpeedConfigModel
+
+
+@dataclasses.dataclass
+class ServingConfig(DeepSpeedConfigModel):
+    """Continuous-batching serving knobs (deepspeed_tpu/serving/)."""
+
+    # slot pool: one statically-shaped KV cache [L, num_slots, H,
+    # max_model_len, hd], allocated once — admission never reshapes it
+    num_slots: int = 8
+    max_model_len: int = 512          # KV-cache columns per slot
+
+    # admission control / robustness
+    max_queue: int = 64               # bounded queue; submit() past this
+                                      # raises QueueFull (backpressure)
+    max_prefills_per_tick: int = 1    # prefill admission budget per tick
+                                      # (bounds tail latency of decode ticks)
+    default_max_new_tokens: int = 64
+    request_timeout_s: Optional[float] = None  # default per-request deadline
+
+    # metrics fan-out through MonitorMaster (serving/metrics.py)
+    monitor: bool = False
+    monitor_interval: int = 16        # ticks between gauge emissions
+    tensorboard: Any = None           # dict -> MonitorSinkConfig
+    wandb: Any = None
+    csv_monitor: Any = None
+
+    ALIASES = {"max_seq_len": "max_model_len"}
+
+    def validate(self):
+        if self.num_slots < 1:
+            raise ConfigError(f"num_slots must be >= 1, got {self.num_slots}")
+        if self.max_model_len < 2:
+            raise ConfigError(
+                f"max_model_len must be >= 2, got {self.max_model_len}")
+        if self.max_queue < 1:
+            raise ConfigError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.max_prefills_per_tick < 1:
+            raise ConfigError("max_prefills_per_tick must be >= 1")
+        if self.default_max_new_tokens < 1:
+            raise ConfigError("default_max_new_tokens must be >= 1")
+        if self.request_timeout_s is not None and self.request_timeout_s <= 0:
+            raise ConfigError("request_timeout_s must be > 0 when set")
+        if self.monitor_interval < 1:
+            raise ConfigError("monitor_interval must be >= 1")
+        for name in ("tensorboard", "wandb", "csv_monitor"):
+            val = getattr(self, name)
+            if val is None:
+                val = MonitorSinkConfig()
+            elif isinstance(val, dict):
+                val = MonitorSinkConfig.from_dict(val)
+            setattr(self, name, val)

@@ -1,0 +1,167 @@
+"""ServingEngine — continuous-batching facade over InferenceEngine.
+
+The online counterpart of ``InferenceEngine.generate()`` (one compiled
+program per static batch): requests arrive one at a time via
+``submit(prompt, ...) -> request_id``, are admitted into a fixed pool of
+decode slots, and every ``step()`` advances ALL in-flight requests by one
+token through a single compiled decode program. Per-token streaming runs
+through ``on_token`` callbacks; robustness controls — bounded admission
+queue with backpressure, per-request deadlines, graceful drain — are
+first-class.
+
+    engine = deepspeed_tpu.init_inference(model, config={...})
+    srv = ServingEngine(engine, {"num_slots": 8, "max_model_len": 512})
+    rid = srv.submit(prompt_ids, SamplingParams(max_new_tokens=32),
+                     on_token=lambda req, tok: print(tok))
+    srv.run_until_idle()
+    print(srv.result(rid).output_ids)
+    srv.shutdown()
+"""
+
+import time
+from typing import Callable, Dict, Optional, Union
+
+import numpy as np
+
+from ..utils.logging import log_dist
+from .config import ServingConfig
+from .metrics import ServingMetrics
+from .scheduler import (ContinuousBatchingScheduler, QueueFull, Request,
+                        RequestState, SamplingParams)
+
+__all__ = ["ServingEngine", "SamplingParams", "QueueFull", "RequestState"]
+
+
+class ServingEngine:
+    """Slot-based continuous-batching serving on top of InferenceEngine."""
+
+    def __init__(self, engine, config: Union[ServingConfig, dict, None] = None,
+                 clock: Callable[[], float] = time.monotonic, seed: int = 0):
+        if config is None:
+            config = ServingConfig()
+        elif isinstance(config, dict):
+            config = ServingConfig.from_dict(config)
+        else:
+            config.validate()
+        self.config = config
+        self.engine = engine
+        self.monitor = None
+        if config.monitor:
+            from ..monitor.monitor import MonitorMaster
+            self.monitor = MonitorMaster(config)
+        self.metrics = ServingMetrics(monitor=self.monitor,
+                                      monitor_interval=config.monitor_interval)
+        self.scheduler = ContinuousBatchingScheduler(
+            engine, config, metrics=self.metrics, clock=clock, seed=seed)
+        self._requests: Dict[int, Request] = {}
+        self._next_id = 0
+        self._draining = False
+        n_pos = getattr(getattr(engine.module, "config", None),
+                        "n_positions", None)
+        if n_pos is not None and config.max_model_len > n_pos:
+            raise ValueError(
+                f"serving.max_model_len={config.max_model_len} exceeds the "
+                f"model's context length n_positions={n_pos}")
+        log_dist(
+            f"ServingEngine initialized: slots={config.num_slots} "
+            f"max_model_len={config.max_model_len} "
+            f"max_queue={config.max_queue}", ranks=[0])
+
+    # ---------------------------------------------------------------- submit
+    def submit(self, prompt, sampling: Optional[SamplingParams] = None,
+               on_token: Optional[Callable] = None) -> int:
+        """Enqueue one request. Returns its request_id; raises ``QueueFull``
+        when the bounded admission queue is at capacity (backpressure — the
+        caller sheds load or retries with backoff) and ``RuntimeError``
+        after shutdown/drain began."""
+        if self._draining:
+            raise RuntimeError("ServingEngine is draining; submit rejected")
+        sampling = sampling or SamplingParams()
+        sampling.validate()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        max_new = (sampling.max_new_tokens
+                   if sampling.max_new_tokens is not None
+                   else self.config.default_max_new_tokens)
+        if prompt.size + max_new > self.config.max_model_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
+                f"exceeds serving.max_model_len={self.config.max_model_len}")
+        req = Request(request_id=self._next_id, prompt=prompt,
+                      sampling=sampling, max_new_tokens=max_new,
+                      on_token=on_token)
+        self.scheduler.enqueue(req)     # raises QueueFull on backpressure
+        self._requests[req.request_id] = req
+        self._next_id += 1
+        return req.request_id
+
+    # ------------------------------------------------------------------ step
+    def step(self) -> int:
+        """One scheduler tick: expire deadlines, admit into free slots
+        (prefill), one fused decode step over all active slots. Returns
+        requests still in flight."""
+        in_flight = self.scheduler.tick()
+        self.metrics.flush()
+        return in_flight
+
+    def run_until_idle(self, max_ticks: int = 100_000) -> int:
+        """Tick until no request is queued or running. Returns ticks run."""
+        for i in range(max_ticks):
+            if self.step() == 0:
+                return i + 1
+        return max_ticks
+
+    # --------------------------------------------------------------- results
+    def result(self, request_id: int) -> Request:
+        return self._requests[request_id]
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a queued request (running requests finish their course)."""
+        req = self._requests.get(request_id)
+        if req is None or req.state is not RequestState.QUEUED:
+            return False
+        try:
+            self.scheduler.queue.remove(req)
+        except ValueError:
+            return False
+        req.state = RequestState.CANCELLED
+        req.finish_time = self.scheduler.clock()
+        return True
+
+    # ------------------------------------------------------------- lifecycle
+    def drain(self, serve_queued: bool = True, max_ticks: int = 100_000):
+        """Graceful shutdown: stop admissions, finish in-flight work.
+        ``serve_queued=False`` additionally cancels everything still
+        queued (only running slots complete)."""
+        self._draining = True
+        if not serve_queued:
+            while self.scheduler.queue:
+                req = self.scheduler.queue.popleft()
+                req.state = RequestState.CANCELLED
+                req.finish_time = self.scheduler.clock()
+        ticks = self.run_until_idle(max_ticks=max_ticks)
+        self.metrics.flush()
+        return ticks
+
+    def shutdown(self, serve_queued: bool = True):
+        """Drain, flush metrics, and close monitor sinks (releases the CSV
+        file handles MonitorMaster holds)."""
+        self.drain(serve_queued=serve_queued)
+        if self.monitor is not None:
+            self.monitor.close()
+
+    # ------------------------------------------------------------- inspection
+    @property
+    def queue_depth(self) -> int:
+        return len(self.scheduler.queue)
+
+    @property
+    def active_requests(self) -> int:
+        return len(self.scheduler.pool.active_slots)
+
+    def decode_executables(self) -> int:
+        """Compiled-executable count of the fused decode step (the
+        compile-once contract: stays 1 across differing prompt lengths)."""
+        return self.engine.slot_decode_executables(
+            self.config.num_slots, self.config.max_model_len)

@@ -1,0 +1,87 @@
+"""Slot-based KV-cache pool.
+
+The TPU answer to GPU paged attention: instead of dynamically growing
+per-request caches (vLLM-style block tables — pointer chasing XLA cannot
+compile to a fixed program), the pool is ONE statically-shaped cache
+``[L, num_slots, H, max_model_len, hd]`` allocated at startup. A request is
+admitted by claiming a free slot (prefill overwrites the slot's whole lane),
+advanced by the fused all-slot decode step, and retired by returning the
+slot to the free list — no shape ever changes, so the decode step compiles
+exactly once.
+
+``SlotPool`` owns the device arrays plus the host-side per-slot registers
+(length counter, pending token, temperature) that the scheduler feeds to
+``InferenceEngine.slot_decode_step`` each tick.
+"""
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class SlotPool:
+    """Fixed pool of decode slots over one static KV cache."""
+
+    def __init__(self, engine, num_slots: int, max_model_len: int):
+        self.engine = engine
+        self.num_slots = num_slots
+        self.max_model_len = max_model_len
+        self.cache = engine.init_slot_pool(num_slots, max_model_len)
+        # host-side slot registers, mirrored into device arrays each tick
+        self.lengths = np.zeros((num_slots,), np.int32)   # tokens in cache
+        self.pending = np.zeros((num_slots,), np.int32)   # next token to feed
+        self.temps = np.zeros((num_slots,), np.float32)
+        self.requests: List[Optional[object]] = [None] * num_slots
+        self._free = list(range(num_slots - 1, -1, -1))   # pop() -> slot 0 first
+        self.total_allocs = 0
+
+    # ------------------------------------------------------------ lifecycle
+    def alloc(self) -> Optional[int]:
+        """Claim a free slot, or None when the pool is saturated."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self.total_allocs += 1
+        return slot
+
+    def free(self, slot: int):
+        """Retire a slot back to the free list (EOS / max-tokens /
+        timeout). The lane's stale K/V needs no scrubbing: the next
+        prefill overwrites the whole lane and the decode mask never looks
+        past the new request's length."""
+        if self.requests[slot] is None and slot in self._free:
+            return
+        self.requests[slot] = None
+        self.lengths[slot] = 0
+        self.pending[slot] = 0
+        self.temps[slot] = 0.0
+        self._free.append(slot)
+
+    def bind(self, slot: int, request, length: int, first_token: int,
+             temperature: float):
+        """Attach an admitted request to its slot after prefill."""
+        self.requests[slot] = request
+        self.lengths[slot] = length
+        self.pending[slot] = first_token
+        self.temps[slot] = temperature
+
+    # ------------------------------------------------------------ queries
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> List[int]:
+        return [s for s in range(self.num_slots)
+                if self.requests[s] is not None]
+
+    @property
+    def utilization(self) -> float:
+        return 1.0 - len(self._free) / self.num_slots
+
+    def decode_arrays(self):
+        """(toks, positions, temps) device-feed arrays for one fused decode
+        step. Free slots carry dummy values (token 0 at column 0 with
+        temp 0); their lane writes land in a lane the next prefill fully
+        overwrites, and their sampled tokens are dropped by the scheduler."""
+        return self.pending.copy(), self.lengths.copy(), self.temps.copy()

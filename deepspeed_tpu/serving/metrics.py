@@ -1,0 +1,101 @@
+"""Serving metrics.
+
+TTFT / per-token latency / queue depth / slot utilization, recorded
+host-side by the scheduler and (when ``serving.monitor`` is on) fanned out
+through the existing ``MonitorMaster`` event sink
+(deepspeed_tpu/monitor/monitor.py) under ``serving/*`` tags — the same
+pipeline training metrics ride, so a serving job lands next to its
+training job in TensorBoard/W&B/CSV.
+"""
+
+from typing import List, Optional, Tuple
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[idx]
+
+
+class ServingMetrics:
+    """Host-side counters + optional MonitorMaster fan-out."""
+
+    def __init__(self, monitor=None, monitor_interval: int = 16):
+        self.monitor = monitor
+        self.monitor_interval = monitor_interval
+        self.ttft_ms: List[float] = []
+        self.token_ms: List[float] = []      # per-token decode-step latency
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0
+        self.timeouts = 0
+        self.tokens_out = 0
+        self.ticks = 0
+        self._events: List[Tuple[str, float, int]] = []
+
+    # ------------------------------------------------------------- recording
+    def record_submit(self):
+        self.submitted += 1
+
+    def record_reject(self):
+        self.rejected += 1
+        self._emit("serving/rejected", self.rejected)
+
+    def record_timeout(self):
+        self.timeouts += 1
+        self._emit("serving/timeouts", self.timeouts)
+
+    def record_ttft(self, seconds: float):
+        self.ttft_ms.append(seconds * 1e3)
+        self.tokens_out += 1         # the first token is sampled at prefill
+        self._emit("serving/ttft_ms", seconds * 1e3)
+
+    def record_decode_step(self, seconds: float, n_active: int):
+        """One fused decode step advanced ``n_active`` requests by one
+        token: the per-token latency every active request observed is the
+        step wall time."""
+        self.token_ms.append(seconds * 1e3)
+        self.tokens_out += n_active
+
+    def record_completion(self, request):
+        self.completed += 1
+        self._emit("serving/completed", self.completed)
+
+    def record_tick(self, queue_depth: int, slot_utilization: float):
+        self.ticks += 1
+        if self.ticks % self.monitor_interval == 0 or self.ticks == 1:
+            self._emit("serving/queue_depth", queue_depth)
+            self._emit("serving/slot_utilization", slot_utilization)
+
+    # ------------------------------------------------------------- fan-out
+    def _emit(self, tag: str, value: float):
+        if self.monitor is not None:
+            self._events.append((tag, float(value), self.ticks))
+
+    def flush(self):
+        """Push buffered events through MonitorMaster.write_events."""
+        if self.monitor is not None and self._events:
+            self.monitor.write_events(self._events)
+            self._events = []
+
+    # ------------------------------------------------------------- summary
+    def summary(self, wall_seconds: Optional[float] = None) -> dict:
+        ttft = sorted(self.ttft_ms)
+        tok = sorted(self.token_ms)
+        out = {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "rejected": self.rejected,
+            "timeouts": self.timeouts,
+            "tokens_out": self.tokens_out,
+            "ticks": self.ticks,
+            "ttft_ms_p50": round(_percentile(ttft, 0.50), 3),
+            "ttft_ms_p95": round(_percentile(ttft, 0.95), 3),
+            "token_ms_p50": round(_percentile(tok, 0.50), 3),
+            "token_ms_p95": round(_percentile(tok, 0.95), 3),
+        }
+        if wall_seconds:
+            out["tokens_per_s"] = round(self.tokens_out / wall_seconds, 2)
+        return out

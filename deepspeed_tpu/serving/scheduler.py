@@ -1,0 +1,215 @@
+"""Continuous-batching request scheduler.
+
+Orca-style iteration-level scheduling on static XLA shapes: each ``tick()``
+(1) expires requests past their deadline, (2) admits queued requests into
+free slots — prefill writes the prompt's K/V into the slot's cache lane and
+samples the request's FIRST token (so TTFT is one prefill away from
+admission), and (3) runs ONE fused decode step over all active slots,
+advancing every in-flight request by one token. Requests retire on EOS or
+max-tokens and their slot returns to the free list for the next admission —
+no compiled shape ever changes.
+"""
+
+import dataclasses
+import enum
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+import jax
+import numpy as np
+
+from ..utils.logging import logger
+from .kv_slots import SlotPool
+from .metrics import ServingMetrics
+
+
+class QueueFull(RuntimeError):
+    """Backpressure: the bounded admission queue is at capacity."""
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+    TIMEOUT = "timeout"
+    CANCELLED = "cancelled"
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request sampling controls (serving supports greedy and
+    temperature sampling; beam/top-k stay on the offline generate() path)."""
+    temperature: float = 0.0
+    max_new_tokens: Optional[int] = None   # None -> config default
+    eos_token_id: Optional[int] = None
+    timeout_s: Optional[float] = None      # None -> config default
+
+    def validate(self):
+        if self.max_new_tokens is not None and self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if self.temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+        if self.timeout_s is not None and self.timeout_s <= 0:
+            raise ValueError("timeout_s must be > 0")
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: int
+    prompt: np.ndarray                     # int32 [T]
+    sampling: SamplingParams
+    max_new_tokens: int
+    state: RequestState = RequestState.QUEUED
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    on_token: Optional[Callable] = None    # on_token(request, token:int)
+    submit_time: float = 0.0
+    deadline: Optional[float] = None
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.TIMEOUT,
+                              RequestState.CANCELLED)
+
+    @property
+    def output_ids(self) -> np.ndarray:
+        """prompt + generated tokens."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+class ContinuousBatchingScheduler:
+    """Admission queue + slot pool + fused decode tick."""
+
+    def __init__(self, engine, config, metrics: ServingMetrics = None,
+                 clock: Callable[[], float] = time.monotonic, seed: int = 0):
+        self.engine = engine
+        self.config = config
+        self.clock = clock
+        self.metrics = metrics or ServingMetrics()
+        self.pool = SlotPool(engine, config.num_slots, config.max_model_len)
+        self.queue: "deque[Request]" = deque()
+        self._base_key = jax.random.PRNGKey(seed)
+        self._tick_no = 0
+
+    # -------------------------------------------------------------- enqueue
+    def enqueue(self, request: Request):
+        """Admission control: bounded queue -> QueueFull backpressure."""
+        if len(self.queue) >= self.config.max_queue:
+            self.metrics.record_reject()
+            raise QueueFull(
+                f"serving queue at capacity ({self.config.max_queue}); "
+                f"retry with backoff")
+        now = self.clock()
+        request.submit_time = now
+        timeout = (request.sampling.timeout_s
+                   if request.sampling.timeout_s is not None
+                   else self.config.request_timeout_s)
+        if timeout is not None:
+            request.deadline = now + timeout
+        self.queue.append(request)
+        self.metrics.record_submit()
+
+    # ----------------------------------------------------------------- tick
+    def tick(self) -> int:
+        """One scheduling iteration. Returns the number of requests still
+        in flight (queued + running) after the tick."""
+        self._tick_no += 1
+        now = self.clock()
+        self._expire(now)
+        self._admit(now)
+        self._decode()
+        self.metrics.record_tick(len(self.queue), self.pool.utilization)
+        return len(self.queue) + len(self.pool.active_slots)
+
+    def _expire(self, now: float):
+        """Deadline enforcement for both queued and running requests."""
+        kept = deque()
+        for req in self.queue:
+            if req.deadline is not None and now > req.deadline:
+                self._finish(req, RequestState.TIMEOUT, now)
+            else:
+                kept.append(req)
+        self.queue = kept
+        for slot in self.pool.active_slots:
+            req = self.pool.requests[slot]
+            if req.deadline is not None and now > req.deadline:
+                self._finish(req, RequestState.TIMEOUT, now)
+                self.pool.free(slot)
+
+    def _admit(self, now: float):
+        """Move queued requests into free slots, prefilling each prompt
+        into its slot's cache lane (bounded per tick so admission bursts
+        cannot starve in-flight decode)."""
+        admitted = 0
+        while (self.queue and self.pool.free_count > 0 and
+               admitted < self.config.max_prefills_per_tick):
+            slot = self.pool.alloc()
+            req = self.queue.popleft()
+            key = jax.random.fold_in(
+                jax.random.fold_in(self._base_key, self._tick_no), slot + 1)
+            self.pool.cache, first = self.engine.slot_prefill(
+                self.pool.cache, slot, req.prompt,
+                temperature=req.sampling.temperature, key=key)
+            t_first = self.clock()
+            req.state = RequestState.RUNNING
+            req.first_token_time = t_first
+            self.metrics.record_ttft(t_first - req.submit_time)
+            self._deliver(req, first)
+            if self._should_finish(req, first):
+                self._finish(req, RequestState.FINISHED, t_first)
+                self.pool.free(slot)
+            else:
+                self.pool.bind(slot, req, len(req.prompt), first,
+                               req.sampling.temperature)
+            admitted += 1
+
+    def _decode(self):
+        """One fused decode step over all slots; retire on EOS/max."""
+        active = self.pool.active_slots
+        if not active:
+            return
+        toks, positions, temps = self.pool.decode_arrays()
+        key = jax.random.fold_in(
+            jax.random.fold_in(self._base_key, self._tick_no), 0)
+        t0 = self.clock()
+        self.pool.cache, nxt = self.engine.slot_decode_step(
+            self.pool.cache, toks, positions, temps, key=key)
+        dt = self.clock() - t0
+        self.metrics.record_decode_step(dt, len(active))
+        now = self.clock()
+        for slot in active:
+            req = self.pool.requests[slot]
+            tok = int(nxt[slot])
+            self.pool.lengths[slot] += 1      # fed token's K/V is in cache
+            self.pool.pending[slot] = tok
+            self._deliver(req, tok)
+            if self._should_finish(req, tok):
+                self._finish(req, RequestState.FINISHED, now)
+                self.pool.free(slot)
+
+    # -------------------------------------------------------------- helpers
+    def _deliver(self, req: Request, tok: int):
+        req.tokens.append(tok)
+        if req.on_token is not None:
+            try:
+                req.on_token(req, tok)
+            except Exception as e:   # user callback must not kill the loop
+                logger.warning(
+                    f"serving: on_token callback failed for request "
+                    f"{req.request_id}: {e}")
+
+    def _should_finish(self, req: Request, tok: int) -> bool:
+        eos = req.sampling.eos_token_id
+        return (len(req.tokens) >= req.max_new_tokens or
+                (eos is not None and tok == eos))
+
+    def _finish(self, req: Request, state: RequestState, now: float):
+        req.state = state
+        req.finish_time = now
+        if state is RequestState.TIMEOUT:
+            self.metrics.record_timeout()
+        elif state is RequestState.FINISHED:
+            self.metrics.record_completion(req)
