@@ -11,6 +11,7 @@ the XLA compilation model.
 from .version import __version__
 from . import comm
 from . import zero
+from . import telemetry
 from .accelerator import get_accelerator, set_accelerator
 from .runtime.config import DeepSpeedConfig
 from .parallel import (initialize_mesh, get_mesh_manager, DeviceMeshManager,

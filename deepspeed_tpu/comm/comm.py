@@ -28,6 +28,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils.logging import logger
+# submodule import (not the telemetry package) — keeps the
+# comm <-> telemetry.export import graph acyclic
+from ..telemetry.trace import get_tracer
 from .logging import get_comms_logger
 
 _INITIALIZED = False
@@ -207,46 +210,73 @@ def _log(name, tensor, axis_name):
         cl.append(name, _size_bytes(tensor), str(axis_name))
 
 
+def _comm_span(name, tensor, axis_name):
+    """Telemetry span for one collective: op kind, payload bytes, mesh axis,
+    participant count (bus bandwidth is derived at export time from bytes ÷
+    measured duration). Collectives inside compiled programs are spanned at
+    TRACE time — XLA owns execution scheduling, so the per-execution wall
+    time of a fused collective is only visible to ``jax.profiler``; these
+    spans give per-op byte/shape accounting and trace-position instead."""
+    tracer = get_tracer()
+    if not tracer.enabled:
+        return tracer.span(name)     # the shared no-op singleton
+    try:
+        # psum of a python 1 folds to the (static) axis size at trace time
+        participants = int(lax.psum(1, axis_name))
+    except Exception:                # axis unbound: eager/host context
+        participants = 0
+    return tracer.span(name, cat="comm",
+                       args={"op": name, "bytes": _size_bytes(tensor),
+                             "axis": str(axis_name),
+                             "participants": participants})
+
+
 def all_reduce(x, op: str = ReduceOp.SUM, axis_name="data"):
     """lax.psum/pmax/pmin over a mesh axis. [COLLECTIVE]"""
     _log("all_reduce", x, axis_name)
-    if op == ReduceOp.SUM:
-        return lax.psum(x, axis_name)
-    if op == ReduceOp.AVG:
-        return lax.pmean(x, axis_name)
-    if op == ReduceOp.MAX:
-        return lax.pmax(x, axis_name)
-    if op == ReduceOp.MIN:
-        return lax.pmin(x, axis_name)
-    if op == ReduceOp.PRODUCT:
-        # EXACT product via all_gather + prod (an exp(psum(log)) trick NaNs
-        # on x<=0 and loses integer precision past 2^24). PRODUCT reduces
-        # are rare and small; the O(world) gather is the honest primitive.
-        return jnp.prod(lax.all_gather(x, axis_name), axis=0)
+    with _comm_span("all_reduce", x, axis_name):
+        if op == ReduceOp.SUM:
+            return lax.psum(x, axis_name)
+        if op == ReduceOp.AVG:
+            return lax.pmean(x, axis_name)
+        if op == ReduceOp.MAX:
+            return lax.pmax(x, axis_name)
+        if op == ReduceOp.MIN:
+            return lax.pmin(x, axis_name)
+        if op == ReduceOp.PRODUCT:
+            # EXACT product via all_gather + prod (an exp(psum(log)) trick
+            # NaNs on x<=0 and loses integer precision past 2^24). PRODUCT
+            # reduces are rare and small; the O(world) gather is the honest
+            # primitive.
+            return jnp.prod(lax.all_gather(x, axis_name), axis=0)
     raise ValueError(f"Unsupported reduce op {op}")
 
 
 def all_gather(x, axis_name="data", axis: int = 0, tiled: bool = True):
     """Gather shards along `axis` from every member of the mesh axis."""
     _log("all_gather", x, axis_name)
-    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    with _comm_span("all_gather", x, axis_name):
+        return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis_name="data", axis: int = 0, op: str = ReduceOp.SUM):
     """psum_scatter: the ZeRO-2/3 gradient primitive
     (reference runtime/comm/coalesced_collectives.py:29)."""
     _log("reduce_scatter", x, axis_name)
-    out = lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
-    if op == ReduceOp.AVG:
-        out = out / axis_size(axis_name)
-    return out
+    with _comm_span("reduce_scatter", x, axis_name):
+        out = lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                               tiled=True)
+        if op == ReduceOp.AVG:
+            out = out / axis_size(axis_name)
+        return out
 
 
 def all_to_all(x, axis_name="expert", split_axis: int = 0, concat_axis: int = 0):
     """MoE dispatch/combine primitive (reference sharded_moe.py:90 _AllToAll)."""
     _log("all_to_all", x, axis_name)
-    return lax.all_to_all(x, axis_name, split_axis=split_axis,
-                          concat_axis=concat_axis, tiled=True)
+    with _comm_span("all_to_all", x, axis_name):
+        return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
 
 
 def broadcast(x, src: int = 0, axis_name="data"):
@@ -258,16 +288,19 @@ def broadcast(x, src: int = 0, axis_name="data"):
     world size — about 2x an optimal broadcast and CONSTANT in world size,
     which is why this is also how GSPMD itself materializes broadcasts."""
     _log("broadcast", x, axis_name)
-    idx = lax.axis_index(axis_name)
-    # where, not multiply: non-src members may hold NaN/inf placeholders
-    # (torch broadcast ignores their buffers entirely)
-    return lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)), axis_name)
+    with _comm_span("broadcast", x, axis_name):
+        idx = lax.axis_index(axis_name)
+        # where, not multiply: non-src members may hold NaN/inf placeholders
+        # (torch broadcast ignores their buffers entirely)
+        return lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)),
+                        axis_name)
 
 
 def ppermute(x, perm: Sequence, axis_name="pipe"):
     """Point-to-point ring/pipeline exchange (reference pipe/p2p.py)."""
     _log("ppermute", x, axis_name)
-    return lax.ppermute(x, axis_name, perm=perm)
+    with _comm_span("ppermute", x, axis_name):
+        return lax.ppermute(x, axis_name, perm=perm)
 
 
 def send_recv_next(x, axis_name="pipe"):

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.api import ModelSpec
+from ..telemetry.trace import get_tracer
 from ..parallel.topology import (DeviceMeshManager, default_devices,
                                  initialize_mesh, get_mesh_manager)
 from ..runtime.zero.partition import ZeroShardingPlanner
@@ -319,11 +320,20 @@ class InferenceEngine:
                     b, t, cache_len, max_new_tokens, temperature, top_k,
                     top_p, eos_token_id, padded=pad_counts is not None)
             self._fn_put(key, fn)
-        with self.mesh:
-            if num_beams > 1:
-                return fn(self.params, input_ids, jax.random.PRNGKey(seed))
-            return fn(self.params, input_ids, jax.random.PRNGKey(seed),
-                      pad_counts)
+        tr = get_tracer()
+        with tr.span("generate", cat="inference",
+                     args={"batch": b, "prompt_len": t,
+                           "max_new_tokens": max_new_tokens,
+                           "num_beams": num_beams}) as sp:
+            with self.mesh:
+                if num_beams > 1:
+                    out = fn(self.params, input_ids, jax.random.PRNGKey(seed))
+                else:
+                    out = fn(self.params, input_ids,
+                             jax.random.PRNGKey(seed), pad_counts)
+            if tr.sync_spans:
+                sp.sync_on(out)
+        return out
 
     def _build_generate(self, b, t, cache_len, max_new_tokens, temperature,
                         top_k, top_p, eos_token_id, padded=False):

@@ -7,7 +7,9 @@ coordinating process writes (reference: rank-0 guard in each monitor).
 """
 
 import csv
+import hashlib
 import os
+import re
 from typing import List, Tuple
 
 import jax
@@ -26,6 +28,11 @@ class Monitor:
         """Release sink resources (file handles, writers). Idempotent."""
 
 
+#: anything outside this set is filesystem-hostile somewhere (spaces, ':'
+#: on Windows/mac, '*?<>|' glob/shell chars, '/' separators) — collapse it
+_TAG_HOSTILE = re.compile(r"[^A-Za-z0-9_.\-]+")
+
+
 class CsvMonitor(Monitor):
     """reference monitor/csv_monitor.py"""
 
@@ -34,13 +41,27 @@ class CsvMonitor(Monitor):
         self.output_path = config.output_path or "csv_monitor_output"
         self.job_name = config.job_name
         self._files = {}
+        self._claimed = {}   # sanitized filename -> originating tag
         if self.enabled and jax.process_index() == 0:
             os.makedirs(os.path.join(self.output_path, self.job_name),
                         exist_ok=True)
 
+    def _safe_name(self, tag):
+        """Sanitize a tag into a single path component: strip every
+        filesystem-hostile character (not just '/'), kill '..' path
+        climbing, and guard against two tags colliding onto one file."""
+        safe = _TAG_HOSTILE.sub("_", tag).lstrip(".")
+        if not safe or set(safe) <= {".", "_"}:
+            safe = "tag"
+        owner = self._claimed.get(safe)
+        if owner is not None and owner != tag:
+            safe = f"{safe}-{hashlib.md5(tag.encode()).hexdigest()[:8]}"
+        self._claimed[safe] = tag
+        return safe
+
     def _file_for(self, tag):
         if tag not in self._files:
-            safe = tag.replace("/", "_")
+            safe = self._safe_name(tag)
             path = os.path.join(self.output_path, self.job_name, f"{safe}.csv")
             f = open(path, "a", newline="")
             self._files[tag] = (f, csv.writer(f))
@@ -113,8 +134,13 @@ class WandbMonitor(Monitor):
     def write_events(self, event_list):
         if self._wandb is None:
             return
+        # one wandb.log per step, not one network call per event: a batch
+        # of same-step tags (the common _post_step shape) is a single log
+        by_step = {}
         for tag, value, step in event_list:
-            self._wandb.log({tag: value}, step=step)
+            by_step.setdefault(step, {})[tag] = value
+        for step in sorted(by_step):
+            self._wandb.log(by_step[step], step=step)
 
     def close(self):
         if self._wandb is not None:
@@ -126,24 +152,32 @@ class WandbMonitor(Monitor):
 
 
 class MonitorMaster(Monitor):
-    """reference monitor/monitor.py:29 — owns all sinks."""
+    """reference monitor/monitor.py:29 — owns all sinks (TensorBoard, W&B,
+    CSV, plus the telemetry/Prometheus sink from the ``prometheus``
+    config block)."""
 
     def __init__(self, ds_config):
+        # telemetry sink import is deferred: telemetry/export.py imports
+        # comm/logging.py, and importing it at module load would cycle
+        from ..telemetry.monitor_sink import TelemetryMonitor
         self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
         self.wandb_monitor = WandbMonitor(ds_config.wandb)
         self.csv_monitor = CsvMonitor(ds_config.csv_monitor)
-        self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
-                        or self.csv_monitor.enabled)
+        self.prometheus_monitor = TelemetryMonitor(
+            getattr(ds_config, "prometheus", None))
+        self._sinks = (self.tb_monitor, self.wandb_monitor, self.csv_monitor,
+                       self.prometheus_monitor)
+        self.enabled = any(s.enabled for s in self._sinks)
 
     def write_events(self, event_list: List[Tuple[str, float, int]]):
         if not self.enabled:
             return
-        for sink in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+        for sink in self._sinks:
             if sink.enabled:
                 sink.write_events(event_list)
 
     def close(self):
         """Close every sink (the serving engine's drain path calls this;
         CSV handles would otherwise leak for the process lifetime)."""
-        for sink in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+        for sink in self._sinks:
             sink.close()

@@ -10,7 +10,9 @@ dp_world_size`` is auto-solved and validated exactly like the reference
 Additions over the reference key set (TPU-first parallelism is config-driven
 rather than delegated to a user mpu): ``tensor_parallel_size``,
 ``pipeline_parallel_size``, ``sequence_parallel_size``,
-``expert_parallel_size`` select the device-mesh axis sizes.
+``expert_parallel_size`` select the device-mesh axis sizes; ``telemetry``
+enables structured step/comm/serving tracing (``TelemetryConfig``) and
+``prometheus`` adds the Prometheus-text monitor sink (docs/observability.md).
 """
 
 import dataclasses
@@ -94,6 +96,47 @@ class MonitorSinkConfig(DeepSpeedConfigModel):
     group: Optional[str] = None
     project: Optional[str] = None
     _ALLOW_EXTRA = True
+
+
+@dataclasses.dataclass
+class TelemetryConfig(DeepSpeedConfigModel):
+    """The ``"telemetry"`` config block (deepspeed_tpu/telemetry/).
+
+    Keys:
+
+    - ``enabled``: turn on structured span tracing (off = zero-cost; the
+      tracer hands out a shared no-op span, no allocation).
+    - ``buffer_size``: span ring-buffer capacity; old spans are
+      overwritten, never grown (low-overhead by construction).
+    - ``sync_spans``: block on step outputs at span exit so durations are
+      honest under XLA async dispatch (off = dispatch-only timings).
+    - ``mfu``: derive model-FLOPs-utilization from the flops profiler's
+      analytic step FLOPs (one extra trace of the step fn, once).
+    - ``peak_tflops_per_device``: hardware peak for the MFU denominator;
+      0 disables the MFU counter unless set.
+    - ``trace_output`` / ``snapshot_output``: file paths for the Chrome
+      trace-event JSON (Perfetto-loadable) and the metrics snapshot JSON.
+    - ``export_interval``: write those files every N global steps
+      (0 = only on demand via telemetry.export helpers).
+
+    The Prometheus text dump is configured separately as a monitor sink —
+    the top-level ``"prometheus"`` block (same shape as ``csv_monitor``).
+    See docs/observability.md.
+    """
+    enabled: bool = False
+    buffer_size: int = 65536
+    sync_spans: bool = True
+    mfu: bool = True
+    peak_tflops_per_device: float = 0.0
+    trace_output: Optional[str] = None
+    snapshot_output: Optional[str] = None
+    export_interval: int = 0
+
+    def validate(self):
+        if self.buffer_size < 16:
+            raise ConfigError("telemetry.buffer_size must be >= 16")
+        if self.export_interval < 0:
+            raise ConfigError("telemetry.export_interval must be >= 0")
 
 
 @dataclasses.dataclass
@@ -184,6 +227,8 @@ class DeepSpeedConfig:
         self.tensorboard = MonitorSinkConfig.from_dict(pd.get(C.TENSORBOARD, {}))
         self.wandb = MonitorSinkConfig.from_dict(pd.get(C.WANDB, {}))
         self.csv_monitor = MonitorSinkConfig.from_dict(pd.get(C.CSV_MONITOR, {}))
+        self.prometheus = MonitorSinkConfig.from_dict(pd.get(C.PROMETHEUS, {}))
+        self.telemetry = TelemetryConfig.from_dict(pd.get(C.TELEMETRY, {}))
         self.flops_profiler = FlopsProfilerConfig.from_dict(pd.get(C.FLOPS_PROFILER, {}))
         self.checkpoint_config = CheckpointConfig.from_dict(pd.get(C.CHECKPOINT, {}))
 
@@ -223,7 +268,8 @@ class DeepSpeedConfig:
         self.progressive_layer_drop = pd.get(C.PROGRESSIVE_LAYER_DROP, {})
         self.pipeline = pd.get(C.PIPELINE, {})
         self.monitor_config_enabled = (self.tensorboard.enabled or self.wandb.enabled
-                                       or self.csv_monitor.enabled)
+                                       or self.csv_monitor.enabled
+                                       or self.prometheus.enabled)
 
         self._do_sanity_check()
 

@@ -79,6 +79,8 @@ MEMORY_BREAKDOWN = "memory_breakdown"
 TENSORBOARD = "tensorboard"
 WANDB = "wandb"
 CSV_MONITOR = "csv_monitor"
+PROMETHEUS = "prometheus"
+TELEMETRY = "telemetry"
 FLOPS_PROFILER = "flops_profiler"
 
 #############################################

@@ -23,6 +23,7 @@ and pure functions inside that step:
 """
 
 import time
+from collections import deque
 from typing import Any, Dict, Optional
 
 import jax
@@ -36,6 +37,7 @@ from ..accelerator import get_accelerator
 from ..comm.logging import configure_comms_logger
 from ..models.api import ModelSpec
 from ..parallel.topology import initialize_mesh, default_devices
+from ..telemetry.trace import RecompileWatchdog, configure_tracer
 from ..utils.logging import logger, log_dist
 from ..utils.timer import (SynchronizedWallClockTimer, ThroughputTimer,
                            FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
@@ -236,7 +238,13 @@ class DeepSpeedEngine:
                                    zoff is not None and
                                    getattr(zoff, "pipeline", False))
         self.grad_shardings = self.planner.grad_shardings(param_shapes)
-        self.scaler_state = init_loss_scale_state(cfg.fp16 if cfg.fp16.enabled else None)
+        # replicated-from-birth scaler state: an uncommitted host pytree
+        # here changes the step fn's input signature once the first step
+        # returns committed arrays — one whole silent recompile at step 2
+        # (found by the telemetry recompile watchdog)
+        self.scaler_state = jax.device_put(
+            init_loss_scale_state(cfg.fp16 if cfg.fp16.enabled else None),
+            NamedSharding(self.mesh, P()))
         self._base_rng = jax.random.PRNGKey(cfg.seed + 1)
 
         # ---- elasticity guard (reference engine.py:482-491: the batch
@@ -392,6 +400,15 @@ class DeepSpeedEngine:
             batch_size=cfg.train_batch_size,
             steps_per_output=cfg.steps_per_print)  # 0 = never print
         configure_comms_logger(cfg.comms_logger)
+        # structured tracer (telemetry/): fwd/bwd/step spans, comm spans,
+        # MFU + recompile-watchdog counters; disabled = zero-cost no-ops
+        self.tracer = configure_tracer(cfg.telemetry)
+        self._watchdog = RecompileWatchdog()
+        self._step_flops: Dict[int, int] = {}   # id(step_fn) -> analytic flops
+        # per-engine monitor-event buffer (bounded: survives a disabled
+        # monitor without growing) — NOT the tracer's global queue, so two
+        # engines in one process can't drain each other's events
+        self._telemetry_events = deque(maxlen=256)
         self.monitor = None
         if MonitorMaster is not None:
             try:
@@ -761,17 +778,24 @@ class DeepSpeedEngine:
                 "only (the forward/backward/step micro API would re-page "
                 "every layer per call)")
         self.timers(FORWARD_GLOBAL_TIMER).start()
-        batch = self._apply_curriculum(batch, min_ndim=2)
-        self._pending_batch = self._to_device_batch(batch)
-        rng = jax.random.fold_in(self._base_rng, self.micro_steps)
-        scale = self.scaler_state.scale
-        theta, keep = self._step_modifiers() if train else (None, None)
-        fn = self._micro_grad_fn if keep is None else \
-            self._train_step_cache.setdefault(
-                ("micro", keep), self._make_micro_grad(keep))
-        with self.mesh:
-            loss, grads = fn(self.params, self._pending_batch, rng, scale,
-                             theta)
+        tr = self.tracer
+        with tr.span("fwd", cat="train",
+                     args={"micro_step": self.micro_steps}) as sp:
+            batch = self._apply_curriculum(batch, min_ndim=2)
+            self._pending_batch = self._to_device_batch(batch)
+            rng = jax.random.fold_in(self._base_rng, self.micro_steps)
+            scale = self.scaler_state.scale
+            theta, keep = self._step_modifiers() if train else (None, None)
+            fn = self._micro_grad_fn if keep is None else \
+                self._train_step_cache.setdefault(
+                    ("micro", keep), self._make_micro_grad(keep))
+            with tr.span("dispatch", cat="train"):
+                with self.mesh:
+                    loss, grads = fn(self.params, self._pending_batch, rng,
+                                     scale, theta)
+            if tr.sync_spans:
+                sp.sync_on(loss)
+        self._watchdog.observe(fn, tracer=tr, label="fwd")
         self._pending_grads = grads
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss / scale
@@ -781,12 +805,18 @@ class DeepSpeedEngine:
         bucket path of stage_1_and_2.py:793 collapses to one jitted add)."""
         assert self._pending_grads is not None, "backward() without forward()"
         self.timers(BACKWARD_GLOBAL_TIMER).start()
-        with self.mesh:
-            if self._grad_acc_buffer is None:
-                self._grad_acc_buffer = self._pending_grads
-            else:
-                self._grad_acc_buffer = self._acc_fn(self._grad_acc_buffer,
-                                                     self._pending_grads)
+        tr = self.tracer
+        with tr.span("bwd", cat="train",
+                     args={"micro_step": self.micro_steps}) as sp:
+            with tr.span("accumulate", cat="train"):
+                with self.mesh:
+                    if self._grad_acc_buffer is None:
+                        self._grad_acc_buffer = self._pending_grads
+                    else:
+                        self._grad_acc_buffer = self._acc_fn(
+                            self._grad_acc_buffer, self._pending_grads)
+            if tr.sync_spans:
+                sp.sync_on(self._grad_acc_buffer)
         self._grad_acc_count += 1
         self._pending_grads = None
         self.micro_steps += 1
@@ -800,17 +830,25 @@ class DeepSpeedEngine:
         assert self.optimizer is not None, "step() requires an optimizer"
         assert self._grad_acc_buffer is not None, "step() without backward()"
         self.timers(STEP_GLOBAL_TIMER).start()
-        if self._offload is not None:
-            metrics = self._offload_apply(self._grad_acc_buffer,
-                                          denom=float(self._grad_acc_count))
-        else:
-            lr = jnp.float32(self.get_lr()[0])
-            with self.mesh:
-                (self.params, self.opt_state, self.scaler_state,
-                 metrics) = self._apply_fn(self.params, self.opt_state,
-                                           self.scaler_state,
-                                           self._grad_acc_buffer, lr,
-                                           jnp.float32(self._grad_acc_count))
+        tr = self.tracer
+        with tr.span("step", cat="train",
+                     args={"step": self.global_steps}) as sp:
+            if self._offload is not None:
+                with tr.span("host_opt_step", cat="train"):
+                    metrics = self._offload_apply(
+                        self._grad_acc_buffer,
+                        denom=float(self._grad_acc_count))
+            else:
+                lr = jnp.float32(self.get_lr()[0])
+                with tr.span("apply", cat="train"):
+                    with self.mesh:
+                        (self.params, self.opt_state, self.scaler_state,
+                         metrics) = self._apply_fn(
+                             self.params, self.opt_state, self.scaler_state,
+                             self._grad_acc_buffer, lr,
+                             jnp.float32(self._grad_acc_count))
+                if tr.sync_spans:
+                    sp.sync_on(metrics)
         self._grad_acc_buffer = None
         self._grad_acc_count = 0
         self._post_step(metrics)
@@ -909,31 +947,48 @@ class DeepSpeedEngine:
         theta, keep = self._step_modifiers()
         if self.eigenvalue is not None:
             self._last_eig_batch = (jax.tree.map(lambda x: x[0], batch), rng)
-        if self._offload is not None:
-            # denom = the batch's ACTUAL gas dim (accum_grads derives gas the
-            # same way), not the config value — they can legitimately differ
-            gas = jax.tree.leaves(batch)[0].shape[0]
-            fn = self._grad_step_fn if keep is None else \
-                self._train_step_cache.setdefault(
-                    ("grad", keep), self._make_grad_step(keep))
-            if self._offload_pipelined:
-                metrics = self._pipelined_offload_step(fn, batch, rng, theta,
-                                                       float(gas))
+        tr = self.tracer
+        step_span = tr.span("train_batch", cat="train",
+                            args={"step": self.global_steps})
+        with step_span as sp:
+            if self._offload is not None:
+                # denom = the batch's ACTUAL gas dim (accum_grads derives gas
+                # the same way), not the config value — they can legitimately
+                # differ
+                gas = jax.tree.leaves(batch)[0].shape[0]
+                fn = self._grad_step_fn if keep is None else \
+                    self._train_step_cache.setdefault(
+                        ("grad", keep), self._make_grad_step(keep))
+                self._maybe_telemetry_flops(
+                    fn, (self.params, self.scaler_state, batch, rng, theta))
+                if self._offload_pipelined:
+                    metrics = self._pipelined_offload_step(fn, batch, rng,
+                                                           theta, float(gas))
+                else:
+                    with tr.span("dispatch", cat="train"):
+                        with self.mesh:
+                            loss, gsum = fn(self.params, self.scaler_state,
+                                            batch, rng, theta)
+                    with tr.span("host_opt_step", cat="train"):
+                        metrics = self._offload_apply(gsum, denom=float(gas))
+                    metrics["loss"] = loss
             else:
-                with self.mesh:
-                    loss, gsum = fn(self.params, self.scaler_state, batch,
-                                    rng, theta)
-                metrics = self._offload_apply(gsum, denom=float(gas))
-                metrics["loss"] = loss
-        else:
-            lr = jnp.float32(self.get_lr()[0])
-            fn = self._train_step_fn if keep is None else \
-                self._train_step_cache.setdefault(
-                    ("train", keep), self._make_train_step(keep))
-            with self.mesh:
-                (self.params, self.opt_state, self.scaler_state,
-                 metrics) = fn(self.params, self.opt_state,
-                               self.scaler_state, batch, lr, rng, theta)
+                lr = jnp.float32(self.get_lr()[0])
+                fn = self._train_step_fn if keep is None else \
+                    self._train_step_cache.setdefault(
+                        ("train", keep), self._make_train_step(keep))
+                self._maybe_telemetry_flops(
+                    fn, (self.params, self.opt_state, self.scaler_state,
+                         batch, lr, rng, theta))
+                with tr.span("dispatch", cat="train"):
+                    with self.mesh:
+                        (self.params, self.opt_state, self.scaler_state,
+                         metrics) = fn(self.params, self.opt_state,
+                                       self.scaler_state, batch, lr, rng,
+                                       theta)
+            if tr.sync_spans:
+                sp.sync_on(metrics)
+        self._telemetry_step_end(fn, step_span)
         self.micro_steps += cfg.gradient_accumulation_steps
         self._post_step(metrics)
         self.tput_timer.stop(global_step=True)
@@ -1022,6 +1077,73 @@ class DeepSpeedEngine:
         if fpcfg.output_file and jax.process_index() == 0:
             with open(fpcfg.output_file, "w") as f:
                 f.write(report + "\n")
+
+    # ------------------------------------------------------------------
+    # telemetry (telemetry/): MFU, recompile watchdog, memory high-water
+    # ------------------------------------------------------------------
+    def _maybe_telemetry_flops(self, fn, args):
+        """Analytic FLOPs of the compiled step, once per step fn — the MFU
+        numerator. Must run BEFORE the step call: the step donates its
+        inputs, and tracing needs live avals."""
+        tcfg = self._config.telemetry
+        if not (self.tracer.enabled and tcfg.mfu) or fn is None or \
+                id(fn) in self._step_flops:
+            return
+        try:
+            from ..profiling.flops_profiler import FlopsProfiler
+            with self.mesh:
+                prof = FlopsProfiler().profile(fn, *args)
+            self._step_flops[id(fn)] = int(prof["flops"])
+        except Exception as e:
+            logger.warning(f"telemetry: step flops profile failed: {e}")
+            self._step_flops[id(fn)] = 0
+
+    def _telemetry_step_end(self, fn, span):
+        """Per-step gauges after the synced train_batch span: step time,
+        MFU, live-memory high-water, recompile watchdog."""
+        tr = self.tracer
+        if not tr.enabled:
+            return
+        step = self.global_steps
+
+        def gauge(tag, value):
+            tr.set_counter(tag, value, step)
+            self._telemetry_events.append((tag, value, step))
+
+        dur_s = span.dur_us / 1e6
+        gauge("telemetry/step_time_ms", span.dur_us / 1e3)
+        # recompile watchdog: a shape/dtype change that grew the jit cache
+        # this step is a perf cliff — count it, don't guess
+        if self._watchdog.observe(fn, tracer=tr, label="train_batch"):
+            gauge("telemetry/recompiles", float(self._watchdog.recompiles))
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        if peak:
+            gauge("telemetry/peak_hbm_gib", peak / 2**30)
+        flops = self._step_flops.get(id(fn), 0) if fn is not None else 0
+        if flops and dur_s > 0:
+            achieved = flops / dur_s
+            gauge("telemetry/step_tflops", achieved / 1e12)
+            peak_t = self._config.telemetry.peak_tflops_per_device
+            if peak_t > 0:
+                mfu = achieved / (peak_t * 1e12 * max(1, self.mesh.size))
+                gauge("telemetry/mfu", mfu)
+
+    def _export_telemetry(self):
+        """Write the Chrome trace / metrics snapshot files (config:
+        telemetry.trace_output / snapshot_output)."""
+        tcfg = self._config.telemetry
+        if jax.process_index() != 0:
+            return
+        from ..telemetry.export import write_chrome_trace, write_snapshot
+        try:
+            if tcfg.trace_output:
+                write_chrome_trace(tcfg.trace_output, self.tracer)
+            if tcfg.snapshot_output:
+                write_snapshot(tcfg.snapshot_output, self.tracer,
+                               extra={"global_steps": self.global_steps})
+        except OSError as e:
+            logger.warning(f"telemetry export failed: {e}")
 
     def _next_gas_batch(self, data_iter):
         """Stack gas micro-batches from an iterator into [gas, ...] leaves."""
@@ -1114,6 +1236,17 @@ class DeepSpeedEngine:
                 events.append(("Train/Samples/moq_bits",
                                self.quantizer.current_bits,
                                self.global_samples))
+            # one gauge space: every monitor event is mirrored into the
+            # telemetry counters (snapshot/Prometheus see it), while the
+            # event batch itself stays per-engine — same split serving
+            # metrics use, so co-resident engines can't steal each other's
+            # events
+            events = [(tag, float(value), samples)
+                      for tag, value, samples in events]
+            for tag, value, samples in events:
+                self.tracer.set_counter(tag, value, samples)
+            events.extend(self._telemetry_events)
+            self._telemetry_events.clear()
             self.monitor.write_events(events)
         if (self._config.steps_per_print and
                 self.global_steps % self._config.steps_per_print == 0):
@@ -1131,6 +1264,10 @@ class DeepSpeedEngine:
                 self._config.steps_per_print and \
                 self.global_steps % self._config.steps_per_print == 0:
             self._log_memory_breakdown()
+        tcfg = self._config.telemetry
+        if tcfg.enabled and tcfg.export_interval and \
+                self.global_steps % tcfg.export_interval == 0:
+            self._export_telemetry()
 
     def _log_memory_breakdown(self):
         """memory_breakdown (reference see_memory_usage): per-device HBM

@@ -38,6 +38,13 @@ class ServingConfig(DeepSpeedConfigModel):
     tensorboard: Any = None           # dict -> MonitorSinkConfig
     wandb: Any = None
     csv_monitor: Any = None
+    prometheus: Any = None            # dict -> MonitorSinkConfig (telemetry
+                                      # sink: {job}.prom text dump)
+
+    # telemetry (dict -> runtime.config.TelemetryConfig): per-request
+    # queue→prefill→decode→complete spans + decode-tick spans; shutdown()
+    # writes trace_output/snapshot_output when set
+    telemetry: Any = None
 
     ALIASES = {"max_seq_len": "max_model_len"}
 
@@ -57,10 +64,13 @@ class ServingConfig(DeepSpeedConfigModel):
             raise ConfigError("request_timeout_s must be > 0 when set")
         if self.monitor_interval < 1:
             raise ConfigError("monitor_interval must be >= 1")
-        for name in ("tensorboard", "wandb", "csv_monitor"):
+        for name in ("tensorboard", "wandb", "csv_monitor", "prometheus"):
             val = getattr(self, name)
             if val is None:
                 val = MonitorSinkConfig()
             elif isinstance(val, dict):
                 val = MonitorSinkConfig.from_dict(val)
             setattr(self, name, val)
+        if isinstance(self.telemetry, dict):
+            from ..runtime.config import TelemetryConfig
+            self.telemetry = TelemetryConfig.from_dict(self.telemetry)

@@ -49,8 +49,12 @@ class ServingEngine:
         if config.monitor:
             from ..monitor.monitor import MonitorMaster
             self.monitor = MonitorMaster(config)
+        from ..telemetry.trace import configure_tracer
+        self.tracer = configure_tracer(config.telemetry) \
+            if config.telemetry is not None else configure_tracer()
         self.metrics = ServingMetrics(monitor=self.monitor,
-                                      monitor_interval=config.monitor_interval)
+                                      monitor_interval=config.monitor_interval,
+                                      tracer=self.tracer)
         self.scheduler = ContinuousBatchingScheduler(
             engine, config, metrics=self.metrics, clock=clock, seed=seed)
         self._requests: Dict[int, Request] = {}
@@ -127,6 +131,7 @@ class ServingEngine:
             return False
         req.state = RequestState.CANCELLED
         req.finish_time = self.scheduler.clock()
+        self._close_request_spans(req)
         return True
 
     # ------------------------------------------------------------- lifecycle
@@ -140,16 +145,39 @@ class ServingEngine:
                 req = self.scheduler.queue.popleft()
                 req.state = RequestState.CANCELLED
                 req.finish_time = self.scheduler.clock()
+                self._close_request_spans(req)
         ticks = self.run_until_idle(max_ticks=max_ticks)
         self.metrics.flush()
         return ticks
 
+    def _close_request_spans(self, req):
+        """Cancellation bypasses the scheduler's _finish: close the
+        request's open async spans so the trace stays balanced."""
+        self.tracer.async_end("request/queued", req.request_id,
+                              cat="serving")
+        self.tracer.async_end("request", req.request_id, cat="serving",
+                              args={"state": req.state.value,
+                                    "tokens": len(req.tokens)})
+
     def shutdown(self, serve_queued: bool = True):
-        """Drain, flush metrics, and close monitor sinks (releases the CSV
-        file handles MonitorMaster holds)."""
+        """Drain, flush metrics, close monitor sinks (releases the CSV
+        file handles MonitorMaster holds), and write the configured
+        telemetry exports (telemetry.trace_output / snapshot_output)."""
         self.drain(serve_queued=serve_queued)
         if self.monitor is not None:
             self.monitor.close()
+        tcfg = self.config.telemetry
+        if tcfg is not None and getattr(tcfg, "enabled", False):
+            from ..telemetry.export import (write_chrome_trace,
+                                            write_snapshot)
+            try:
+                if tcfg.trace_output:
+                    write_chrome_trace(tcfg.trace_output, self.tracer)
+                if tcfg.snapshot_output:
+                    write_snapshot(tcfg.snapshot_output, self.tracer,
+                                   extra={"serving": self.metrics.summary()})
+            except OSError as e:
+                log_dist(f"serving telemetry export failed: {e}", ranks=[0])
 
     # ------------------------------------------------------------- inspection
     @property

@@ -1,14 +1,19 @@
 """Serving metrics.
 
 TTFT / per-token latency / queue depth / slot utilization, recorded
-host-side by the scheduler and (when ``serving.monitor`` is on) fanned out
-through the existing ``MonitorMaster`` event sink
-(deepspeed_tpu/monitor/monitor.py) under ``serving/*`` tags — the same
-pipeline training metrics ride, so a serving job lands next to its
-training job in TensorBoard/W&B/CSV.
+host-side by the scheduler. Every gauge lands in the process-wide
+telemetry counters (telemetry/trace.py) — so the metrics snapshot and the
+Prometheus dump see serving state live — while the monitor events buffer
+PER ENGINE and ``flush()`` fans them into ``MonitorMaster.write_events``,
+the same sink set training metrics ride, so a serving job lands next to
+its training job in TensorBoard/W&B/CSV and in the Prometheus sink. The
+event buffer is deliberately per-instance, not the tracer's global queue:
+two engines in one process must not drain each other's events.
 """
 
 from typing import List, Optional, Tuple
+
+from ..telemetry.trace import get_tracer
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -20,11 +25,14 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
 
 
 class ServingMetrics:
-    """Host-side counters + optional MonitorMaster fan-out."""
+    """Host-side counters mirrored into the telemetry gauges, with
+    optional MonitorMaster fan-out on ``flush()``."""
 
-    def __init__(self, monitor=None, monitor_interval: int = 16):
+    def __init__(self, monitor=None, monitor_interval: int = 16,
+                 tracer=None):
         self.monitor = monitor
         self.monitor_interval = monitor_interval
+        self.tracer = tracer or get_tracer()
         self.ttft_ms: List[float] = []
         self.token_ms: List[float] = []      # per-token decode-step latency
         self.submitted = 0
@@ -71,11 +79,14 @@ class ServingMetrics:
 
     # ------------------------------------------------------------- fan-out
     def _emit(self, tag: str, value: float):
+        """Gauge into the shared telemetry counters (snapshot/Prometheus
+        see it live) + a per-engine monitor event."""
+        self.tracer.set_counter(tag, float(value), self.ticks)
         if self.monitor is not None:
             self._events.append((tag, float(value), self.ticks))
 
     def flush(self):
-        """Push buffered events through MonitorMaster.write_events."""
+        """Fan this engine's buffered events into MonitorMaster."""
         if self.monitor is not None and self._events:
             self.monitor.write_events(self._events)
             self._events = []

@@ -19,6 +19,7 @@ from typing import Callable, List, Optional
 import jax
 import numpy as np
 
+from ..telemetry.trace import get_tracer
 from ..utils.logging import logger
 from .kv_slots import SlotPool
 from .metrics import ServingMetrics
@@ -93,6 +94,9 @@ class ContinuousBatchingScheduler:
         self.queue: "deque[Request]" = deque()
         self._base_key = jax.random.PRNGKey(seed)
         self._tick_no = 0
+        # per-request async spans (queue → prefill → decode → complete)
+        # land in the same trace as train/comm spans
+        self.tracer = get_tracer()
 
     # -------------------------------------------------------------- enqueue
     def enqueue(self, request: Request):
@@ -110,6 +114,11 @@ class ContinuousBatchingScheduler:
         if timeout is not None:
             request.deadline = now + timeout
         self.queue.append(request)
+        tr = self.tracer
+        tr.async_begin("request", request.request_id, cat="serving",
+                       args={"prompt_len": int(request.prompt.size),
+                             "max_new_tokens": request.max_new_tokens})
+        tr.async_begin("request/queued", request.request_id, cat="serving")
         self.metrics.record_submit()
 
     # ----------------------------------------------------------------- tick
@@ -144,15 +153,24 @@ class ContinuousBatchingScheduler:
         into its slot's cache lane (bounded per tick so admission bursts
         cannot starve in-flight decode)."""
         admitted = 0
+        tr = self.tracer
         while (self.queue and self.pool.free_count > 0 and
                admitted < self.config.max_prefills_per_tick):
             slot = self.pool.alloc()
             req = self.queue.popleft()
+            tr.async_end("request/queued", req.request_id, cat="serving")
+            tr.async_begin("request/decode", req.request_id, cat="serving",
+                           args={"slot": slot})
             key = jax.random.fold_in(
                 jax.random.fold_in(self._base_key, self._tick_no), slot + 1)
-            self.pool.cache, first = self.engine.slot_prefill(
-                self.pool.cache, slot, req.prompt,
-                temperature=req.sampling.temperature, key=key)
+            with tr.span("prefill", cat="serving",
+                         args={"request_id": req.request_id, "slot": slot,
+                               "prompt_len": int(req.prompt.size)}):
+                # slot_prefill returns the first token as a python int —
+                # already device-synced, so the span duration is honest
+                self.pool.cache, first = self.engine.slot_prefill(
+                    self.pool.cache, slot, req.prompt,
+                    temperature=req.sampling.temperature, key=key)
             t_first = self.clock()
             req.state = RequestState.RUNNING
             req.first_token_time = t_first
@@ -175,8 +193,12 @@ class ContinuousBatchingScheduler:
         key = jax.random.fold_in(
             jax.random.fold_in(self._base_key, self._tick_no), 0)
         t0 = self.clock()
-        self.pool.cache, nxt = self.engine.slot_decode_step(
-            self.pool.cache, toks, positions, temps, key=key)
+        with self.tracer.span("decode_step", cat="serving",
+                              args={"n_active": len(active),
+                                    "tick": self._tick_no}):
+            # slot_decode_step returns host ndarrays (already synced)
+            self.pool.cache, nxt = self.engine.slot_decode_step(
+                self.pool.cache, toks, positions, temps, key=key)
         dt = self.clock() - t0
         self.metrics.record_decode_step(dt, len(active))
         now = self.clock()
@@ -209,6 +231,17 @@ class ContinuousBatchingScheduler:
     def _finish(self, req: Request, state: RequestState, now: float):
         req.state = state
         req.finish_time = now
+        tr = self.tracer
+        if req.first_token_time is None:
+            # expired straight out of the queue: close the queued phase
+            tr.async_end("request/queued", req.request_id, cat="serving")
+        else:
+            tr.async_end("request/decode", req.request_id, cat="serving")
+        tr.async_end(
+            "request", req.request_id, cat="serving",
+            args={"state": state.value, "tokens": len(req.tokens),
+                  "ttft_ms": None if req.first_token_time is None else
+                  round((req.first_token_time - req.submit_time) * 1e3, 3)})
         if state is RequestState.TIMEOUT:
             self.metrics.record_timeout()
         elif state is RequestState.FINISHED:

@@ -1,0 +1,29 @@
+"""deepspeed_tpu.telemetry — unified structured tracing & metrics.
+
+Usage::
+
+    from deepspeed_tpu.telemetry import get_tracer
+    tr = get_tracer()
+    tr.configure(enabled=True)
+    with tr.span("fwd") as sp:
+        loss = step(...)
+        sp.sync_on(loss)          # honest timing under async dispatch
+    from deepspeed_tpu.telemetry.export import write_chrome_trace
+    write_chrome_trace("trace.json")   # load in ui.perfetto.dev
+
+Training runs enable it via the ``"telemetry"`` config block
+(runtime/config.py); serving via ``ServingConfig.telemetry``. See
+docs/observability.md.
+"""
+
+from .trace import (Span, Tracer, RecompileWatchdog, get_tracer,
+                    configure_tracer)
+from .export import (chrome_trace, write_chrome_trace, metrics_snapshot,
+                     write_snapshot, prometheus_dump, span_aggregates,
+                     comm_table)
+from .monitor_sink import TelemetryMonitor
+
+__all__ = ["Span", "Tracer", "RecompileWatchdog", "get_tracer",
+           "configure_tracer", "chrome_trace", "write_chrome_trace",
+           "metrics_snapshot", "write_snapshot", "prometheus_dump",
+           "span_aggregates", "comm_table", "TelemetryMonitor"]

@@ -1,0 +1,184 @@
+"""Telemetry exporters: Chrome trace JSON, metrics snapshot, Prometheus.
+
+Three views over one ``Tracer``:
+
+- ``chrome_trace`` / ``write_chrome_trace`` — trace-event JSON loadable in
+  Perfetto (ui.perfetto.dev) or chrome://tracing. Complete spans become
+  ``ph="X"`` events (nesting falls out of ts/dur on a shared tid); async
+  request spans become ``ph="b"/"e"`` pairs keyed by request id.
+- ``metrics_snapshot`` / ``write_snapshot`` — JSON aggregates: per-span
+  count/total/mean/max, the counter gauges (MFU, recompiles, memory
+  high-water, serving gauges), and a per-collective table with payload
+  bytes and derived algorithm/bus bandwidth (comm/logging.py formulas).
+- ``prometheus_dump`` — the same gauges in Prometheus text exposition
+  format, for scrape-by-file or pushgateway-style export. Also what the
+  ``TelemetryMonitor`` sink writes.
+"""
+
+import json
+import re
+from typing import Any, Dict, List, Optional
+
+from .trace import Tracer, get_tracer
+
+
+def _calc_bw(op, nbytes, dur_s, n):
+    # deferred: comm/comm.py imports telemetry.trace, so a module-level
+    # import of comm.logging here would be order-sensitive
+    from ..comm.logging import calc_bw_log
+    return calc_bw_log(op, nbytes, dur_s, n)
+
+__all__ = ["chrome_trace", "write_chrome_trace", "span_aggregates",
+           "comm_table", "metrics_snapshot", "write_snapshot",
+           "prometheus_dump"]
+
+
+def _pid() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def chrome_trace(tracer: Optional[Tracer] = None) -> Dict[str, Any]:
+    """Trace-event JSON dict (Perfetto-loadable)."""
+    tracer = tracer or get_tracer()
+    pid = _pid()
+    events: List[Dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": f"deepspeed_tpu rank {pid}"},
+    }]
+    for sp in tracer.spans():
+        ev: Dict[str, Any] = {"name": sp.name, "cat": sp.cat, "ph": sp.ph,
+                              "ts": sp.ts_us, "pid": pid, "tid": sp.tid}
+        if sp.ph == "X":
+            ev["dur"] = sp.dur_us
+        if sp.ph in ("b", "e"):
+            ev["id"] = format(sp.aid or 0, "x")
+        if sp.ph == "i":
+            ev["s"] = "t"      # thread-scoped instant
+        args = dict(sp.args) if sp.args else {}
+        if sp.cat == "comm" and sp.ph == "X":
+            args.update(_bw_args(sp))
+        if args:
+            ev["args"] = args
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"dropped_spans": tracer.dropped}}
+
+
+def write_chrome_trace(path: str, tracer: Optional[Tracer] = None) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(tracer), f)
+    return path
+
+
+def _bw_args(sp) -> Dict[str, float]:
+    """Derived bandwidth for a comm span (GB/s, from measured duration —
+    trace-time spans have ~0 duration and report 0)."""
+    args = sp.args or {}
+    nbytes = int(args.get("bytes", 0))
+    n = int(args.get("participants", 0)) or 1
+    algbw, busbw = _calc_bw(args.get("op", sp.name), nbytes,
+                            sp.dur_us / 1e6, n)
+    return {"algbw_gbps": round(algbw, 3), "busbw_gbps": round(busbw, 3)}
+
+
+def span_aggregates(tracer: Optional[Tracer] = None) -> Dict[str, Any]:
+    """Per-name aggregates over complete spans: where did the time go."""
+    tracer = tracer or get_tracer()
+    out: Dict[str, Any] = {}
+    for sp in tracer.spans():
+        if sp.ph != "X":
+            continue
+        rec = out.setdefault(sp.name, {"count": 0, "total_ms": 0.0,
+                                       "max_ms": 0.0})
+        rec["count"] += 1
+        rec["total_ms"] += sp.dur_us / 1e3
+        rec["max_ms"] = max(rec["max_ms"], sp.dur_us / 1e3)
+    for rec in out.values():
+        rec["mean_ms"] = rec["total_ms"] / rec["count"]
+        rec["total_ms"] = round(rec["total_ms"], 4)
+        rec["mean_ms"] = round(rec["mean_ms"], 4)
+        rec["max_ms"] = round(rec["max_ms"], 4)
+    return out
+
+
+def comm_table(tracer: Optional[Tracer] = None) -> Dict[str, Any]:
+    """Per-collective totals: calls, payload bytes, derived bus bandwidth."""
+    tracer = tracer or get_tracer()
+    out: Dict[str, Any] = {}
+    for sp in tracer.spans():
+        if sp.cat != "comm" or sp.ph != "X":
+            continue
+        args = sp.args or {}
+        op = args.get("op", sp.name)
+        rec = out.setdefault(op, {"calls": 0, "bytes": 0, "total_ms": 0.0,
+                                  "participants": int(
+                                      args.get("participants", 0))})
+        rec["calls"] += 1
+        rec["bytes"] += int(args.get("bytes", 0))
+        rec["total_ms"] += sp.dur_us / 1e3
+    for op, rec in out.items():
+        algbw, busbw = _calc_bw(op, rec["bytes"], rec["total_ms"] / 1e3,
+                                max(rec["participants"], 1))
+        rec["algbw_gbps"] = round(algbw, 3)
+        rec["busbw_gbps"] = round(busbw, 3)
+        rec["total_ms"] = round(rec["total_ms"], 4)
+    return out
+
+
+def metrics_snapshot(tracer: Optional[Tracer] = None,
+                     extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """One JSON document answering "where did this step's time go": span
+    aggregates + gauges (MFU, recompiles, memory) + comm table."""
+    tracer = tracer or get_tracer()
+    counters = {tag: val for tag, (val, _step) in tracer.counters().items()}
+    snap = {"spans": span_aggregates(tracer), "counters": counters,
+            "comm": comm_table(tracer), "dropped_spans": tracer.dropped}
+    if extra:
+        snap.update(extra)
+    return snap
+
+
+def write_snapshot(path: str, tracer: Optional[Tracer] = None,
+                   extra: Optional[Dict[str, Any]] = None) -> str:
+    with open(path, "w") as f:
+        json.dump(metrics_snapshot(tracer, extra=extra), f, indent=2,
+                  default=str)
+    return path
+
+
+_PROM_NAME = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom(name: str) -> str:
+    name = _PROM_NAME.sub("_", name)
+    return name if not name[:1].isdigit() else "_" + name
+
+
+def prometheus_dump(tracer: Optional[Tracer] = None,
+                    prefix: str = "dstpu") -> str:
+    """Prometheus text exposition of the gauges + span aggregates."""
+    tracer = tracer or get_tracer()
+    lines: List[str] = []
+    lines.append(f"# TYPE {prefix}_metric gauge")
+    for tag, (val, _step) in sorted(tracer.counters().items()):
+        try:
+            fval = float(val)
+        except (TypeError, ValueError):
+            continue
+        lines.append(f'{prefix}_metric{{tag="{_prom(tag)}"}} {fval}')
+    aggs = span_aggregates(tracer)
+    if aggs:
+        lines.append(f"# TYPE {prefix}_span_ms_total counter")
+        lines.append(f"# TYPE {prefix}_span_count counter")
+        for name, rec in sorted(aggs.items()):
+            lines.append(f'{prefix}_span_ms_total{{name="{_prom(name)}"}} '
+                         f'{rec["total_ms"]}')
+            lines.append(f'{prefix}_span_count{{name="{_prom(name)}"}} '
+                         f'{rec["count"]}')
+    lines.append(f"# TYPE {prefix}_dropped_spans gauge")
+    lines.append(f"{prefix}_dropped_spans {tracer.dropped}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,65 @@
+"""TelemetryMonitor — the fourth ``MonitorMaster`` sink.
+
+Mirrors every monitor event into the telemetry counter gauges (so the
+metrics snapshot and Prometheus dump see everything TensorBoard/W&B/CSV
+see) and, when ``output_path`` is configured, maintains a Prometheus text
+exposition file at ``{output_path}/{job_name}.prom`` — rewritten on every
+``write_events`` batch (gauges are latest-value; the file is tiny) and on
+``close()``.
+
+Config block (training JSON and serving JSON alike)::
+
+    "prometheus": {"enabled": true, "output_path": "./prom",
+                   "job_name": "my_run"}
+"""
+
+import os
+
+from ..utils.logging import logger
+from .export import prometheus_dump
+from .trace import get_tracer
+
+
+class TelemetryMonitor:
+    """Monitor-protocol sink feeding the telemetry pipeline (duck-typed to
+    monitor/monitor.py's ``Monitor``: write_events/close/enabled)."""
+
+    def __init__(self, config=None):
+        self.enabled = bool(getattr(config, "enabled", False))
+        self.output_path = getattr(config, "output_path", "") or ""
+        self.job_name = getattr(config, "job_name", "DeepSpeedJobName")
+        self._path = None
+        if self.enabled and self.output_path:
+            try:
+                import jax
+                if jax.process_index() != 0:
+                    return
+            except Exception:
+                pass
+            os.makedirs(self.output_path, exist_ok=True)
+            self._path = os.path.join(self.output_path,
+                                      f"{self.job_name}.prom")
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        tracer = get_tracer()
+        for tag, value, step in event_list:
+            # gauge-only: emit() here would re-queue the event and feed the
+            # pipeline back into itself on the next flush
+            tracer.set_counter(tag, value, step)
+        self._rewrite()
+
+    def _rewrite(self):
+        if self._path is None:
+            return
+        try:
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(prometheus_dump(get_tracer()))
+            os.replace(tmp, self._path)
+        except OSError as e:
+            logger.warning(f"TelemetryMonitor: prometheus write failed: {e}")
+
+    def close(self):
+        self._rewrite()

@@ -1,0 +1,339 @@
+"""Structured tracer — the substrate of deepspeed_tpu observability.
+
+One per-process ``Tracer`` owns a fixed-capacity ring buffer of ``Span``
+records plus a lightweight counter pipeline. Spans are host-side wall-time
+intervals opened with ``tracer.span("fwd")`` context managers; under XLA's
+async dispatch a raw host interval only measures *dispatch*, so spans carry
+explicit sync points: ``sp.sync_on(outputs)`` blocks on the step's outputs
+before the end timestamp is taken (the CUDA-event analogue of
+utils/timer.py's ``stop(sync=True)``).
+
+Three record kinds:
+
+- complete spans (``ph='X'``): nested host intervals — fwd/bwd/step,
+  dispatch, prefill, decode ticks. Nesting is depth-tracked per thread.
+- async spans (``ph='b'``/``'e'``): intervals that outlive any one stack
+  frame — a serving request's queue→prefill→decode→complete lifecycle,
+  keyed by request id.
+- counters: latest-value metrics (MFU, recompiles, queue depth, ...) in
+  one process-wide gauge space — everything the training engine and the
+  serving stack record lands here, so the metrics snapshot and Prometheus
+  dump see it all. Monitor-EVENT fan-out stays per-producer: the engine
+  and ``ServingMetrics`` buffer their own ``(tag, value, step)`` batches
+  for ``MonitorMaster.write_events`` (a shared event queue would let two
+  engines in one process drain each other's events); ``emit()`` +
+  ``drain_events()`` remain as a single-consumer pipeline for scripts.
+
+Disabled is the default and costs nothing: ``span()`` returns a shared
+no-op singleton — no ``Span`` object is ever allocated (asserted by
+tests/unit/test_telemetry.py). Counters stay live regardless, since the
+monitor pipeline must work without tracing.
+
+Exporters (Chrome trace JSON for Perfetto, metrics snapshot, Prometheus
+text) live in telemetry/export.py; the ``MonitorMaster`` sink in
+telemetry/monitor_sink.py.
+"""
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Span", "Tracer", "RecompileWatchdog", "get_tracer",
+           "configure_tracer"]
+
+_NOSYNC = object()
+
+
+def _default_sync():
+    """Best-effort full-device sync for ``sync=True`` spans without an
+    output to block on (accurate spans should prefer ``sync_on(value)``)."""
+    try:
+        import jax
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+def _block_on(value):
+    try:
+        import jax
+        jax.block_until_ready(value)
+    except Exception:
+        pass
+
+
+class Span:
+    """One record in the ring buffer. Also its own context manager, so an
+    enabled ``tracer.span(...)`` costs exactly one allocation."""
+
+    __slots__ = ("name", "cat", "ts_us", "dur_us", "depth", "tid", "args",
+                 "ph", "aid", "_tracer", "_sync", "_sync_val")
+
+    def __init__(self, tracer, name: str, cat: str = "host",
+                 args: Optional[Dict[str, Any]] = None, sync: bool = False,
+                 ph: str = "X", aid: Optional[int] = None):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.ph = ph
+        self.aid = aid
+        self.ts_us = 0.0
+        self.dur_us = 0.0
+        self.depth = 0
+        self.tid = threading.get_ident()
+        self._tracer = tracer
+        self._sync = sync
+        self._sync_val = _NOSYNC
+
+    def sync_on(self, value):
+        """Block on ``value`` (any pytree of jax arrays) at span exit before
+        the end timestamp — the honest duration under async dispatch."""
+        self._sync_val = value
+        return value
+
+    def set(self, **kwargs):
+        """Attach/update args on an open span."""
+        if self.args is None:
+            self.args = {}
+        self.args.update(kwargs)
+
+    def __enter__(self):
+        tr = self._tracer
+        self.depth = tr._enter_depth()
+        self.ts_us = time.perf_counter_ns() / 1e3
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._sync_val is not _NOSYNC:
+            _block_on(self._sync_val)
+        elif self._sync:
+            _default_sync()
+        self.dur_us = time.perf_counter_ns() / 1e3 - self.ts_us
+        tr = self._tracer
+        tr._exit_depth()
+        # drop the references a retained record doesn't need
+        self._sync_val = _NOSYNC
+        tr._record(self)
+        return False
+
+
+class _NullSpan:
+    """Shared no-op span: what a disabled tracer hands out. A singleton —
+    the zero-cost-when-disabled contract is that no object is allocated."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def sync_on(self, value):
+        return value
+
+    def set(self, **kwargs):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Per-process structured tracer: span ring buffer + counter pipeline."""
+
+    def __init__(self, buffer_size: int = 65536, enabled: bool = False):
+        self.enabled = enabled
+        self.sync_spans = True
+        self._cap = max(16, int(buffer_size))
+        self._ring: List[Optional[Span]] = [None] * self._cap
+        self._head = 0          # next write index
+        self._total = 0         # spans ever recorded (wraparound detector)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._counters: Dict[str, Any] = {}
+        self._pending: "deque" = deque(maxlen=8192)
+
+    # ------------------------------------------------------------ configure
+    def configure(self, config=None, **overrides):
+        """Apply a ``TelemetryConfig`` (or kwargs): enabled, buffer_size,
+        sync_spans. Resizing the buffer clears recorded spans."""
+        kv = {}
+        if config is not None:
+            for k in ("enabled", "buffer_size", "sync_spans"):
+                if hasattr(config, k):
+                    kv[k] = getattr(config, k)
+        kv.update(overrides)
+        if "buffer_size" in kv and int(kv["buffer_size"]) != self._cap:
+            with self._lock:
+                self._cap = max(16, int(kv["buffer_size"]))
+                self._ring = [None] * self._cap
+                self._head = 0
+                self._total = 0
+        if "sync_spans" in kv:
+            self.sync_spans = bool(kv["sync_spans"])
+        if "enabled" in kv:
+            self.enabled = bool(kv["enabled"])
+        return self
+
+    # ----------------------------------------------------------------- spans
+    def span(self, name: str, cat: str = "host",
+             args: Optional[Dict[str, Any]] = None, sync: bool = False):
+        """Open a nested wall-time span. ``sync=True`` fences the device at
+        exit; for accuracy prefer ``sp.sync_on(step_outputs)``. Disabled
+        tracer: returns the shared no-op singleton (no allocation)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return Span(self, name, cat=cat, args=args,
+                    sync=sync and self.sync_spans)
+
+    def instant(self, name: str, cat: str = "host",
+                args: Optional[Dict[str, Any]] = None):
+        """Zero-duration marker event."""
+        if not self.enabled:
+            return
+        sp = Span(self, name, cat=cat, args=args, ph="i")
+        sp.ts_us = time.perf_counter_ns() / 1e3
+        self._record(sp)
+
+    def async_begin(self, name: str, aid: int, cat: str = "async",
+                    args: Optional[Dict[str, Any]] = None):
+        """Open one side of an async span (an interval that outlives the
+        current stack frame, e.g. a serving request). Pair with
+        ``async_end`` on the same (name, aid)."""
+        if not self.enabled:
+            return
+        sp = Span(self, name, cat=cat, args=args, ph="b", aid=aid)
+        sp.ts_us = time.perf_counter_ns() / 1e3
+        self._record(sp)
+
+    def async_end(self, name: str, aid: int, cat: str = "async",
+                  args: Optional[Dict[str, Any]] = None):
+        if not self.enabled:
+            return
+        sp = Span(self, name, cat=cat, args=args, ph="e", aid=aid)
+        sp.ts_us = time.perf_counter_ns() / 1e3
+        self._record(sp)
+
+    def _record(self, span: Span):
+        with self._lock:
+            self._ring[self._head] = span
+            self._head = (self._head + 1) % self._cap
+            self._total += 1
+
+    def _enter_depth(self) -> int:
+        d = getattr(self._tls, "depth", 0)
+        self._tls.depth = d + 1
+        return d
+
+    def _exit_depth(self):
+        self._tls.depth = max(0, getattr(self._tls, "depth", 1) - 1)
+
+    def spans(self) -> List[Span]:
+        """Recorded spans, oldest first (at most ``buffer_size``; older
+        records are overwritten — the ring never grows)."""
+        with self._lock:
+            if self._total < self._cap:
+                return [s for s in self._ring[:self._head] if s is not None]
+            return ([s for s in self._ring[self._head:] if s is not None] +
+                    [s for s in self._ring[:self._head] if s is not None])
+
+    @property
+    def dropped(self) -> int:
+        """Spans overwritten by ring wraparound."""
+        return max(0, self._total - self._cap)
+
+    # -------------------------------------------------------------- counters
+    def emit(self, tag: str, value: float, step: Optional[int] = None):
+        """Update the gauge AND queue a monitor event on the process-global
+        pipeline — a convenience for scripts with ONE drain_events()
+        consumer. Library producers (the engine, ServingMetrics) use
+        ``set_counter`` plus their own event buffers instead, so
+        co-resident producers can't steal each other's events. Works with
+        tracing disabled (gauges must not depend on span recording)."""
+        self._counters[tag] = (value, step)
+        self._pending.append((tag, value, 0 if step is None else step))
+
+    def set_counter(self, tag: str, value: float, step: Optional[int] = None):
+        """Gauge-only update (no queued monitor event) — what the engines
+        and the TelemetryMonitor sink use (the sink re-queueing events
+        would loop the pipeline back into itself)."""
+        self._counters[tag] = (value, step)
+
+    def counters(self) -> Dict[str, Any]:
+        return dict(self._counters)
+
+    def drain_events(self):
+        """Take all pending (tag, value, step) monitor events."""
+        out = list(self._pending)
+        self._pending.clear()
+        return out
+
+    # ------------------------------------------------------------------ misc
+    def clear(self):
+        with self._lock:
+            self._ring = [None] * self._cap
+            self._head = 0
+            self._total = 0
+        self._counters.clear()
+        self._pending.clear()
+
+
+class RecompileWatchdog:
+    """Counts jit cache growth per step (recompiles). A shape/dtype change
+    that silently recompiles the train step is the #1 TPU perf cliff; this
+    makes it a counter instead of a mystery.
+
+    ``observe(fn)`` samples ``fn._cache_size()`` and returns how many NEW
+    executables appeared since the last observation of that fn (0 on first
+    sight — the initial compile is expected). Holds a reference to each
+    watched fn so ids stay unique."""
+
+    def __init__(self):
+        self._watched: Dict[int, Any] = {}
+        self.recompiles = 0
+
+    def observe(self, fn, tracer: Optional[Tracer] = None,
+                label: str = "train_step") -> int:
+        size_of = getattr(fn, "_cache_size", None)
+        if size_of is None:
+            return 0
+        try:
+            size = int(size_of())
+        except Exception:
+            return 0
+        prev = self._watched.get(id(fn))
+        self._watched[id(fn)] = (fn, size)
+        if prev is None:
+            return 0
+        delta = max(0, size - prev[1])
+        if delta:
+            self.recompiles += delta
+            if tracer is not None:
+                # gauge-only: the caller owns monitor-event fan-out
+                tracer.set_counter("telemetry/recompiles", self.recompiles)
+                tracer.instant(f"recompile:{label}", cat="warning",
+                               args={"new_executables": delta,
+                                     "total": self.recompiles})
+        return delta
+
+
+_TRACER: Optional[Tracer] = None
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (created disabled; ``DSTPU_TELEMETRY=1``
+    enables it from the environment for script-level use)."""
+    global _TRACER
+    if _TRACER is None:
+        _TRACER = Tracer(
+            enabled=os.environ.get("DSTPU_TELEMETRY", "") in ("1", "true"))
+    return _TRACER
+
+
+def configure_tracer(config=None, **overrides) -> Tracer:
+    """Configure the global tracer from a ``TelemetryConfig`` block
+    (runtime/config.py) or kwargs; returns it."""
+    return get_tracer().configure(config, **overrides)

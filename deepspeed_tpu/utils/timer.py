@@ -64,7 +64,14 @@ class _Timer:
         return val
 
     def mean(self):
-        return self.elapsed_ / max(self.count, 1)
+        # like elapsed(): a still-running interval counts, so a live query
+        # mid-step doesn't under-report (and 0/0 on a never-stopped timer)
+        val = self.elapsed_
+        count = self.count
+        if self.started:
+            val += time.perf_counter() - self.start_time
+            count += 1
+        return val / max(count, 1)
 
     def reset(self):
         self.started = False
@@ -152,6 +159,13 @@ class ThroughputTimer:
 
     def avg_samples_per_sec(self):
         if self.total_elapsed_time > 0:
-            steps = self.global_step_count - self.start_step + 1
+            # accumulation starts at global_step_count == max(start_step, 1)
+            # (stop() increments before the >= start_step check, so step 0
+            # can never accumulate): steps counted since then, floored at 1
+            # so the first measured step — global_step_count == start_step —
+            # can't divide by zero or overcount
+            steps = self.global_step_count - max(self.start_step, 1) + 1
+            if steps < 1:
+                return 0.0
             return self.batch_size * steps / self.total_elapsed_time
         return 0.0
