@@ -84,6 +84,7 @@ def run_350m():
 
 def run_1p3b(stage: int = 2):
     import jax
+    import jax.numpy as jnp
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import GPT2Model, GPT2_1_3B
 
@@ -129,13 +130,13 @@ def run_1p3b(stage: int = 2):
     rng_key = jax.random.fold_in(engine._base_rng, 999)
     with engine.mesh:
         l, gsum = engine._grad_step_fn(engine.params, engine.scaler_state,
-                                       b, rng_key, None)
+                                       b, rng_key, None, jnp.float32(1.0))
     float(l)
     del l, gsum
     t0 = time.perf_counter()
     with engine.mesh:
         l, gsum = engine._grad_step_fn(engine.params, engine.scaler_state,
-                                       b, rng_key, None)
+                                       b, rng_key, None, jnp.float32(1.0))
     float(l)
     dt_compute = time.perf_counter() - t0
     del l, gsum, b

@@ -110,7 +110,8 @@ def audit(name, mesh_kw, config_over, n_devices=8, with_flops=False):
     with engine.mesh:
         lowered = engine._train_step_fn.lower(
             engine.params, engine.opt_state, engine.scaler_state, batch,
-            jnp.float32(1e-3), jax.random.PRNGKey(0), None)
+            jnp.float32(1e-3), jax.random.PRNGKey(0), None,
+            jnp.float32(1.0))
         compiled = lowered.compile()
         hlo = compiled.as_text()
     stats = _collect(hlo)

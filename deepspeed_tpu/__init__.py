@@ -12,6 +12,7 @@ from .version import __version__
 from . import comm
 from . import zero
 from . import telemetry
+from . import resilience
 from .accelerator import get_accelerator, set_accelerator
 from .runtime.config import DeepSpeedConfig
 from .parallel import (initialize_mesh, get_mesh_manager, DeviceMeshManager,

@@ -1,0 +1,31 @@
+"""deepspeed_tpu.resilience — fault tolerance for preemptible fleets.
+
+Checkpoint integrity (SHA-256 manifests + newest→oldest valid-tag
+fallback + keep-last-N retention), retryable checkpoint IO, SIGTERM/SIGINT
+preemption handling with emergency checkpointing, the training failure
+sentinel (NaN/grad-spike policies), and the deterministic fault-injection
+registry every one of those paths is tested through.
+
+Wired through runtime/checkpointing.py, runtime/engine.py, and
+serving/engine.py; configured by the ``"resilience"`` block
+(``ResilienceConfig``) in both training and serving JSON. See
+docs/resilience.md.
+"""
+
+from .config import ResilienceConfig, SENTINEL_POLICIES
+from .faults import KNOWN_FAULTS, FaultInjector, fault, get_injector
+from .manifest import (CheckpointLoadError, MANIFEST_NAME, gc_checkpoints,
+                       list_tags, verify_manifest, write_manifest)
+from .preemption import PreemptionHandler, TrainingPreempted
+from .retry import retry_io
+from .sentinel import SentinelError, TrainingSentinel
+
+__all__ = [
+    "ResilienceConfig", "SENTINEL_POLICIES",
+    "KNOWN_FAULTS", "FaultInjector", "fault", "get_injector",
+    "MANIFEST_NAME", "write_manifest", "verify_manifest", "list_tags",
+    "gc_checkpoints", "CheckpointLoadError",
+    "PreemptionHandler", "TrainingPreempted",
+    "retry_io",
+    "TrainingSentinel", "SentinelError",
+]

@@ -1,0 +1,95 @@
+"""ResilienceConfig — the ``"resilience"`` config block.
+
+Shared between the training config (runtime/config.py) and the serving
+config (serving/config.py), so one JSON vocabulary covers both stacks:
+
+    "resilience": {
+        "verify_on_load": true,
+        "fallback_on_corruption": true,
+        "keep_last_n": 3,
+        "save_retries": 3,
+        "handle_signals": true,
+        "emergency_checkpoint_dir": "/ckpt/emergency",
+        "autosave_interval": 500,
+        "autosave_dir": "/ckpt/auto",
+        "sentinel_policy": "rollback",
+        "sentinel_patience": 3
+    }
+
+See docs/resilience.md for full semantics.
+"""
+
+import dataclasses
+from typing import Optional
+
+from ..runtime.config_utils import ConfigError, DeepSpeedConfigModel
+
+__all__ = ["ResilienceConfig", "SENTINEL_POLICIES"]
+
+SENTINEL_POLICIES = ("off", "warn", "skip", "rollback")
+
+
+@dataclasses.dataclass
+class ResilienceConfig(DeepSpeedConfigModel):
+    # ---- checkpoint integrity -------------------------------------------
+    #: verify the per-file SHA-256 manifest before loading a tag
+    verify_on_load: bool = True
+    #: on a corrupt/partial tag, fall back newest→oldest to the most
+    #: recent valid tag instead of failing the load
+    fallback_on_corruption: bool = True
+    #: keep only the newest N tags after each successful save (0 = keep all)
+    keep_last_n: int = 0
+
+    # ---- retryable IO ---------------------------------------------------
+    #: retry attempts (beyond the first try) for each engine save/load call
+    save_retries: int = 0
+    load_retries: int = 0
+    #: first backoff delay; doubles per retry up to retry_max_backoff_s,
+    #: with uniform jitter in [0.5x, 1.5x]
+    retry_backoff_s: float = 0.5
+    retry_max_backoff_s: float = 8.0
+
+    # ---- preemption handling -------------------------------------------
+    #: install a SIGTERM/SIGINT handler; the engine checkpoints and raises
+    #: TrainingPreempted at the next step boundary (serving: drains)
+    handle_signals: bool = False
+    #: where the emergency checkpoint goes (falls back to autosave_dir,
+    #: then to the directory of the last explicit save_checkpoint call)
+    emergency_checkpoint_dir: Optional[str] = None
+    #: auto-checkpoint every N global steps into autosave_dir (0 = off)
+    autosave_interval: int = 0
+    autosave_dir: Optional[str] = None
+
+    # ---- training sentinel ---------------------------------------------
+    #: off | warn | skip | rollback — what to do about NaN/Inf loss and
+    #: grad-norm spikes. skip/rollback also gate the optimizer update
+    #: inside the compiled step, so a bad step never touches the params.
+    sentinel_policy: str = "off"
+    #: consecutive bad steps before rollback fires (warn/skip act per step)
+    sentinel_patience: int = 1
+    #: grad-norm ceiling counted as a spike (0 = NaN/Inf detection only)
+    sentinel_grad_norm_threshold: float = 0.0
+    #: rollbacks allowed before the sentinel gives up and raises
+    max_rollbacks: int = 3
+
+    def validate(self):
+        if self.sentinel_policy not in SENTINEL_POLICIES:
+            raise ConfigError(
+                f"resilience.sentinel_policy must be one of "
+                f"{SENTINEL_POLICIES}, got {self.sentinel_policy!r}")
+        for name in ("keep_last_n", "save_retries", "load_retries",
+                     "autosave_interval"):
+            if getattr(self, name) < 0:
+                raise ConfigError(f"resilience.{name} must be >= 0")
+        if self.sentinel_patience < 1:
+            raise ConfigError("resilience.sentinel_patience must be >= 1")
+        if self.max_rollbacks < 0:
+            raise ConfigError("resilience.max_rollbacks must be >= 0")
+        if self.retry_backoff_s < 0 or self.retry_max_backoff_s < 0:
+            raise ConfigError("resilience retry backoffs must be >= 0")
+        if self.sentinel_grad_norm_threshold < 0:
+            raise ConfigError(
+                "resilience.sentinel_grad_norm_threshold must be >= 0")
+        if self.autosave_interval and not self.autosave_dir:
+            raise ConfigError(
+                "resilience.autosave_interval requires autosave_dir")

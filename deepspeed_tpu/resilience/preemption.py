@@ -1,0 +1,115 @@
+"""Preemption handling — SIGTERM/SIGINT to clean drain.
+
+TPU pods are preemptible; the Gemma-on-TPU report (PAPERS.md) names host
+reclamation as the dominant fleet failure mode. The OS gives seconds of
+grace after SIGTERM, so the handler does the only async-signal-safe thing —
+set a flag — and the engines act at their next safe boundary:
+
+- ``DeepSpeedEngine.train_batch`` writes an emergency checkpoint and raises
+  ``TrainingPreempted`` *before* consuming the next batch, so resume
+  replays the exact remaining trajectory.
+- ``ServingEngine.step`` stops admissions and drains in-flight requests.
+
+Handlers are process-global state (there is one signal table), so the
+handler is a singleton; ``PreemptionHandler.reset()`` restores the previous
+handlers (the ``faultinject``/autouse test fixtures call it).
+"""
+
+import signal
+import threading
+from typing import Optional, Tuple
+
+from ..utils.logging import logger
+
+__all__ = ["PreemptionHandler", "TrainingPreempted"]
+
+
+class TrainingPreempted(RuntimeError):
+    """Raised at the step boundary after a preemption signal; carries the
+    emergency checkpoint path (or None if no save directory was known)."""
+
+    def __init__(self, message: str, checkpoint_dir: Optional[str] = None):
+        super().__init__(message)
+        self.checkpoint_dir = checkpoint_dir
+
+
+class PreemptionHandler:
+    """Singleton SIGTERM/SIGINT latch. ``preempted`` flips true in the
+    handler; engines poll it at step/tick boundaries."""
+
+    _instance: Optional["PreemptionHandler"] = None
+
+    def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                   signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._prev = {}
+        self._installed = False
+        self._flag = threading.Event()
+        self.last_signum: Optional[int] = None
+
+    # ------------------------------------------------------------- install
+    @classmethod
+    def install(cls, signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                 signal.SIGINT)
+                ) -> "PreemptionHandler":
+        """Install (idempotently) and return the process handler."""
+        if cls._instance is None:
+            cls._instance = cls(signals)
+        cls._instance._install()
+        return cls._instance
+
+    @classmethod
+    def instance(cls) -> Optional["PreemptionHandler"]:
+        return cls._instance
+
+    @classmethod
+    def reset(cls):
+        """Uninstall and drop the singleton (test teardown)."""
+        if cls._instance is not None:
+            cls._instance.uninstall()
+            cls._instance = None
+
+    def _install(self):
+        if self._installed:
+            return
+        try:
+            for sig in self._signals:
+                self._prev[sig] = signal.signal(sig, self._on_signal)
+            self._installed = True
+        except ValueError:
+            # signal.signal only works in the main thread; a worker-thread
+            # engine still gets the simulated path (signal()/fault)
+            logger.warning(
+                "preemption handler not installed (not in main thread); "
+                "only simulated preemption is available")
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    # -------------------------------------------------------------- events
+    def _on_signal(self, signum, frame):
+        # async-signal-safe: set the flag, nothing else
+        self.last_signum = signum
+        self._flag.set()
+
+    def signal(self, signum: Optional[int] = None):
+        """Simulate a preemption (the ``preempt_signal`` fault point and
+        cluster-manager integrations that deliver notice out-of-band)."""
+        self.last_signum = signum
+        self._flag.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self._flag.is_set()
+
+    def clear(self):
+        self._flag.clear()
+        self.last_signum = None

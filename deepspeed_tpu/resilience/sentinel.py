@@ -1,0 +1,89 @@
+"""Training failure sentinel — NaN/Inf-loss and grad-norm-spike detection.
+
+A silently diverging run burns pod-hours: the loss goes NaN at step N and
+nothing notices until a human reads the curves. The sentinel watches every
+step's (loss, grad_norm) on the host and applies the configured policy:
+
+- ``warn``     — log + count (``resilience/sentinel_bad_steps``).
+- ``skip``     — additionally the engine gates the optimizer update inside
+  the compiled step (non-finite grads / grad-norm over threshold skip the
+  ``lax.cond`` update branch), so a bad step never touches the params; the
+  step is accounted in ``engine.skipped_steps`` and the LR does not advance.
+- ``rollback`` — after ``sentinel_patience`` *consecutive* bad steps,
+  reload the last known checkpoint (``resilience/rollbacks``); after
+  ``max_rollbacks`` rollbacks the sentinel raises instead of looping.
+
+The host check costs one device sync per step (the metrics are consumed
+anyway wherever steps_per_print or monitors are on).
+"""
+
+import math
+from typing import Optional
+
+from ..utils.logging import logger
+
+__all__ = ["TrainingSentinel", "SentinelError"]
+
+
+class SentinelError(RuntimeError):
+    """Training health is unrecoverable under the configured policy."""
+
+
+class TrainingSentinel:
+
+    def __init__(self, config, tracer=None):
+        self.policy = config.sentinel_policy
+        self.patience = int(config.sentinel_patience)
+        self.grad_norm_threshold = float(config.sentinel_grad_norm_threshold)
+        self.max_rollbacks = int(config.max_rollbacks)
+        self.tracer = tracer
+        self.bad_steps = 0
+        self.consecutive_bad = 0
+        self.rollbacks = 0
+
+    # --------------------------------------------------------------- detect
+    def is_bad(self, loss: float, grad_norm: float) -> Optional[str]:
+        """The reason this step is unhealthy, or None."""
+        if not math.isfinite(loss):
+            return f"non-finite loss ({loss})"
+        if self.grad_norm_threshold > 0:
+            if not math.isfinite(grad_norm):
+                return f"non-finite grad norm ({grad_norm})"
+            if grad_norm > self.grad_norm_threshold:
+                return (f"grad norm spike ({grad_norm:.3e} > "
+                        f"{self.grad_norm_threshold:.3e})")
+        return None
+
+    # --------------------------------------------------------------- policy
+    def observe(self, loss: float, grad_norm: float, step: int = 0) -> str:
+        """Record one step; returns the action the engine must take:
+        ``"ok"``, ``"warn"``, ``"skip"``, or ``"rollback"``."""
+        reason = self.is_bad(loss, grad_norm)
+        if reason is None:
+            self.consecutive_bad = 0
+            return "ok"
+        self.bad_steps += 1
+        self.consecutive_bad += 1
+        logger.warning(
+            f"sentinel: bad step {step}: {reason} "
+            f"(consecutive={self.consecutive_bad}/{self.patience}, "
+            f"policy={self.policy})")
+        if self.tracer is not None:
+            self.tracer.set_counter("resilience/sentinel_bad_steps",
+                                    float(self.bad_steps), step)
+            self.tracer.instant("sentinel_bad_step", cat="resilience",
+                                args={"reason": reason, "step": step})
+        if self.policy == "rollback" and \
+                self.consecutive_bad >= self.patience:
+            self.consecutive_bad = 0
+            self.rollbacks += 1
+            if self.rollbacks > self.max_rollbacks:
+                raise SentinelError(
+                    f"sentinel: {self.rollbacks - 1} rollback(s) did not "
+                    f"restore training health (max_rollbacks="
+                    f"{self.max_rollbacks}); aborting")
+            if self.tracer is not None:
+                self.tracer.set_counter("resilience/rollbacks",
+                                        float(self.rollbacks), step)
+            return "rollback"
+        return self.policy if self.policy in ("warn", "skip") else "warn"

@@ -7,10 +7,26 @@ orbax-based engine provides async + multi-host sharded saves (the Nebula
 analogue, nebula_checkpoint_engine.py).
 """
 
+import hashlib
 import os
 from typing import Any
 
 from ...utils.logging import logger
+
+
+def _fsync_dir(path):
+    """fsync a directory so a just-renamed entry survives a crash — the
+    rename alone only orders the *file* data, not the directory entry."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platforms without directory fds
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 class CheckpointEngine:
@@ -20,7 +36,10 @@ class CheckpointEngine:
     collective = False
 
     def __init__(self, config_params=None):
-        pass
+        #: abs path -> (sha256, size) of the bytes save() INTENDED to write;
+        #: the integrity manifest (resilience/manifest.py) trusts these over
+        #: a disk re-read, so a torn write mismatches its own manifest
+        self.written = {}
 
     def create(self, tag):
         """Notify start of a new checkpoint `tag` (reference :15)."""
@@ -44,16 +63,35 @@ class MsgpackCheckpointEngine(CheckpointEngine):
 
     def save(self, state_dict, path):
         from flax import serialization
+        from ...resilience.faults import fault
         data = serialization.msgpack_serialize(state_dict)
+        if fault("io_write_fail"):
+            raise OSError(f"injected write failure: {path}")
+        # record intent BEFORE the torn-write fault: a truncated file then
+        # mismatches its own manifest, exactly like a real mid-save crash
+        self.written[os.path.abspath(path)] = (
+            hashlib.sha256(data).hexdigest(), len(data))
+        if fault("io_truncate"):
+            data = data[:max(1, len(data) // 2)]
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)
+            f.flush()
+            # fsync before the rename: os.replace is atomic in the
+            # namespace but NOT durable — a crash after an unfsynced rename
+            # can persist a zero-length file under the final name
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
 
     def load(self, path, map_location=None):
         from flax import serialization
+        from ...resilience.faults import fault
         with open(path, "rb") as f:
-            return serialization.msgpack_restore(f.read())
+            data = f.read()
+        if fault("io_read_corrupt"):
+            data = bytes([data[0] ^ 0xFF]) + data[1:] if data else b"\xc1"
+        return serialization.msgpack_restore(data)
 
 
 class OrbaxCheckpointEngine(CheckpointEngine):

@@ -18,21 +18,88 @@ Layout (reference layout kept recognizable):
     <dir>/<tag>/optim_states.msgpack      — optimizer + loss-scale state
     <dir>/<tag>/engine_state.json         — counters, lr sched, client state
     <dir>/<tag>/ds_config.json            — config snapshot
+    <dir>/<tag>/manifest.json             — per-file SHA-256 integrity map
+
+Fault tolerance (deepspeed_tpu/resilience/, config block ``resilience``):
+every tag carries an integrity manifest written at commit time and verified
+on load; ``latest`` advances only after ``checkpoint_engine.commit()``
+succeeds, via an fsynced atomic rename; a corrupt/partial latest tag falls
+back newest→oldest to the most recent valid tag; engine save/load IO
+retries with jittered exponential backoff (``resilience/ckpt_retries``);
+keep-last-N retention GC runs after each successful save.
 """
 
 import dataclasses
 import json
 import os
+from contextlib import nullcontext
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
+from ..resilience.config import ResilienceConfig
+from ..resilience.manifest import (CheckpointLoadError, gc_checkpoints,
+                                   list_tags, verify_manifest,
+                                   write_manifest)
+from ..resilience.retry import retry_io
 from ..utils.logging import logger, log_dist
-from .checkpoint_engine.checkpoint_engine import get_checkpoint_engine
+from .checkpoint_engine.checkpoint_engine import (_fsync_dir,
+                                                  get_checkpoint_engine)
 from .fp16.loss_scaler import LossScaleState
 
 import jax.numpy as jnp
+
+
+def _rcfg(config) -> ResilienceConfig:
+    r = getattr(config, "resilience", None)
+    return r if r is not None else ResilienceConfig()
+
+
+def _bump(tracer, tag: str, n: int = 1):
+    """Increment a monotonic telemetry counter (gauge holds the total)."""
+    if tracer is None:
+        return
+    cur = tracer.counters().get(tag)
+    val = (cur[0] if isinstance(cur, tuple) else cur or 0.0) + n
+    tracer.set_counter(tag, float(val))
+
+
+def _retrying(ckpt_engine, rcfg: ResilienceConfig, tracer, attempts: int):
+    """Engine save/load calls wrapped in jittered-backoff retry; each retry
+    bumps ``resilience/ckpt_retries``."""
+
+    def call(fn, *args, label):
+        return retry_io(
+            fn, *args, attempts=attempts,
+            base_delay=rcfg.retry_backoff_s,
+            max_delay=rcfg.retry_max_backoff_s,
+            on_retry=lambda i, e: _bump(tracer, "resilience/ckpt_retries"),
+            label=label)
+
+    return call
+
+
+def _write_latest(save_dir, tag):
+    """Advance the ``latest`` pointer durably: fsynced tmp + atomic rename
+    + parent-dir fsync — a crash can only ever leave the OLD pointer."""
+    path = os.path.join(save_dir, "latest")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(tag))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(save_dir)
+
+
+def _read_latest(load_dir) -> Optional[str]:
+    path = os.path.join(load_dir, "latest")
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        tag = f.read().strip()
+    return tag or None
 
 
 def _gather_to_host(engine, tree):
@@ -64,10 +131,48 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
                     save_latest=True):
     if tag is None:
         tag = f"global_step{engine.global_steps}"
+    save_dir = str(save_dir)
+    rcfg = _rcfg(engine._config)
+    tracer = getattr(engine, "tracer", None)
     ckpt_engine = get_checkpoint_engine(engine._config)
+    _save = _retrying(ckpt_engine, rcfg, tracer, rcfg.save_retries)
     ckpt_dir = os.path.join(save_dir, str(tag))
     is_writer = jax.process_index() == 0
+    span = tracer.span("save_checkpoint", cat="resilience",
+                       args={"tag": str(tag)}) \
+        if tracer is not None else nullcontext()
 
+    with span:
+        _save_checkpoint_files(engine, ckpt_engine, _save, ckpt_dir,
+                               tag, client_state, is_writer)
+        # seal BEFORE advancing 'latest': an async write failure surfaces
+        # here (raise or False) and the pointer keeps naming the previous
+        # good checkpoint — never a torn tag
+        if ckpt_engine.commit(tag) is False:
+            raise IOError(
+                f"checkpoint_engine.commit({tag!r}) failed; 'latest' still "
+                f"names the previous checkpoint")
+        if is_writer:
+            # integrity manifest at commit time, from the writer's intended
+            # bytes where known — a torn write mismatches it on load
+            write_manifest(ckpt_dir, tag=str(tag),
+                           intents=getattr(ckpt_engine, "written", None))
+            _emit_zero_to_fp32_script(save_dir)
+            if save_latest:
+                _write_latest(save_dir, tag)
+            if rcfg.keep_last_n:
+                gc_checkpoints(save_dir, rcfg.keep_last_n,
+                               protect=(str(tag),))
+    from .. import comm as dist
+    dist.barrier()
+    log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+    # remembered for sentinel rollback and emergency preemption saves
+    engine._last_save_dir = save_dir
+    return ckpt_dir
+
+
+def _save_checkpoint_files(engine, ckpt_engine, _save, ckpt_dir, tag,
+                           client_state, is_writer):
     ckpt_engine.create(tag)
     # gather on ALL processes (collective); write on the writer — or on all
     # processes for collective engines (orbax)
@@ -95,10 +200,12 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     if is_writer:
         os.makedirs(ckpt_dir, exist_ok=True)
     if is_writer or ckpt_engine.collective:
-        ckpt_engine.save(params_host,
-                         os.path.join(ckpt_dir, "model_states.msgpack"))
-        ckpt_engine.save(optim_state,
-                         os.path.join(ckpt_dir, "optim_states.msgpack"))
+        _save(ckpt_engine.save, params_host,
+              os.path.join(ckpt_dir, "model_states.msgpack"),
+              label="ckpt save model_states")
+        _save(ckpt_engine.save, optim_state,
+              os.path.join(ckpt_dir, "optim_states.msgpack"),
+              label="ckpt save optim_states")
     if is_writer:
         engine_state = {
             "global_steps": engine.global_steps,
@@ -116,18 +223,6 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
             json.dump(engine_state, f, indent=2, default=str)
         with open(os.path.join(ckpt_dir, "ds_config.json"), "w") as f:
             json.dump(engine._config._param_dict, f, indent=2, default=str)
-    # seal BEFORE advancing 'latest': an async write failure raises here
-    # and the pointer keeps naming the previous good checkpoint
-    ckpt_engine.commit(tag)
-    if is_writer:
-        _emit_zero_to_fp32_script(save_dir)
-        if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
-    from .. import comm as dist
-    dist.barrier()
-    log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
-    return ckpt_dir
 
 
 def _engine_for_layout(config, model_states_path):
@@ -153,23 +248,86 @@ def _restore_like(template_shardings, tree):
 
 def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
                     load_lr_scheduler_states=True, load_module_only=False):
-    if tag is None:
-        latest_path = os.path.join(load_dir, "latest")
-        if not os.path.isfile(latest_path):
-            logger.warning(f"no 'latest' file at {load_dir}; nothing loaded")
-            return None, {}
-        with open(latest_path) as f:
-            tag = f.read().strip()
-    ckpt_dir = os.path.join(load_dir, str(tag))
-    if not os.path.isdir(ckpt_dir):
-        logger.warning(f"checkpoint dir {ckpt_dir} missing; nothing loaded")
-        return None, {}
+    """Restore engine state. With ``tag=None``, resolves ``latest`` and —
+    when the resilience config allows — falls back newest→oldest to the
+    most recent tag that passes manifest verification and deserializes.
+    Raises ``CheckpointLoadError`` (naming the directory scanned and every
+    tag found) when nothing is loadable."""
+    load_dir = str(load_dir)
+    rcfg = _rcfg(engine._config)
+    tracer = getattr(engine, "tracer", None)
+    explicit = tag is not None
+    tags_found = list_tags(load_dir)
+    if explicit:
+        candidates = [str(tag)]
+    else:
+        latest_tag = _read_latest(load_dir)
+        if latest_tag is None:
+            raise CheckpointLoadError(
+                f"cannot load checkpoint: no (or empty) 'latest' pointer "
+                f"in {load_dir!r}; tags found: {tags_found or 'none'}. "
+                f"Pass tag= explicitly, or save a checkpoint first.")
+        candidates = [latest_tag]
+        if rcfg.fallback_on_corruption:
+            candidates += [t for t in tags_found if t != latest_tag]
 
+    span = tracer.span("load_checkpoint", cat="resilience",
+                       args={"dir": load_dir}) \
+        if tracer is not None else nullcontext()
+    errors = []
+    with span:
+        for i, cand in enumerate(candidates):
+            ckpt_dir = os.path.join(load_dir, cand)
+            if not os.path.isdir(ckpt_dir):
+                errors.append(f"{cand}: tag directory missing")
+                continue
+            if rcfg.verify_on_load:
+                problems = verify_manifest(ckpt_dir)
+                if problems:
+                    logger.warning(
+                        f"checkpoint {ckpt_dir} failed integrity "
+                        f"verification: {problems}")
+                    errors.append(f"{cand}: " + "; ".join(problems))
+                    continue
+            try:
+                result = _load_tag(engine, ckpt_dir, rcfg, tracer,
+                                   load_optimizer_states,
+                                   load_lr_scheduler_states,
+                                   load_module_only)
+            except Exception as e:  # torn state that slipped past verify
+                logger.warning(f"checkpoint {ckpt_dir} unreadable: {e}")
+                errors.append(f"{cand}: {type(e).__name__}: {e}")
+                continue
+            if i > 0:
+                # rolled back past the (corrupt) latest to an older tag
+                _bump(tracer, "resilience/rollbacks")
+                log_dist(
+                    f"checkpoint fallback: tag '{candidates[0]}' invalid; "
+                    f"restored older valid tag '{cand}'", ranks=[0])
+            return result
+    raise CheckpointLoadError(
+        f"no loadable checkpoint under {load_dir!r}: tried {candidates}; "
+        f"tags found: {tags_found or 'none'}"
+        + (f"; errors: {errors}" if errors else ""))
+
+
+def _load_tag(engine, ckpt_dir, rcfg, tracer, load_optimizer_states,
+              load_lr_scheduler_states, load_module_only):
     ckpt_engine = _engine_for_layout(engine._config,
                                      os.path.join(ckpt_dir,
                                                   "model_states.msgpack"))
-    params = ckpt_engine.load(os.path.join(ckpt_dir, "model_states.msgpack"))
+    _load = _retrying(ckpt_engine, rcfg, tracer, rcfg.load_retries)
     offload = getattr(engine, "_offload", None)
+    need_optim = (load_optimizer_states and not load_module_only and
+                  (engine.opt_state is not None or offload is not None))
+    # all reads complete before any engine state mutates, so a torn file
+    # cannot leave the engine half-restored
+    params = _load(ckpt_engine.load,
+                   os.path.join(ckpt_dir, "model_states.msgpack"),
+                   label="ckpt load model_states")
+    optim = _load(ckpt_engine.load,
+                  os.path.join(ckpt_dir, "optim_states.msgpack"),
+                  label="ckpt load optim_states") if need_optim else None
     if offload is not None:
         # checkpoint holds fp32 masters; host offload owns them — the
         # device-param refresh happens ONCE at the end (after optimizer
@@ -195,9 +353,7 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
                 engine.lr_scheduler.load_state_dict(engine_state["lr_scheduler"])
         client_state = engine_state.get("client_state", {})
 
-    if load_optimizer_states and not load_module_only and \
-            (engine.opt_state is not None or offload is not None):
-        optim = ckpt_engine.load(os.path.join(ckpt_dir, "optim_states.msgpack"))
+    if need_optim:
         if offload is not None and optim.get("offload") is not None:
             offload.load_state_dict(optim["offload"])
         if engine.opt_state is not None and \
@@ -259,13 +415,39 @@ def load_params_for_inference(load_dir, tag=None, like=None, shardings=None,
                               cast=None):
     """Load params from a training checkpoint dir into serving shardings
     (the reference's checkpoint-loading path of InferenceEngine,
-    inference/engine.py:338,419 — here any mp/dp layout reshards on load)."""
-    if tag is None:
-        latest_path = os.path.join(load_dir, "latest")
-        if os.path.isfile(latest_path):
-            with open(latest_path) as f:
-                tag = f.read().strip()
-    ckpt_dir = os.path.join(load_dir, str(tag)) if tag else load_dir
+    inference/engine.py:338,419 — here any mp/dp layout reshards on load).
+    Integrity-checked like the training path: the tag must pass manifest
+    verification, with newest→oldest fallback when ``latest`` is corrupt."""
+    load_dir = str(load_dir)
+    if tag is not None:
+        candidates = [str(tag)]
+    else:
+        latest_tag = _read_latest(load_dir)
+        if latest_tag is None and os.path.exists(
+                os.path.join(load_dir, "model_states.msgpack")):
+            candidates = [""]       # load_dir IS the tag directory
+        elif latest_tag is None:
+            raise CheckpointLoadError(
+                f"cannot load serving params: no 'latest' pointer in "
+                f"{load_dir!r}; tags found: {list_tags(load_dir) or 'none'}")
+        else:
+            candidates = [latest_tag] + [t for t in list_tags(load_dir)
+                                         if t != latest_tag]
+    ckpt_dir, errors = None, []
+    for cand in candidates:
+        d = os.path.join(load_dir, cand) if cand else load_dir
+        problems = verify_manifest(d)
+        if problems:
+            logger.warning(f"serving load: {d} failed verification: "
+                           f"{problems}")
+            errors.append(f"{cand or load_dir}: " + "; ".join(problems))
+            continue
+        ckpt_dir = d
+        break
+    if ckpt_dir is None:
+        raise CheckpointLoadError(
+            f"no loadable checkpoint under {load_dir!r}: tried "
+            f"{candidates}; errors: {errors}")
     params = get_fp32_state_dict_from_checkpoint(ckpt_dir)
     if like is not None:
         want = jax.tree.structure(like)

@@ -231,6 +231,10 @@ class DeepSpeedConfig:
         self.telemetry = TelemetryConfig.from_dict(pd.get(C.TELEMETRY, {}))
         self.flops_profiler = FlopsProfilerConfig.from_dict(pd.get(C.FLOPS_PROFILER, {}))
         self.checkpoint_config = CheckpointConfig.from_dict(pd.get(C.CHECKPOINT, {}))
+        # fault tolerance: checkpoint integrity/fallback, preemption
+        # handling, the training sentinel (deepspeed_tpu/resilience/)
+        from ..resilience.config import ResilienceConfig
+        self.resilience = ResilienceConfig.from_dict(pd.get(C.RESILIENCE, {}))
 
         # ---- scalars ----
         self.steps_per_print = pd.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)

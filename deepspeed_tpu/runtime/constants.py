@@ -82,6 +82,7 @@ CSV_MONITOR = "csv_monitor"
 PROMETHEUS = "prometheus"
 TELEMETRY = "telemetry"
 FLOPS_PROFILER = "flops_profiler"
+RESILIENCE = "resilience"
 
 #############################################
 # Activation checkpointing

@@ -416,6 +416,24 @@ class DeepSpeedEngine:
             except Exception as e:
                 logger.warning(f"monitor disabled: {e}")
 
+        # ---- resilience (deepspeed_tpu/resilience/): training sentinel,
+        #      preemption handling, auto-checkpoint cadence ----
+        rcfg = cfg.resilience
+        self._resilience = rcfg
+        # skip/rollback also gate the optimizer update INSIDE the compiled
+        # step (non-finite grads / grad-norm spikes take the lax.cond skip
+        # branch), so a bad step never touches params or optimizer state
+        self._sentinel_gate = rcfg.sentinel_policy in ("skip", "rollback")
+        self._sentinel = None
+        if rcfg.sentinel_policy != "off":
+            from ..resilience.sentinel import TrainingSentinel
+            self._sentinel = TrainingSentinel(rcfg, tracer=self.tracer)
+        self._preemption = None
+        if rcfg.handle_signals:
+            from ..resilience.preemption import PreemptionHandler
+            self._preemption = PreemptionHandler.install()
+        self._last_save_dir = None   # updated by save_checkpoint
+
         self._grad_acc_buffer = None
         self._grad_acc_count = 0
         self._pending_batch = None
@@ -511,7 +529,10 @@ class DeepSpeedEngine:
 
     def _apply_update(self, params, opt_state, scaler_state, grads, lr,
                       denom):
-        """Unscale/average → clip → cond(update | skip) → scaler update."""
+        """Unscale/average → clip → cond(update | skip) → scaler update.
+        Returns ``applied`` alongside ``finite``: with the sentinel gating
+        (resilience.sentinel_policy skip/rollback), non-finite grads and
+        grad-norm spikes skip the update branch even outside fp16."""
         cfg = self._config
         inv = 1.0 / (denom * scaler_state.scale)
         grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
@@ -520,6 +541,13 @@ class DeepSpeedEngine:
             finite = grads_finite(grads)
         else:
             finite = jnp.bool_(True)
+        applied = finite
+        if self._sentinel_gate:
+            if not cfg.fp16.enabled:
+                applied = grads_finite(grads)
+            thresh = self._resilience.sentinel_grad_norm_threshold
+            if thresh > 0:
+                applied = applied & (grad_norm <= thresh)
 
         def do_update(args):
             p, s = args
@@ -534,14 +562,16 @@ class DeepSpeedEngine:
         def skip(args):
             return args
 
-        new_params, new_opt = lax.cond(finite, do_update, skip,
+        new_params, new_opt = lax.cond(applied, do_update, skip,
                                        (params, opt_state))
+        # the scaler reacts to fp16 overflow only — a sentinel skip must
+        # not halve the loss scale
         new_scaler = update_loss_scale(
             scaler_state, finite, dynamic=self._dynamic_scale,
             scale_window=cfg.fp16.loss_scale_window,
             min_scale=cfg.fp16.min_loss_scale,
             max_hysteresis=cfg.fp16.hysteresis)
-        return new_params, new_opt, new_scaler, finite, grad_norm
+        return new_params, new_opt, new_scaler, finite, grad_norm, applied
 
     def _compile_fns(self):
         if self._param_runner is not None:
@@ -555,10 +585,15 @@ class DeepSpeedEngine:
         rep = NamedSharding(mesh, P())
 
         # --- shared gradient-accumulation body (scan over gas micros) ---
+        # loss_mul is a traced scalar, 1.0 in normal operation; the
+        # ``nan_loss`` fault point passes NaN so injected divergence flows
+        # through the REAL path (NaN loss → NaN grads → sentinel gate)
         def accum_grads(params, scaler_state, batch, rng, pld_theta=None,
-                        ltd_keep=None):
+                        ltd_keep=None, loss_mul=None):
             gas = jax.tree.leaves(batch)[0].shape[0]
             scale = scaler_state.scale
+            if loss_mul is not None:
+                scale = scale * loss_mul
 
             # Cast the fp32 masters ONCE, outside the gas scan — grads wrt
             # the cast tree are identical to chaining through the cast's
@@ -610,17 +645,19 @@ class DeepSpeedEngine:
         # trade the seqlen curriculum makes), cached in _train_step_cache.
         def make_train_step(ltd_keep):
             def train_step(params, opt_state, scaler_state, batch, lr, rng,
-                           pld_theta):
+                           pld_theta, loss_mul):
                 lsum, gsum, gas = accum_grads(params, scaler_state, batch,
-                                              rng, pld_theta, ltd_keep)
-                new_params, new_opt, new_scaler, finite, grad_norm = \
-                    self._apply_update(params, opt_state, scaler_state, gsum,
-                                       lr, denom=jnp.float32(gas))
+                                              rng, pld_theta, ltd_keep,
+                                              loss_mul)
+                new_params, new_opt, new_scaler, finite, grad_norm, applied \
+                    = self._apply_update(params, opt_state, scaler_state,
+                                         gsum, lr, denom=jnp.float32(gas))
                 metrics = {
                     "loss": lsum / (gas * scaler_state.scale),
                     "grad_norm": grad_norm,
                     "loss_scale": scaler_state.scale,
                     "overflow": ~finite,
+                    "applied": applied,
                 }
                 return new_params, new_opt, new_scaler, metrics
 
@@ -628,7 +665,7 @@ class DeepSpeedEngine:
                 train_step,
                 in_shardings=(self.param_shardings, self.opt_state_shardings,
                               None, self._batch_sharding(True), None, None,
-                              None),
+                              None, None),
                 out_shardings=(self.param_shardings,
                                self.opt_state_shardings, None, None),
                 donate_argnums=(0, 1, 2))
@@ -640,15 +677,17 @@ class DeepSpeedEngine:
 
         # --- offload path: grads-only step; host SIMD Adam applies them ---
         def make_grad_step(ltd_keep):
-            def grad_step(params, scaler_state, batch, rng, pld_theta):
+            def grad_step(params, scaler_state, batch, rng, pld_theta,
+                          loss_mul):
                 lsum, gsum, gas = accum_grads(params, scaler_state, batch,
-                                              rng, pld_theta, ltd_keep)
+                                              rng, pld_theta, ltd_keep,
+                                              loss_mul)
                 return lsum / (gas * scaler_state.scale), gsum
 
             return jax.jit(
                 grad_step,
                 in_shardings=(self.param_shardings, None,
-                              self._batch_sharding(True), None, None),
+                              self._batch_sharding(True), None, None, None),
                 out_shardings=(rep, self.grad_shardings))
 
         self._make_grad_step = make_grad_step
@@ -686,12 +725,12 @@ class DeepSpeedEngine:
                                donate_argnums=(0,))
 
         def apply_step(params, opt_state, scaler_state, grads, lr, denom):
-            new_params, new_opt, new_scaler, finite, grad_norm = \
+            new_params, new_opt, new_scaler, finite, grad_norm, applied = \
                 self._apply_update(params, opt_state, scaler_state, grads, lr,
                                    denom)
             return new_params, new_opt, new_scaler, {
                 "grad_norm": grad_norm, "overflow": ~finite,
-                "loss_scale": scaler_state.scale}
+                "applied": applied, "loss_scale": scaler_state.scale}
 
         self._apply_fn = jax.jit(
             apply_step,
@@ -855,7 +894,8 @@ class DeepSpeedEngine:
         self.timers(STEP_GLOBAL_TIMER).stop()
         return metrics
 
-    def _pipelined_offload_step(self, fn, batch, rng, theta, gas):
+    def _pipelined_offload_step(self, fn, batch, rng, theta, gas,
+                                loss_mul=None):
         """One-step-delayed optimizer exchange (reference
         swap_tensor/pipelined_optimizer_swapper.py; round-3 weak #4): the
         grad step for THIS batch is dispatched async, then the host applies
@@ -863,9 +903,11 @@ class DeepSpeedEngine:
         params while the device computes. Params used by step N therefore
         reflect grads through step N-2 — the standard delayed-param-update
         staleness, opted into via offload_optimizer.pipeline_read/write."""
+        if loss_mul is None:
+            loss_mul = jnp.float32(1.0)
         with self.mesh:
             loss, gsum = fn(self.params, self.scaler_state, batch, rng,
-                            theta)
+                            theta, loss_mul)
         # start this step's grad d2h immediately so it lands during the
         # next step's host work
         for g in jax.tree.leaves(gsum):
@@ -930,6 +972,7 @@ class DeepSpeedEngine:
         """Run one full global step (gas × micro) as one compiled program."""
         assert self.optimizer is not None
         cfg = self._config
+        self._check_preemption()
         if batch is None:
             batch = self._next_gas_batch(data_iter)
         batch = self._apply_curriculum(batch)
@@ -945,6 +988,7 @@ class DeepSpeedEngine:
         rng = jax.random.fold_in(self._base_rng, self.global_steps)
         self._maybe_profile_flops(batch, rng)
         theta, keep = self._step_modifiers()
+        loss_mul = self._loss_mul()
         if self.eigenvalue is not None:
             self._last_eig_batch = (jax.tree.map(lambda x: x[0], batch), rng)
         tr = self.tracer
@@ -960,15 +1004,17 @@ class DeepSpeedEngine:
                     self._train_step_cache.setdefault(
                         ("grad", keep), self._make_grad_step(keep))
                 self._maybe_telemetry_flops(
-                    fn, (self.params, self.scaler_state, batch, rng, theta))
+                    fn, (self.params, self.scaler_state, batch, rng, theta,
+                         loss_mul))
                 if self._offload_pipelined:
                     metrics = self._pipelined_offload_step(fn, batch, rng,
-                                                           theta, float(gas))
+                                                           theta, float(gas),
+                                                           loss_mul)
                 else:
                     with tr.span("dispatch", cat="train"):
                         with self.mesh:
                             loss, gsum = fn(self.params, self.scaler_state,
-                                            batch, rng, theta)
+                                            batch, rng, theta, loss_mul)
                     with tr.span("host_opt_step", cat="train"):
                         metrics = self._offload_apply(gsum, denom=float(gas))
                     metrics["loss"] = loss
@@ -979,13 +1025,13 @@ class DeepSpeedEngine:
                         ("train", keep), self._make_train_step(keep))
                 self._maybe_telemetry_flops(
                     fn, (self.params, self.opt_state, self.scaler_state,
-                         batch, lr, rng, theta))
+                         batch, lr, rng, theta, loss_mul))
                 with tr.span("dispatch", cat="train"):
                     with self.mesh:
                         (self.params, self.opt_state, self.scaler_state,
                          metrics) = fn(self.params, self.opt_state,
                                        self.scaler_state, batch, lr, rng,
-                                       theta)
+                                       theta, loss_mul)
             if tr.sync_spans:
                 sp.sync_on(metrics)
         self._telemetry_step_end(fn, step_span)
@@ -1045,10 +1091,11 @@ class DeepSpeedEngine:
         if prof_fn is None:
             return
         lr = jnp.float32(self.get_lr()[0])
-        args = (self.params, self.scaler_state, batch, rng, None) \
+        one = jnp.float32(1.0)
+        args = (self.params, self.scaler_state, batch, rng, None, one) \
             if self._offload is not None else \
             (self.params, self.opt_state, self.scaler_state, batch, lr, rng,
-             None)
+             None, one)
         profiler = FlopsProfiler(fpcfg)
         with self.mesh:
             prof = profiler.profile(prof_fn, *args)
@@ -1154,6 +1201,95 @@ class DeepSpeedEngine:
     def _to_device_batch(self, batch):
         return jax.tree.map(jnp.asarray, batch)
 
+    # ------------------------------------------------------------------
+    # resilience (resilience/): preemption, sentinel, fault injection
+    # ------------------------------------------------------------------
+    def _loss_mul(self):
+        """Traced loss multiplier: 1.0 normally; NaN when the ``nan_loss``
+        fault point fires, so injected divergence exercises the REAL
+        NaN-loss path (grads go NaN inside the compiled step)."""
+        from ..resilience.faults import fault
+        if fault("nan_loss"):
+            logger.warning(
+                f"fault injection: nan_loss at step {self.global_steps}")
+            return jnp.float32(np.nan)
+        return jnp.float32(1.0)
+
+    @property
+    def preempted(self) -> bool:
+        """True once a preemption signal (or injected ``preempt_signal``
+        fault) has been observed; train_batch raises TrainingPreempted at
+        its next call."""
+        return self._preemption is not None and self._preemption.preempted
+
+    def _check_preemption(self):
+        """Step-boundary preemption check: on SIGTERM/SIGINT (or the
+        ``preempt_signal`` fault), write an emergency checkpoint and raise
+        ``TrainingPreempted`` BEFORE consuming the next batch — resume from
+        the emergency checkpoint replays the identical trajectory."""
+        if self._preemption is None:
+            return
+        from ..resilience.faults import fault
+        from ..resilience.preemption import TrainingPreempted
+        if fault("preempt_signal"):
+            self._preemption.signal()
+        if not self._preemption.preempted:
+            return
+        tr = self.tracer
+        tr.set_counter("resilience/preemptions", 1.0, self.global_steps)
+        with tr.span("emergency_checkpoint", cat="resilience",
+                     args={"step": self.global_steps}):
+            ckpt_dir = self._emergency_checkpoint()
+        where = f"at {ckpt_dir}" if ckpt_dir else \
+            "NOT saved (no known checkpoint directory)"
+        raise TrainingPreempted(
+            f"preemption signal received; emergency checkpoint {where} "
+            f"after step {self.global_steps}", checkpoint_dir=ckpt_dir)
+
+    def _emergency_checkpoint(self):
+        rcfg = self._resilience
+        save_dir = (rcfg.emergency_checkpoint_dir or rcfg.autosave_dir or
+                    self._last_save_dir)
+        if save_dir is None:
+            logger.warning(
+                "preempted but no emergency_checkpoint_dir / autosave_dir "
+                "configured and no prior save_checkpoint call; state lost")
+            return None
+        log_dist(f"preemption: writing emergency checkpoint to {save_dir}",
+                 ranks=[0])
+        return self.save_checkpoint(save_dir)
+
+    def _sentinel_rollback(self):
+        """Rollback policy: restore the last known checkpoint (emergency /
+        autosave / last explicit save directory)."""
+        from ..resilience.sentinel import SentinelError
+        rcfg = self._resilience
+        load_dir = (self._last_save_dir or rcfg.autosave_dir or
+                    rcfg.emergency_checkpoint_dir)
+        if load_dir is None:
+            raise SentinelError(
+                "sentinel rollback requested but no checkpoint exists: "
+                "save one (or configure resilience.autosave_dir) before "
+                "enabling sentinel_policy='rollback'")
+        log_dist(f"sentinel: rolling back to last checkpoint in {load_dir} "
+                 f"(rollback #{self._sentinel.rollbacks})", ranks=[0])
+        with self.tracer.span("sentinel_rollback", cat="resilience"):
+            self.load_checkpoint(load_dir)
+
+    def _observe_sentinel(self, metrics) -> str:
+        """Host-side sentinel bookkeeping after a step: feeds this step's
+        (loss, grad_norm) to the sentinel and returns its action ("ok",
+        "warn", "skip", "rollback"). Under skip/rollback the in-step gate
+        already withheld the bad update; this is the accounting half."""
+        if self._sentinel is None:
+            return "ok"
+        loss = metrics.get("loss")
+        gn = metrics.get("grad_norm")
+        return self._sentinel.observe(
+            float(loss) if loss is not None else 0.0,
+            float(gn) if gn is not None else 0.0,
+            step=self.global_steps)
+
     def _step_modifiers(self):
         """Per-step forward modifiers: (pld_theta traced scalar | None,
         ltd_keep static int | None). Stored for _post_step logging."""
@@ -1213,7 +1349,13 @@ class DeepSpeedEngine:
             self._compile_fns()
         self.global_samples += self._config.train_batch_size
         overflow = bool(metrics.get("overflow", False))
-        if overflow:
+        sentinel_action = self._observe_sentinel(metrics)
+        if sentinel_action == "rollback":
+            # restore the last checkpoint and stop accounting this step —
+            # counters/lr below would mutate the just-restored state
+            self._sentinel_rollback()
+            return
+        if overflow or sentinel_action == "skip":
             self.skipped_steps += 1
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step()
@@ -1268,6 +1410,14 @@ class DeepSpeedEngine:
         if tcfg.enabled and tcfg.export_interval and \
                 self.global_steps % tcfg.export_interval == 0:
             self._export_telemetry()
+        rcfg = self._resilience
+        if rcfg.autosave_interval and \
+                self.global_steps % rcfg.autosave_interval == 0:
+            # periodic auto-checkpoint cadence (preemption insurance):
+            # bounds steps-lost to autosave_interval
+            with self.tracer.span("autosave", cat="resilience",
+                                  args={"step": self.global_steps}):
+                self.save_checkpoint(rcfg.autosave_dir)
 
     def _log_memory_breakdown(self):
         """memory_breakdown (reference see_memory_usage): per-device HBM

@@ -201,8 +201,10 @@ class PipelineEngine(DeepSpeedEngine):
         # configs enabling them raise in __init__ — the arg exists only to
         # match the base train_batch calling convention.
         def train_step(params, opt_state, scaler_state, batch, lr, rng,
-                       pld_theta=None):
+                       pld_theta=None, loss_mul=None):
             scale = scaler_state.scale
+            if loss_mul is not None:   # nan_loss fault point (resilience)
+                scale = scale * loss_mul
 
             def scaled_loss(p):
                 return self._pipeline_loss(p, batch, rng) * scale
@@ -210,7 +212,7 @@ class PipelineEngine(DeepSpeedEngine):
             loss, grads = jax.value_and_grad(scaled_loss)(params)
             grads = lax.with_sharding_constraint(
                 grads, jax.tree.map(lambda s: s.spec, self.grad_shardings))
-            new_params, new_opt, new_scaler, finite, grad_norm = \
+            new_params, new_opt, new_scaler, finite, grad_norm, applied = \
                 self._apply_update(params, opt_state, scaler_state, grads, lr,
                                    denom=jnp.float32(1.0))
             metrics = {
@@ -218,6 +220,7 @@ class PipelineEngine(DeepSpeedEngine):
                 "grad_norm": grad_norm,
                 "loss_scale": scaler_state.scale,
                 "overflow": ~finite,
+                "applied": applied,
             }
             return new_params, new_opt, new_scaler, metrics
 
@@ -225,7 +228,7 @@ class PipelineEngine(DeepSpeedEngine):
             train_step,
             in_shardings=(self.param_shardings, self.opt_state_shardings,
                           None, self._batch_sharding(True), None, None,
-                          None),
+                          None, None),
             out_shardings=(self.param_shardings, self.opt_state_shardings,
                            None, None),
             donate_argnums=(0, 1, 2)) if self.optimizer is not None else None
@@ -245,12 +248,12 @@ class PipelineEngine(DeepSpeedEngine):
         self._acc_fn = None
 
         def apply_step(params, opt_state, scaler_state, grads, lr, denom):
-            new_params, new_opt, new_scaler, finite, grad_norm = \
+            new_params, new_opt, new_scaler, finite, grad_norm, applied = \
                 self._apply_update(params, opt_state, scaler_state, grads, lr,
                                    denom)
             return new_params, new_opt, new_scaler, {
                 "grad_norm": grad_norm, "overflow": ~finite,
-                "loss_scale": scaler_state.scale}
+                "applied": applied, "loss_scale": scaler_state.scale}
 
         self._apply_fn = jax.jit(
             apply_step,

@@ -46,6 +46,12 @@ class ServingConfig(DeepSpeedConfigModel):
     # writes trace_output/snapshot_output when set
     telemetry: Any = None
 
+    # resilience (dict -> resilience.config.ResilienceConfig): with
+    # handle_signals, SIGTERM/SIGINT stops admissions and drains in-flight
+    # requests at the next tick (running slots complete, queued requests
+    # are cancelled) — the serving half of preemption handling
+    resilience: Any = None
+
     ALIASES = {"max_seq_len": "max_model_len"}
 
     def validate(self):
@@ -74,3 +80,8 @@ class ServingConfig(DeepSpeedConfigModel):
         if isinstance(self.telemetry, dict):
             from ..runtime.config import TelemetryConfig
             self.telemetry = TelemetryConfig.from_dict(self.telemetry)
+        from ..resilience.config import ResilienceConfig
+        if isinstance(self.resilience, dict):
+            self.resilience = ResilienceConfig.from_dict(self.resilience)
+        elif self.resilience is None:
+            self.resilience = ResilienceConfig()

@@ -60,6 +60,11 @@ class ServingEngine:
         self._requests: Dict[int, Request] = {}
         self._next_id = 0
         self._draining = False
+        self._preempt_drained = False
+        self._preemption = None
+        if config.resilience is not None and config.resilience.handle_signals:
+            from ..resilience.preemption import PreemptionHandler
+            self._preemption = PreemptionHandler.install()
         n_pos = getattr(getattr(engine.module, "config", None),
                         "n_positions", None)
         if n_pos is not None and config.max_model_len > n_pos:
@@ -104,10 +109,31 @@ class ServingEngine:
     def step(self) -> int:
         """One scheduler tick: expire deadlines, admit into free slots
         (prefill), one fused decode step over all active slots. Returns
-        requests still in flight."""
+        requests still in flight. On a preemption signal (SIGTERM/SIGINT
+        or the ``preempt_signal`` fault) the tick becomes a clean drain:
+        admissions stop, running slots complete, queued requests cancel."""
+        if self._check_preemption():
+            return 0
         in_flight = self.scheduler.tick()
         self.metrics.flush()
         return in_flight
+
+    def _check_preemption(self) -> bool:
+        if self._preemption is None or self._draining:
+            return False
+        from ..resilience.faults import fault
+        if fault("preempt_signal"):
+            self._preemption.signal()
+        if not self._preemption.preempted:
+            return False
+        self._preempt_drained = True
+        self.tracer.set_counter("resilience/preemptions", 1.0)
+        log_dist("serving: preemption signal received; draining "
+                 f"({self.active_requests} running, {self.queue_depth} "
+                 f"queued)", ranks=[0])
+        with self.tracer.span("preempt_drain", cat="resilience"):
+            self.drain(serve_queued=False)
+        return True
 
     def run_until_idle(self, max_ticks: int = 100_000) -> int:
         """Tick until no request is queued or running. Returns ticks run."""
@@ -180,6 +206,11 @@ class ServingEngine:
                 log_dist(f"serving telemetry export failed: {e}", ranks=[0])
 
     # ------------------------------------------------------------- inspection
+    @property
+    def preempted(self) -> bool:
+        """True once a preemption signal triggered the clean drain."""
+        return self._preempt_drained
+
     @property
     def queue_depth(self) -> int:
         return len(self.scheduler.queue)

@@ -1,0 +1,342 @@
+"""Fault-injection tests for deepspeed_tpu/resilience/.
+
+Every recovery path is *driven*, not trusted: the ``faultinject`` fixture
+(tests/conftest.py) arms deterministic faults against the library's fault
+points — torn/corrupt/failed checkpoint IO, NaN loss, preemption — and the
+tests assert the configured policy actually recovers: manifest verification
++ newest→oldest tag fallback, retry/backoff, sentinel skip vs rollback,
+SIGTERM emergency save with an identical resumed loss trajectory, and
+keep-last-N retention GC.
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.resilience import (CheckpointLoadError, TrainingPreempted,
+                                      gc_checkpoints, list_tags,
+                                      verify_manifest, write_manifest)
+
+TINY = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                  n_head=4, pad_vocab_to_multiple=8)
+
+
+def cfg(**over):
+    c = {
+        "train_batch_size": 8,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 0,
+    }
+    c.update(over)
+    return c
+
+
+def make_engine(config):
+    return deepspeed_tpu.initialize(model=GPT2Model(TINY), config=config)[0]
+
+
+def batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"input_ids": rng.integers(0, 255, (1, 8, 16), dtype=np.int32)}
+            for _ in range(n)]
+
+
+def params_of(engine):
+    return [np.asarray(x) for x in jax.tree.leaves(engine.get_fp32_params())]
+
+
+def counter(engine, tag):
+    val = engine.tracer.counters().get(tag)
+    return (val[0] if isinstance(val, tuple) else val) or 0.0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: manifest + fallback
+# ---------------------------------------------------------------------------
+def test_manifest_written_and_valid(tmp_path):
+    e = make_engine(cfg())
+    e.train_batch(batch=batches(1)[0])
+    ckpt_dir = e.save_checkpoint(tmp_path)
+    assert os.path.isfile(os.path.join(ckpt_dir, "manifest.json"))
+    assert verify_manifest(ckpt_dir) == []
+
+
+def _two_checkpoints(tmp_path):
+    e = make_engine(cfg())
+    for b in batches(2):
+        e.train_batch(batch=b)
+        e.save_checkpoint(tmp_path)
+    assert (tmp_path / "latest").read_text() == "global_step2"
+    return e
+
+
+def test_corrupt_latest_falls_back_to_previous_valid_tag(tmp_path):
+    _two_checkpoints(tmp_path)
+    p = tmp_path / "global_step2" / "model_states.msgpack"
+    data = bytearray(p.read_bytes())
+    data[10] ^= 0xFF                       # same size, wrong content
+    p.write_bytes(bytes(data))
+
+    e2 = make_engine(cfg())
+    path, _ = e2.load_checkpoint(tmp_path)
+    assert path.endswith("global_step1")
+    assert e2.global_steps == 1
+    assert counter(e2, "resilience/rollbacks") >= 1
+
+
+def test_truncated_latest_falls_back(tmp_path):
+    _two_checkpoints(tmp_path)
+    p = tmp_path / "global_step2" / "model_states.msgpack"
+    p.write_bytes(p.read_bytes()[:100])    # partial write
+
+    e2 = make_engine(cfg())
+    path, _ = e2.load_checkpoint(tmp_path)
+    assert path.endswith("global_step1")
+    assert e2.global_steps == 1
+
+
+def test_all_tags_corrupt_raises_with_context(tmp_path):
+    e = make_engine(cfg())
+    e.train_batch(batch=batches(1)[0])
+    e.save_checkpoint(tmp_path)
+    (tmp_path / "global_step1" / "model_states.msgpack").write_bytes(b"xx")
+    e2 = make_engine(cfg())
+    with pytest.raises(CheckpointLoadError) as ei:
+        e2.load_checkpoint(tmp_path)
+    msg = str(ei.value)
+    assert str(tmp_path) in msg and "global_step1" in msg
+
+
+def test_torn_write_mismatches_its_own_manifest(tmp_path, faultinject):
+    """io_truncate models a crash that let os.replace publish half a file:
+    the manifest (hash of the INTENDED bytes) disagrees, and load falls
+    back to the previous tag."""
+    e = make_engine(cfg())
+    e.train_batch(batch=batches(1)[0])
+    e.save_checkpoint(tmp_path)            # good global_step1
+    e.train_batch(batch=batches(1, seed=1)[0])
+    faultinject.arm("io_truncate")         # tears the next model_states
+    e.save_checkpoint(tmp_path)            # torn global_step2
+    assert verify_manifest(str(tmp_path / "global_step2")) != []
+
+    e2 = make_engine(cfg())
+    path, _ = e2.load_checkpoint(tmp_path)
+    assert path.endswith("global_step1")
+
+
+def test_missing_latest_raises_actionable_error(tmp_path):
+    e = make_engine(cfg())
+    with pytest.raises(CheckpointLoadError) as ei:
+        e.load_checkpoint(tmp_path)
+    assert str(tmp_path) in str(ei.value)
+
+    e.train_batch(batch=batches(1)[0])
+    e.save_checkpoint(tmp_path)
+    os.remove(tmp_path / "latest")
+    with pytest.raises(CheckpointLoadError) as ei:
+        make_engine(cfg()).load_checkpoint(tmp_path)
+    assert "global_step1" in str(ei.value)   # tags found are named
+
+    with pytest.raises(CheckpointLoadError):
+        e.load_checkpoint(tmp_path, tag="no_such_tag")
+
+
+# ---------------------------------------------------------------------------
+# retryable IO
+# ---------------------------------------------------------------------------
+def test_save_retries_injected_write_failures(tmp_path, faultinject):
+    e = make_engine(cfg(resilience={"save_retries": 3,
+                                    "retry_backoff_s": 0.01,
+                                    "retry_max_backoff_s": 0.02}))
+    e.train_batch(batch=batches(1)[0])
+    before = counter(e, "resilience/ckpt_retries")
+    faultinject.arm("io_write_fail", times=2)
+    e.save_checkpoint(tmp_path)
+    assert faultinject.fired["io_write_fail"] == 2
+    assert counter(e, "resilience/ckpt_retries") - before >= 2
+    # the checkpoint written after the retries is fully valid
+    e2 = make_engine(cfg())
+    path, _ = e2.load_checkpoint(tmp_path)
+    assert path is not None
+
+
+def test_failed_save_never_advances_latest(tmp_path, faultinject):
+    e = make_engine(cfg())                 # save_retries=0
+    e.train_batch(batch=batches(1)[0])
+    e.save_checkpoint(tmp_path)
+    e.train_batch(batch=batches(1, seed=1)[0])
+    faultinject.arm("io_write_fail", times=5)
+    with pytest.raises(OSError):
+        e.save_checkpoint(tmp_path)
+    assert (tmp_path / "latest").read_text() == "global_step1"
+    e2 = make_engine(cfg())
+    path, _ = e2.load_checkpoint(tmp_path)
+    assert path.endswith("global_step1")
+
+
+# ---------------------------------------------------------------------------
+# training sentinel
+# ---------------------------------------------------------------------------
+def test_sentinel_warn_counts_but_does_not_skip(faultinject):
+    e = make_engine(cfg(resilience={"sentinel_policy": "warn"}))
+    faultinject.arm("nan_loss")
+    loss = float(e.train_batch(batch=batches(1)[0]))
+    assert not np.isfinite(loss)
+    assert e._sentinel.bad_steps == 1
+    assert e.skipped_steps == 0            # warn observes, never intervenes
+
+
+def test_sentinel_skip_preserves_params(faultinject):
+    e = make_engine(cfg(resilience={"sentinel_policy": "skip"}))
+    bs = batches(3)
+    e.train_batch(batch=bs[0])
+    before = params_of(e)
+    faultinject.arm("nan_loss")
+    loss = float(e.train_batch(batch=bs[1]))
+    assert not np.isfinite(loss)
+    assert e.skipped_steps == 1
+    for a, b in zip(before, params_of(e)):
+        np.testing.assert_array_equal(a, b)  # bad update never applied
+    # training is healthy again on the next step
+    assert np.isfinite(float(e.train_batch(batch=bs[2])))
+    assert e.skipped_steps == 1
+
+
+def test_sentinel_grad_norm_spike_skips():
+    e = make_engine(cfg(resilience={"sentinel_policy": "skip",
+                                    "sentinel_grad_norm_threshold": 1e-12}))
+    before = params_of(e)
+    loss = float(e.train_batch(batch=batches(1)[0]))
+    assert np.isfinite(loss)               # the loss itself is fine
+    assert e.skipped_steps == 1            # but the spike gated the update
+    for a, b in zip(before, params_of(e)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sentinel_rollback_restores_last_checkpoint(tmp_path, faultinject):
+    e = make_engine(cfg(resilience={"sentinel_policy": "rollback",
+                                    "sentinel_patience": 2}))
+    bs = batches(5)
+    e.train_batch(batch=bs[0])
+    e.train_batch(batch=bs[1])
+    e.save_checkpoint(tmp_path)
+    saved = params_of(e)
+    faultinject.arm("nan_loss", times=2)   # two consecutive bad steps
+    e.train_batch(batch=bs[2])
+    assert e.global_steps == 3             # patience not yet exhausted
+    e.train_batch(batch=bs[3])
+    assert e.global_steps == 2             # rolled back to the checkpoint
+    assert e._sentinel.rollbacks == 1
+    assert counter(e, "resilience/rollbacks") >= 1
+    for a, b in zip(saved, params_of(e)):
+        np.testing.assert_array_equal(a, b)
+    assert np.isfinite(float(e.train_batch(batch=bs[4])))
+
+
+# ---------------------------------------------------------------------------
+# preemption: emergency checkpoint + identical resumed trajectory
+# ---------------------------------------------------------------------------
+def test_sigterm_emergency_checkpoint_resumes_identically(tmp_path):
+    bs = batches(6, seed=3)
+    ref = make_engine(cfg())
+    ref_losses = [float(ref.train_batch(batch=b)) for b in bs]
+
+    edir = str(tmp_path / "emergency")
+    e1 = make_engine(cfg(resilience={"handle_signals": True,
+                                     "emergency_checkpoint_dir": edir}))
+    for b in bs[:3]:
+        e1.train_batch(batch=b)
+    os.kill(os.getpid(), signal.SIGTERM)   # a real preemption signal
+    with pytest.raises(TrainingPreempted) as ei:
+        e1.train_batch(batch=bs[3])
+    assert ei.value.checkpoint_dir is not None
+    assert verify_manifest(ei.value.checkpoint_dir) == []
+
+    e2 = make_engine(cfg())
+    e2.load_checkpoint(edir)
+    assert e2.global_steps == 3
+    resumed = [float(e2.train_batch(batch=b)) for b in bs[3:]]
+    np.testing.assert_allclose(resumed, ref_losses[3:], atol=1e-6)
+
+
+def test_injected_preemption_uses_last_save_dir(tmp_path, faultinject):
+    e = make_engine(cfg(resilience={"handle_signals": True}))
+    bs = batches(2)
+    e.train_batch(batch=bs[0])
+    e.save_checkpoint(tmp_path)            # becomes the emergency target
+    e.train_batch(batch=bs[1])
+    faultinject.arm("preempt_signal")
+    with pytest.raises(TrainingPreempted) as ei:
+        e.train_batch(batch=bs[1])
+    assert ei.value.checkpoint_dir == os.path.join(str(tmp_path),
+                                                   "global_step2")
+    assert (tmp_path / "latest").read_text() == "global_step2"
+
+
+def test_serving_preemption_drains_cleanly(faultinject):
+    from deepspeed_tpu.serving import (RequestState, SamplingParams,
+                                       ServingEngine)
+    model = GPT2Model(GPT2Config(vocab_size=128, n_positions=64, n_embd=64,
+                                 n_layer=2, n_head=4, pad_vocab_to_multiple=1,
+                                 dtype="float32"))
+    eng = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    srv = ServingEngine(eng, {"num_slots": 2, "max_model_len": 64,
+                              "resilience": {"handle_signals": True}})
+    rng = np.random.default_rng(0)
+    rids = [srv.submit(rng.integers(1, 127, (5,), dtype=np.int32),
+                       SamplingParams(max_new_tokens=4)) for _ in range(4)]
+    srv.step()                             # one request admitted + decoding
+    faultinject.arm("preempt_signal")
+    assert srv.step() == 0                 # tick became a clean drain
+    assert srv.preempted
+    states = [srv.result(r).state for r in rids]
+    assert states.count(RequestState.FINISHED) >= 1   # running completed
+    assert states.count(RequestState.CANCELLED) >= 1  # queued shed
+    assert all(s in (RequestState.FINISHED, RequestState.CANCELLED)
+               for s in states)
+    with pytest.raises(RuntimeError):
+        srv.submit(np.arange(1, 4, dtype=np.int32))   # admissions closed
+
+
+# ---------------------------------------------------------------------------
+# retention GC + autosave cadence
+# ---------------------------------------------------------------------------
+def test_retention_keeps_exactly_n_tags(tmp_path):
+    e = make_engine(cfg(resilience={"keep_last_n": 2}))
+    for b in batches(4):
+        e.train_batch(batch=b)
+        e.save_checkpoint(tmp_path)
+    assert list_tags(str(tmp_path)) == ["global_step4", "global_step3"]
+    assert (tmp_path / "latest").read_text() == "global_step4"
+    # the survivors are intact
+    e2 = make_engine(cfg())
+    e2.load_checkpoint(tmp_path)
+    assert e2.global_steps == 4
+
+
+def test_gc_never_removes_latest_or_protected(tmp_path):
+    for name in ("global_step1", "global_step2", "global_step3"):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "model_states.msgpack").write_bytes(b"x")
+        write_manifest(str(d), tag=name)
+    (tmp_path / "latest").write_text("global_step1")  # oldest is live
+    removed = gc_checkpoints(str(tmp_path), keep_last_n=1)
+    assert "global_step1" not in removed
+    assert (tmp_path / "global_step1").exists()
+
+
+def test_autosave_interval(tmp_path):
+    adir = str(tmp_path / "auto")
+    e = make_engine(cfg(resilience={"autosave_interval": 2,
+                                    "autosave_dir": adir}))
+    for b in batches(4):
+        e.train_batch(batch=b)
+    assert set(list_tags(adir)) == {"global_step2", "global_step4"}
