@@ -1,9 +1,11 @@
 """Telemetry overhead benchmark: tracer-on vs tracer-off step time.
 
-Runs the same tiny-GPT2 `train_batch` loop twice — telemetry disabled,
-then enabled (spans + MFU counters + recompile watchdog + ring buffer) —
-and writes benchmarks/telemetry_overhead.json with median step times and
-the relative overhead. Asserts the enabled tracer costs < 2% of step time
+Runs the same tiny-GPT2 `train_batch` loop three times — telemetry
+disabled; enabled (spans + MFU counters + recompile watchdog + ring
+buffer); and enabled WITH the goodput ledger and the statusz server
+(an HTTP thread parked on a live port) — and writes
+benchmarks/telemetry_overhead.json with median step times and the
+relative overheads. Asserts both enabled modes cost < 2% of step time
 (the low-overhead contract of deepspeed_tpu/telemetry/).
 
 Both loops block on the loss every step, so the comparison isolates the
@@ -48,7 +50,7 @@ WARMUP = int(os.environ.get("TEL_WARMUP", 5))
 THRESHOLD_PCT = float(os.environ.get("TEL_THRESHOLD_PCT", 2.0))
 
 
-def build_engine(telemetry_enabled: bool):
+def build_engine(telemetry_enabled: bool, full: bool = False):
     model = GPT2Model(GPT2Config(
         vocab_size=256, n_positions=128,
         n_embd=int(os.environ.get("TEL_EMBD", 128)),
@@ -63,46 +65,89 @@ def build_engine(telemetry_enabled: bool):
         "telemetry": {"enabled": telemetry_enabled,
                       # measure span machinery, not the one-time step trace
                       # the MFU counter needs
-                      "mfu": False},
+                      "mfu": False,
+                      # the ledger rides telemetry.enabled; the "on" loop
+                      # isolates the tracer, the "full" loop adds it back
+                      "goodput": full},
+        # full mode: a live introspection server parked on an ephemeral
+        # loopback port while the loop runs
+        "statusz": {"enabled": full, "port": 0},
     })
     return engine
 
 
-def run_loop(telemetry_enabled: bool):
-    engine = build_engine(telemetry_enabled)
+def _apply_mode(telemetry_enabled: bool, full: bool):
+    """The tracer and the ledger are process-global; re-assert a mode
+    before its block (the last-built engine's config would otherwise win
+    for every engine)."""
+    from deepspeed_tpu.telemetry import configure_ledger, get_tracer
+    get_tracer().configure(enabled=telemetry_enabled)
+    configure_ledger(enabled=full)
+
+
+def run_block(engine, n_steps: int, collect=None):
     seq = int(os.environ.get("TEL_SEQ", 64))
     rng = np.random.default_rng(0)
-    times = []
-    for i in range(WARMUP + STEPS):
+    for _ in range(n_steps):
         batch = {"input_ids": rng.integers(
             0, 255, size=(1, engine.train_batch_size, seq), dtype=np.int32)}
         t0 = time.perf_counter()
         loss = engine.train_batch(batch=batch)
-        jax.block_until_ready(loss)      # both loops pay the sync
+        jax.block_until_ready(loss)      # every mode pays the sync
         dt = time.perf_counter() - t0
-        if i >= WARMUP:
-            times.append(dt)
-    return times
+        if collect is not None:
+            collect.append(dt)
 
 
 def main():
     tracer = get_tracer()
 
-    t_off = run_loop(False)
-    assert not tracer.enabled
-    t_on = run_loop(True)
-    assert tracer.enabled and len(tracer.spans()) > 0
+    # one engine per mode; steps run in INTERLEAVED round-robin blocks so
+    # machine drift (thermal, co-tenants) hits all three modes equally —
+    # sequential loops showed several % of drift, swamping the real cost
+    modes = {"off": (False, False), "on": (True, False),
+             "full": (True, True)}
+    engines, times = {}, {name: [] for name in modes}
+    for name, (tel, full) in modes.items():
+        engines[name] = build_engine(tel, full=full)
+    assert engines["full"].statusz is not None and \
+        engines["full"].statusz.port > 0
+    for name, (tel, full) in modes.items():      # compile + warmup
+        _apply_mode(tel, full)
+        run_block(engines[name], WARMUP)
+
+    block = max(1, STEPS // 6)
+    done = 0
+    while done < STEPS:
+        n = min(block, STEPS - done)
+        for name, (tel, full) in modes.items():
+            _apply_mode(tel, full)
+            run_block(engines[name], n, collect=times[name])
+        done += n
+
+    _apply_mode(True, True)
+    assert len(tracer.spans()) > 0
+    from deepspeed_tpu.telemetry.goodput import get_ledger
+    assert get_ledger().snapshot()["buckets"]["productive_step"] > 0
+    t_off, t_on, t_full = times["off"], times["on"], times["full"]
+    for engine in engines.values():
+        engine.close()
 
     off_ms = statistics.median(t_off) * 1e3
     on_ms = statistics.median(t_on) * 1e3
+    full_ms = statistics.median(t_full) * 1e3
     overhead_pct = 100.0 * (on_ms - off_ms) / off_ms
+    overhead_full_pct = 100.0 * (full_ms - off_ms) / off_ms
     result = {
         "steps": STEPS,
         "step_ms_tracer_off_p50": round(off_ms, 4),
         "step_ms_tracer_on_p50": round(on_ms, 4),
+        "step_ms_full_p50": round(full_ms, 4),
         "step_ms_tracer_off_mean": round(statistics.mean(t_off) * 1e3, 4),
         "step_ms_tracer_on_mean": round(statistics.mean(t_on) * 1e3, 4),
+        "step_ms_full_mean": round(statistics.mean(t_full) * 1e3, 4),
         "overhead_pct": round(overhead_pct, 3),
+        "overhead_full_pct": round(overhead_full_pct, 3),
         "threshold_pct": THRESHOLD_PCT,
         "spans_recorded": len(tracer.spans()),
         "devices": jax.device_count(),
@@ -115,7 +160,12 @@ def main():
     assert overhead_pct < THRESHOLD_PCT, (
         f"telemetry overhead {overhead_pct:.2f}% exceeds the "
         f"{THRESHOLD_PCT}% budget")
-    print(f"OK: tracer-on overhead {overhead_pct:.2f}% < {THRESHOLD_PCT}%")
+    assert overhead_full_pct < THRESHOLD_PCT, (
+        f"telemetry+ledger+statusz overhead {overhead_full_pct:.2f}% "
+        f"exceeds the {THRESHOLD_PCT}% budget")
+    print(f"OK: tracer-on overhead {overhead_pct:.2f}%, with goodput "
+          f"ledger + statusz server {overhead_full_pct:.2f}% — both < "
+          f"{THRESHOLD_PCT}%")
 
 
 if __name__ == "__main__":

@@ -141,8 +141,9 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     span = tracer.span("save_checkpoint", cat="resilience",
                        args={"tag": str(tag)}) \
         if tracer is not None else nullcontext()
+    from ..telemetry.goodput import get_ledger
 
-    with span:
+    with get_ledger().track("checkpoint_save"), span:
         _save_checkpoint_files(engine, ckpt_engine, _save, ckpt_dir,
                                tag, client_state, is_writer)
         # seal BEFORE advancing 'latest': an async write failure surfaces
@@ -168,6 +169,10 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
     # remembered for sentinel rollback and emergency preemption saves
     engine._last_save_dir = save_dir
+    history = getattr(engine, "_ckpt_history", None)
+    if history is not None:     # shown on the engine's /statusz page
+        history.append({"kind": "save", "tag": str(tag),
+                        "step": engine.global_steps})
     return ckpt_dir
 
 
@@ -274,8 +279,9 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
     span = tracer.span("load_checkpoint", cat="resilience",
                        args={"dir": load_dir}) \
         if tracer is not None else nullcontext()
+    from ..telemetry.goodput import get_ledger
     errors = []
-    with span:
+    with get_ledger().track("checkpoint_load"), span:
         for i, cand in enumerate(candidates):
             ckpt_dir = os.path.join(load_dir, cand)
             if not os.path.isdir(ckpt_dir):
@@ -304,6 +310,10 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
                 log_dist(
                     f"checkpoint fallback: tag '{candidates[0]}' invalid; "
                     f"restored older valid tag '{cand}'", ranks=[0])
+            history = getattr(engine, "_ckpt_history", None)
+            if history is not None:
+                history.append({"kind": "load", "tag": cand,
+                                "step": engine.global_steps})
             return result
     raise CheckpointLoadError(
         f"no loadable checkpoint under {load_dir!r}: tried {candidates}; "

@@ -131,12 +131,37 @@ class TelemetryConfig(DeepSpeedConfigModel):
     trace_output: Optional[str] = None
     snapshot_output: Optional[str] = None
     export_interval: int = 0
+    #: goodput ledger (telemetry/goodput.py): wall-clock bucket accounting
+    #: alongside the tracer; rides telemetry.enabled, opt out with false
+    goodput: bool = True
 
     def validate(self):
         if self.buffer_size < 16:
             raise ConfigError("telemetry.buffer_size must be >= 16")
         if self.export_interval < 0:
             raise ConfigError("telemetry.export_interval must be >= 0")
+
+
+@dataclasses.dataclass
+class StatuszConfig(DeepSpeedConfigModel):
+    """The ``"statusz"`` config block (telemetry/statusz.py): an opt-in
+    live introspection HTTP server — ``/healthz`` (liveness, tied to
+    drain/preemption state), ``/metrics`` (live Prometheus text),
+    ``/statusz`` (human-readable status page, ``?format=json`` for
+    machines), ``/trace?last_ms=N`` (Chrome trace slice). Disabled by
+    default: no thread, no port. ``port: 0`` binds an ephemeral port
+    (read it back from ``engine.statusz.port``)."""
+    enabled: bool = False
+    host: str = "127.0.0.1"
+    port: int = 0
+    #: how many recent spans the /statusz page shows
+    spans: int = 50
+
+    def validate(self):
+        if not (0 <= int(self.port) <= 65535):
+            raise ConfigError("statusz.port must be in [0, 65535]")
+        if self.spans < 1:
+            raise ConfigError("statusz.spans must be >= 1")
 
 
 @dataclasses.dataclass
@@ -229,6 +254,7 @@ class DeepSpeedConfig:
         self.csv_monitor = MonitorSinkConfig.from_dict(pd.get(C.CSV_MONITOR, {}))
         self.prometheus = MonitorSinkConfig.from_dict(pd.get(C.PROMETHEUS, {}))
         self.telemetry = TelemetryConfig.from_dict(pd.get(C.TELEMETRY, {}))
+        self.statusz = StatuszConfig.from_dict(pd.get(C.STATUSZ, {}))
         self.flops_profiler = FlopsProfilerConfig.from_dict(pd.get(C.FLOPS_PROFILER, {}))
         self.checkpoint_config = CheckpointConfig.from_dict(pd.get(C.CHECKPOINT, {}))
         # fault tolerance: checkpoint integrity/fallback, preemption

@@ -81,6 +81,7 @@ WANDB = "wandb"
 CSV_MONITOR = "csv_monitor"
 PROMETHEUS = "prometheus"
 TELEMETRY = "telemetry"
+STATUSZ = "statusz"
 FLOPS_PROFILER = "flops_profiler"
 RESILIENCE = "resilience"
 

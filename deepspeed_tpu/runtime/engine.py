@@ -22,6 +22,7 @@ and pure functions inside that step:
     DynamicLossScaler + the overflow check collective (stage_1_and_2.py:1848).
 """
 
+import json
 import time
 from collections import deque
 from typing import Any, Dict, Optional
@@ -403,6 +404,14 @@ class DeepSpeedEngine:
         # structured tracer (telemetry/): fwd/bwd/step spans, comm spans,
         # MFU + recompile-watchdog counters; disabled = zero-cost no-ops
         self.tracer = configure_tracer(cfg.telemetry)
+        # goodput ledger (telemetry/goodput.py): wall-clock bucket
+        # accounting — productive step vs compile/recompile/checkpoint/
+        # sentinel/preemption/data-wait badput; rides telemetry.enabled
+        from ..telemetry.goodput import configure_ledger
+        self._ledger = configure_ledger(
+            enabled=cfg.telemetry.enabled and cfg.telemetry.goodput)
+        self._ledger_step_iv = None   # last step interval, for sentinel
+                                      # reclassification in _post_step
         self._watchdog = RecompileWatchdog()
         self._step_flops: Dict[int, int] = {}   # id(step_fn) -> analytic flops
         # per-engine monitor-event buffer (bounded: survives a disabled
@@ -433,6 +442,19 @@ class DeepSpeedEngine:
             from ..resilience.preemption import PreemptionHandler
             self._preemption = PreemptionHandler.install()
         self._last_save_dir = None   # updated by save_checkpoint
+        # recent checkpoint activity, shown on /statusz (appended by
+        # runtime/checkpointing.py and the sentinel rollback path)
+        self._ckpt_history = deque(maxlen=32)
+
+        # ---- statusz introspection server (telemetry/statusz.py):
+        #      /healthz /metrics /statusz /trace — opt-in, off = no thread
+        self.statusz = None
+        self._closed = False
+        if cfg.statusz.enabled:
+            from ..telemetry.statusz import StatuszServer
+            self.statusz = StatuszServer(cfg.statusz, tracer=self.tracer)
+            self.statusz.register("training", self._statusz_section)
+            self.statusz.register_health("training", self._health_check)
 
         self._grad_acc_buffer = None
         self._grad_acc_count = 0
@@ -818,8 +840,9 @@ class DeepSpeedEngine:
                 "every layer per call)")
         self.timers(FORWARD_GLOBAL_TIMER).start()
         tr = self.tracer
-        with tr.span("fwd", cat="train",
-                     args={"micro_step": self.micro_steps}) as sp:
+        g_iv = self._ledger.track("productive_step")
+        with g_iv, tr.span("fwd", cat="train",
+                           args={"micro_step": self.micro_steps}) as sp:
             batch = self._apply_curriculum(batch, min_ndim=2)
             self._pending_batch = self._to_device_batch(batch)
             rng = jax.random.fold_in(self._base_rng, self.micro_steps)
@@ -834,7 +857,11 @@ class DeepSpeedEngine:
                                      scale, theta)
             if tr.sync_spans:
                 sp.sync_on(loss)
-        self._watchdog.observe(fn, tracer=tr, label="fwd")
+        first_sight = not self._watchdog.seen(fn)
+        if self._watchdog.observe(fn, tracer=tr, label="fwd", owner=self):
+            g_iv.reclassify("recompile")
+        elif first_sight:
+            g_iv.reclassify("compile")
         self._pending_grads = grads
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss / scale
@@ -845,7 +872,8 @@ class DeepSpeedEngine:
         assert self._pending_grads is not None, "backward() without forward()"
         self.timers(BACKWARD_GLOBAL_TIMER).start()
         tr = self.tracer
-        with tr.span("bwd", cat="train",
+        with self._ledger.track("productive_step"), \
+             tr.span("bwd", cat="train",
                      args={"micro_step": self.micro_steps}) as sp:
             with tr.span("accumulate", cat="train"):
                 with self.mesh:
@@ -870,8 +898,9 @@ class DeepSpeedEngine:
         assert self._grad_acc_buffer is not None, "step() without backward()"
         self.timers(STEP_GLOBAL_TIMER).start()
         tr = self.tracer
-        with tr.span("step", cat="train",
-                     args={"step": self.global_steps}) as sp:
+        g_iv = self._ledger.track("productive_step")
+        with g_iv, tr.span("step", cat="train",
+                           args={"step": self.global_steps}) as sp:
             if self._offload is not None:
                 with tr.span("host_opt_step", cat="train"):
                     metrics = self._offload_apply(
@@ -890,6 +919,7 @@ class DeepSpeedEngine:
                     sp.sync_on(metrics)
         self._grad_acc_buffer = None
         self._grad_acc_count = 0
+        self._ledger_step_iv = g_iv   # _post_step may reclassify (sentinel)
         self._post_step(metrics)
         self.timers(STEP_GLOBAL_TIMER).stop()
         return metrics
@@ -978,8 +1008,11 @@ class DeepSpeedEngine:
         batch = self._apply_curriculum(batch)
         if self._param_runner is not None:
             self.tput_timer.start()
-            metrics = self._param_runner.train_batch(batch)
+            g_iv = self._ledger.track("productive_step")
+            with g_iv:
+                metrics = self._param_runner.train_batch(batch)
             self.micro_steps += cfg.gradient_accumulation_steps
+            self._ledger_step_iv = g_iv
             self._post_step(metrics)
             self.tput_timer.stop(global_step=True)
             return metrics["loss"]
@@ -994,7 +1027,9 @@ class DeepSpeedEngine:
         tr = self.tracer
         step_span = tr.span("train_batch", cat="train",
                             args={"step": self.global_steps})
-        with step_span as sp:
+        g_iv = self._ledger.track("productive_step")
+        fn = None
+        with g_iv, step_span as sp:
             if self._offload is not None:
                 # denom = the batch's ACTUAL gas dim (accum_grads derives gas
                 # the same way), not the config value — they can legitimately
@@ -1034,7 +1069,17 @@ class DeepSpeedEngine:
                                        theta, loss_mul)
             if tr.sync_spans:
                 sp.sync_on(metrics)
+        # goodput classification: a step that paid the initial XLA compile
+        # or a watchdog-flagged recompile was not productive step time —
+        # the first sight is read BEFORE _telemetry_step_end registers fn
+        first_sight = fn is not None and not self._watchdog.seen(fn)
+        rc_before = self._watchdog.recompiles
         self._telemetry_step_end(fn, step_span)
+        if first_sight:
+            g_iv.reclassify("compile")
+        elif self._watchdog.recompiles > rc_before:
+            g_iv.reclassify("recompile")
+        self._ledger_step_iv = g_iv
         self.micro_steps += cfg.gradient_accumulation_steps
         self._post_step(metrics)
         self.tput_timer.stop(global_step=True)
@@ -1154,14 +1199,15 @@ class DeepSpeedEngine:
         step = self.global_steps
 
         def gauge(tag, value):
-            tr.set_counter(tag, value, step)
+            tr.set_counter(tag, value, step, owner=self)
             self._telemetry_events.append((tag, value, step))
 
         dur_s = span.dur_us / 1e6
         gauge("telemetry/step_time_ms", span.dur_us / 1e3)
         # recompile watchdog: a shape/dtype change that grew the jit cache
         # this step is a perf cliff — count it, don't guess
-        if self._watchdog.observe(fn, tracer=tr, label="train_batch"):
+        if self._watchdog.observe(fn, tracer=tr, label="train_batch",
+                                  owner=self):
             gauge("telemetry/recompiles", float(self._watchdog.recompiles))
         stats = jax.local_devices()[0].memory_stats() or {}
         peak = stats.get("peak_bytes_in_use")
@@ -1193,9 +1239,11 @@ class DeepSpeedEngine:
             logger.warning(f"telemetry export failed: {e}")
 
     def _next_gas_batch(self, data_iter):
-        """Stack gas micro-batches from an iterator into [gas, ...] leaves."""
+        """Stack gas micro-batches from an iterator into [gas, ...] leaves.
+        Time blocked on the input pipeline is ``data_wait`` badput."""
         gas = self._config.gradient_accumulation_steps
-        micros = [next(data_iter) for _ in range(gas)]
+        with self._ledger.track("data_wait"):
+            micros = [next(data_iter) for _ in range(gas)]
         return jax.tree.map(lambda *xs: np.stack(xs), *micros)
 
     def _to_device_batch(self, batch):
@@ -1236,10 +1284,14 @@ class DeepSpeedEngine:
         if not self._preemption.preempted:
             return
         tr = self.tracer
-        tr.set_counter("resilience/preemptions", 1.0, self.global_steps)
+        tr.set_counter("resilience/preemptions", 1.0, self.global_steps,
+                       owner=self)
         with tr.span("emergency_checkpoint", cat="resilience",
                      args={"step": self.global_steps}):
-            ckpt_dir = self._emergency_checkpoint()
+            # outermost-wins: the emergency save's IO counts as
+            # 'preemption' badput, not 'checkpoint_save'
+            with self._ledger.track("preemption"):
+                ckpt_dir = self._emergency_checkpoint()
         where = f"at {ckpt_dir}" if ckpt_dir else \
             "NOT saved (no known checkpoint directory)"
         raise TrainingPreempted(
@@ -1274,7 +1326,13 @@ class DeepSpeedEngine:
         log_dist(f"sentinel: rolling back to last checkpoint in {load_dir} "
                  f"(rollback #{self._sentinel.rollbacks})", ranks=[0])
         with self.tracer.span("sentinel_rollback", cat="resilience"):
-            self.load_checkpoint(load_dir)
+            # outermost-wins: the checkpoint load inside lands in the
+            # ledger's 'sentinel' bucket, not 'checkpoint_load'
+            with self._ledger.track("sentinel"):
+                self.load_checkpoint(load_dir)
+        self._ckpt_history.append(
+            {"kind": "rollback", "dir": str(load_dir),
+             "step": self.global_steps})
 
     def _observe_sentinel(self, metrics) -> str:
         """Host-side sentinel bookkeeping after a step: feeds this step's
@@ -1350,6 +1408,12 @@ class DeepSpeedEngine:
         self.global_samples += self._config.train_batch_size
         overflow = bool(metrics.get("overflow", False))
         sentinel_action = self._observe_sentinel(metrics)
+        if sentinel_action in ("skip", "rollback") and \
+                self._ledger_step_iv is not None:
+            # the step's work was withheld/thrown away — its wall time is
+            # sentinel badput, not productive training
+            self._ledger_step_iv.reclassify("sentinel")
+            self._ledger_step_iv = None
         if sentinel_action == "rollback":
             # restore the last checkpoint and stop accounting this step —
             # counters/lr below would mutate the just-restored state
@@ -1386,7 +1450,7 @@ class DeepSpeedEngine:
             events = [(tag, float(value), samples)
                       for tag, value, samples in events]
             for tag, value, samples in events:
-                self.tracer.set_counter(tag, value, samples)
+                self.tracer.set_counter(tag, value, samples, owner=self)
             events.extend(self._telemetry_events)
             self._telemetry_events.clear()
             self.monitor.write_events(events)
@@ -1438,6 +1502,66 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # introspection / properties (reference engine property surface)
     # ------------------------------------------------------------------
+    def close(self):
+        """Release this engine's observability footprint: stop the statusz
+        server (port + thread), close the monitor sinks, and retract this
+        engine's gauges from the shared telemetry counter space — with two
+        co-resident engines, prometheus_dump()//metrics must not keep
+        reporting a closed engine's last step time as live. Idempotent;
+        params/optimizer state are untouched (a closed engine can still
+        train, it just stops being observable)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.statusz is not None:
+            self.statusz.close()
+        if self.monitor is not None:
+            self.monitor.close()
+        self.tracer.release_counters(self)
+
+    def _health_check(self):
+        """Training liveness: unhealthy once a preemption signal latched
+        (the engine is about to checkpoint and raise)."""
+        if self.preempted:
+            return False, "preempted"
+        return True, f"training (step {self.global_steps})"
+
+    def _statusz_section(self) -> dict:
+        import hashlib
+        cfg_bytes = json.dumps(self._config._param_dict, sort_keys=True,
+                               default=str).encode()
+        counters = self.tracer.counters()
+
+        def gauge(tag):
+            val = counters.get(tag)
+            return round(val[0], 4) if val is not None else None
+
+        out = {
+            "config_fingerprint": hashlib.sha256(cfg_bytes).hexdigest()[:12],
+            "global_steps": self.global_steps,
+            "skipped_steps": self.skipped_steps,
+            "global_samples": self.global_samples,
+            "lr": self.get_lr()[0],
+            "recompiles": self._watchdog.recompiles,
+            "zero_stage": self.zero_stage,
+            "mesh": f"pp{self.mesh_manager.pp}/dp{self.mesh_manager.dp}/"
+                    f"ep{self.mesh_manager.ep}/sp{self.mesh_manager.sp}/"
+                    f"tp{self.mesh_manager.tp}",
+        }
+        for tag in ("telemetry/step_time_ms", "telemetry/mfu",
+                    "telemetry/step_tflops", "telemetry/peak_hbm_gib"):
+            val = gauge(tag)
+            if val is not None:
+                out[tag.split("/", 1)[1]] = val
+        if self._ckpt_history:
+            out["checkpoint_history"] = "; ".join(
+                f"{e['kind']}@step{e['step']}:{e.get('tag', e.get('dir'))}"
+                for e in list(self._ckpt_history)[-8:])
+        if self._sentinel is not None:
+            out["sentinel_bad_steps"] = self._sentinel.bad_steps
+            out["sentinel_rollbacks"] = self._sentinel.rollbacks
+        return out
+
     def _dump_state(self) -> str:
         """dump_state (reference engine dump): a one-shot engine summary
         for debugging config resolution."""

@@ -16,6 +16,38 @@ from ..runtime.config_utils import ConfigError, DeepSpeedConfigModel
 
 
 @dataclasses.dataclass
+class SLOConfig(DeepSpeedConfigModel):
+    """The serving ``"slo"`` block (serving/metrics.py): sliding-window
+    latency percentiles + error-budget burn rate against configurable
+    targets. ``window`` bounds the percentile sources (a long-running
+    replica's memory stays O(window)); each ``*_ms`` target is optional —
+    unset targets track percentiles but contribute no violations. The
+    burn-rate gauge is observed violation rate ÷ allowed violation rate
+    (``1 - target``): 1.0 = burning budget exactly as fast as allowed,
+    >1 = out of SLO."""
+    #: sliding-window size (latency samples kept per metric)
+    window: int = 1024
+    #: time-to-first-token target, ms (p{quantile} must stay under it)
+    ttft_ms: Optional[float] = None
+    #: time-per-output-token target, ms (fused decode-step wall time)
+    tpot_ms: Optional[float] = None
+    #: end-to-end request latency target, ms
+    e2e_ms: Optional[float] = None
+    #: fraction of samples that must meet each target (0.99 = "p99 SLO")
+    target: float = 0.99
+
+    def validate(self):
+        if self.window < 8:
+            raise ConfigError("slo.window must be >= 8")
+        if not (0.0 < self.target < 1.0):
+            raise ConfigError("slo.target must be in (0, 1)")
+        for name in ("ttft_ms", "tpot_ms", "e2e_ms"):
+            val = getattr(self, name)
+            if val is not None and val <= 0:
+                raise ConfigError(f"slo.{name} must be > 0 when set")
+
+
+@dataclasses.dataclass
 class ServingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving knobs (deepspeed_tpu/serving/)."""
 
@@ -45,6 +77,15 @@ class ServingConfig(DeepSpeedConfigModel):
     # queue→prefill→decode→complete spans + decode-tick spans; shutdown()
     # writes trace_output/snapshot_output when set
     telemetry: Any = None
+
+    # statusz (dict -> runtime.config.StatuszConfig): live introspection
+    # server — /healthz goes 503 while this replica drains, so a balancer
+    # stops routing before the process exits
+    statusz: Any = None
+
+    # slo (dict -> SLOConfig): sliding-window TTFT/TPOT/e2e percentiles
+    # and error-budget burn rate (serving/metrics.py)
+    slo: Any = None
 
     # resilience (dict -> resilience.config.ResilienceConfig): with
     # handle_signals, SIGTERM/SIGINT stops admissions and drains in-flight
@@ -80,6 +121,15 @@ class ServingConfig(DeepSpeedConfigModel):
         if isinstance(self.telemetry, dict):
             from ..runtime.config import TelemetryConfig
             self.telemetry = TelemetryConfig.from_dict(self.telemetry)
+        from ..runtime.config import StatuszConfig
+        if isinstance(self.statusz, dict):
+            self.statusz = StatuszConfig.from_dict(self.statusz)
+        elif self.statusz is None:
+            self.statusz = StatuszConfig()
+        if isinstance(self.slo, dict):
+            self.slo = SLOConfig.from_dict(self.slo)
+        elif self.slo is None:
+            self.slo = SLOConfig()
         from ..resilience.config import ResilienceConfig
         if isinstance(self.resilience, dict):
             self.resilience = ResilienceConfig.from_dict(self.resilience)

@@ -52,9 +52,25 @@ class ServingEngine:
         from ..telemetry.trace import configure_tracer
         self.tracer = configure_tracer(config.telemetry) \
             if config.telemetry is not None else configure_tracer()
+        from ..telemetry.goodput import configure_ledger, get_ledger
+        tcfg = config.telemetry
+        if tcfg is not None:
+            # config wins, same contract as configure_tracer; without a
+            # telemetry block the process-global ledger state stands (a
+            # co-resident training engine may have enabled it)
+            configure_ledger(enabled=bool(
+                getattr(tcfg, "enabled", False) and
+                getattr(tcfg, "goodput", True)))
+        self._ledger = get_ledger()
         self.metrics = ServingMetrics(monitor=self.monitor,
                                       monitor_interval=config.monitor_interval,
-                                      tracer=self.tracer)
+                                      tracer=self.tracer, slo=config.slo)
+        self.statusz = None
+        if getattr(config.statusz, "enabled", False):
+            from ..telemetry.statusz import StatuszServer
+            self.statusz = StatuszServer(config.statusz, tracer=self.tracer)
+            self.statusz.register("serving", self._statusz_section)
+            self.statusz.register_health("serving", self._health_check)
         self.scheduler = ContinuousBatchingScheduler(
             engine, config, metrics=self.metrics, clock=clock, seed=seed)
         self._requests: Dict[int, Request] = {}
@@ -114,7 +130,9 @@ class ServingEngine:
         admissions stop, running slots complete, queued requests cancel."""
         if self._check_preemption():
             return 0
-        in_flight = self.scheduler.tick()
+        bucket = "serving_drain" if self._draining else "serving_step"
+        with self._ledger.track(bucket):
+            in_flight = self.scheduler.tick()
         self.metrics.flush()
         return in_flight
 
@@ -127,12 +145,13 @@ class ServingEngine:
         if not self._preemption.preempted:
             return False
         self._preempt_drained = True
-        self.tracer.set_counter("resilience/preemptions", 1.0)
+        self.tracer.set_counter("resilience/preemptions", 1.0, owner=self)
         log_dist("serving: preemption signal received; draining "
                  f"({self.active_requests} running, {self.queue_depth} "
                  f"queued)", ranks=[0])
         with self.tracer.span("preempt_drain", cat="resilience"):
-            self.drain(serve_queued=False)
+            with self._ledger.track("preemption"):
+                self.drain(serve_queued=False)
         return True
 
     def run_until_idle(self, max_ticks: int = 100_000) -> int:
@@ -187,8 +206,10 @@ class ServingEngine:
 
     def shutdown(self, serve_queued: bool = True):
         """Drain, flush metrics, close monitor sinks (releases the CSV
-        file handles MonitorMaster holds), and write the configured
-        telemetry exports (telemetry.trace_output / snapshot_output)."""
+        file handles MonitorMaster holds), write the configured telemetry
+        exports (telemetry.trace_output / snapshot_output), stop the
+        statusz server, and retract this engine's gauges from the shared
+        telemetry counter space."""
         self.drain(serve_queued=serve_queued)
         if self.monitor is not None:
             self.monitor.close()
@@ -204,6 +225,47 @@ class ServingEngine:
                                    extra={"serving": self.metrics.summary()})
             except OSError as e:
                 log_dist(f"serving telemetry export failed: {e}", ranks=[0])
+        if self.statusz is not None:
+            self.statusz.close()
+        # gauge lifecycle: a closed engine's queue depth / TTFT must not
+        # survive in prometheus_dump() or /metrics as if it were live
+        self.metrics.close()
+        self.tracer.release_counters(self)
+
+    # ------------------------------------------------------------- statusz
+    def _health_check(self):
+        """Load-balancer liveness: unhealthy the moment drain starts (or
+        a preemption landed), so routing stops BEFORE in-flight work
+        finishes — the window where new submits would be rejected."""
+        if self._preempt_drained:
+            return False, "preempted (drained)"
+        if self._draining:
+            return False, "draining"
+        return True, "serving"
+
+    def _statusz_section(self) -> dict:
+        out = {
+            "queue_depth": self.queue_depth,
+            "active_requests": self.active_requests,
+            "num_slots": self.config.num_slots,
+            "slot_occupancy": round(
+                self.active_requests / self.config.num_slots, 3),
+            "submitted": self.metrics.submitted,
+            "completed": self.metrics.completed,
+            "rejected": self.metrics.rejected,
+            "timeouts": self.metrics.timeouts,
+            "tokens_out": self.metrics.tokens_out,
+            "draining": self._draining,
+        }
+        for name, ps in self.metrics.percentiles().items():
+            if ps["n"]:
+                out[f"{name}_p50/p95/p99"] = \
+                    f'{ps["p50"]} / {ps["p95"]} / {ps["p99"]}'
+        slo = self.metrics.slo_status()
+        if any(m.get("target_ms") is not None
+               for m in slo["metrics"].values()):
+            out["slo_burn_rate"] = slo["burn_rate"]
+        return out
 
     # ------------------------------------------------------------- inspection
     @property

@@ -1,17 +1,28 @@
-"""Serving metrics.
+"""Serving metrics + sliding-window SLO tracking.
 
-TTFT / per-token latency / queue depth / slot utilization, recorded
-host-side by the scheduler. Every gauge lands in the process-wide
-telemetry counters (telemetry/trace.py) — so the metrics snapshot and the
-Prometheus dump see serving state live — while the monitor events buffer
-PER ENGINE and ``flush()`` fans them into ``MonitorMaster.write_events``,
-the same sink set training metrics ride, so a serving job lands next to
-its training job in TensorBoard/W&B/CSV and in the Prometheus sink. The
-event buffer is deliberately per-instance, not the tracer's global queue:
-two engines in one process must not drain each other's events.
+TTFT / per-token latency / end-to-end latency / queue depth / slot
+utilization, recorded host-side by the scheduler. Every gauge lands in
+the process-wide telemetry counters (telemetry/trace.py) — so the metrics
+snapshot, the Prometheus dump, and ``/statusz`` see serving state live —
+while the monitor events buffer PER ENGINE and ``flush()`` fans them into
+``MonitorMaster.write_events``, the same sink set training metrics ride.
+The event buffer is deliberately per-instance, not the tracer's global
+queue: two engines in one process must not drain each other's events.
+Gauges are written with this instance as their *owner*, so ``close()``
+retracts them — a shut-down replica's queue depth must not linger in
+``/metrics`` as if it were live.
+
+Latency percentile sources are **bounded sliding windows**
+(``deque(maxlen=slo.window)``): a replica serving millions of requests
+keeps O(window) memory, and the percentiles describe *recent* behavior —
+what an SLO is about. The SLO tracker compares the windows against the
+configured targets (``slo.ttft_ms`` / ``tpot_ms`` / ``e2e_ms`` at
+``slo.target``) and publishes a burn-rate gauge: observed violation rate
+÷ allowed violation rate (>1 = out of budget).
 """
 
-from typing import List, Optional, Tuple
+from collections import deque
+from typing import Dict, List, Optional, Tuple
 
 from ..telemetry.trace import get_tracer
 
@@ -29,12 +40,17 @@ class ServingMetrics:
     optional MonitorMaster fan-out on ``flush()``."""
 
     def __init__(self, monitor=None, monitor_interval: int = 16,
-                 tracer=None):
+                 tracer=None, slo=None):
         self.monitor = monitor
         self.monitor_interval = monitor_interval
         self.tracer = tracer or get_tracer()
-        self.ttft_ms: List[float] = []
-        self.token_ms: List[float] = []      # per-token decode-step latency
+        self.slo = slo
+        window = int(getattr(slo, "window", 1024) or 1024)
+        self.window = window
+        # bounded percentile sources: O(window) forever
+        self.ttft_ms: "deque[float]" = deque(maxlen=window)
+        self.token_ms: "deque[float]" = deque(maxlen=window)
+        self.e2e_ms: "deque[float]" = deque(maxlen=window)
         self.submitted = 0
         self.completed = 0
         self.rejected = 0
@@ -42,6 +58,7 @@ class ServingMetrics:
         self.tokens_out = 0
         self.ticks = 0
         self._events: List[Tuple[str, float, int]] = []
+        self._closed = False
 
     # ------------------------------------------------------------- recording
     def record_submit(self):
@@ -70,18 +87,82 @@ class ServingMetrics:
     def record_completion(self, request):
         self.completed += 1
         self._emit("serving/completed", self.completed)
+        finish = getattr(request, "finish_time", None)
+        submit = getattr(request, "submit_time", None)
+        if finish is not None and submit is not None and finish >= submit:
+            e2e = (finish - submit) * 1e3
+            self.e2e_ms.append(e2e)
+            self._emit("serving/e2e_ms", e2e)
 
     def record_tick(self, queue_depth: int, slot_utilization: float):
         self.ticks += 1
         if self.ticks % self.monitor_interval == 0 or self.ticks == 1:
             self._emit("serving/queue_depth", queue_depth)
             self._emit("serving/slot_utilization", slot_utilization)
+            self._emit_slo_gauges()
+
+    # ------------------------------------------------------------------ SLO
+    def _slo_targets(self) -> Dict[str, Optional[float]]:
+        return {"ttft_ms": getattr(self.slo, "ttft_ms", None),
+                "tpot_ms": getattr(self.slo, "tpot_ms", None),
+                "e2e_ms": getattr(self.slo, "e2e_ms", None)}
+
+    def _windows(self) -> Dict[str, "deque[float]"]:
+        return {"ttft_ms": self.ttft_ms, "tpot_ms": self.token_ms,
+                "e2e_ms": self.e2e_ms}
+
+    def percentiles(self) -> Dict[str, Dict[str, float]]:
+        """p50/p95/p99 over the sliding windows, per latency metric."""
+        out = {}
+        for name, window in self._windows().items():
+            vals = sorted(window)
+            out[name] = {"p50": round(_percentile(vals, 0.50), 3),
+                         "p95": round(_percentile(vals, 0.95), 3),
+                         "p99": round(_percentile(vals, 0.99), 3),
+                         "n": len(vals)}
+        return out
+
+    def slo_status(self) -> Dict[str, object]:
+        """Per-metric in-window violation fraction + the overall burn
+        rate (worst metric). Metrics without a configured target report
+        percentiles only."""
+        target = float(getattr(self.slo, "target", 0.99) or 0.99)
+        allowed = max(1e-9, 1.0 - target)
+        targets = self._slo_targets()
+        metrics = {}
+        burn = 0.0
+        for name, window in self._windows().items():
+            limit = targets.get(name)
+            entry = {"target_ms": limit, "n": len(window)}
+            if limit is not None and window:
+                bad = sum(1 for v in window if v > limit)
+                rate = bad / len(window)
+                entry["violation_rate"] = round(rate, 6)
+                entry["burn_rate"] = round(rate / allowed, 4)
+                burn = max(burn, entry["burn_rate"])
+            metrics[name] = entry
+        return {"target_quantile": target, "burn_rate": round(burn, 4),
+                "metrics": metrics}
+
+    def _emit_slo_gauges(self):
+        pct = self.percentiles()
+        for name, ps in pct.items():
+            if ps["n"]:
+                for q in ("p50", "p95", "p99"):
+                    self._gauge(f"serving/{name}_{q}", ps[q])
+        if any(v is not None for v in self._slo_targets().values()):
+            self._gauge("serving/slo_burn_rate",
+                        self.slo_status()["burn_rate"])
 
     # ------------------------------------------------------------- fan-out
+    def _gauge(self, tag: str, value: float):
+        """Gauge-only (no monitor event), owned by this instance."""
+        self.tracer.set_counter(tag, float(value), self.ticks, owner=self)
+
     def _emit(self, tag: str, value: float):
         """Gauge into the shared telemetry counters (snapshot/Prometheus
         see it live) + a per-engine monitor event."""
-        self.tracer.set_counter(tag, float(value), self.ticks)
+        self._gauge(tag, value)
         if self.monitor is not None:
             self._events.append((tag, float(value), self.ticks))
 
@@ -91,10 +172,19 @@ class ServingMetrics:
             self.monitor.write_events(self._events)
             self._events = []
 
+    def close(self):
+        """Retract this instance's gauges from the shared counter space —
+        prometheus_dump()/​/metrics must not keep reporting a closed
+        engine's last values as live. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.flush()
+        self.tracer.release_counters(self)
+
     # ------------------------------------------------------------- summary
     def summary(self, wall_seconds: Optional[float] = None) -> dict:
-        ttft = sorted(self.ttft_ms)
-        tok = sorted(self.token_ms)
+        pct = self.percentiles()
         out = {
             "submitted": self.submitted,
             "completed": self.completed,
@@ -102,11 +192,17 @@ class ServingMetrics:
             "timeouts": self.timeouts,
             "tokens_out": self.tokens_out,
             "ticks": self.ticks,
-            "ttft_ms_p50": round(_percentile(ttft, 0.50), 3),
-            "ttft_ms_p95": round(_percentile(ttft, 0.95), 3),
-            "token_ms_p50": round(_percentile(tok, 0.50), 3),
-            "token_ms_p95": round(_percentile(tok, 0.95), 3),
+            "ttft_ms_p50": pct["ttft_ms"]["p50"],
+            "ttft_ms_p95": pct["ttft_ms"]["p95"],
+            "ttft_ms_p99": pct["ttft_ms"]["p99"],
+            "token_ms_p50": pct["tpot_ms"]["p50"],
+            "token_ms_p95": pct["tpot_ms"]["p95"],
+            "token_ms_p99": pct["tpot_ms"]["p99"],
+            "e2e_ms_p50": pct["e2e_ms"]["p50"],
+            "e2e_ms_p95": pct["e2e_ms"]["p95"],
         }
+        if any(v is not None for v in self._slo_targets().values()):
+            out["slo"] = self.slo_status()
         if wall_seconds:
             out["tokens_per_s"] = round(self.tokens_out / wall_seconds, 2)
         return out

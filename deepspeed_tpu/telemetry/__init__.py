@@ -22,8 +22,12 @@ from .export import (chrome_trace, write_chrome_trace, metrics_snapshot,
                      write_snapshot, prometheus_dump, span_aggregates,
                      comm_table)
 from .monitor_sink import TelemetryMonitor
+from .goodput import GoodputLedger, get_ledger, configure_ledger
+from .statusz import StatuszServer
 
 __all__ = ["Span", "Tracer", "RecompileWatchdog", "get_tracer",
            "configure_tracer", "chrome_trace", "write_chrome_trace",
            "metrics_snapshot", "write_snapshot", "prometheus_dump",
-           "span_aggregates", "comm_table", "TelemetryMonitor"]
+           "span_aggregates", "comm_table", "TelemetryMonitor",
+           "GoodputLedger", "get_ledger", "configure_ledger",
+           "StatuszServer"]

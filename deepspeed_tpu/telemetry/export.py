@@ -137,6 +137,10 @@ def metrics_snapshot(tracer: Optional[Tracer] = None,
     counters = {tag: val for tag, (val, _step) in tracer.counters().items()}
     snap = {"spans": span_aggregates(tracer), "counters": counters,
             "comm": comm_table(tracer), "dropped_spans": tracer.dropped}
+    from .goodput import get_ledger
+    ledger = get_ledger()
+    if ledger.enabled:
+        snap["goodput"] = ledger.snapshot()
     if extra:
         snap.update(extra)
     return snap
@@ -179,6 +183,20 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
                          f'{rec["total_ms"]}')
             lines.append(f'{prefix}_span_count{{name="{_prom(name)}"}} '
                          f'{rec["count"]}')
+    from .goodput import get_ledger
+    ledger = get_ledger()
+    if ledger.enabled:
+        snap = ledger.snapshot()
+        lines.append(f"# TYPE {prefix}_goodput_seconds gauge")
+        for bucket, secs in sorted(snap["buckets"].items()):
+            lines.append(
+                f'{prefix}_goodput_seconds{{bucket="{_prom(bucket)}"}} '
+                f"{secs}")
+        lines.append(f"# TYPE {prefix}_goodput_fraction gauge")
+        lines.append(f"{prefix}_goodput_fraction "
+                     f"{snap['goodput_fraction']}")
+        lines.append(f"# TYPE {prefix}_wall_seconds gauge")
+        lines.append(f"{prefix}_wall_seconds {snap['wall_s']}")
     lines.append(f"# TYPE {prefix}_dropped_spans gauge")
     lines.append(f"{prefix}_dropped_spans {tracer.dropped}")
     return "\n".join(lines) + "\n"

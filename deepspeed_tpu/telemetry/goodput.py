@@ -1,0 +1,256 @@
+"""Goodput ledger — classify every second of wall-clock into named buckets.
+
+On preemptible TPU fleets the operator question is not "what is the step
+time" but "what fraction of wall-clock was productive training". The
+ledger answers it by accounting wall time into exclusive buckets:
+
+- ``productive_step``  — synced train-step time (goodput)
+- ``serving_step``     — serving scheduler ticks (goodput for replicas)
+- ``compile``          — steps that paid the *initial* XLA compile
+- ``recompile``        — steps the RecompileWatchdog flagged (jit-cache
+  growth: the silent-recompile perf cliff, made a first-class cost)
+- ``checkpoint_save`` / ``checkpoint_load`` — checkpoint IO
+- ``sentinel``         — steps whose update the sentinel skipped, plus
+  rollback restores (work that had to be thrown away)
+- ``preemption``       — preemption handling (emergency checkpoint, drain)
+- ``data_wait``        — blocking on the input pipeline
+- ``serving_drain``    — serving drain (no new admissions)
+- ``idle``             — the residual: wall-clock not attributed above
+
+Buckets are *exclusive* and sum to measured wall-clock by construction:
+``idle`` is computed as the residual at snapshot time, and nested
+``track()`` intervals follow an **outermost-wins** rule — an interval
+opened while another is active on the same ledger contributes to the
+outer interval's bucket (so a checkpoint load performed *inside* a
+sentinel rollback lands in ``sentinel``, not split across two buckets).
+
+Intervals support late reclassification: the engine opens a step interval
+as ``productive_step`` and, once the recompile watchdog has spoken, moves
+it to ``compile``/``recompile``/``sentinel`` — time transfers between
+buckets, never double-counts.
+
+Disabled (the default) costs nothing: ``track()`` returns a shared no-op
+interval, no object is allocated, no clock is read. Enable through the
+``telemetry`` config block (``{"telemetry": {"enabled": true}}`` enables
+the ledger alongside the tracer; ``"goodput": false`` opts out) or
+``configure_ledger(enabled=True)``.
+
+Every interval close mirrors the bucket totals and the goodput fraction
+into the process-global tracer gauges (``goodput/*``), so
+``metrics_snapshot()``, ``prometheus_dump()``, the monitor sinks, and the
+``/statusz`` page all see the ledger live.
+"""
+
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["GoodputLedger", "get_ledger", "configure_ledger",
+           "BUCKETS", "PRODUCTIVE_BUCKETS"]
+
+#: the full bucket vocabulary (snapshot always reports every name, so
+#: downstream dashboards get a stable schema)
+BUCKETS = ("productive_step", "serving_step", "compile", "recompile",
+           "checkpoint_save", "checkpoint_load", "sentinel", "preemption",
+           "data_wait", "serving_drain", "idle")
+
+#: buckets counted as goodput in the fraction's numerator
+PRODUCTIVE_BUCKETS = ("productive_step", "serving_step")
+
+
+class _Interval:
+    """One tracked wall-clock interval. Context manager; one allocation
+    per *outermost* track() call on an enabled ledger."""
+
+    __slots__ = ("bucket", "seconds", "_ledger", "_t0", "_closed")
+
+    def __init__(self, ledger: "GoodputLedger", bucket: str):
+        self.bucket = bucket
+        self.seconds = 0.0
+        self._ledger = ledger
+        self._t0 = 0.0
+        self._closed = False
+
+    def __enter__(self):
+        self._t0 = self._ledger._clock()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.seconds = self._ledger._clock() - self._t0
+        self._closed = True
+        self._ledger._commit(self)
+        return False
+
+    def reclassify(self, bucket: str):
+        """Move this interval's time to another bucket — the engine opens
+        a step as ``productive_step`` and renames it once it knows whether
+        the step compiled, recompiled, or was sentinel-skipped."""
+        if bucket == self.bucket:
+            return
+        if self._closed:
+            self._ledger._move(self.bucket, bucket, self.seconds)
+        self.bucket = bucket
+
+
+class _NullInterval:
+    """Shared no-op interval: what a disabled ledger (or a nested track()
+    under outermost-wins) hands out. No allocation, no clock read."""
+
+    __slots__ = ()
+    bucket = None
+    seconds = 0.0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def reclassify(self, bucket):
+        pass
+
+
+_NULL_INTERVAL = _NullInterval()
+
+
+class GoodputLedger:
+    """Wall-clock accountant: exclusive buckets + residual idle."""
+
+    def __init__(self, enabled: bool = False, clock=time.monotonic):
+        self.enabled = enabled
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._buckets: Dict[str, float] = {}
+        self._t0: Optional[float] = None
+        self._last_export = 0.0
+        #: min seconds between gauge-mirror refreshes (the snapshot and
+        #: prometheus_dump read the ledger directly and are always live;
+        #: only the redundant goodput/* gauge mirror is throttled)
+        self.export_interval_s = 0.2
+
+    # ------------------------------------------------------------ configure
+    def configure(self, enabled: Optional[bool] = None) -> "GoodputLedger":
+        if enabled is not None:
+            was = self.enabled
+            self.enabled = bool(enabled)
+            if self.enabled and not was:
+                self.reset()
+        return self
+
+    def reset(self):
+        """Restart the wall-clock epoch and zero every bucket."""
+        with self._lock:
+            self._buckets = {}
+            self._t0 = self._clock()
+        self._last_export = 0.0
+
+    # ------------------------------------------------------------- tracking
+    def track(self, bucket: str):
+        """Open an exclusive interval attributed to ``bucket``. Nested
+        calls on the same thread return the shared no-op interval (the
+        outer interval keeps the time — outermost wins). Disabled ledger:
+        the same no-op, zero cost."""
+        if not self.enabled:
+            return _NULL_INTERVAL
+        if getattr(self._tls, "active", False):
+            return _NULL_INTERVAL
+        self._tls.active = True
+        if self._t0 is None:
+            self.reset()
+        return _Interval(self, bucket)
+
+    def record(self, bucket: str, seconds: float):
+        """Attribute ``seconds`` of already-measured time to ``bucket``
+        (for callers that timed the work themselves)."""
+        if not self.enabled or seconds <= 0:
+            return
+        with self._lock:
+            self._buckets[bucket] = self._buckets.get(bucket, 0.0) + seconds
+        self._export()
+
+    def _commit(self, interval: _Interval):
+        self._tls.active = False
+        with self._lock:
+            self._buckets[interval.bucket] = \
+                self._buckets.get(interval.bucket, 0.0) + interval.seconds
+        self._export()
+
+    def _move(self, src: str, dst: str, seconds: float):
+        with self._lock:
+            self._buckets[src] = self._buckets.get(src, 0.0) - seconds
+            if abs(self._buckets[src]) < 1e-12:
+                self._buckets[src] = 0.0
+            self._buckets[dst] = self._buckets.get(dst, 0.0) + seconds
+        self._export()
+
+    # -------------------------------------------------------------- reading
+    def wall_seconds(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        return max(0.0, self._clock() - self._t0)
+
+    def snapshot(self) -> Dict[str, object]:
+        """The ledger as one JSON-able dict. Buckets (including the
+        computed ``idle`` residual) sum to ``wall_s`` by construction."""
+        wall = self.wall_seconds()
+        with self._lock:
+            buckets = {name: round(self._buckets.get(name, 0.0), 6)
+                       for name in BUCKETS if name != "idle"}
+            for name, secs in self._buckets.items():
+                if name not in buckets:          # caller-defined bucket
+                    buckets[name] = round(secs, 6)
+        attributed = sum(buckets.values())
+        buckets["idle"] = round(max(0.0, wall - attributed), 6)
+        productive = sum(buckets.get(b, 0.0) for b in PRODUCTIVE_BUCKETS)
+        badput = {name: secs for name, secs in buckets.items()
+                  if name not in PRODUCTIVE_BUCKETS and name != "idle"
+                  and secs > 0}
+        return {
+            "wall_s": round(wall, 6),
+            "buckets": buckets,
+            "goodput_fraction": round(productive / wall, 6) if wall else 0.0,
+            "badput": badput,
+        }
+
+    # ------------------------------------------------------------- mirroring
+    def _export(self):
+        """Mirror bucket totals + goodput fraction into the tracer gauges
+        so every existing exporter (snapshot, Prometheus, monitor sinks,
+        /statusz) sees the ledger without new plumbing. Rate-limited to
+        ``export_interval_s`` — per-step gauge rewrites would be pure
+        overhead (the ledger itself is always read live)."""
+        wall = self.wall_seconds()
+        now = self._clock()
+        if self._last_export and \
+                now - self._last_export < self.export_interval_s:
+            return
+        self._last_export = now
+        from .trace import get_tracer
+        tracer = get_tracer()
+        with self._lock:
+            items = list(self._buckets.items())
+        productive = 0.0
+        for name, secs in items:
+            tracer.set_counter(f"goodput/{name}_s", round(secs, 6))
+            if name in PRODUCTIVE_BUCKETS:
+                productive += secs
+        if wall > 0:
+            tracer.set_counter("goodput/wall_s", round(wall, 6))
+            tracer.set_counter("goodput/fraction",
+                               round(productive / wall, 6))
+
+
+_LEDGER: Optional[GoodputLedger] = None
+
+
+def get_ledger() -> GoodputLedger:
+    """The process-global goodput ledger (created disabled)."""
+    global _LEDGER
+    if _LEDGER is None:
+        _LEDGER = GoodputLedger()
+    return _LEDGER
+
+
+def configure_ledger(enabled: Optional[bool] = None) -> GoodputLedger:
+    return get_ledger().configure(enabled=enabled)

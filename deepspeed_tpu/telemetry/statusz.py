@@ -1,0 +1,279 @@
+"""Live introspection server — /healthz, /metrics, /statusz, /trace.
+
+The file exporters (trace JSON, snapshot JSON, .prom rewrite) answer
+"what happened"; this answers "what is happening *right now*" over plain
+HTTP, so a load balancer, a Prometheus scraper, `bin/ds_tpu_top`, and a
+human with a browser all read the same live state:
+
+- ``/healthz``        — liveness/readiness. 200 while every registered
+  health check passes; 503 (with the failing reasons) once any fails —
+  a serving replica registers its drain/preemption state here, so the
+  balancer stops routing to a draining replica *before* it disappears.
+- ``/metrics``        — the Prometheus text exposition, live (the same
+  bytes the ``prometheus`` monitor sink writes to its ``.prom`` file).
+- ``/statusz``        — human-readable HTML: process info + config
+  fingerprint, the goodput table, every registered section (training
+  counters, serving queue/slots/SLO), recent spans.
+- ``/statusz?format=json`` (alias ``/statusz.json``) — the same data as
+  one JSON document (what ``bin/ds_tpu_top`` polls).
+- ``/trace?last_ms=N`` — Chrome trace-event JSON of the last N ms of the
+  span ring buffer (load in ui.perfetto.dev); no param = full buffer.
+
+Opt-in and off by default: no thread is started and no port is bound
+unless the ``statusz`` config block enables it. The server is a stdlib
+``ThreadingHTTPServer`` on a daemon thread bound to ``host`` (default
+loopback); ``port: 0`` binds an ephemeral port (read it back from
+``server.port``). ``close()`` shuts the listener down and joins the
+thread — engines own their server and close it on shutdown.
+"""
+
+import html
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..utils.logging import logger
+from .trace import get_tracer
+
+__all__ = ["StatuszServer"]
+
+
+class StatuszServer:
+    """One engine's introspection endpoint. Providers and health checks
+    are registered by name; the handler composes them per request."""
+
+    def __init__(self, config=None, tracer=None, host: Optional[str] = None,
+                 port: Optional[int] = None):
+        self.tracer = tracer or get_tracer()
+        host = host if host is not None else \
+            getattr(config, "host", "127.0.0.1")
+        port = port if port is not None else int(getattr(config, "port", 0))
+        self.max_spans = int(getattr(config, "spans", 50) or 50)
+        #: name -> callable() -> dict (one /statusz section each)
+        self._providers: Dict[str, Callable[[], dict]] = {}
+        #: name -> callable() -> (healthy: bool, detail: str)
+        self._health: Dict[str, Callable[[], Tuple[bool, str]]] = {}
+        self._t_start = time.time()
+        self._httpd = ThreadingHTTPServer((host, port), _make_handler(self))
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dstpu-statusz",
+            daemon=True)
+        self._thread.start()
+        self._closed = False
+        logger.info(f"statusz server listening on http://{self.host}:"
+                    f"{self.port}")
+
+    # ------------------------------------------------------------- registry
+    def register(self, name: str, provider: Callable[[], dict]):
+        """Add a /statusz section; ``provider()`` returns a flat dict."""
+        self._providers[name] = provider
+        return self
+
+    def register_health(self, name: str,
+                        check: Callable[[], Tuple[bool, str]]):
+        """Add a /healthz check; ``check()`` returns (healthy, detail)."""
+        self._health[name] = check
+        return self
+
+    def unregister(self, name: str):
+        self._providers.pop(name, None)
+        self._health.pop(name, None)
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        """The bound port (resolves ``port: 0`` ephemeral binds)."""
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self):
+        """Stop serving, release the port, join the thread. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------- payloads
+    def health(self) -> Tuple[bool, str]:
+        problems = []
+        for name, check in list(self._health.items()):
+            try:
+                ok, detail = check()
+            except Exception as e:   # a broken check is an unhealthy check
+                ok, detail = False, f"health check error: {e}"
+            if not ok:
+                problems.append(f"{name}: {detail}")
+        if problems:
+            return False, "; ".join(problems)
+        return True, "ok"
+
+    def status(self) -> dict:
+        """Everything /statusz shows, as one JSON-able document."""
+        from .goodput import get_ledger
+        healthy, detail = self.health()
+        counters = {tag: val for tag, (val, _s)
+                    in self.tracer.counters().items()}
+        doc = {
+            "process": {
+                "pid": os.getpid(),
+                "uptime_s": round(time.time() - self._t_start, 1),
+                "healthy": healthy,
+                "health_detail": detail,
+            },
+            "counters": counters,
+            "sections": {},
+            "spans": self._recent_spans(),
+        }
+        ledger = get_ledger()
+        if ledger.enabled:
+            doc["goodput"] = ledger.snapshot()
+        for name, provider in list(self._providers.items()):
+            try:
+                doc["sections"][name] = provider()
+            except Exception as e:
+                doc["sections"][name] = {"error": str(e)}
+        return doc
+
+    def _recent_spans(self):
+        spans = [s for s in self.tracer.spans() if s.ph == "X"]
+        out = []
+        for s in spans[-self.max_spans:]:
+            out.append({"name": s.name, "cat": s.cat,
+                        "dur_ms": round(s.dur_us / 1e3, 3)})
+        return out
+
+    def trace_slice(self, last_ms: Optional[float] = None) -> dict:
+        """Chrome trace JSON, optionally cut to the last ``last_ms``
+        milliseconds of span activity (span timestamps share the
+        ``perf_counter_ns`` clock, so "now" is directly comparable)."""
+        from .export import chrome_trace
+        doc = chrome_trace(self.tracer)
+        if last_ms is None:
+            return doc
+        cutoff = time.perf_counter_ns() / 1e3 - float(last_ms) * 1e3
+        doc["traceEvents"] = [
+            ev for ev in doc["traceEvents"]
+            if ev["ph"] == "M" or
+            ev.get("ts", 0) + ev.get("dur", 0) >= cutoff]
+        return doc
+
+    # ---------------------------------------------------------------- html
+    def status_html(self) -> str:
+        doc = self.status()
+        esc = html.escape
+        parts = ["<!doctype html><html><head><title>deepspeed_tpu statusz"
+                 "</title><style>body{font-family:monospace;margin:2em}"
+                 "table{border-collapse:collapse;margin:0.5em 0}"
+                 "td,th{border:1px solid #999;padding:2px 8px;"
+                 "text-align:left}h2{margin-top:1.2em}"
+                 ".bad{color:#b00}.good{color:#080}</style></head><body>",
+                 "<h1>deepspeed_tpu /statusz</h1>"]
+        proc = doc["process"]
+        cls = "good" if proc["healthy"] else "bad"
+        parts.append(
+            f"<p>pid {proc['pid']} · uptime {proc['uptime_s']}s · health "
+            f"<span class='{cls}'>{esc(proc['health_detail'])}</span></p>")
+
+        def table(rows):
+            body = "".join(f"<tr><td>{esc(str(k))}</td>"
+                           f"<td>{esc(str(v))}</td></tr>"
+                           for k, v in rows)
+            return f"<table>{body}</table>"
+
+        if "goodput" in doc:
+            g = doc["goodput"]
+            parts.append("<h2>goodput</h2>")
+            parts.append(f"<p>wall {g['wall_s']}s · goodput fraction "
+                         f"<b>{g['goodput_fraction']}</b></p>")
+            rows = sorted(g["buckets"].items(), key=lambda kv: -kv[1])
+            parts.append(table([(k, f"{v}s") for k, v in rows if v > 0]))
+        for name, section in doc["sections"].items():
+            parts.append(f"<h2>{esc(name)}</h2>")
+            parts.append(table(sorted(section.items())))
+        parts.append("<h2>counters</h2>")
+        parts.append(table(sorted(doc["counters"].items())))
+        if doc["spans"]:
+            parts.append(f"<h2>last {len(doc['spans'])} spans</h2>")
+            parts.append(table([(f"{s['cat']}/{s['name']}",
+                                 f"{s['dur_ms']}ms") for s in doc["spans"]]))
+        parts.append("<p><a href='/metrics'>/metrics</a> · "
+                     "<a href='/healthz'>/healthz</a> · "
+                     "<a href='/trace'>/trace</a> · "
+                     "<a href='/statusz?format=json'>json</a></p>")
+        parts.append("</body></html>")
+        return "".join(parts)
+
+
+def _make_handler(server: StatuszServer):
+    """Handler class closed over the StatuszServer (BaseHTTPRequestHandler
+    instantiates per request; state lives on ``server``)."""
+
+    class Handler(BaseHTTPRequestHandler):
+
+        def log_message(self, fmt, *args):   # keep stdout clean
+            pass
+
+        def _send(self, code: int, body: str, ctype: str):
+            data = body.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            try:
+                url = urlparse(self.path)
+                path = url.path.rstrip("/") or "/statusz"
+                qs = parse_qs(url.query)
+                if path == "/healthz":
+                    healthy, detail = server.health()
+                    self._send(200 if healthy else 503, detail + "\n",
+                               "text/plain; charset=utf-8")
+                elif path == "/metrics":
+                    from .export import prometheus_dump
+                    self._send(200, prometheus_dump(server.tracer),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif path in ("/statusz", "/statusz.json", "/varz"):
+                    as_json = (path == "/statusz.json" or
+                               qs.get("format", [""])[0] == "json")
+                    if as_json:
+                        self._send(200, json.dumps(server.status(),
+                                                   default=str),
+                                   "application/json")
+                    else:
+                        self._send(200, server.status_html(),
+                                   "text/html; charset=utf-8")
+                elif path == "/trace":
+                    last_ms = qs.get("last_ms", [None])[0]
+                    doc = server.trace_slice(
+                        float(last_ms) if last_ms is not None else None)
+                    self._send(200, json.dumps(doc), "application/json")
+                else:
+                    self._send(404, "not found: try /healthz /metrics "
+                               "/statusz /trace\n",
+                               "text/plain; charset=utf-8")
+            except BrokenPipeError:      # client went away mid-response
+                pass
+            except Exception as e:
+                try:
+                    self._send(500, f"statusz error: {e}\n",
+                               "text/plain; charset=utf-8")
+                except OSError:
+                    pass
+
+    return Handler

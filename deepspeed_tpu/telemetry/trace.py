@@ -154,6 +154,13 @@ class Tracer:
         self._lock = threading.Lock()
         self._tls = threading.local()
         self._counters: Dict[str, Any] = {}
+        # gauge ownership: tag -> id(owner) for gauges registered by a
+        # closable producer (an engine); release_counters(owner) drops the
+        # tags that owner still holds, so /metrics and prometheus_dump
+        # never report stale values from a closed engine. Last writer
+        # wins: a tag two co-resident engines both write belongs to
+        # whichever wrote it last, and only that one's close() removes it.
+        self._counter_owners: Dict[str, int] = {}
         self._pending: "deque" = deque(maxlen=8192)
 
     # ------------------------------------------------------------ configure
@@ -256,11 +263,28 @@ class Tracer:
         self._counters[tag] = (value, step)
         self._pending.append((tag, value, 0 if step is None else step))
 
-    def set_counter(self, tag: str, value: float, step: Optional[int] = None):
+    def set_counter(self, tag: str, value: float, step: Optional[int] = None,
+                    owner: Any = None):
         """Gauge-only update (no queued monitor event) — what the engines
         and the TelemetryMonitor sink use (the sink re-queueing events
-        would loop the pipeline back into itself)."""
+        would loop the pipeline back into itself). ``owner`` ties the tag
+        to a closable producer for ``release_counters``."""
         self._counters[tag] = (value, step)
+        if owner is not None:
+            self._counter_owners[tag] = id(owner)
+        # owner=None leaves any existing ownership standing: the
+        # TelemetryMonitor sink mirrors an engine's own events back into
+        # the gauge space ownerless, and that mirror must not strip the
+        # engine's right to retract its tags at close()
+
+    def release_counters(self, owner: Any):
+        """Drop every gauge still owned by ``owner`` (engine close path):
+        a closed engine's queue depth / step time must not linger in
+        prometheus_dump() or /metrics as if it were live."""
+        oid = id(owner)
+        for tag in [t for t, o in self._counter_owners.items() if o == oid]:
+            del self._counter_owners[tag]
+            self._counters.pop(tag, None)
 
     def counters(self) -> Dict[str, Any]:
         return dict(self._counters)
@@ -278,6 +302,7 @@ class Tracer:
             self._head = 0
             self._total = 0
         self._counters.clear()
+        self._counter_owners.clear()
         self._pending.clear()
 
 
@@ -295,8 +320,14 @@ class RecompileWatchdog:
         self._watched: Dict[int, Any] = {}
         self.recompiles = 0
 
+    def seen(self, fn) -> bool:
+        """Whether ``fn`` has been observed before — False means the next
+        call pays the initial compile (the goodput ledger's ``compile``
+        bucket, distinct from a ``recompile``)."""
+        return id(fn) in self._watched
+
     def observe(self, fn, tracer: Optional[Tracer] = None,
-                label: str = "train_step") -> int:
+                label: str = "train_step", owner: Any = None) -> int:
         size_of = getattr(fn, "_cache_size", None)
         if size_of is None:
             return 0
@@ -313,7 +344,8 @@ class RecompileWatchdog:
             self.recompiles += delta
             if tracer is not None:
                 # gauge-only: the caller owns monitor-event fan-out
-                tracer.set_counter("telemetry/recompiles", self.recompiles)
+                tracer.set_counter("telemetry/recompiles", self.recompiles,
+                                   owner=owner)
                 tracer.instant(f"recompile:{label}", cat="warning",
                                args={"new_executables": delta,
                                      "total": self.recompiles})

@@ -226,3 +226,50 @@ def test_compiled_program_cache_lru_eviction(engine):
     pool, tok = eng.slot_prefill(pool, 0, np.arange(4, dtype=np.int32))
     assert len(eng._fns) == 2 and len(eng._slot_fns) >= 2
     assert 0 <= tok < VOCAB
+
+
+def test_latency_windows_bounded_memory():
+    """Satellite: percentile sources are fixed-size sliding windows — a
+    long-running replica's metrics memory stays O(slo.window), and the
+    percentiles describe the RECENT samples, not the whole lifetime."""
+    from deepspeed_tpu.serving.config import SLOConfig
+    from deepspeed_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics(slo=SLOConfig.from_dict({"window": 32}))
+    for i in range(10_000):
+        m.record_ttft(1.0)             # 1000ms each, ancient history
+    for _ in range(32):
+        m.record_ttft(0.002)           # 2ms, the recent window
+        m.record_decode_step(0.001, n_active=1)
+    assert len(m.ttft_ms) == 32        # O(window), not O(requests)
+    assert len(m.token_ms) == 32
+    assert m.ttft_ms.maxlen == 32 and m.e2e_ms.maxlen == 32
+    pct = m.percentiles()
+    assert pct["ttft_ms"]["p99"] == pytest.approx(2.0)   # old 1000ms gone
+    assert m.tokens_out == 10_000 + 64  # totals still lifetime-accurate
+    m.close()
+
+
+def test_slo_burn_rate_tracking():
+    """Sliding-window SLO: violation rate vs the error budget. 10% of
+    TTFTs over target at a p99 SLO = burning budget at 10x."""
+    from deepspeed_tpu.serving.config import SLOConfig
+    from deepspeed_tpu.serving.metrics import ServingMetrics
+    from deepspeed_tpu.telemetry import get_tracer
+
+    slo = SLOConfig.from_dict({"window": 100, "ttft_ms": 50.0,
+                               "target": 0.99})
+    m = ServingMetrics(slo=slo)
+    for i in range(100):
+        m.record_ttft(0.010 if i % 10 else 0.100)   # 10% violate 50ms
+    status = m.slo_status()
+    assert status["metrics"]["ttft_ms"]["violation_rate"] == \
+        pytest.approx(0.10)
+    assert status["burn_rate"] == pytest.approx(10.0)
+    # gauges surface on tick (snapshot/Prometheus/statusz all read them)
+    m.record_tick(queue_depth=0, slot_utilization=0.0)
+    counters = get_tracer().counters()
+    assert counters["serving/slo_burn_rate"][0] == pytest.approx(10.0)
+    assert counters["serving/ttft_ms_p50"][0] == pytest.approx(10.0)
+    m.close()
+    assert "serving/slo_burn_rate" not in get_tracer().counters()

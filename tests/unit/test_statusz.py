@@ -1,0 +1,438 @@
+"""Goodput ledger + statusz introspection server tests.
+
+Contracts under test: ledger buckets sum to measured wall-clock (idle is
+the residual, nesting is outermost-wins, reclassification moves time
+without double-counting); an injected recompile, checkpoint save, and
+sentinel rollback each land in their own badput bucket; disabled mode
+allocates nothing. The statusz server answers /healthz /metrics /statusz
+/trace over REAL HTTP on an ephemeral localhost port, /healthz goes 503
+while a serving replica drains, the server is fully off by default (no
+thread, no port), and close() leaks no thread. Gauge lifecycle: a closed
+engine's gauges leave the shared counter space (two co-resident engines,
+last-writer-wins ownership)."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.telemetry import get_tracer, prometheus_dump
+from deepspeed_tpu.telemetry.goodput import (_NULL_INTERVAL, BUCKETS,
+                                             GoodputLedger, get_ledger)
+from deepspeed_tpu.telemetry.statusz import StatuszServer
+
+TINY = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                  n_head=4, pad_vocab_to_multiple=8)
+
+
+@pytest.fixture
+def tracer():
+    tr = get_tracer()
+    prev_enabled, prev_sync = tr.enabled, tr.sync_spans
+    tr.clear()
+    tr.configure(enabled=True, buffer_size=4096, sync_spans=True)
+    yield tr
+    tr.clear()
+    tr.configure(enabled=prev_enabled, sync_spans=prev_sync)
+
+
+@pytest.fixture
+def ledger():
+    """The process-global ledger, enabled and clean; disabled after."""
+    led = get_ledger()
+    led.configure(enabled=True)
+    led.reset()
+    yield led
+    led.configure(enabled=False)
+
+
+def _get(url, timeout=5.0):
+    """(status_code, body_text) for a GET, without raising on 4xx/5xx."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ------------------------------------------------------------ goodput ledger
+
+def test_ledger_buckets_sum_to_wall_clock():
+    now = [100.0]
+    led = GoodputLedger(enabled=True, clock=lambda: now[0])
+    led.reset()
+    with led.track("productive_step"):
+        now[0] += 3.0
+    with led.track("checkpoint_save"):
+        now[0] += 1.0
+    now[0] += 2.0                      # unattributed -> idle
+    snap = led.snapshot()
+    assert snap["wall_s"] == pytest.approx(6.0)
+    assert snap["buckets"]["productive_step"] == pytest.approx(3.0)
+    assert snap["buckets"]["checkpoint_save"] == pytest.approx(1.0)
+    assert snap["buckets"]["idle"] == pytest.approx(2.0)
+    # the sum-to-wall-clock contract, and a stable bucket schema
+    assert sum(snap["buckets"].values()) == pytest.approx(snap["wall_s"])
+    assert set(BUCKETS) <= set(snap["buckets"])
+    assert snap["goodput_fraction"] == pytest.approx(0.5)
+    assert snap["badput"] == {"checkpoint_save": 1.0}
+
+
+def test_ledger_outermost_wins_nesting():
+    now = [0.0]
+    led = GoodputLedger(enabled=True, clock=lambda: now[0])
+    led.reset()
+    with led.track("sentinel"):
+        with led.track("checkpoint_load"):   # nested: no-op interval
+            now[0] += 2.0
+        now[0] += 1.0
+    snap = led.snapshot()
+    # all 3s in the OUTER bucket — a rollback's inner checkpoint load must
+    # not split the time (and must not double-count it)
+    assert snap["buckets"]["sentinel"] == pytest.approx(3.0)
+    assert snap["buckets"]["checkpoint_load"] == 0.0
+    assert sum(snap["buckets"].values()) == pytest.approx(snap["wall_s"])
+
+
+def test_ledger_reclassify_moves_time():
+    now = [0.0]
+    led = GoodputLedger(enabled=True, clock=lambda: now[0])
+    led.reset()
+    iv = led.track("productive_step")
+    with iv:
+        now[0] += 4.0
+    iv.reclassify("recompile")
+    snap = led.snapshot()
+    assert snap["buckets"]["productive_step"] == 0.0
+    assert snap["buckets"]["recompile"] == pytest.approx(4.0)
+    assert sum(snap["buckets"].values()) == pytest.approx(snap["wall_s"])
+    iv.reclassify("recompile")           # idempotent
+    assert led.snapshot()["buckets"]["recompile"] == pytest.approx(4.0)
+
+
+def test_ledger_disabled_allocates_nothing():
+    led = GoodputLedger(enabled=False)
+    a = led.track("productive_step")
+    b = led.track("checkpoint_save")
+    # zero-cost contract: the SAME shared no-op interval, no allocation
+    assert a is b is _NULL_INTERVAL
+    with a:
+        pass
+    a.reclassify("recompile")
+    assert led._buckets == {}
+    assert led.snapshot()["wall_s"] == 0.0
+
+
+def test_ledger_exports_gauges(tracer, ledger):
+    now0 = ledger._clock()
+    with ledger.track("productive_step"):
+        time.sleep(0.01)
+    counters = tracer.counters()
+    assert counters["goodput/productive_step_s"][0] > 0
+    assert 0 < counters["goodput/fraction"][0] <= 1.0
+    # and the exporters carry the ledger
+    text = prometheus_dump(tracer)
+    assert 'dstpu_goodput_seconds{bucket="productive_step"}' in text
+    assert "dstpu_goodput_fraction" in text
+    from deepspeed_tpu.telemetry import metrics_snapshot
+    snap = metrics_snapshot(tracer)
+    assert "goodput" in snap
+    assert snap["goodput"]["wall_s"] >= ledger._clock() - now0 - 1e-3
+
+
+# ------------------------------------------- goodput through the real engine
+
+def _engine(tmp_path, over=None):
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "telemetry": {"enabled": True, "mfu": False},
+    }
+    cfg.update(over or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(TINY),
+                                               config=cfg)
+    return engine
+
+
+def _batch(seqlen=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 255, size=(1, 8, seqlen),
+                                      dtype=np.int32)}
+
+
+def test_engine_goodput_attribution(tracer, tmp_path, faultinject):
+    """The acceptance scenario: an injected recompile, a checkpoint save,
+    and a sentinel rollback each appear in their own badput bucket, and
+    the buckets sum to measured wall-clock within 1%."""
+    engine = _engine(tmp_path, over={
+        "resilience": {"sentinel_policy": "rollback",
+                       "sentinel_patience": 1}})
+    led = get_ledger()
+    assert led.enabled                 # rides telemetry.enabled
+    led.reset()
+    t0 = time.monotonic()
+
+    engine.train_batch(batch=_batch(seqlen=16, seed=0))   # initial compile
+    engine.train_batch(batch=_batch(seqlen=16, seed=1))   # productive
+    engine.save_checkpoint(str(tmp_path / "ckpt"))        # checkpoint_save
+    engine.train_batch(batch=_batch(seqlen=8, seed=2))    # forced recompile
+    faultinject.arm("nan_loss", times=1)
+    engine.train_batch(batch=_batch(seqlen=8, seed=3))    # sentinel rollback
+
+    wall_measured = time.monotonic() - t0
+    snap = led.snapshot()
+    b = snap["buckets"]
+    assert b["compile"] > 0            # step 1 paid the initial compile
+    assert b["productive_step"] > 0    # step 2 was clean
+    assert b["checkpoint_save"] > 0
+    assert b["recompile"] > 0          # the seqlen change
+    assert b["sentinel"] > 0           # the NaN step + rollback restore
+    assert engine._sentinel.rollbacks == 1
+    # buckets (incl. the idle residual) account for all wall-clock
+    assert sum(b.values()) == pytest.approx(snap["wall_s"], rel=0.01)
+    assert snap["wall_s"] == pytest.approx(wall_measured, rel=0.01,
+                                           abs=0.05)
+    assert 0 < snap["goodput_fraction"] < 1
+    engine.close()
+
+
+def test_engine_goodput_disabled_by_default(tmp_path):
+    engine = _engine(tmp_path, over={"telemetry": {"enabled": False}})
+    assert not get_ledger().enabled
+    assert engine._ledger.track("productive_step") is _NULL_INTERVAL
+    engine.close()
+
+
+# ------------------------------------------------------------ statusz server
+
+def test_statusz_endpoints_real_http(tracer, ledger):
+    with tracer.span("unit_span"):
+        time.sleep(0.001)
+    with ledger.track("productive_step"):
+        time.sleep(0.001)
+    tracer.set_counter("telemetry/step_time_ms", 12.5)
+    srv = StatuszServer(port=0)
+    srv.register("demo", lambda: {"answer": 42})
+    try:
+        assert srv.port > 0            # ephemeral bind resolved
+        code, body = _get(f"{srv.url}/healthz")
+        assert code == 200 and body.strip() == "ok"
+
+        code, body = _get(f"{srv.url}/metrics")
+        assert code == 200
+        assert "dstpu_goodput_fraction" in body
+        assert 'dstpu_metric{tag="telemetry_step_time_ms"} 12.5' in body
+        for line in body.strip().splitlines():   # Prometheus text format
+            if not line.startswith("#"):
+                name_labels, value = line.rsplit(" ", 1)
+                float(value)
+                assert name_labels.startswith("dstpu_")
+
+        code, body = _get(f"{srv.url}/statusz")
+        assert code == 200
+        assert "<html" in body and "goodput" in body and "demo" in body
+
+        code, body = _get(f"{srv.url}/statusz?format=json")
+        doc = json.loads(body)
+        assert doc["sections"]["demo"] == {"answer": 42}
+        assert doc["process"]["healthy"] is True
+        assert doc["goodput"]["buckets"]["productive_step"] > 0
+        assert any(s["name"] == "unit_span" for s in doc["spans"])
+
+        # /trace round-trips through the Chrome trace loader contract
+        code, body = _get(f"{srv.url}/trace")
+        trace = json.loads(body)
+        names = [e.get("name") for e in trace["traceEvents"]]
+        assert "unit_span" in names
+        for ev in trace["traceEvents"]:
+            assert {"ph", "pid"} <= set(ev)
+
+        code, body = _get(f"{srv.url}/trace?last_ms=0.001")
+        sliced = json.loads(body)
+        # everything but the process-name metadata is older than the slice
+        assert all(e["ph"] == "M" for e in sliced["traceEvents"])
+
+        code, _ = _get(f"{srv.url}/nope")
+        assert code == 404
+    finally:
+        srv.close()
+
+
+def test_statusz_healthz_reflects_health_checks(tracer):
+    state = {"ok": True}
+    srv = StatuszServer(port=0)
+    srv.register_health("unit", lambda: (state["ok"], "draining"))
+    try:
+        assert _get(f"{srv.url}/healthz")[0] == 200
+        state["ok"] = False
+        code, body = _get(f"{srv.url}/healthz")
+        assert code == 503 and "unit: draining" in body
+        state["ok"] = True
+        assert _get(f"{srv.url}/healthz")[0] == 200
+    finally:
+        srv.close()
+
+
+def test_statusz_close_leaks_no_thread(tracer):
+    before = {t.name for t in threading.enumerate()}
+    srv = StatuszServer(port=0)
+    url = srv.url
+    assert any(t.name == "dstpu-statusz" for t in threading.enumerate())
+    srv.close()
+    srv.close()                        # idempotent
+    assert {t.name for t in threading.enumerate()
+            if t.name == "dstpu-statusz"} <= before
+    with pytest.raises((urllib.error.URLError, OSError)):
+        urllib.request.urlopen(f"{url}/healthz", timeout=0.5)
+
+
+def test_statusz_disabled_by_default(tmp_path):
+    """The hard contract: no statusz block -> no thread, no port."""
+    before = sum(1 for t in threading.enumerate()
+                 if t.name == "dstpu-statusz")
+    engine = _engine(tmp_path)
+    assert engine.statusz is None
+    from deepspeed_tpu.serving.config import ServingConfig
+    scfg = ServingConfig.from_dict({"num_slots": 2})
+    assert not scfg.statusz.enabled
+    assert sum(1 for t in threading.enumerate()
+               if t.name == "dstpu-statusz") == before
+    engine.close()
+
+
+def test_training_engine_statusz_section(tracer, tmp_path):
+    engine = _engine(tmp_path, over={"statusz": {"enabled": True,
+                                                 "port": 0}})
+    try:
+        engine.train_batch(batch=_batch())
+        engine.save_checkpoint(str(tmp_path / "ck"))
+        code, body = _get(f"{engine.statusz.url}/statusz?format=json")
+        doc = json.loads(body)
+        sec = doc["sections"]["training"]
+        assert sec["global_steps"] == 1
+        assert len(sec["config_fingerprint"]) == 12
+        assert "save@step1" in sec["checkpoint_history"]
+        assert _get(f"{engine.statusz.url}/healthz")[0] == 200
+    finally:
+        engine.close()
+    # close() took the server down with it
+    with pytest.raises((urllib.error.URLError, OSError)):
+        urllib.request.urlopen(f"{engine.statusz.url}/healthz", timeout=0.5)
+
+
+# --------------------------------------------------- serving: drain + healthz
+
+@pytest.fixture(scope="module")
+def infer_engine():
+    model = GPT2Model(GPT2Config(vocab_size=128, n_positions=64, n_embd=64,
+                                 n_layer=2, n_head=4, pad_vocab_to_multiple=1,
+                                 dtype="float32"))
+    return deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+
+
+def test_serving_healthz_flips_during_drain(tracer, infer_engine):
+    from deepspeed_tpu.serving import SamplingParams, ServingEngine
+    srv = ServingEngine(infer_engine, {
+        "num_slots": 2, "max_model_len": 64,
+        "statusz": {"enabled": True, "port": 0},
+        "slo": {"ttft_ms": 10_000.0, "window": 64}})
+    url = srv.statusz.url
+    rng = np.random.default_rng(0)
+    for _ in range(2):
+        srv.submit(rng.integers(0, 128, (4,), dtype=np.int32),
+                   SamplingParams(max_new_tokens=2))
+    assert _get(f"{url}/healthz")[0] == 200   # serving: routable
+    code, body = _get(f"{url}/statusz?format=json")
+    assert json.loads(body)["sections"]["serving"]["queue_depth"] >= 0
+
+    srv.drain()                        # stop admissions, finish in-flight
+    code, body = _get(f"{url}/healthz")
+    assert code == 503                 # balancer must stop routing
+    assert "draining" in body
+    srv.shutdown()
+    with pytest.raises((urllib.error.URLError, OSError)):
+        urllib.request.urlopen(f"{url}/healthz", timeout=0.5)
+
+
+# -------------------------------------------------------- gauge lifecycle
+
+def test_gauge_lifecycle_two_coresident_engines(tracer):
+    """Closed engine's gauges leave /metrics; a tag both engines write
+    belongs to the last writer and survives the other's close()."""
+    from deepspeed_tpu.serving.metrics import ServingMetrics
+    a = ServingMetrics(tracer=tracer)
+    b = ServingMetrics(tracer=tracer)
+    a.record_ttft(0.010)               # shared tag, A writes first
+    a.record_reject()                  # A-only tag
+    b.record_ttft(0.020)               # B takes the shared tag over
+    assert tracer.counters()["serving/ttft_ms"][0] == 20.0
+    assert "serving/rejected" in tracer.counters()
+
+    a.close()
+    counters = tracer.counters()
+    assert "serving/rejected" not in counters          # A's gauge retracted
+    assert counters["serving/ttft_ms"][0] == 20.0      # B's still live
+    assert 'tag="serving_rejected"' not in prometheus_dump(tracer)
+
+    b.close()
+    assert "serving/ttft_ms" not in tracer.counters()  # nothing stale left
+
+
+def test_training_engine_close_releases_gauges(tracer, tmp_path):
+    engine = _engine(tmp_path)
+    engine.train_batch(batch=_batch())
+    assert "telemetry/step_time_ms" in tracer.counters()
+    engine.close()
+    engine.close()                     # idempotent
+    assert "telemetry/step_time_ms" not in tracer.counters()
+    assert "telemetry_step_time_ms" not in prometheus_dump(tracer)
+    # ownerless gauges (comm layer etc.) are untouched by engine close
+    tracer.set_counter("some/global", 1.0)
+    assert "some/global" in tracer.counters()
+
+
+# ------------------------------------------------------------- ds_tpu_top
+
+def test_ds_tpu_top_once_renders(tracer, ledger, tmp_path):
+    import os
+    with ledger.track("productive_step"):
+        time.sleep(0.002)
+    tracer.set_counter("serving/queue_depth", 3.0)
+    srv = StatuszServer(port=0)
+    try:
+        top = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "bin",
+            "ds_tpu_top")
+        out = subprocess.run(
+            [sys.executable, top, "--once", "--url", srv.url],
+            capture_output=True, text=True, timeout=30)
+        assert out.returncode == 0, out.stderr
+        assert "goodput" in out.stdout
+        assert "queue depth" in out.stdout
+    finally:
+        srv.close()
+
+
+def test_serving_slo_example_config_parses():
+    """examples/configs/serving_slo.json stays a valid ServingConfig."""
+    import os
+    from deepspeed_tpu.serving.config import ServingConfig
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "examples", "configs",
+        "serving_slo.json")
+    with open(path) as f:
+        cfg = ServingConfig.from_dict(json.load(f))
+    assert cfg.statusz.enabled and cfg.statusz.port == 8080
+    assert cfg.slo.ttft_ms == 200 and cfg.slo.target == 0.99
+    assert cfg.telemetry.goodput and cfg.resilience.handle_signals
