@@ -1,12 +1,13 @@
 """Telemetry overhead benchmark: tracer-on vs tracer-off step time.
 
-Runs the same tiny-GPT2 `train_batch` loop three times — telemetry
+Runs the same tiny-GPT2 `train_batch` loop four times — telemetry
 disabled; enabled (spans + MFU counters + recompile watchdog + ring
-buffer); and enabled WITH the goodput ledger and the statusz server
-(an HTTP thread parked on a live port) — and writes
-benchmarks/telemetry_overhead.json with median step times and the
-relative overheads. Asserts both enabled modes cost < 2% of step time
-(the low-overhead contract of deepspeed_tpu/telemetry/).
+buffer); enabled WITH the goodput ledger and the statusz server (an HTTP
+thread parked on a live port); and the full observability plane PLUS the
+flight recorder (per-step ring records + trigger rules armed, no trigger
+firing) — and writes benchmarks/telemetry_overhead.json with median step
+times and the relative overheads. Asserts every enabled mode costs < 2%
+of step time (the low-overhead contract of deepspeed_tpu/telemetry/).
 
 Both loops block on the loss every step, so the comparison isolates the
 tracer's span machinery from the device sync it performs by design
@@ -50,7 +51,8 @@ WARMUP = int(os.environ.get("TEL_WARMUP", 5))
 THRESHOLD_PCT = float(os.environ.get("TEL_THRESHOLD_PCT", 2.0))
 
 
-def build_engine(telemetry_enabled: bool, full: bool = False):
+def build_engine(telemetry_enabled: bool, full: bool = False,
+                 recorder_dir: str = ""):
     model = GPT2Model(GPT2Config(
         vocab_size=256, n_positions=128,
         n_embd=int(os.environ.get("TEL_EMBD", 128)),
@@ -72,6 +74,12 @@ def build_engine(telemetry_enabled: bool, full: bool = False):
         # full mode: a live introspection server parked on an ephemeral
         # loopback port while the loop runs
         "statusz": {"enabled": full, "port": 0},
+        # rec mode: the flight recorder ring + trigger rules, with the
+        # slow-step threshold parked high so no trigger fires — the cost
+        # under measurement is recording, not capture
+        "flight_recorder": {"enabled": bool(recorder_dir),
+                            "dir": recorder_dir or "unused",
+                            "slow_step_factor": 1000.0},
     })
     return engine
 
@@ -100,19 +108,22 @@ def run_block(engine, n_steps: int, collect=None):
 
 
 def main():
+    import tempfile
     tracer = get_tracer()
+    rec_dir = tempfile.mkdtemp(prefix="dstpu_overhead_rec_")
 
     # one engine per mode; steps run in INTERLEAVED round-robin blocks so
-    # machine drift (thermal, co-tenants) hits all three modes equally —
+    # machine drift (thermal, co-tenants) hits all modes equally —
     # sequential loops showed several % of drift, swamping the real cost
-    modes = {"off": (False, False), "on": (True, False),
-             "full": (True, True)}
+    modes = {"off": (False, False, ""), "on": (True, False, ""),
+             "full": (True, True, ""), "rec": (True, True, rec_dir)}
     engines, times = {}, {name: [] for name in modes}
-    for name, (tel, full) in modes.items():
-        engines[name] = build_engine(tel, full=full)
+    for name, (tel, full, rdir) in modes.items():
+        engines[name] = build_engine(tel, full=full, recorder_dir=rdir)
     assert engines["full"].statusz is not None and \
         engines["full"].statusz.port > 0
-    for name, (tel, full) in modes.items():      # compile + warmup
+    assert engines["rec"]._recorder is not None
+    for name, (tel, full, _rdir) in modes.items():   # compile + warmup
         _apply_mode(tel, full)
         run_block(engines[name], WARMUP)
 
@@ -120,7 +131,7 @@ def main():
     done = 0
     while done < STEPS:
         n = min(block, STEPS - done)
-        for name, (tel, full) in modes.items():
+        for name, (tel, full, _rdir) in modes.items():
             _apply_mode(tel, full)
             run_block(engines[name], n, collect=times[name])
         done += n
@@ -129,25 +140,35 @@ def main():
     assert len(tracer.spans()) > 0
     from deepspeed_tpu.telemetry.goodput import get_ledger
     assert get_ledger().snapshot()["buckets"]["productive_step"] > 0
-    t_off, t_on, t_full = times["off"], times["on"], times["full"]
+    # the recorder recorded every step and — with no trigger firing —
+    # wrote nothing to disk
+    assert len(engines["rec"]._recorder._records) >= STEPS
+    assert engines["rec"]._recorder.bundles() == []
+    t_off, t_on = times["off"], times["on"]
+    t_full, t_rec = times["full"], times["rec"]
     for engine in engines.values():
         engine.close()
 
     off_ms = statistics.median(t_off) * 1e3
     on_ms = statistics.median(t_on) * 1e3
     full_ms = statistics.median(t_full) * 1e3
+    rec_ms = statistics.median(t_rec) * 1e3
     overhead_pct = 100.0 * (on_ms - off_ms) / off_ms
     overhead_full_pct = 100.0 * (full_ms - off_ms) / off_ms
+    overhead_rec_pct = 100.0 * (rec_ms - off_ms) / off_ms
     result = {
         "steps": STEPS,
         "step_ms_tracer_off_p50": round(off_ms, 4),
         "step_ms_tracer_on_p50": round(on_ms, 4),
         "step_ms_full_p50": round(full_ms, 4),
+        "step_ms_recorder_p50": round(rec_ms, 4),
         "step_ms_tracer_off_mean": round(statistics.mean(t_off) * 1e3, 4),
         "step_ms_tracer_on_mean": round(statistics.mean(t_on) * 1e3, 4),
         "step_ms_full_mean": round(statistics.mean(t_full) * 1e3, 4),
+        "step_ms_recorder_mean": round(statistics.mean(t_rec) * 1e3, 4),
         "overhead_pct": round(overhead_pct, 3),
         "overhead_full_pct": round(overhead_full_pct, 3),
+        "overhead_recorder_pct": round(overhead_rec_pct, 3),
         "threshold_pct": THRESHOLD_PCT,
         "spans_recorded": len(tracer.spans()),
         "devices": jax.device_count(),
@@ -163,9 +184,13 @@ def main():
     assert overhead_full_pct < THRESHOLD_PCT, (
         f"telemetry+ledger+statusz overhead {overhead_full_pct:.2f}% "
         f"exceeds the {THRESHOLD_PCT}% budget")
-    print(f"OK: tracer-on overhead {overhead_pct:.2f}%, with goodput "
-          f"ledger + statusz server {overhead_full_pct:.2f}% — both < "
-          f"{THRESHOLD_PCT}%")
+    assert overhead_rec_pct < THRESHOLD_PCT, (
+        f"total observability overhead (tracer+ledger+statusz+flight "
+        f"recorder) {overhead_rec_pct:.2f}% exceeds the "
+        f"{THRESHOLD_PCT}% budget")
+    print(f"OK: tracer-on overhead {overhead_pct:.2f}%, + goodput "
+          f"ledger + statusz server {overhead_full_pct:.2f}%, + flight "
+          f"recorder {overhead_rec_pct:.2f}% — all < {THRESHOLD_PCT}%")
 
 
 if __name__ == "__main__":

@@ -204,7 +204,23 @@ def _size_bytes(x):
         return 0
 
 
+# cumulative collective accounting (ops + payload bytes), maintained
+# unconditionally — two integer adds at trace time. The flight recorder
+# diffs this per step record to show how much collective traffic the
+# anomalous step carried, without scanning the span ring.
+_COMM_OPS = 0
+_COMM_BYTES = 0
+
+
+def comm_stats():
+    """Cumulative {ops, bytes} traced through the collective wrappers."""
+    return {"ops": _COMM_OPS, "bytes": _COMM_BYTES}
+
+
 def _log(name, tensor, axis_name):
+    global _COMM_OPS, _COMM_BYTES
+    _COMM_OPS += 1
+    _COMM_BYTES += _size_bytes(tensor)
     cl = get_comms_logger()
     if cl is not None and cl.enabled:
         cl.append(name, _size_bytes(tensor), str(axis_name))

@@ -20,6 +20,9 @@ Fault points (each checked via ``fault(name)`` at its site):
 - ``preempt_signal``  — the engine treats the step boundary as if SIGTERM
   had arrived (exercises emergency checkpoint + drain without a real
   signal).
+- ``slow_step``       — the training engine sleeps long enough inside the
+  step for the flight recorder's k×EMA slow-step rule to fire (exercises
+  anomaly capture without depending on machine load).
 
 Arming is deterministic and count-based: ``arm(name, times=2, skip=1)``
 fires on the 2nd and 3rd hits of the fault point, then disarms itself.
@@ -39,6 +42,7 @@ KNOWN_FAULTS = frozenset({
     "io_read_corrupt",
     "nan_loss",
     "preempt_signal",
+    "slow_step",
 })
 
 

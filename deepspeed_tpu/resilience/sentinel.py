@@ -31,12 +31,16 @@ class SentinelError(RuntimeError):
 
 class TrainingSentinel:
 
-    def __init__(self, config, tracer=None):
+    def __init__(self, config, tracer=None, recorder=None):
         self.policy = config.sentinel_policy
         self.patience = int(config.sentinel_patience)
         self.grad_norm_threshold = float(config.sentinel_grad_norm_threshold)
         self.max_rollbacks = int(config.max_rollbacks)
         self.tracer = tracer
+        # flight recorder (telemetry/flight_recorder.py): a bad step is a
+        # postmortem trigger — capture the evidence before the rollback
+        # path rewrites the state
+        self.recorder = recorder
         self.bad_steps = 0
         self.consecutive_bad = 0
         self.rollbacks = 0
@@ -73,6 +77,9 @@ class TrainingSentinel:
                                     float(self.bad_steps), step)
             self.tracer.instant("sentinel_bad_step", cat="resilience",
                                 args={"reason": reason, "step": step})
+        if self.recorder is not None:
+            self.recorder.trigger("sentinel", f"step {step}: {reason}",
+                                  step=step)
         if self.policy == "rollback" and \
                 self.consecutive_bad >= self.patience:
             self.consecutive_bad = 0

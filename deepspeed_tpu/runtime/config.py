@@ -165,6 +165,84 @@ class StatuszConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class FlightRecorderConfig(DeepSpeedConfigModel):
+    """The ``"flight_recorder"`` config block
+    (telemetry/flight_recorder.py): an always-on bounded ring of recent
+    step records plus anomaly-triggered postmortem bundles on disk.
+    Disabled (the default) allocates nothing — no object, no directory,
+    no thread.
+
+    Trigger rules: step time over ``slow_step_factor`` × EMA (armed
+    after ``warmup_steps`` baseline steps; ``slow_step_ms`` adds an
+    absolute ceiling), recompile-watchdog events, sentinel NaN/grad-spike
+    events, serving SLO burn rate over ``slo_burn_threshold``,
+    preemption latch, hostagg straggler edges, and explicit
+    ``/debug/capture`` requests. Bundles are keep-last-``keep`` with
+    atomic writes and per-kind ``debounce_s`` so a pathological run
+    cannot fill the disk or capture in a loop."""
+    enabled: bool = False
+    #: bundle output directory (created lazily at the first trigger)
+    dir: str = "flight_bundles"
+    #: step records kept in memory (each bundle embeds the full ring)
+    ring: int = 256
+    #: on-disk bundles kept (oldest deleted first)
+    keep: int = 8
+    #: min seconds between bundles of the SAME trigger kind
+    debounce_s: float = 30.0
+    slow_step_factor: float = 3.0
+    #: absolute slow-step ceiling in ms; 0 disables the absolute rule
+    slow_step_ms: float = 0.0
+    warmup_steps: int = 5
+    ema_alpha: float = 0.2
+    #: trace-slice window embedded in each bundle, ms
+    trace_ms: float = 10_000.0
+    #: serving: SLO error-budget burn rate that triggers a capture
+    slo_burn_threshold: float = 2.0
+
+    def validate(self):
+        if self.ring < 8:
+            raise ConfigError("flight_recorder.ring must be >= 8")
+        if self.keep < 1:
+            raise ConfigError("flight_recorder.keep must be >= 1")
+        if self.debounce_s < 0:
+            raise ConfigError("flight_recorder.debounce_s must be >= 0")
+        if self.slow_step_factor <= 1.0:
+            raise ConfigError(
+                "flight_recorder.slow_step_factor must be > 1")
+        if not (0.0 < self.ema_alpha <= 1.0):
+            raise ConfigError(
+                "flight_recorder.ema_alpha must be in (0, 1]")
+        if self.trace_ms <= 0:
+            raise ConfigError("flight_recorder.trace_ms must be > 0")
+        if self.warmup_steps < 1:
+            raise ConfigError("flight_recorder.warmup_steps must be >= 1")
+
+
+@dataclasses.dataclass
+class HostAggConfig(DeepSpeedConfigModel):
+    """The ``"hostagg"`` config block (telemetry/hostagg.py): cross-host
+    straggler attribution. Every ``interval`` steps each host contributes
+    a tiny metrics vector (step time, data-wait, heartbeat seqno) to a
+    low-frequency all-gather; the aggregate exports ``dstpu_host_*``
+    gauges, flags the slowest host as a straggler when max/median exceeds
+    ``straggler_factor`` (a flight-recorder trigger), and reports a host
+    whose seqno stalls for ``heartbeat_misses`` aggregations as a missing
+    heartbeat (flips /healthz)."""
+    enabled: bool = False
+    interval: int = 10
+    straggler_factor: float = 1.5
+    heartbeat_misses: int = 3
+
+    def validate(self):
+        if self.interval < 1:
+            raise ConfigError("hostagg.interval must be >= 1")
+        if self.straggler_factor <= 1.0:
+            raise ConfigError("hostagg.straggler_factor must be > 1")
+        if self.heartbeat_misses < 1:
+            raise ConfigError("hostagg.heartbeat_misses must be >= 1")
+
+
+@dataclasses.dataclass
 class FlopsProfilerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     profile_step: int = 1
@@ -255,6 +333,9 @@ class DeepSpeedConfig:
         self.prometheus = MonitorSinkConfig.from_dict(pd.get(C.PROMETHEUS, {}))
         self.telemetry = TelemetryConfig.from_dict(pd.get(C.TELEMETRY, {}))
         self.statusz = StatuszConfig.from_dict(pd.get(C.STATUSZ, {}))
+        self.flight_recorder = FlightRecorderConfig.from_dict(
+            pd.get(C.FLIGHT_RECORDER, {}))
+        self.hostagg = HostAggConfig.from_dict(pd.get(C.HOSTAGG, {}))
         self.flops_profiler = FlopsProfilerConfig.from_dict(pd.get(C.FLOPS_PROFILER, {}))
         self.checkpoint_config = CheckpointConfig.from_dict(pd.get(C.CHECKPOINT, {}))
         # fault tolerance: checkpoint integrity/fallback, preemption

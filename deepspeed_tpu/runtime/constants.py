@@ -82,6 +82,8 @@ CSV_MONITOR = "csv_monitor"
 PROMETHEUS = "prometheus"
 TELEMETRY = "telemetry"
 STATUSZ = "statusz"
+FLIGHT_RECORDER = "flight_recorder"
+HOSTAGG = "hostagg"
 FLOPS_PROFILER = "flops_profiler"
 RESILIENCE = "resilience"
 

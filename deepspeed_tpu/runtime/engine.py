@@ -414,6 +414,26 @@ class DeepSpeedEngine:
                                       # reclassification in _post_step
         self._watchdog = RecompileWatchdog()
         self._step_flops: Dict[int, int] = {}   # id(step_fn) -> analytic flops
+        self._step_cost: Dict[int, dict] = {}   # id(step_fn) -> cost summary
+        self._last_fn_id = None                 # active compiled executable
+        # flight recorder (telemetry/flight_recorder.py): bounded ring of
+        # step records + anomaly-triggered postmortem bundles. Off by
+        # default = no object, no directory, no thread.
+        self._recorder = None
+        if cfg.flight_recorder.enabled:
+            from ..telemetry.flight_recorder import FlightRecorder
+            self._recorder = FlightRecorder(cfg.flight_recorder,
+                                            tracer=self.tracer)
+            self._recorder.add_provider("training", self._statusz_section)
+            self._recorder.set_cost_provider(self._xla_cost_summary)
+        # cross-host straggler attribution (telemetry/hostagg.py): per-host
+        # step-time/data-wait/heartbeat vector on a low-frequency gather
+        self._hostagg = None
+        self._last_data_wait_s = 0.0
+        if cfg.hostagg.enabled:
+            from ..telemetry.hostagg import HostAggregator
+            self._hostagg = HostAggregator(cfg.hostagg, tracer=self.tracer,
+                                           owner=self)
         # per-engine monitor-event buffer (bounded: survives a disabled
         # monitor without growing) — NOT the tracer's global queue, so two
         # engines in one process can't drain each other's events
@@ -436,7 +456,8 @@ class DeepSpeedEngine:
         self._sentinel = None
         if rcfg.sentinel_policy != "off":
             from ..resilience.sentinel import TrainingSentinel
-            self._sentinel = TrainingSentinel(rcfg, tracer=self.tracer)
+            self._sentinel = TrainingSentinel(rcfg, tracer=self.tracer,
+                                              recorder=self._recorder)
         self._preemption = None
         if rcfg.handle_signals:
             from ..resilience.preemption import PreemptionHandler
@@ -455,6 +476,13 @@ class DeepSpeedEngine:
             self.statusz = StatuszServer(cfg.statusz, tracer=self.tracer)
             self.statusz.register("training", self._statusz_section)
             self.statusz.register_health("training", self._health_check)
+            if self._recorder is not None:
+                self.statusz.attach_recorder(self._recorder)
+            if self._hostagg is not None:
+                self.statusz.attach_hostagg(self._hostagg)
+                # a host with a heartbeat gap is a pod problem: flip
+                # /healthz so the operator's probe sees it
+                self.statusz.register_health("hosts", self._hostagg.health)
 
         self._grad_acc_buffer = None
         self._grad_acc_count = 0
@@ -1003,6 +1031,18 @@ class DeepSpeedEngine:
         assert self.optimizer is not None
         cfg = self._config
         self._check_preemption()
+        # flight recorder: the step record's wall time starts here so an
+        # injected (or real) input-pipeline stall is part of the step the
+        # operator sees — the record's goodput deltas attribute it
+        rec = self._recorder
+        t_rec = time.perf_counter() if (rec is not None or
+                                        self._hostagg is not None) else 0.0
+        if rec is not None:
+            from ..resilience.faults import fault
+            if fault("slow_step"):
+                # deterministic slow-step injection: sleep well past the
+                # k×EMA trigger whatever this machine's step time is
+                time.sleep(0.05 + 5.0 * rec.ema_ms / 1e3)
         if batch is None:
             batch = self._next_gas_batch(data_iter)
         batch = self._apply_curriculum(batch)
@@ -1013,6 +1053,9 @@ class DeepSpeedEngine:
                 metrics = self._param_runner.train_batch(batch)
             self.micro_steps += cfg.gradient_accumulation_steps
             self._ledger_step_iv = g_iv
+            if rec is not None or self._hostagg is not None:
+                self._flight_record((time.perf_counter() - t_rec) * 1e3,
+                                    False, False)
             self._post_step(metrics)
             self.tput_timer.stop(global_step=True)
             return metrics["loss"]
@@ -1075,12 +1118,22 @@ class DeepSpeedEngine:
         first_sight = fn is not None and not self._watchdog.seen(fn)
         rc_before = self._watchdog.recompiles
         self._telemetry_step_end(fn, step_span)
+        if fn is not None and not tr.enabled and \
+                (rec is not None or self._hostagg is not None):
+            # the watchdog normally rides _telemetry_step_end; keep the
+            # recompile trigger honest when only the recorder is on
+            self._watchdog.observe(fn, label="train_batch")
+        recompiled = self._watchdog.recompiles > rc_before
         if first_sight:
             g_iv.reclassify("compile")
-        elif self._watchdog.recompiles > rc_before:
+        elif recompiled:
             g_iv.reclassify("recompile")
+        self._last_fn_id = id(fn) if fn is not None else None
         self._ledger_step_iv = g_iv
         self.micro_steps += cfg.gradient_accumulation_steps
+        if rec is not None or self._hostagg is not None:
+            self._flight_record((time.perf_counter() - t_rec) * 1e3,
+                                first_sight, recompiled)
         self._post_step(metrics)
         self.tput_timer.stop(global_step=True)
         return metrics["loss"]
@@ -1186,6 +1239,14 @@ class DeepSpeedEngine:
             with self.mesh:
                 prof = FlopsProfiler().profile(fn, *args)
             self._step_flops[id(fn)] = int(prof["flops"])
+            # cost evidence for flight-recorder bundles: what the active
+            # compiled executable costs, per the analytic count AND XLA's
+            # own cost analysis of the lowered program
+            self._step_cost[id(fn)] = {
+                "flops": int(prof["flops"]),
+                "xla_flops": prof.get("xla_flops"),
+                "per_phase": prof.get("per_phase"),
+            }
         except Exception as e:
             logger.warning(f"telemetry: step flops profile failed: {e}")
             self._step_flops[id(fn)] = 0
@@ -1238,6 +1299,43 @@ class DeepSpeedEngine:
         except OSError as e:
             logger.warning(f"telemetry export failed: {e}")
 
+    def _xla_cost_summary(self) -> dict:
+        """Bundle section: the XLA cost-analysis summary of the compiled
+        executable the last step ran (captured when the MFU profiler
+        traced it; empty when telemetry.mfu is off)."""
+        return dict(self._step_cost.get(self._last_fn_id, {}))
+
+    def _flight_record(self, dur_ms, compiled, recompiled):
+        """Feed one finished step to the flight recorder (ring record,
+        slow-step rule, recompile trigger) and the host aggregator
+        (straggler attribution on its gather cadence)."""
+        rec = self._recorder
+        if rec is not None:
+            rec.record_step(self.global_steps, dur_ms, compile=compiled,
+                            recompile=recompiled)
+            if recompiled:
+                rec.trigger(
+                    "recompile",
+                    f"step {self.global_steps}: jit cache grew "
+                    f"({self._watchdog.recompiles} recompiles total)",
+                    step=self.global_steps)
+        agg = self._hostagg
+        if agg is not None:
+            dw_ms = 0.0
+            if self._ledger.enabled:
+                dw = self._ledger.totals().get("data_wait", 0.0)
+                dw_ms = max(0.0, (dw - self._last_data_wait_s) * 1e3)
+                self._last_data_wait_s = dw
+            agg.update_local(dur_ms, data_wait_ms=dw_ms)
+            res = agg.maybe_aggregate(self.global_steps + 1)
+            if res and res.get("new_straggler") and rec is not None:
+                rec.trigger(
+                    "straggler",
+                    f"host {res['straggler']} step time "
+                    f"{res['max_ms']:.1f}ms vs median "
+                    f"{res['median_ms']:.1f}ms ({res['spread']:.2f}x)",
+                    step=self.global_steps)
+
     def _next_gas_batch(self, data_iter):
         """Stack gas micro-batches from an iterator into [gas, ...] leaves.
         Time blocked on the input pipeline is ``data_wait`` badput."""
@@ -1286,6 +1384,13 @@ class DeepSpeedEngine:
         tr = self.tracer
         tr.set_counter("resilience/preemptions", 1.0, self.global_steps,
                        owner=self)
+        if self._recorder is not None:
+            # capture BEFORE the emergency save: there may be no second
+            # chance, so the preemption trigger bypasses debounce
+            self._recorder.trigger(
+                "preemption",
+                f"signal latched at step {self.global_steps}",
+                step=self.global_steps, force=True)
         with tr.span("emergency_checkpoint", cat="resilience",
                      args={"step": self.global_steps}):
             # outermost-wins: the emergency save's IO counts as
@@ -1530,11 +1635,10 @@ class DeepSpeedEngine:
         import hashlib
         cfg_bytes = json.dumps(self._config._param_dict, sort_keys=True,
                                default=str).encode()
-        counters = self.tracer.counters()
 
         def gauge(tag):
-            val = counters.get(tag)
-            return round(val[0], 4) if val is not None else None
+            val = self.tracer.counter_value(tag)
+            return round(val, 4) if val is not None else None
 
         out = {
             "config_fingerprint": hashlib.sha256(cfg_bytes).hexdigest()[:12],

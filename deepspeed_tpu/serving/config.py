@@ -87,6 +87,11 @@ class ServingConfig(DeepSpeedConfigModel):
     # and error-budget burn rate (serving/metrics.py)
     slo: Any = None
 
+    # flight_recorder (dict -> runtime.config.FlightRecorderConfig):
+    # per-tick step records (queue depth, SLO burn) + postmortem bundles
+    # on SLO burn-rate spikes, preemption, and /debug/capture
+    flight_recorder: Any = None
+
     # resilience (dict -> resilience.config.ResilienceConfig): with
     # handle_signals, SIGTERM/SIGINT stops admissions and drains in-flight
     # requests at the next tick (running slots complete, queued requests
@@ -130,6 +135,12 @@ class ServingConfig(DeepSpeedConfigModel):
             self.slo = SLOConfig.from_dict(self.slo)
         elif self.slo is None:
             self.slo = SLOConfig()
+        from ..runtime.config import FlightRecorderConfig
+        if isinstance(self.flight_recorder, dict):
+            self.flight_recorder = FlightRecorderConfig.from_dict(
+                self.flight_recorder)
+        elif self.flight_recorder is None:
+            self.flight_recorder = FlightRecorderConfig()
         from ..resilience.config import ResilienceConfig
         if isinstance(self.resilience, dict):
             self.resilience = ResilienceConfig.from_dict(self.resilience)

@@ -65,12 +65,24 @@ class ServingEngine:
         self.metrics = ServingMetrics(monitor=self.monitor,
                                       monitor_interval=config.monitor_interval,
                                       tracer=self.tracer, slo=config.slo)
+        # flight recorder: per-tick records (queue depth, SLO burn) +
+        # postmortem bundles on burn-rate spikes / preemption / explicit
+        # /debug/capture; off by default = nothing allocated
+        self._recorder = None
+        self._last_burn = 0.0
+        if getattr(config.flight_recorder, "enabled", False):
+            from ..telemetry.flight_recorder import FlightRecorder
+            self._recorder = FlightRecorder(config.flight_recorder,
+                                            tracer=self.tracer)
+            self._recorder.add_provider("serving", self._statusz_section)
         self.statusz = None
         if getattr(config.statusz, "enabled", False):
             from ..telemetry.statusz import StatuszServer
             self.statusz = StatuszServer(config.statusz, tracer=self.tracer)
             self.statusz.register("serving", self._statusz_section)
             self.statusz.register_health("serving", self._health_check)
+            if self._recorder is not None:
+                self.statusz.attach_recorder(self._recorder)
         self.scheduler = ContinuousBatchingScheduler(
             engine, config, metrics=self.metrics, clock=clock, seed=seed)
         self._requests: Dict[int, Request] = {}
@@ -130,11 +142,38 @@ class ServingEngine:
         admissions stop, running slots complete, queued requests cancel."""
         if self._check_preemption():
             return 0
+        rec = self._recorder
+        t0 = time.perf_counter() if rec is not None else 0.0
         bucket = "serving_drain" if self._draining else "serving_step"
         with self._ledger.track(bucket):
             in_flight = self.scheduler.tick()
         self.metrics.flush()
+        if rec is not None:
+            self._flight_record((time.perf_counter() - t0) * 1e3)
         return in_flight
+
+    def _flight_record(self, dur_ms: float):
+        """One scheduler tick into the flight recorder. Tick times swing
+        legitimately (prefill vs decode), so the slow-step rule stays off;
+        the serving trigger is the SLO error-budget burn rate crossing
+        ``flight_recorder.slo_burn_threshold`` (edge-triggered — a burn
+        that stays high fires once, not every tick)."""
+        rec = self._recorder
+        burn = self.metrics.last_burn_rate
+        rec.record_step(self.metrics.ticks, dur_ms, slow_check=False,
+                        extra={"queue_depth": self.queue_depth,
+                               "active_requests": self.active_requests,
+                               "draining": self._draining,
+                               "slo_burn_rate": burn})
+        if burn is not None:
+            thresh = rec.slo_burn_threshold
+            if burn > thresh and self._last_burn <= thresh:
+                rec.trigger(
+                    "slo_burn",
+                    f"tick {self.metrics.ticks}: burn rate {burn:.2f} "
+                    f"crossed {thresh:g} (queue {self.queue_depth}, "
+                    f"{self.active_requests} active)")
+            self._last_burn = burn
 
     def _check_preemption(self) -> bool:
         if self._preemption is None or self._draining:
@@ -146,6 +185,14 @@ class ServingEngine:
             return False
         self._preempt_drained = True
         self.tracer.set_counter("resilience/preemptions", 1.0, owner=self)
+        if self._recorder is not None:
+            # capture before the drain rewrites queue/slot state; bypasses
+            # debounce — there is no second chance after a preemption
+            self._recorder.trigger(
+                "preemption",
+                f"serving drain on preemption signal "
+                f"({self.active_requests} running, {self.queue_depth} "
+                f"queued)", force=True)
         log_dist("serving: preemption signal received; draining "
                  f"({self.active_requests} running, {self.queue_depth} "
                  f"queued)", ranks=[0])

@@ -57,6 +57,11 @@ class ServingMetrics:
         self.timeouts = 0
         self.tokens_out = 0
         self.ticks = 0
+        #: last computed SLO burn rate (refreshed every monitor_interval
+        #: ticks by _emit_slo_gauges); None until targets produce one.
+        #: The per-tick flight-recorder path reads this instead of
+        #: re-walking the O(window) percentile sources every tick.
+        self.last_burn_rate: Optional[float] = None
         self._events: List[Tuple[str, float, int]] = []
         self._closed = False
 
@@ -151,8 +156,8 @@ class ServingMetrics:
                 for q in ("p50", "p95", "p99"):
                     self._gauge(f"serving/{name}_{q}", ps[q])
         if any(v is not None for v in self._slo_targets().values()):
-            self._gauge("serving/slo_burn_rate",
-                        self.slo_status()["burn_rate"])
+            self.last_burn_rate = self.slo_status()["burn_rate"]
+            self._gauge("serving/slo_burn_rate", self.last_burn_rate)
 
     # ------------------------------------------------------------- fan-out
     def _gauge(self, tag: str, value: float):

@@ -18,16 +18,19 @@ docs/observability.md.
 
 from .trace import (Span, Tracer, RecompileWatchdog, get_tracer,
                     configure_tracer)
-from .export import (chrome_trace, write_chrome_trace, metrics_snapshot,
-                     write_snapshot, prometheus_dump, span_aggregates,
-                     comm_table)
+from .export import (chrome_trace, write_chrome_trace, chrome_trace_slice,
+                     metrics_snapshot, write_snapshot, prometheus_dump,
+                     span_aggregates, comm_table)
 from .monitor_sink import TelemetryMonitor
 from .goodput import GoodputLedger, get_ledger, configure_ledger
 from .statusz import StatuszServer
+from .flight_recorder import FlightRecorder
+from .hostagg import HostAggregator
 
 __all__ = ["Span", "Tracer", "RecompileWatchdog", "get_tracer",
            "configure_tracer", "chrome_trace", "write_chrome_trace",
-           "metrics_snapshot", "write_snapshot", "prometheus_dump",
-           "span_aggregates", "comm_table", "TelemetryMonitor",
-           "GoodputLedger", "get_ledger", "configure_ledger",
-           "StatuszServer"]
+           "chrome_trace_slice", "metrics_snapshot", "write_snapshot",
+           "prometheus_dump", "span_aggregates", "comm_table",
+           "TelemetryMonitor", "GoodputLedger", "get_ledger",
+           "configure_ledger", "StatuszServer", "FlightRecorder",
+           "HostAggregator"]

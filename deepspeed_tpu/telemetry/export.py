@@ -17,6 +17,7 @@ Three views over one ``Tracer``:
 
 import json
 import re
+import time
 from typing import Any, Dict, List, Optional
 
 from .trace import Tracer, get_tracer
@@ -28,9 +29,9 @@ def _calc_bw(op, nbytes, dur_s, n):
     from ..comm.logging import calc_bw_log
     return calc_bw_log(op, nbytes, dur_s, n)
 
-__all__ = ["chrome_trace", "write_chrome_trace", "span_aggregates",
-           "comm_table", "metrics_snapshot", "write_snapshot",
-           "prometheus_dump"]
+__all__ = ["chrome_trace", "write_chrome_trace", "chrome_trace_slice",
+           "span_aggregates", "comm_table", "metrics_snapshot",
+           "write_snapshot", "prometheus_dump"]
 
 
 def _pid() -> int:
@@ -72,6 +73,23 @@ def write_chrome_trace(path: str, tracer: Optional[Tracer] = None) -> str:
     with open(path, "w") as f:
         json.dump(chrome_trace(tracer), f)
     return path
+
+
+def chrome_trace_slice(tracer: Optional[Tracer] = None,
+                       last_ms: Optional[float] = None) -> Dict[str, Any]:
+    """Chrome trace JSON cut to the last ``last_ms`` milliseconds of span
+    activity (span timestamps share the ``perf_counter_ns`` clock, so
+    "now" is directly comparable). ``None`` = the full buffer. Shared by
+    the statusz ``/trace`` endpoint and the flight-recorder bundles."""
+    doc = chrome_trace(tracer)
+    if last_ms is None:
+        return doc
+    cutoff = time.perf_counter_ns() / 1e3 - float(last_ms) * 1e3
+    doc["traceEvents"] = [
+        ev for ev in doc["traceEvents"]
+        if ev["ph"] == "M" or
+        ev.get("ts", 0) + ev.get("dur", 0) >= cutoff]
+    return doc
 
 
 def _bw_args(sp) -> Dict[str, float]:
@@ -167,13 +185,23 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
     """Prometheus text exposition of the gauges + span aggregates."""
     tracer = tracer or get_tracer()
     lines: List[str] = []
+    host_lines: List[str] = []
     lines.append(f"# TYPE {prefix}_metric gauge")
     for tag, (val, _step) in sorted(tracer.counters().items()):
         try:
             fval = float(val)
         except (TypeError, ValueError):
             continue
+        if tag.startswith("host/"):
+            # per-host aggregates (telemetry/hostagg.py) get dedicated
+            # series — dashboards alert on dstpu_host_step_time_spread
+            # without label-matching through the generic gauge
+            name = _prom(tag[len("host/"):])
+            host_lines.append(f"# TYPE {prefix}_host_{name} gauge")
+            host_lines.append(f"{prefix}_host_{name} {fval}")
+            continue
         lines.append(f'{prefix}_metric{{tag="{_prom(tag)}"}} {fval}')
+    lines.extend(host_lines)
     aggs = span_aggregates(tracer)
     if aggs:
         lines.append(f"# TYPE {prefix}_span_ms_total counter")

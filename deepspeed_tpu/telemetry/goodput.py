@@ -185,6 +185,12 @@ class GoodputLedger:
         self._export()
 
     # -------------------------------------------------------------- reading
+    def totals(self) -> Dict[str, float]:
+        """Raw bucket totals (no idle residual, no rounding) — what the
+        flight recorder diffs per step record; cheaper than snapshot()."""
+        with self._lock:
+            return dict(self._buckets)
+
     def wall_seconds(self) -> float:
         if self._t0 is None:
             return 0.0

@@ -1,0 +1,199 @@
+"""Cross-host aggregation — straggler attribution + heartbeat gaps.
+
+On a multi-host slice every collective is a barrier: the whole pod runs at
+the speed of its slowest host, and per-host telemetry alone cannot say
+*which* host that is. The aggregator piggybacks a tiny per-host metrics
+vector — ``[host_id, step_time_ms, data_wait_ms, heartbeat_seqno]`` — on a
+low-frequency all-gather (``multihost_utils.process_allgather`` every
+``interval`` steps; a few doubles per host, noise next to a train step)
+and computes:
+
+- **per-host spread**: min / median / max step time across hosts, and the
+  ``spread`` ratio max/median;
+- **slowest-host attribution**: when spread exceeds
+  ``straggler_factor`` the slowest host is flagged as the straggler —
+  an *edge* on the flag is a flight-recorder trigger
+  (telemetry/flight_recorder.py), so the postmortem bundle names the
+  host while the evidence is fresh;
+- **heartbeat gaps**: a host whose seqno stops advancing for
+  ``heartbeat_misses`` consecutive aggregations is reported missing
+  (its step loop is stuck even though it still answers the collective),
+  which flips ``/healthz`` through the registered health check.
+
+Results are exported as ``host/*`` tracer gauges, which
+``prometheus_dump`` emits as dedicated ``dstpu_host_*`` series, and as
+the ``hosts`` document on ``/statusz`` (the straggler table
+``bin/ds_tpu_top`` renders).
+
+Single-process runs (and tests) inject ``gather_fn`` to simulate
+per-host feeds; the default gather degrades to a one-host list outside a
+multi-process runtime.
+"""
+
+from typing import Any, Callable, Dict, List, Optional
+
+from .trace import get_tracer
+
+__all__ = ["HostAggregator"]
+
+
+def _process_index() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def _default_gather(vec: List[float]) -> List[List[float]]:
+    """All-gather one metrics vector across hosts; identity when the
+    runtime is single-process."""
+    try:
+        import jax
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+            rows = multihost_utils.process_allgather(
+                np.asarray(vec, np.float64))
+            return [list(map(float, row))
+                    for row in np.asarray(rows).reshape(-1, len(vec))]
+    except Exception:
+        pass
+    return [list(vec)]
+
+
+class HostAggregator:
+    """Per-host metrics vector exchange + straggler/heartbeat analysis."""
+
+    def __init__(self, config=None, tracer=None,
+                 gather_fn: Optional[Callable] = None,
+                 host_id: Optional[int] = None, owner: Any = None):
+        def g(key, default):
+            return getattr(config, key, default) if config is not None \
+                else default
+
+        self.tracer = tracer or get_tracer()
+        self.interval = max(1, int(g("interval", 10)))
+        self.straggler_factor = float(g("straggler_factor", 1.5))
+        self.heartbeat_misses = max(1, int(g("heartbeat_misses", 3)))
+        self._gather = gather_fn or _default_gather
+        self._host_id = host_id if host_id is not None else _process_index()
+        self._owner = owner
+        self._seqno = 0
+        self._step_ms = 0.0
+        self._data_wait_ms = 0.0
+        self._rounds = 0
+        #: host -> [last seqno, rounds since it advanced]
+        self._seen: Dict[int, List[int]] = {}
+        self._prev_straggler: Optional[int] = None
+        self.last: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------ local feed
+    def update_local(self, step_time_ms: float, data_wait_ms: float = 0.0):
+        """Record this host's latest step. The seqno is the heartbeat: a
+        stuck step loop stops advancing it even if the host still answers
+        the gather."""
+        self._seqno += 1
+        self._step_ms = float(step_time_ms)
+        self._data_wait_ms = float(data_wait_ms)
+
+    # ------------------------------------------------------------- aggregate
+    def maybe_aggregate(self, steps_done: int) -> Optional[Dict[str, Any]]:
+        """Aggregate on the configured step cadence; None off-cadence.
+        Every host must call this at the same steps — the gather is a
+        collective."""
+        if steps_done % self.interval != 0:
+            return None
+        return self.aggregate()
+
+    def aggregate(self) -> Dict[str, Any]:
+        vec = [float(self._host_id), self._step_ms, self._data_wait_ms,
+               float(self._seqno)]
+        rows = self._gather(vec)
+        self._rounds += 1
+        hosts: Dict[int, Dict[str, Any]] = {}
+        for row in rows:
+            hosts[int(row[0])] = {"step_time_ms": round(float(row[1]), 3),
+                                  "data_wait_ms": round(float(row[2]), 3),
+                                  "seqno": int(row[3])}
+        missing = []
+        for hid in sorted(hosts):
+            state = self._seen.get(hid)
+            seq = hosts[hid]["seqno"]
+            if state is None or seq > state[0]:
+                self._seen[hid] = [seq, 0]
+            else:
+                state[1] += 1
+                if state[1] >= self.heartbeat_misses:
+                    missing.append(hid)
+
+        by_time = sorted((h["step_time_ms"], hid)
+                         for hid, h in hosts.items())
+        times = [t for t, _ in by_time]
+        n = len(times)
+        # true median (mean of the middle two for even n): the upper-middle
+        # shortcut would make a 2-host straggler mathematically invisible
+        # (median == max ⇒ spread pinned at 1.0)
+        median = times[n // 2] if n % 2 else \
+            0.5 * (times[n // 2 - 1] + times[n // 2])
+        max_ms, slowest = by_time[-1]
+        spread = max_ms / max(median, 1e-9)
+        straggler = slowest if (len(hosts) > 1 and
+                                spread > self.straggler_factor) else None
+        res: Dict[str, Any] = {
+            "round": self._rounds,
+            "n_hosts": len(hosts),
+            "min_ms": round(by_time[0][0], 3),
+            "median_ms": round(median, 3),
+            "max_ms": round(max_ms, 3),
+            "spread": round(spread, 3),
+            "straggler": straggler,
+            # edge detection: the flight-recorder trigger fires when a
+            # straggler APPEARS (or moves), not on every cadence it persists
+            "new_straggler": straggler is not None and
+            straggler != self._prev_straggler,
+            "missing": missing,
+            "hosts": hosts,
+        }
+        self._prev_straggler = straggler
+        self.last = res
+        self._export(res)
+        return res
+
+    def _export(self, res: Dict[str, Any]):
+        """Mirror the aggregate into ``host/*`` gauges (prometheus_dump
+        emits these as dedicated ``dstpu_host_*`` series)."""
+        tr = self.tracer
+        o = self._owner
+
+        def gauge(name, value):
+            tr.set_counter(f"host/{name}", float(value), owner=o)
+
+        gauge("n_hosts", res["n_hosts"])
+        gauge("step_time_min_ms", res["min_ms"])
+        gauge("step_time_median_ms", res["median_ms"])
+        gauge("step_time_max_ms", res["max_ms"])
+        gauge("step_time_spread", res["spread"])
+        gauge("straggler", -1 if res["straggler"] is None
+              else res["straggler"])
+        gauge("missing_heartbeats", len(res["missing"]))
+
+    # --------------------------------------------------------------- health
+    def health(self):
+        """/healthz check: a host with a heartbeat gap is a pod problem."""
+        if self.last is not None and self.last["missing"]:
+            return False, (f"missing heartbeat from host(s) "
+                           f"{self.last['missing']}")
+        n = self.last["n_hosts"] if self.last is not None else 0
+        return True, f"{n} host(s) reporting"
+
+    # -------------------------------------------------------------- summary
+    def summary(self) -> Dict[str, Any]:
+        """The ``hosts`` document on /statusz (what ds_tpu_top renders)."""
+        if self.last is None:
+            return {"n_hosts": 0, "rounds": 0}
+        out = dict(self.last)
+        out.pop("new_straggler", None)
+        # JSON object keys are strings; normalize so consumers don't care
+        out["hosts"] = {str(hid): h for hid, h in out["hosts"].items()}
+        return out

@@ -18,6 +18,14 @@ human with a browser all read the same live state:
   one JSON document (what ``bin/ds_tpu_top`` polls).
 - ``/trace?last_ms=N`` — Chrome trace-event JSON of the last N ms of the
   span ring buffer (load in ui.perfetto.dev); no param = full buffer.
+- ``/debug/bundles`` / ``/debug/bundle?id=N`` / ``/debug/capture`` — the
+  flight-recorder surface (telemetry/flight_recorder.py) when one is
+  attached: list the on-disk postmortem bundles, download one, or force
+  an explicit capture (a trigger rule in its own right).
+
+Malformed query parameters (``/trace?last_ms=-5``, ``?last_ms=abc``, an
+unknown ``?format=``) answer HTTP 400 with a one-line message — a typo'd
+dashboard URL must not surface a 500 traceback.
 
 Opt-in and off by default: no thread is started and no port is bound
 unless the ``statusz`` config block enables it. The server is a stdlib
@@ -57,6 +65,8 @@ class StatuszServer:
         self._providers: Dict[str, Callable[[], dict]] = {}
         #: name -> callable() -> (healthy: bool, detail: str)
         self._health: Dict[str, Callable[[], Tuple[bool, str]]] = {}
+        self._recorder = None     # FlightRecorder (the /debug/* surface)
+        self._hostagg = None      # HostAggregator (the straggler table)
         self._t_start = time.time()
         self._httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self._httpd.daemon_threads = True
@@ -83,6 +93,18 @@ class StatuszServer:
     def unregister(self, name: str):
         self._providers.pop(name, None)
         self._health.pop(name, None)
+
+    def attach_recorder(self, recorder):
+        """Expose a FlightRecorder: /debug/bundles, /debug/bundle?id=N,
+        /debug/capture, and the fired-recently banner on /statusz."""
+        self._recorder = recorder
+        return self
+
+    def attach_hostagg(self, hostagg):
+        """Expose a HostAggregator: the ``hosts`` document in the statusz
+        JSON and the straggler table on the HTML page."""
+        self._hostagg = hostagg
+        return self
 
     # ------------------------------------------------------------ lifecycle
     @property
@@ -141,6 +163,10 @@ class StatuszServer:
         ledger = get_ledger()
         if ledger.enabled:
             doc["goodput"] = ledger.snapshot()
+        if self._recorder is not None:
+            doc["flight_recorder"] = self._recorder.summary()
+        if self._hostagg is not None:
+            doc["hosts"] = self._hostagg.summary()
         for name, provider in list(self._providers.items()):
             try:
                 doc["sections"][name] = provider()
@@ -158,18 +184,10 @@ class StatuszServer:
 
     def trace_slice(self, last_ms: Optional[float] = None) -> dict:
         """Chrome trace JSON, optionally cut to the last ``last_ms``
-        milliseconds of span activity (span timestamps share the
-        ``perf_counter_ns`` clock, so "now" is directly comparable)."""
-        from .export import chrome_trace
-        doc = chrome_trace(self.tracer)
-        if last_ms is None:
-            return doc
-        cutoff = time.perf_counter_ns() / 1e3 - float(last_ms) * 1e3
-        doc["traceEvents"] = [
-            ev for ev in doc["traceEvents"]
-            if ev["ph"] == "M" or
-            ev.get("ts", 0) + ev.get("dur", 0) >= cutoff]
-        return doc
+        milliseconds of span activity (the flight recorder writes the
+        same slice into its bundles — telemetry/export.py owns it)."""
+        from .export import chrome_trace_slice
+        return chrome_trace_slice(self.tracer, last_ms=last_ms)
 
     # ---------------------------------------------------------------- html
     def status_html(self) -> str:
@@ -194,6 +212,16 @@ class StatuszServer:
                            for k, v in rows)
             return f"<table>{body}</table>"
 
+        fr = doc.get("flight_recorder")
+        if fr and fr.get("last"):
+            last = fr["last"]
+            parts.append(
+                f"<p class='bad'><b>flight recorder fired "
+                f"{last.get('age_s', '?')}s ago</b>: "
+                f"{esc(str(last.get('kind')))} — "
+                f"{esc(str(last.get('detail', '')))} "
+                f"(<a href='/debug/bundles'>{fr.get('bundles', 0)} "
+                f"bundle(s)</a>)</p>")
         if "goodput" in doc:
             g = doc["goodput"]
             parts.append("<h2>goodput</h2>")
@@ -201,6 +229,29 @@ class StatuszServer:
                          f"<b>{g['goodput_fraction']}</b></p>")
             rows = sorted(g["buckets"].items(), key=lambda kv: -kv[1])
             parts.append(table([(k, f"{v}s") for k, v in rows if v > 0]))
+        hosts = doc.get("hosts")
+        if hosts and hosts.get("hosts"):
+            parts.append("<h2>hosts</h2>")
+            strag = hosts.get("straggler")
+            strag_txt = (f"<span class='bad'>host {strag}</span>"
+                         if strag is not None else "none")
+            parts.append(
+                f"<p>step time min/median/max "
+                f"{hosts.get('min_ms')} / {hosts.get('median_ms')} / "
+                f"{hosts.get('max_ms')} ms · spread "
+                f"{hosts.get('spread')}x · straggler {strag_txt}</p>")
+            rows = []
+            for hid, h in sorted(hosts["hosts"].items(),
+                                 key=lambda kv: str(kv[0])):
+                mark = ""
+                if strag is not None and str(hid) == str(strag):
+                    mark = " (straggler)"
+                if str(hid) in {str(m) for m in hosts.get("missing", [])}:
+                    mark = " (MISSING HEARTBEAT)"
+                rows.append((f"host {hid}{mark}",
+                             f"{h['step_time_ms']}ms · data-wait "
+                             f"{h['data_wait_ms']}ms · seq {h['seqno']}"))
+            parts.append(table(rows))
         for name, section in doc["sections"].items():
             parts.append(f"<h2>{esc(name)}</h2>")
             parts.append(table(sorted(section.items())))
@@ -235,6 +286,12 @@ def _make_handler(server: StatuszServer):
             self.end_headers()
             self.wfile.write(data)
 
+        def _bad(self, msg: str):
+            """HTTP 400 with a one-line message: a malformed query param
+            is the CALLER's bug, never a 500 traceback."""
+            self._send(400, msg.splitlines()[0] + "\n",
+                       "text/plain; charset=utf-8")
+
         def do_GET(self):
             try:
                 url = urlparse(self.path)
@@ -249,8 +306,11 @@ def _make_handler(server: StatuszServer):
                     self._send(200, prometheus_dump(server.tracer),
                                "text/plain; version=0.0.4; charset=utf-8")
                 elif path in ("/statusz", "/statusz.json", "/varz"):
-                    as_json = (path == "/statusz.json" or
-                               qs.get("format", [""])[0] == "json")
+                    fmt = qs.get("format", [""])[0]
+                    if fmt not in ("", "json", "html"):
+                        return self._bad(
+                            f"unknown format={fmt!r}: want json or html")
+                    as_json = path == "/statusz.json" or fmt == "json"
                     if as_json:
                         self._send(200, json.dumps(server.status(),
                                                    default=str),
@@ -259,13 +319,64 @@ def _make_handler(server: StatuszServer):
                         self._send(200, server.status_html(),
                                    "text/html; charset=utf-8")
                 elif path == "/trace":
-                    last_ms = qs.get("last_ms", [None])[0]
-                    doc = server.trace_slice(
-                        float(last_ms) if last_ms is not None else None)
+                    raw = qs.get("last_ms", [None])[0]
+                    last_ms = None
+                    if raw is not None:
+                        try:
+                            last_ms = float(raw)
+                        except ValueError:
+                            return self._bad(
+                                f"bad last_ms={raw!r}: want a number of "
+                                f"milliseconds")
+                        if not (last_ms >= 0) or last_ms != last_ms or \
+                                last_ms == float("inf"):
+                            return self._bad(
+                                f"bad last_ms={raw!r}: want a finite "
+                                f"number >= 0")
+                    doc = server.trace_slice(last_ms)
                     self._send(200, json.dumps(doc), "application/json")
+                elif path == "/debug/bundles":
+                    rec = server._recorder
+                    if rec is None:
+                        return self._send(
+                            404, "no flight recorder attached (enable the "
+                            "flight_recorder config block)\n",
+                            "text/plain; charset=utf-8")
+                    self._send(200,
+                               json.dumps({"bundles": rec.bundles(),
+                                           "dir": rec.dir}),
+                               "application/json")
+                elif path == "/debug/bundle":
+                    rec = server._recorder
+                    if rec is None:
+                        return self._send(
+                            404, "no flight recorder attached\n",
+                            "text/plain; charset=utf-8")
+                    raw = qs.get("id", [None])[0]
+                    if raw is None or not raw.isdigit():
+                        return self._bad(
+                            f"bad id={raw!r}: want /debug/bundle?id=N "
+                            f"(see /debug/bundles)")
+                    body = rec.read_bundle(int(raw))
+                    if body is None:
+                        return self._send(
+                            404, f"no bundle with id {raw}\n",
+                            "text/plain; charset=utf-8")
+                    self._send(200, body, "application/json")
+                elif path == "/debug/capture":
+                    rec = server._recorder
+                    if rec is None:
+                        return self._send(
+                            404, "no flight recorder attached\n",
+                            "text/plain; charset=utf-8")
+                    bundle = rec.trigger(
+                        "manual", detail="explicit /debug/capture",
+                        force=True)
+                    self._send(200, json.dumps({"bundle": bundle}),
+                               "application/json")
                 else:
                     self._send(404, "not found: try /healthz /metrics "
-                               "/statusz /trace\n",
+                               "/statusz /trace /debug/bundles\n",
                                "text/plain; charset=utf-8")
             except BrokenPipeError:      # client went away mid-response
                 pass

@@ -289,6 +289,13 @@ class Tracer:
     def counters(self) -> Dict[str, Any]:
         return dict(self._counters)
 
+    def counter_value(self, tag: str, default=None):
+        """Latest value of one gauge (without its step), or ``default`` —
+        the cheap single-tag read for per-tick consumers that must not pay
+        for a full counters() copy."""
+        val = self._counters.get(tag)
+        return val[0] if val is not None else default
+
     def drain_events(self):
         """Take all pending (tag, value, step) monitor events."""
         out = list(self._pending)

@@ -1,0 +1,419 @@
+"""Flight recorder + cross-host aggregation tests.
+
+Contracts under test: each trigger rule fires exactly once per injected
+event (slow step via the ``slow_step`` fault point, recompile via a
+seqlen change, sentinel via ``nan_loss``) and its bundle carries the
+evidence — a loadable Perfetto trace slice, a goodput snapshot that sums
+to wall-clock, the config fingerprint; retention keeps last-N bundles
+with atomic writes; per-kind debounce suppresses capture loops while
+distinct kinds still capture; a disabled config allocates no recorder, no
+thread, no directory; hostagg attributes the straggler on simulated
+per-host feeds (including a host with a stalled heartbeat seqno, which
+flips the health check) and exports dstpu_host_* gauges; statusz grows
+/debug/bundles, /debug/bundle?id=, and /debug/capture.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.runtime.config import FlightRecorderConfig, HostAggConfig
+from deepspeed_tpu.telemetry import get_tracer, prometheus_dump
+from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder
+from deepspeed_tpu.telemetry.goodput import get_ledger
+from deepspeed_tpu.telemetry.hostagg import HostAggregator
+
+TINY = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                  n_head=4, pad_vocab_to_multiple=8)
+
+
+@pytest.fixture
+def tracer():
+    tr = get_tracer()
+    prev_enabled, prev_sync = tr.enabled, tr.sync_spans
+    tr.clear()
+    tr.configure(enabled=True, buffer_size=4096, sync_spans=True)
+    yield tr
+    tr.clear()
+    tr.configure(enabled=prev_enabled, sync_spans=prev_sync)
+
+
+def _engine(bundle_dir, over=None):
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "telemetry": {"enabled": True, "mfu": False},
+        # factor 4 (not the default 3): CI noise headroom for the clean
+        # steps, while the injected sleep (5×EMA + 50ms) still always fires
+        "flight_recorder": {"enabled": True, "dir": str(bundle_dir),
+                            "warmup_steps": 2, "debounce_s": 30.0,
+                            "slow_step_factor": 4.0},
+    }
+    cfg.update(over or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(TINY),
+                                               config=cfg)
+    return engine
+
+
+def _batch(seqlen=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 255, size=(1, 8, seqlen),
+                                      dtype=np.int32)}
+
+
+def _bundle_files(bundle_dir):
+    return sorted(f for f in os.listdir(bundle_dir)
+                  if f.startswith("bundle-") and f.endswith(".json"))
+
+
+# ------------------------------------------------- trigger rules (the engine)
+
+def test_each_trigger_fires_exactly_once_per_event(tracer, tmp_path,
+                                                   faultinject):
+    """One injected slow step, one recompile, one sentinel NaN — exactly
+    one bundle per trigger class, each correctly attributed."""
+    bdir = tmp_path / "bundles"
+    # skip policy: the in-step gate withholds the NaN update, so the run
+    # recovers and the injected NaN is exactly ONE sentinel event (under
+    # "warn" the poisoned params would re-trigger every later step)
+    engine = _engine(bdir, over={
+        "resilience": {"sentinel_policy": "skip"}})
+    try:
+        for i in range(4):                              # warm baseline
+            engine.train_batch(batch=_batch(seed=i))
+        assert not bdir.exists()                        # anomaly-free: no IO
+
+        faultinject.arm("slow_step", times=1)
+        engine.train_batch(batch=_batch(seed=10))       # slow step
+        engine.train_batch(batch=_batch(seqlen=8, seed=11))   # recompile
+        faultinject.arm("nan_loss", times=1)
+        engine.train_batch(batch=_batch(seqlen=8, seed=12))   # sentinel
+
+        files = _bundle_files(bdir)
+        kinds = [f.split("-", 2)[2][:-len(".json")] for f in files]
+        assert sorted(kinds) == ["recompile", "sentinel", "slow_step"]
+        assert engine._recorder.trigger_counts == {
+            "slow_step": 1, "recompile": 1, "sentinel": 1}
+        # two more clean steps: no further triggers, no further captures
+        engine.train_batch(batch=_batch(seqlen=8, seed=13))
+        engine.train_batch(batch=_batch(seqlen=8, seed=14))
+        assert len(_bundle_files(bdir)) == 3
+        assert engine._recorder.trigger_counts == {
+            "slow_step": 1, "recompile": 1, "sentinel": 1}
+    finally:
+        engine.close()
+
+
+def test_bundle_contents_round_trip(tracer, tmp_path, faultinject):
+    """A bundle is self-contained: the trace slice loads as Chrome trace
+    JSON, the goodput snapshot sums to wall, the status section carries
+    the config fingerprint, and the step records hold the anomaly."""
+    bdir = tmp_path / "bundles"
+    engine = _engine(bdir)
+    try:
+        for i in range(4):
+            engine.train_batch(batch=_batch(seed=i))
+        faultinject.arm("slow_step", times=1)
+        engine.train_batch(batch=_batch(seed=9))
+        [fname] = _bundle_files(bdir)
+        with open(bdir / fname) as f:
+            doc = json.load(f)
+        assert doc["kind"] == "slow_step"
+        # trace slice loads under the Chrome trace-event contract
+        events = doc["trace"]["traceEvents"]
+        assert events and all({"ph", "pid"} <= set(ev) for ev in events)
+        assert any(ev.get("name") == "train_batch" for ev in events)
+        # goodput snapshot sums to wall by construction
+        g = doc["goodput"]
+        assert sum(g["buckets"].values()) == pytest.approx(g["wall_s"],
+                                                           rel=0.01)
+        # status section = the statusz training section
+        sec = doc["status"]["training"]
+        assert len(sec["config_fingerprint"]) == 12
+        assert sec["global_steps"] == 4
+        # the ring holds the anomalous step, flagged, with goodput deltas
+        slow = [r for r in doc["records"] if r.get("slow")]
+        assert len(slow) == 1
+        assert slow[0]["dur_ms"] > 3.0 * engine._recorder.ema_ms / 2
+        assert "goodput" in slow[0]
+        # counters snapshot rides along
+        assert "telemetry/step_time_ms" in doc["counters"]
+    finally:
+        engine.close()
+
+
+def test_disabled_config_allocates_nothing(tracer, tmp_path):
+    """No flight_recorder block: no recorder object, no thread, no
+    directory, no files — and no host aggregator either."""
+    before = set(threading.enumerate())
+    cwd_entries = set(os.listdir("."))
+    engine = _engine(tmp_path / "unused", over={"flight_recorder": {}})
+    try:
+        assert engine._recorder is None
+        assert engine._hostagg is None
+        engine.train_batch(batch=_batch())
+        assert not (tmp_path / "unused").exists()
+        assert set(threading.enumerate()) == before
+        assert set(os.listdir(".")) == cwd_entries
+    finally:
+        engine.close()
+
+
+# ----------------------------------------------- recorder unit: ring + rules
+
+def _recorder(tmp_path, clock=None, **over):
+    kwargs = dict(dir=str(tmp_path / "b"), warmup_steps=2, debounce_s=30.0)
+    kwargs.update(over)
+    cfg = FlightRecorderConfig(enabled=True, **kwargs)
+    extra = {"clock": clock} if clock is not None else {}
+    return FlightRecorder(cfg, tracer=get_tracer(), **extra)
+
+
+def test_slow_step_rule_ema_and_warmup(tmp_path):
+    rec = _recorder(tmp_path, warmup_steps=3)
+    # during warmup the rule is unarmed — a spike against a 1-step
+    # baseline must not capture
+    assert rec.record_step(0, 10.0) is None
+    assert rec.record_step(1, 400.0) is None
+    assert rec.trigger_counts == {}
+    rec = _recorder(tmp_path, warmup_steps=3)
+    assert rec.record_step(0, 10.0) is None
+    assert rec.record_step(1, 10.0) is None
+    assert rec.record_step(2, 10.0) is None
+    # compile/recompile steps are excluded from the rule AND the EMA
+    ema = rec.ema_ms
+    assert rec.record_step(3, 900.0, compile=True) is None
+    assert rec.record_step(4, 900.0, recompile=True) is None
+    assert rec.ema_ms == ema
+    # a normal-speed step: quiet
+    assert rec.record_step(5, 12.0) is None
+    # the anomaly fires
+    path = rec.record_step(6, 400.0)
+    assert path is not None and os.path.exists(path)
+    assert rec.trigger_counts == {"slow_step": 1}
+
+
+def test_retention_and_per_kind_debounce(tmp_path):
+    now = [0.0]
+    rec = _recorder(tmp_path, keep=3, debounce_s=10.0,
+                    clock=lambda: now[0])
+    # same kind inside the window: suppressed (counted, not written)
+    assert rec.trigger("manual", "a", force=True) is not None
+    assert rec.trigger("recompile", "b") is not None
+    assert rec.trigger("recompile", "c") is None          # debounced
+    assert rec.suppressed == 1
+    # a DIFFERENT kind is not held hostage by the recompile window
+    assert rec.trigger("sentinel", "d") is not None
+    now[0] += 11.0                                        # window expires
+    assert rec.trigger("recompile", "e") is not None
+    # keep-last-N: only the 3 newest bundle files survive
+    files = sorted(os.listdir(rec.dir))
+    assert len(files) == 3
+    assert files[0].startswith("bundle-000002-")          # oldest GC'd
+    # no torn bundles: every survivor parses
+    for f in files:
+        with open(os.path.join(rec.dir, f)) as fh:
+            json.load(fh)
+    # force bypasses debounce (preemption / explicit capture path)
+    assert rec.trigger("recompile", "f") is None
+    assert rec.trigger("recompile", "g", force=True) is not None
+
+
+def test_bundle_index_and_read(tmp_path):
+    rec = _recorder(tmp_path)
+    rec.record_step(0, 5.0)
+    p = rec.trigger("manual", "hello", force=True)
+    idx = rec.bundles()
+    assert [b["kind"] for b in idx] == ["manual"]
+    body = rec.read_bundle(idx[0]["id"])
+    doc = json.loads(body)
+    assert doc["detail"] == "hello" and doc["records"]
+    assert rec.read_bundle(999) is None
+    assert os.path.basename(p) == idx[0]["file"]
+
+
+# ------------------------------------------------------ hostagg (simulated)
+
+def _feeds(rows):
+    """gather_fn over a mutable script: each aggregate() pops one round of
+    per-host vectors [host, step_ms, data_wait_ms, seqno]."""
+    it = iter(rows)
+    return lambda vec: [list(map(float, r)) for r in next(it)]
+
+
+def test_hostagg_straggler_detection_and_gauges(tracer):
+    cfg = HostAggConfig(enabled=True, interval=1, straggler_factor=1.5)
+    agg = HostAggregator(cfg, tracer=tracer, gather_fn=_feeds([
+        [(0, 10, 0, 1), (1, 11, 0, 1), (2, 10, 1, 1), (3, 12, 0, 1)],
+        [(0, 10, 0, 2), (1, 48, 0, 2), (2, 10, 1, 2), (3, 12, 0, 2)],
+        [(0, 10, 0, 3), (1, 50, 0, 3), (2, 10, 2, 3), (3, 12, 0, 3)],
+    ]))
+    r1 = agg.aggregate()
+    assert r1["straggler"] is None and not r1["new_straggler"]
+    r2 = agg.aggregate()
+    assert r2["straggler"] == 1 and r2["new_straggler"]
+    assert r2["max_ms"] == 48 and r2["median_ms"] == 11
+    r3 = agg.aggregate()                   # persists: no new edge
+    assert r3["straggler"] == 1 and not r3["new_straggler"]
+    # gauges → dedicated dstpu_host_* prometheus series
+    text = prometheus_dump(tracer)
+    assert "dstpu_host_step_time_max_ms 50.0" in text
+    assert "dstpu_host_straggler 1.0" in text
+    assert "dstpu_host_n_hosts 4.0" in text
+    # host/* tags do NOT leak into the generic gauge dump too
+    assert 'tag="host_' not in text
+    ok, _detail = agg.health()
+    assert ok
+
+
+def test_hostagg_missing_heartbeat_flips_health(tracer):
+    cfg = HostAggConfig(enabled=True, interval=1, heartbeat_misses=2)
+    # host 2's seqno stalls at 5 while others advance
+    rounds = [[(0, 10, 0, i), (1, 10, 0, i), (2, 10, 0, 5)]
+              for i in (5, 6, 7, 8)]
+    agg = HostAggregator(cfg, tracer=tracer, gather_fn=_feeds(rounds))
+    assert agg.aggregate()["missing"] == []       # first sight: baseline
+    assert agg.aggregate()["missing"] == []       # one miss: not yet
+    res = agg.aggregate()                         # second miss: reported
+    assert res["missing"] == [2]
+    ok, detail = agg.health()
+    assert not ok and "2" in detail
+    assert prometheus_dump(tracer).count("dstpu_host_missing_heartbeats 1.0")
+
+
+def test_hostagg_cadence_and_single_host(tracer):
+    agg = HostAggregator(HostAggConfig(enabled=True, interval=5),
+                         tracer=tracer)
+    agg.update_local(12.0, data_wait_ms=1.0)
+    assert agg.maybe_aggregate(3) is None         # off-cadence
+    res = agg.maybe_aggregate(5)
+    assert res["n_hosts"] == 1 and res["straggler"] is None
+    assert res["hosts"][agg._host_id]["step_time_ms"] == 12.0
+    summary = agg.summary()
+    assert summary["n_hosts"] == 1 and "new_straggler" not in summary
+
+
+def test_engine_hostagg_straggler_triggers_bundle(tracer, tmp_path):
+    """The straggler edge is itself a flight-recorder trigger: simulate a
+    4-host gather where this host's feed rides along and another host is
+    slow — one straggler bundle appears, named after the host."""
+    bdir = tmp_path / "bundles"
+    engine = _engine(bdir, over={"hostagg": {"enabled": True,
+                                             "interval": 1}})
+    try:
+        calls = {"n": 0}
+
+        def gather(vec):
+            calls["n"] += 1
+            others = [[7.0, vec[1] * 6 if calls["n"] >= 3 else vec[1],
+                       0.0, float(calls["n"])]]
+            return [list(vec)] + others
+
+        engine._hostagg._gather = gather
+        for i in range(4):
+            engine.train_batch(batch=_batch(seed=i))
+        files = _bundle_files(bdir)
+        kinds = {f.split("-", 2)[2][:-len(".json")] for f in files}
+        assert kinds == {"straggler"}
+        [f] = files
+        with open(bdir / f) as fh:
+            doc = json.load(fh)
+        assert "host 7" in doc["detail"]
+        assert engine._hostagg.last["straggler"] == 7
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------- serving: SLO burn edge
+
+def test_serving_slo_burn_triggers_bundle(tracer, tmp_path):
+    """An SLO burn-rate spike is edge-triggered into exactly one bundle,
+    and each tick's record carries queue/SLO state."""
+    from deepspeed_tpu.serving import SamplingParams, ServingEngine
+    model = GPT2Model(GPT2Config(vocab_size=128, n_positions=64, n_embd=64,
+                                 n_layer=2, n_head=4, pad_vocab_to_multiple=1,
+                                 dtype="float32"))
+    infer = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    bdir = tmp_path / "bundles"
+    srv = ServingEngine(infer, {
+        "num_slots": 2, "max_model_len": 64,
+        # an unmeetable TTFT target: every sample violates, burn = 100x
+        "slo": {"ttft_ms": 0.001, "window": 64},
+        "monitor_interval": 1,          # refresh the burn gauge every tick
+        "flight_recorder": {"enabled": True, "dir": str(bdir),
+                            "debounce_s": 30.0, "slo_burn_threshold": 2.0}})
+    try:
+        rng = np.random.default_rng(0)
+        for _ in range(2):
+            srv.submit(rng.integers(0, 128, (4,), dtype=np.int32),
+                       SamplingParams(max_new_tokens=2))
+        srv.run_until_idle()
+        assert srv._recorder.trigger_counts.get("slo_burn") == 1
+        files = _bundle_files(bdir)
+        assert [f.split("-", 2)[2][:-len(".json")] for f in files] == \
+            ["slo_burn"]
+        with open(bdir / files[0]) as f:
+            doc = json.load(f)
+        assert "burn rate" in doc["detail"]
+        assert doc["records"]
+        assert all("queue_depth" in r and "slo_burn_rate" in r
+                   for r in doc["records"])
+    finally:
+        srv.shutdown()
+
+
+# --------------------------------------------------- statusz /debug surface
+
+def test_statusz_debug_bundle_endpoints(tracer, tmp_path):
+    import urllib.error
+    import urllib.request
+    from deepspeed_tpu.telemetry.statusz import StatuszServer
+
+    def get(url):
+        try:
+            with urllib.request.urlopen(url, timeout=5.0) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    rec = _recorder(tmp_path)
+    rec.record_step(0, 5.0)
+    srv = StatuszServer(port=0)
+    try:
+        # without a recorder the surface 404s with a one-line hint
+        code, body = get(f"{srv.url}/debug/bundles")
+        assert code == 404 and "flight recorder" in body
+        srv.attach_recorder(rec)
+
+        code, body = get(f"{srv.url}/debug/capture")
+        assert code == 200
+        bundle = json.loads(body)["bundle"]
+        assert bundle and os.path.exists(bundle)
+
+        code, body = get(f"{srv.url}/debug/bundles")
+        listing = json.loads(body)["bundles"]
+        assert len(listing) == 1 and listing[0]["kind"] == "manual"
+
+        code, body = get(f"{srv.url}/debug/bundle?id={listing[0]['id']}")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["kind"] == "manual" and doc["records"]
+
+        assert get(f"{srv.url}/debug/bundle?id=999")[0] == 404
+        assert get(f"{srv.url}/debug/bundle?id=abc")[0] == 400
+        assert get(f"{srv.url}/debug/bundle")[0] == 400
+
+        # the statusz JSON carries the recorder summary for ds_tpu_top
+        code, body = get(f"{srv.url}/statusz?format=json")
+        fr = json.loads(body)["flight_recorder"]
+        assert fr["bundles"] == 1 and fr["last"]["kind"] == "manual"
+    finally:
+        srv.close()

@@ -269,6 +269,27 @@ def test_statusz_endpoints_real_http(tracer, ledger):
         srv.close()
 
 
+def test_statusz_malformed_params_return_400(tracer):
+    """Request hardening: a typo'd dashboard URL answers 400 with a
+    one-line message, never a 500 traceback."""
+    srv = StatuszServer(port=0)
+    try:
+        for q in ("/trace?last_ms=-5", "/trace?last_ms=abc",
+                  "/trace?last_ms=nan", "/trace?last_ms=inf",
+                  "/statusz?format=xml", "/statusz?format=yaml"):
+            code, body = _get(f"{srv.url}{q}")
+            assert code == 400, f"{q} -> {code}"
+            assert len(body.strip().splitlines()) == 1, q
+            assert "Traceback" not in body
+        # the valid spellings still answer 200
+        assert _get(f"{srv.url}/trace?last_ms=5")[0] == 200
+        assert _get(f"{srv.url}/trace?last_ms=0")[0] == 200
+        assert _get(f"{srv.url}/statusz?format=json")[0] == 200
+        assert _get(f"{srv.url}/statusz?format=html")[0] == 200
+    finally:
+        srv.close()
+
+
 def test_statusz_healthz_reflects_health_checks(tracer):
     state = {"ok": True}
     srv = StatuszServer(port=0)
