@@ -10,5 +10,9 @@ from .comm import (ReduceOp, init_distributed, is_initialized, get_rank,
                    all_to_all_single, reduce, gather, scatter, new_group,
                    get_global_rank, monitored_barrier, isend, irecv, send,
                    recv, has_all_gather_into_tensor,
-                   has_reduce_scatter_tensor)
+                   has_reduce_scatter_tensor,
+                   # compression-aware dispatch accounting
+                   comm_stats, reset_comm_stats)
+from .compression import (CommCompressionConfig, configure_comm_compression,
+                          get_comm_compression, reset_comm_compression)
 from .logging import CommsLogger, get_comms_logger, configure_comms_logger

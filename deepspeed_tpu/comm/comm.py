@@ -194,8 +194,23 @@ def destroy_process_group():
 
 
 # --------------------------------------------------------------------------
-# Device/compute plane — collectives for use inside shard_map
+# Device/compute plane — collectives for use inside shard_map.
+#
+# Every wrapper routes through ONE compression-aware dispatch point: the
+# policy from comm/compression.py (the `comm_compression` config block)
+# decides per call whether to trace the plain lax op (policy "off" — the
+# bitwise escape hatch: byte-identical programs to an uncompressed build),
+# the full-precision explicit path ("fp32"), or the blockwise-quantized
+# wire implementations in comm/quantized.py ("int8"/"fp8_block").
+# Accounting records WIRE bytes per op — what a ring implementation puts on
+# each member's links, compressed size when a codec ran — split into
+# intra-host and inter-host traffic when the (host, local) layout is known.
 # --------------------------------------------------------------------------
+
+from .compression import get_comm_compression
+
+_SUMLIKE = (ReduceOp.SUM, ReduceOp.AVG)
+
 
 def _size_bytes(x):
     try:
@@ -204,53 +219,152 @@ def _size_bytes(x):
         return 0
 
 
-# cumulative collective accounting (ops + payload bytes), maintained
-# unconditionally — two integer adds at trace time. The flight recorder
-# diffs this per step record to show how much collective traffic the
-# anomalous step carried, without scanning the span ring.
+def _participants(axis_name) -> int:
+    """Static axis size at trace time (psum of a python 1 folds to a
+    constant — no HLO is emitted); 0 when the axis is unbound
+    (eager/host context)."""
+    try:
+        return int(lax.psum(1, axis_name))
+    except Exception:
+        return 0
+
+
+# Baseline per-member ring wire-byte model, from the logical payload bytes:
+# all_gather's input is the SHARD (it ships n-1 copies of it); reduce_
+# scatter/all_to_all move (n-1)/n of the full input per member; all_reduce
+# = reduce-scatter + all-gather = 2(n-1)/n; broadcast lowers to a masked
+# psum (see broadcast()) so it pays the full all-reduce ring, ~2x an
+# optimal broadcast; scatter lowers to broadcast + local slice and
+# inherits its wire cost under its own op name.
+_BASE_WIRE = {
+    "all_reduce": lambda nb, n: 2 * (n - 1) * nb // n,
+    "all_gather": lambda nb, n: (n - 1) * nb,
+    "reduce_scatter": lambda nb, n: (n - 1) * nb // n,
+    "all_to_all": lambda nb, n: (n - 1) * nb // n,
+    "broadcast": lambda nb, n: 2 * (n - 1) * nb // n,
+    "scatter": lambda nb, n: 2 * (n - 1) * nb // n,
+    "ppermute": lambda nb, n: nb,
+}
+
+
+def _base_wire(op: str, logical: int, n: int) -> int:
+    if n <= 1:
+        # unbound axis (host context) or single member: nothing crosses a
+        # link for n==1; keep the logical size for n==0 so eager callers
+        # still see their payload accounted
+        return logical if n == 0 else 0
+    return _BASE_WIRE[op](logical, n)
+
+
+# cumulative collective accounting, maintained unconditionally — a few
+# integer adds at trace time. The flight recorder diffs this per step
+# record to show how much collective traffic the anomalous step carried,
+# without scanning the span ring.
 _COMM_OPS = 0
-_COMM_BYTES = 0
+_COMM_WIRE_BYTES = 0
+_COMM_LOGICAL_BYTES = 0
+_COMM_INTER_BYTES = 0
+_COMM_INTRA_BYTES = 0
 
 
 def comm_stats():
-    """Cumulative {ops, bytes} traced through the collective wrappers."""
-    return {"ops": _COMM_OPS, "bytes": _COMM_BYTES}
+    """Cumulative collective accounting traced through the wrappers.
+
+    ``bytes`` is WIRE bytes (per-member link traffic, compressed size when
+    a quantized policy ran); ``logical_bytes`` is the uncompressed payload
+    the caller handed in; ``inter_host_bytes``/``intra_host_bytes`` split
+    the wire traffic by link scope when the (host, local) layout is known
+    (comm_compression.devices_per_host, else the process-local device
+    count)."""
+    return {"ops": _COMM_OPS, "bytes": _COMM_WIRE_BYTES,
+            "logical_bytes": _COMM_LOGICAL_BYTES,
+            "inter_host_bytes": _COMM_INTER_BYTES,
+            "intra_host_bytes": _COMM_INTRA_BYTES}
 
 
-def _log(name, tensor, axis_name):
-    global _COMM_OPS, _COMM_BYTES
+def reset_comm_stats():
+    global _COMM_OPS, _COMM_WIRE_BYTES, _COMM_LOGICAL_BYTES
+    global _COMM_INTER_BYTES, _COMM_INTRA_BYTES
+    _COMM_OPS = _COMM_WIRE_BYTES = _COMM_LOGICAL_BYTES = 0
+    _COMM_INTER_BYTES = _COMM_INTRA_BYTES = 0
+
+
+def _split_inter(wire: int, n: int) -> int:
+    """Inter-host share of a FLAT collective's wire bytes: with L members
+    per host laid out host-major, H = n/L of the n ring links cross hosts,
+    and every ring link carries the same traffic — so H/n of the bytes are
+    inter-host. 0 when the axis fits on one host (or layout unknown)."""
+    if n <= 1:
+        return 0
+    local = get_comm_compression().local_members(n)
+    if not local:
+        return 0
+    return wire * (n // local) // n
+
+
+def _account(op, logical, wire, n, axis_name, inter=None):
+    """Record one traced collective into the cumulative counters + comms
+    logger. ``inter``: explicit inter-host wire bytes (hierarchical ops
+    know their legs); default = the flat ring-link model."""
+    global _COMM_OPS, _COMM_WIRE_BYTES, _COMM_LOGICAL_BYTES
+    global _COMM_INTER_BYTES, _COMM_INTRA_BYTES
+    if inter is None:
+        inter = _split_inter(wire, n)
     _COMM_OPS += 1
-    _COMM_BYTES += _size_bytes(tensor)
+    _COMM_WIRE_BYTES += wire
+    _COMM_LOGICAL_BYTES += logical
+    _COMM_INTER_BYTES += inter
+    _COMM_INTRA_BYTES += wire - inter
     cl = get_comms_logger()
     if cl is not None and cl.enabled:
-        cl.append(name, _size_bytes(tensor), str(axis_name))
+        cl.append(op, wire, str(axis_name))
+    return inter
 
 
-def _comm_span(name, tensor, axis_name):
-    """Telemetry span for one collective: op kind, payload bytes, mesh axis,
-    participant count (bus bandwidth is derived at export time from bytes ÷
-    measured duration). Collectives inside compiled programs are spanned at
-    TRACE time — XLA owns execution scheduling, so the per-execution wall
-    time of a fused collective is only visible to ``jax.profiler``; these
-    spans give per-op byte/shape accounting and trace-position instead."""
+def _comm_span(name, logical, wire, axis_name, participants, policy="off"):
+    """Telemetry span for one collective: op kind, logical payload bytes,
+    wire bytes, mesh axis, participant count, active compression policy
+    (bus bandwidth is derived at export time from WIRE bytes ÷ measured
+    duration). Collectives inside compiled programs are spanned at TRACE
+    time — XLA owns execution scheduling, so the per-execution wall time
+    of a fused collective is only visible to ``jax.profiler``; these spans
+    give per-op byte/shape accounting and trace-position instead."""
     tracer = get_tracer()
     if not tracer.enabled:
         return tracer.span(name)     # the shared no-op singleton
-    try:
-        # psum of a python 1 folds to the (static) axis size at trace time
-        participants = int(lax.psum(1, axis_name))
-    except Exception:                # axis unbound: eager/host context
-        participants = 0
-    return tracer.span(name, cat="comm",
-                       args={"op": name, "bytes": _size_bytes(tensor),
-                             "axis": str(axis_name),
-                             "participants": participants})
+    args = {"op": name, "bytes": logical, "wire_bytes": wire,
+            "axis": str(axis_name), "participants": participants}
+    if policy != "off":
+        args["policy"] = policy
+    return tracer.span(name, cat="comm", args=args)
+
+
+def _dispatch(op, x, axis_name, quantizable=True):
+    """The single dispatch decision: (policy, participants, logical bytes).
+    policy "off" means: trace the plain lax op (bitwise escape hatch)."""
+    logical = _size_bytes(x)
+    n = _participants(axis_name)
+    cc = get_comm_compression()
+    policy = cc.policy_for(op, axis_name, logical) if (quantizable and
+                                                       n > 1) else "off"
+    return cc, policy, n, logical
 
 
 def all_reduce(x, op: str = ReduceOp.SUM, axis_name="data"):
     """lax.psum/pmax/pmin over a mesh axis. [COLLECTIVE]"""
-    _log("all_reduce", x, axis_name)
-    with _comm_span("all_reduce", x, axis_name):
+    cc, policy, n, logical = _dispatch(
+        "all_reduce", x, axis_name, quantizable=op in _SUMLIKE)
+    if policy in ("int8", "fp8_block") and x.size % n == 0:
+        from .quantized import (quantized_all_reduce,
+                                quantized_all_reduce_wire_bytes)
+        wire = quantized_all_reduce_wire_bytes(x.size, n, cc.block_size)
+        _account("all_reduce", logical, wire, n, axis_name)
+        with _comm_span("all_reduce", logical, wire, axis_name, n, policy):
+            return quantized_all_reduce(x, axis_name, n, cc.block_size,
+                                        policy, avg=op == ReduceOp.AVG)
+    wire = _base_wire("all_reduce", logical, n)
+    _account("all_reduce", logical, wire, n, axis_name)
+    with _comm_span("all_reduce", logical, wire, axis_name, n):
         if op == ReduceOp.SUM:
             return lax.psum(x, axis_name)
         if op == ReduceOp.AVG:
@@ -269,17 +383,65 @@ def all_reduce(x, op: str = ReduceOp.SUM, axis_name="data"):
 
 
 def all_gather(x, axis_name="data", axis: int = 0, tiled: bool = True):
-    """Gather shards along `axis` from every member of the mesh axis."""
-    _log("all_gather", x, axis_name)
-    with _comm_span("all_gather", x, axis_name):
+    """Gather shards along `axis` from every member of the mesh axis.
+    Policy int8/fp8_block ships the shard blockwise-quantized (the ZeRO-3
+    param-gather wire, ZeRO++ qwZ)."""
+    cc, policy, n, logical = _dispatch(
+        "all_gather", x, axis_name, quantizable=tiled)
+    if policy in ("int8", "fp8_block"):
+        from .quantized import (quantized_all_gather,
+                                quantized_all_gather_wire_bytes)
+        wire = quantized_all_gather_wire_bytes(x.size, n, cc.block_size)
+        _account("all_gather", logical, wire, n, axis_name)
+        with _comm_span("all_gather", logical, wire, axis_name, n, policy):
+            return quantized_all_gather(x, axis_name, axis, n,
+                                        cc.block_size, policy)
+    wire = _base_wire("all_gather", logical, n)
+    _account("all_gather", logical, wire, n, axis_name)
+    with _comm_span("all_gather", logical, wire, axis_name, n):
         return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis_name="data", axis: int = 0, op: str = ReduceOp.SUM):
     """psum_scatter: the ZeRO-2/3 gradient primitive
-    (reference runtime/comm/coalesced_collectives.py:29)."""
-    _log("reduce_scatter", x, axis_name)
-    with _comm_span("reduce_scatter", x, axis_name):
+    (reference runtime/comm/coalesced_collectives.py:29).
+
+    Policy int8/fp8_block quantizes the exchange; with
+    ``comm_compression.hierarchical`` and a known (host, local) layout it
+    becomes the two-level ZeRO++ qgZ path — full-precision reduce inside
+    each host, quantized exchange across hosts — so only the compressed
+    payload crosses the inter-host links."""
+    cc, policy, n, logical = _dispatch(
+        "reduce_scatter", x, axis_name, quantizable=op in _SUMLIKE)
+    if policy in ("int8", "fp8_block") and x.shape[axis] % n == 0:
+        from .quantized import (
+            hierarchical_reduce_scatter,
+            hierarchical_reduce_scatter_wire_bytes,
+            quantized_reduce_scatter, quantized_reduce_scatter_wire_bytes)
+        from ..parallel.topology import hierarchical_axis_groups
+        avg = op == ReduceOp.AVG
+        local = cc.local_members(n) if cc.hierarchical else 0
+        if local:
+            intra_g, inter_g = hierarchical_axis_groups(n, local)
+            intra_b, inter_b = hierarchical_reduce_scatter_wire_bytes(
+                x.size, n, local, cc.block_size, x.dtype.itemsize)
+            wire = intra_b + inter_b
+            _account("reduce_scatter", logical, wire, n, axis_name,
+                     inter=inter_b)
+            with _comm_span("reduce_scatter", logical, wire, axis_name, n,
+                            policy):
+                return hierarchical_reduce_scatter(
+                    x, axis_name, axis, n, local, intra_g, inter_g,
+                    cc.block_size, policy, avg)
+        wire = quantized_reduce_scatter_wire_bytes(x.size, n, cc.block_size)
+        _account("reduce_scatter", logical, wire, n, axis_name)
+        with _comm_span("reduce_scatter", logical, wire, axis_name, n,
+                        policy):
+            return quantized_reduce_scatter(x, axis_name, axis, n,
+                                            cc.block_size, policy, avg)
+    wire = _base_wire("reduce_scatter", logical, n)
+    _account("reduce_scatter", logical, wire, n, axis_name)
+    with _comm_span("reduce_scatter", logical, wire, axis_name, n):
         out = lax.psum_scatter(x, axis_name, scatter_dimension=axis,
                                tiled=True)
         if op == ReduceOp.AVG:
@@ -289,10 +451,43 @@ def reduce_scatter(x, axis_name="data", axis: int = 0, op: str = ReduceOp.SUM):
 
 def all_to_all(x, axis_name="expert", split_axis: int = 0, concat_axis: int = 0):
     """MoE dispatch/combine primitive (reference sharded_moe.py:90 _AllToAll)."""
-    _log("all_to_all", x, axis_name)
-    with _comm_span("all_to_all", x, axis_name):
+    cc, policy, n, logical = _dispatch("all_to_all", x, axis_name)
+    if policy in ("int8", "fp8_block") and x.shape[split_axis] % n == 0:
+        from .quantized import (quantized_all_to_all,
+                                quantized_all_to_all_wire_bytes)
+        wire = quantized_all_to_all_wire_bytes(x.size, n, cc.block_size)
+        _account("all_to_all", logical, wire, n, axis_name)
+        with _comm_span("all_to_all", logical, wire, axis_name, n, policy):
+            return quantized_all_to_all(x, axis_name, split_axis,
+                                        concat_axis, n, cc.block_size,
+                                        policy)
+    wire = _base_wire("all_to_all", logical, n)
+    _account("all_to_all", logical, wire, n, axis_name)
+    with _comm_span("all_to_all", logical, wire, axis_name, n):
         return lax.all_to_all(x, axis_name, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
+
+
+def _broadcast_impl(x, src, axis_name, op_label):
+    """Shared broadcast lowering (broadcast + scatter account under their
+    own op names but put the same masked-psum ring on the wire)."""
+    cc, policy, n, logical = _dispatch("broadcast", x, axis_name)
+    if policy in ("int8", "fp8_block"):
+        from .quantized import (quantized_broadcast,
+                                quantized_broadcast_wire_bytes)
+        wire = quantized_broadcast_wire_bytes(x.size, n, cc.block_size)
+        _account(op_label, logical, wire, n, axis_name)
+        with _comm_span(op_label, logical, wire, axis_name, n, policy):
+            return quantized_broadcast(x, src, axis_name, n, cc.block_size,
+                                       policy)
+    wire = _base_wire("broadcast", logical, n)
+    _account(op_label, logical, wire, n, axis_name)
+    with _comm_span(op_label, logical, wire, axis_name, n):
+        idx = lax.axis_index(axis_name)
+        # where, not multiply: non-src members may hold NaN/inf placeholders
+        # (torch broadcast ignores their buffers entirely)
+        return lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)),
+                        axis_name)
 
 
 def broadcast(x, src: int = 0, axis_name="data"):
@@ -303,30 +498,28 @@ def broadcast(x, src: int = 0, axis_name="data"):
     contribution. Cost: a ring all-reduce moves ~2·N per link regardless of
     world size — about 2x an optimal broadcast and CONSTANT in world size,
     which is why this is also how GSPMD itself materializes broadcasts."""
-    _log("broadcast", x, axis_name)
-    with _comm_span("broadcast", x, axis_name):
-        idx = lax.axis_index(axis_name)
-        # where, not multiply: non-src members may hold NaN/inf placeholders
-        # (torch broadcast ignores their buffers entirely)
-        return lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)),
-                        axis_name)
+    return _broadcast_impl(x, src, axis_name, "broadcast")
 
 
 def ppermute(x, perm: Sequence, axis_name="pipe"):
-    """Point-to-point ring/pipeline exchange (reference pipe/p2p.py)."""
-    _log("ppermute", x, axis_name)
-    with _comm_span("ppermute", x, axis_name):
+    """Point-to-point ring/pipeline exchange (reference pipe/p2p.py).
+    Never compressed: pipeline activations are latency-bound single hops."""
+    logical = _size_bytes(x)
+    n = _participants(axis_name)
+    wire = _base_wire("ppermute", logical, n)
+    _account("ppermute", logical, wire, n, axis_name)
+    with _comm_span("ppermute", logical, wire, axis_name, n):
         return lax.ppermute(x, axis_name, perm=perm)
 
 
 def send_recv_next(x, axis_name="pipe"):
     """Shift +1 along axis (stage i → stage i+1), wrapping."""
-    n = axis_size(axis_name)
+    n = int(axis_size(axis_name))
     return ppermute(x, [(i, (i + 1) % n) for i in range(n)], axis_name)
 
 
 def send_recv_prev(x, axis_name="pipe"):
-    n = axis_size(axis_name)
+    n = int(axis_size(axis_name))
     return ppermute(x, [(i, (i - 1) % n) for i in range(n)], axis_name)
 
 
@@ -379,9 +572,12 @@ def gather(x, dst: int = 0, axis_name="data", axis: int = 0):
 def scatter(x, src: int = 0, axis_name="data", axis: int = 0):
     """Member i receives src's i-th shard along ``axis``. Non-src members'
     inputs are fully ignored (broadcast uses where-masking, so NaN/inf
-    placeholders are fine). Logged once, by the inner broadcast."""
-    full = broadcast(x, src=src, axis_name=axis_name)
-    n = lax.axis_size(axis_name)
+    placeholders are fine). Accounted once, under its OWN op name with the
+    broadcast lowering's wire cost (it used to inherit a "broadcast" entry
+    at the full-tensor count, which hid its real identity from the
+    before/after compression ratios)."""
+    full = _broadcast_impl(x, src, axis_name, "scatter")
+    n = _participants(axis_name)
     if full.shape[axis] % n:
         raise ValueError(f"scatter: dim {axis} ({full.shape[axis]}) must "
                          f"divide by axis size {n}")
@@ -464,7 +660,9 @@ def axis_index(axis_name):
 
 
 def axis_size(axis_name):
-    return lax.axis_size(axis_name)
+    # psum of a python 1 folds to the static axis size at trace time
+    # (lax.axis_size only exists in newer jax releases)
+    return lax.psum(1, axis_name)
 
 
 def log_summary():

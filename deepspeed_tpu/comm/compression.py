@@ -1,0 +1,161 @@
+"""``comm_compression`` — policy for the compression-aware comm dispatch.
+
+Every device-plane collective in :mod:`deepspeed_tpu.comm.comm` consults
+this module's process-global config before tracing the XLA op. Per
+collective the policy is one of:
+
+- ``"off"``   — the escape hatch: the wrapper traces the EXACT same
+  ``jax.lax`` call as before the dispatch refactor, so the compiled
+  program is byte-identical to an uncompressed build.
+- ``"fp32"``  — route through the explicit dispatch implementations but
+  keep full-precision wire payloads. Numerically ~equal to ``off`` (the
+  reduction order changes), NOT bitwise; exists so before/after byte
+  telemetry is measured through the same instrumentation.
+- ``"int8"``  — blockwise int8 wire payload + per-block f32 scales
+  (ZeRO++ qwZ/qgZ, arxiv 2306.10209; EQuARX-style XLA-native lowering,
+  arxiv 2506.17615).
+- ``"fp8_block"`` — same blockwise codec with an fp8 (e4m3) carrier;
+  needs a jaxlib with ``jnp.float8_e4m3fn``.
+
+``hierarchical`` additionally turns the quantized reduce-scatter into the
+two-level ZeRO++ gradient exchange: full-precision intra-host
+reduce-scatter along the inner (local) subaxis, quantized inter-host
+exchange along the outer (host) subaxis — see comm/quantized.py and
+parallel/topology.hierarchical_axis_groups.
+
+The config is process-global (like the comms logger): collectives are
+traced inside compiled programs, so the policy must be fixed before the
+step function compiles. ``DeepSpeedEngine`` installs it from the
+``"comm_compression"`` JSON block at init.
+"""
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+from ..runtime.config_utils import ConfigError, DeepSpeedConfigModel
+
+#: collectives the dispatch can compress (ppermute is point-to-point and
+#: stays full precision; `scatter` rides the broadcast policy — it IS a
+#: broadcast on the wire)
+COMPRESSIBLE_OPS = ("all_reduce", "all_gather", "reduce_scatter",
+                    "all_to_all", "broadcast")
+POLICIES = ("off", "fp32", "int8", "fp8_block")
+
+
+@dataclasses.dataclass
+class CommCompressionConfig(DeepSpeedConfigModel):
+    """The ``"comm_compression"`` config block (docs/comm.md)."""
+    enabled: bool = False
+    #: per-collective policy: off | fp32 | int8 | fp8_block
+    all_reduce: str = "off"
+    all_gather: str = "off"
+    reduce_scatter: str = "off"
+    all_to_all: str = "off"
+    broadcast: str = "off"
+    #: values per f32 scale block of the blockwise wire codec
+    block_size: int = 256
+    #: mesh axes whose collectives may compress; collectives over any other
+    #: axis (pipe/model/seq) always run at full precision
+    allowed_axes: Sequence[str] = ("data", "expert")
+    #: two-level reduce-scatter (intra-host full precision, inter-host
+    #: quantized) when the axis spans hosts
+    hierarchical: bool = True
+    #: members of the compressed axis per host; 0 = auto
+    #: (jax.local_device_count()). The CPU fake-multichip tests set this
+    #: explicitly to model a multi-host wire on one machine.
+    devices_per_host: int = 0
+    #: tensors smaller than this many bytes never compress — the scale
+    #: overhead and the extra rounding aren't worth it (docs/comm.md,
+    #: "when not to quantize")
+    min_bytes: int = 2048
+
+    def validate(self):
+        for op in COMPRESSIBLE_OPS:
+            pol = getattr(self, op)
+            if pol not in POLICIES:
+                raise ConfigError(
+                    f"comm_compression.{op} must be one of {POLICIES}, "
+                    f"got {pol!r}")
+            if pol == "fp8_block":
+                from ..ops.quant_core import FP8_DTYPE
+                if FP8_DTYPE is None:
+                    raise ConfigError(
+                        "comm_compression: fp8_block needs a jaxlib with "
+                        "float8_e4m3fn; use int8")
+        if self.block_size < 1:
+            raise ConfigError("comm_compression.block_size must be >= 1")
+        if self.devices_per_host < 0:
+            raise ConfigError(
+                "comm_compression.devices_per_host must be >= 0")
+        if self.min_bytes < 0:
+            raise ConfigError("comm_compression.min_bytes must be >= 0")
+        self.allowed_axes = tuple(self.allowed_axes)
+
+    # ---------------------------------------------------------------- policy
+    def _axis_allowed(self, axis_name) -> bool:
+        axes = axis_name if isinstance(axis_name, (tuple, list)) \
+            else (axis_name,)
+        return all(str(a) in self.allowed_axes for a in axes)
+
+    def policy_for(self, op: str, axis_name, nbytes: int) -> str:
+        """Effective policy for one traced collective call."""
+        if not self.enabled:
+            return "off"
+        pol = getattr(self, op, "off")
+        if pol == "off":
+            return "off"
+        if not self._axis_allowed(axis_name):
+            return "off"
+        if pol in ("int8", "fp8_block") and nbytes < self.min_bytes:
+            # still dispatch (byte accounting stays comparable), but keep
+            # the payload full precision
+            return "fp32"
+        return pol
+
+    def local_members(self, axis_size: int) -> int:
+        """Members of a size-``axis_size`` compressed axis that share a
+        host: the configured devices_per_host, else the process-local
+        device count, clamped into a valid (host, local) split. Returns 0
+        when no meaningful split exists (single host or indivisible)."""
+        n = self.devices_per_host
+        if n == 0:
+            try:
+                import jax
+                n = jax.local_device_count()
+            except Exception:
+                return 0
+        if n <= 1 or n >= axis_size or axis_size % n:
+            return 0
+        return n
+
+    @property
+    def zero_path_active(self) -> bool:
+        """True when the engine should route ZeRO param/grad exchange
+        through the explicit (shard_map) collective path: any policy a
+        ZeRO step uses is non-off. ``fp32`` counts — it is the measured
+        byte baseline for the compressed path."""
+        return self.enabled and any(
+            getattr(self, op) != "off"
+            for op in ("all_reduce", "all_gather", "reduce_scatter"))
+
+
+_CC = CommCompressionConfig()
+
+
+def get_comm_compression() -> CommCompressionConfig:
+    return _CC
+
+
+def configure_comm_compression(config) -> CommCompressionConfig:
+    """Install the process-global policy. Accepts a config object or the
+    raw JSON dict of the ``comm_compression`` block."""
+    global _CC
+    if isinstance(config, dict):
+        config = CommCompressionConfig.from_dict(config)
+    _CC = config
+    return _CC
+
+
+def reset_comm_compression():
+    global _CC
+    _CC = CommCompressionConfig()

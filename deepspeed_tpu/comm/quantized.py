@@ -1,0 +1,252 @@
+"""Quantized + hierarchical collective implementations.
+
+The compressed lowerings the comm dispatch (comm/comm.py) routes to when a
+``comm_compression`` policy is active. All functions run INSIDE compiled
+programs (under shard_map over a bound mesh axis) and genuinely move the
+compressed carrier over the interconnect: the ``jax.lax`` collectives here
+are traced on int8 (or fp8-bitcast-int8) payloads plus small f32 scale
+tensors — XLA ships exactly those bytes.
+
+Wire format: the blockwise codec from ops/quant_core.py — contiguous
+blocks of ``block`` values, one f32 scale per block (ZeRO++ qwZ,
+arxiv 2306.10209 §4.1). The hierarchical reduce-scatter is the qgZ
+gradient exchange: full-precision reduce within a host (cheap ICI),
+quantized exchange across hosts (the expensive DCN hop), as EQuARX
+(arxiv 2506.17615) does natively in XLA.
+
+Every public collective has a ``*_wire_bytes`` companion: the analytic
+per-participant link-byte model the dispatch records into the comm
+telemetry (comm_stats / spans / flight recorder). The models count what a
+ring implementation moves per member, split into intra-host and
+inter-host traffic when the (host, local) split is known.
+
+Accuracy note: quantization error is bounded per block by scale/2 =
+absmax_block/(2*qmax); the hierarchical reduce-scatter quantizes AFTER the
+intra-host reduction, so the error scales with the number of HOSTS, not
+the number of devices.
+"""
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.quant_core import (FP8_DTYPE, block_count, dequantize_blockwise,
+                              quantize_blockwise, wire_nbytes)
+
+
+def _effective_block(row_size: int, block: Optional[int]) -> Optional[int]:
+    """A block that never straddles the per-member rows of an exchange:
+    the configured block when it divides the row, else one scale per row."""
+    if block and block > 0 and row_size % block == 0:
+        return block
+    return row_size
+
+
+def _psum_carrier(q):
+    """(payload, restore) for a masked-psum transport of the wire dtype:
+    int8 sums directly; fp8 has no add on every backend, so it rides an
+    int8 bitcast (bit-identical — only one member contributes non-zero)."""
+    if q.dtype == jnp.int8:
+        return q, lambda s: s
+    return (lax.bitcast_convert_type(q, jnp.int8),
+            lambda s: lax.bitcast_convert_type(s, q.dtype))
+
+
+# ------------------------------------------------------------------ all_gather
+
+def quantized_all_gather(x, axis_name, axis: int, n: int,
+                         block: int, wire: str):
+    """Blockwise-quantized tiled all-gather: each member ships its shard as
+    int8/fp8 + per-block f32 scales; receivers dequantize and concatenate
+    along ``axis`` — semantics of ``lax.all_gather(tiled=True)`` up to
+    quantization error of the SENDER's shard (the ZeRO-3 param gather)."""
+    q, scales = quantize_blockwise(x, block, wire)
+    gq = lax.all_gather(q, axis_name)                 # [n, *shape] wire dtype
+    gs = lax.all_gather(scales, axis_name)            # [n, nblocks] f32
+    nb = gs.shape[1]
+    deq = gq.reshape(n, nb, -1).astype(jnp.float32) * gs[:, :, None]
+    deq = deq.reshape((n,) + x.shape)
+    out = jnp.moveaxis(deq, 0, axis)                  # tiled concat on `axis`
+    shape = list(x.shape)
+    shape[axis] *= n
+    return out.reshape(shape).astype(x.dtype)
+
+
+def quantized_all_gather_wire_bytes(size: int, n: int, block: int) -> int:
+    """Per-member link bytes: (n-1) copies of the compressed shard."""
+    return (n - 1) * wire_nbytes(size, block)
+
+
+# -------------------------------------------------------------- reduce_scatter
+
+def _rows_quantize(rows, block: int, wire: str):
+    """Quantize a [n, row] matrix with blocks aligned to rows; returns
+    (q [n, row], scales [n, nb_row])."""
+    n, row = rows.shape
+    eff = _effective_block(row, block)
+    q, scales = quantize_blockwise(rows, eff, wire)
+    return q, scales.reshape(n, -1)
+
+
+def _a2a_dequant_sum(rows, axis_name, groups, block, wire):
+    """Quantize per-destination rows, all-to-all them (int8/fp8 wire),
+    dequantize the received rows and sum: one quantized reduce-scatter leg.
+    rows: [g, row] where g = group size; returns [row] f32 sums."""
+    q, scales = _rows_quantize(rows, block, wire)
+    rq = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                        tiled=False, axis_index_groups=groups)
+    rs = lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0,
+                        tiled=False, axis_index_groups=groups)
+    nb = rs.shape[1]
+    deq = rq.reshape(rows.shape[0], nb, -1).astype(jnp.float32) \
+        * rs[:, :, None]
+    return jnp.sum(deq.reshape(rows.shape), axis=0)
+
+
+def quantized_reduce_scatter(x, axis_name, axis: int, n: int,
+                             block: int, wire: str, avg: bool):
+    """Flat (single-level) quantized reduce-scatter: quantize the n
+    per-destination chunks, all-to-all int8, dequantize + sum locally.
+    Semantics of ``lax.psum_scatter(tiled=True)`` up to quantization error
+    of the UNREDUCED contributions."""
+    xm = jnp.moveaxis(x, axis, 0)
+    chunk = xm.shape[0] // n
+    rows = xm.reshape(n, -1)                           # [n, chunk*rest]
+    total = _a2a_dequant_sum(rows, axis_name, None, block, wire)
+    if avg:
+        total = total / n
+    out = total.reshape((chunk,) + xm.shape[1:])
+    return jnp.moveaxis(out, 0, axis).astype(x.dtype)
+
+
+def quantized_reduce_scatter_wire_bytes(size: int, n: int,
+                                        block: int) -> int:
+    """Per-member link bytes: sends (n-1) of its n compressed chunks."""
+    row = size // n
+    eff = _effective_block(row, block)
+    return (n - 1) * wire_nbytes(row, eff)
+
+
+def hierarchical_reduce_scatter(x, axis_name, axis: int, n: int,
+                                local: int, intra_groups, inter_groups,
+                                block: int, wire: str, avg: bool):
+    """Two-level ZeRO++-style reduce-scatter over a flat axis of ``n``
+    members laid out host-major with ``local`` members per host:
+
+      1. chunk-permute locally so the result lands in standard
+         reduce-scatter order (free: a reshape/transpose of local data),
+      2. full-precision ``psum_scatter`` within each host (intra links),
+      3. blockwise-quantized all-to-all + dequant-sum across hosts
+         (the only inter-host traffic: int8/fp8 + scales).
+
+    Matches ``lax.psum_scatter(tiled=True)`` semantics up to quantization
+    error of the HOST-REDUCED partial sums."""
+    hosts = n // local
+    xm = jnp.moveaxis(x, axis, 0)
+    dim = xm.shape[0]
+    chunk = dim // n
+    # standard rs gives member i = h*local + l chunk i; the two-level
+    # exchange naturally yields chunk l*hosts + h — pre-swap the (host,
+    # local) chunk grid so they coincide
+    y = xm.reshape(hosts, local, chunk, *xm.shape[1:])
+    y = jnp.swapaxes(y, 0, 1).reshape(dim, *xm.shape[1:])
+    # leg 1: intra-host reduce-scatter, full precision
+    part = lax.psum_scatter(y, axis_name, scatter_dimension=0,
+                            axis_index_groups=intra_groups, tiled=True)
+    # leg 2: inter-host quantized exchange (all_to_all + dequant-sum)
+    rows = part.reshape(hosts, -1)                 # [hosts, chunk*rest]
+    total = _a2a_dequant_sum(rows, axis_name, inter_groups, block, wire)
+    if avg:
+        total = total / n
+    out = total.reshape((chunk,) + xm.shape[1:])
+    return jnp.moveaxis(out, 0, axis).astype(x.dtype)
+
+
+def hierarchical_reduce_scatter_wire_bytes(
+        size: int, n: int, local: int, block: int,
+        elem_bytes: int) -> Tuple[int, int]:
+    """(intra_bytes, inter_bytes) per member: full-precision intra-host
+    reduce-scatter of the whole payload, then the quantized inter-host
+    exchange of the host-reduced 1/local slice."""
+    hosts = n // local
+    intra = (local - 1) * (size // local) * elem_bytes
+    row = size // (local * hosts)
+    eff = _effective_block(row, block)
+    inter = (hosts - 1) * wire_nbytes(row, eff)
+    return intra, inter
+
+
+# ------------------------------------------------------------------ all_reduce
+
+def quantized_all_reduce(x, axis_name, n: int, block: int, wire: str,
+                         avg: bool):
+    """Quantized ring-style AVERAGE/SUM: quantized reduce-scatter of the
+    flattened tensor, then quantized all-gather of the reduced chunks
+    (both legs int8/fp8 wire). Requires x.size % n == 0 — the dispatch
+    falls back to full precision otherwise."""
+    xf = x.reshape(-1)
+    chunk = quantized_reduce_scatter(xf, axis_name, 0, n, block, wire, avg)
+    full = quantized_all_gather(chunk, axis_name, 0, n, block, wire)
+    return full.reshape(x.shape).astype(x.dtype)
+
+
+def quantized_all_reduce_wire_bytes(size: int, n: int, block: int) -> int:
+    return (quantized_reduce_scatter_wire_bytes(size, n, block) +
+            quantized_all_gather_wire_bytes(size // n, n, block))
+
+
+# ------------------------------------------------------------------ all_to_all
+
+def quantized_all_to_all(x, axis_name, split_axis: int, concat_axis: int,
+                         n: int, block: int, wire: str):
+    """Blockwise-quantized tiled all-to-all (the MoE dispatch/combine wire):
+    quantize the n per-destination slices, exchange int8/fp8 + scales,
+    dequantize and reassemble with ``lax.all_to_all(tiled=True)``
+    semantics."""
+    xm = jnp.moveaxis(x, split_axis, 0)                # [dim_s, *rest]
+    ds = xm.shape[0] // n
+    rows = xm.reshape(n, -1)                           # [n, ds*rest]
+    q, scales = _rows_quantize(rows, block, wire)
+    rq = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                        tiled=False)
+    rs = lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0,
+                        tiled=False)
+    nb = rs.shape[1]
+    deq = rq.reshape(n, nb, -1).astype(jnp.float32) * rs[:, :, None]
+    blocks = deq.reshape(n, ds, *xm.shape[1:])         # [n, split/n, *rest]
+    blocks = jnp.moveaxis(blocks, 1, split_axis + 1)   # restore layout
+    out = jnp.moveaxis(blocks, 0, concat_axis)         # tiled concat
+    shape = list(x.shape)
+    shape[split_axis] //= n
+    shape[concat_axis] *= n
+    return out.reshape(shape).astype(x.dtype)
+
+
+def quantized_all_to_all_wire_bytes(size: int, n: int, block: int) -> int:
+    row = size // n
+    eff = _effective_block(row, block)
+    return (n - 1) * wire_nbytes(row, eff)
+
+
+# ------------------------------------------------------------------- broadcast
+
+def quantized_broadcast(x, src: int, axis_name, n: int, block: int,
+                        wire: str):
+    """Quantized broadcast-via-masked-psum: only src contributes non-zero
+    int8 blocks, so the integer psum reconstructs src's payload exactly
+    (no overflow possible); fp8 rides an int8 bitcast. Wire cost is the
+    psum ring on the COMPRESSED payload — ~2x the compressed size instead
+    of ~2x full precision."""
+    q, scales = quantize_blockwise(x, block, wire)
+    idx = lax.axis_index(axis_name)
+    payload, restore = _psum_carrier(q)
+    summed = lax.psum(jnp.where(idx == src, payload,
+                                jnp.zeros_like(payload)), axis_name)
+    sscales = lax.psum(jnp.where(idx == src, scales,
+                                 jnp.zeros_like(scales)), axis_name)
+    return dequantize_blockwise(restore(summed), sscales, x.dtype)
+
+
+def quantized_broadcast_wire_bytes(size: int, n: int, block: int) -> int:
+    return int(2 * (n - 1) / n * wire_nbytes(size, block))

@@ -25,17 +25,18 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .quant_core import pertensor_int8, sign_dequantize, sign_quantize
+
 
 def sign_compress(x):
     """x -> (int8 sign, f32 scale) with scale = mean(|x|) (the 1-bit
-    compression of the reference's compressed_allreduce)."""
-    scale = jnp.mean(jnp.abs(x))
-    sign = jnp.where(x >= 0, jnp.int8(1), jnp.int8(-1))
-    return sign, scale
+    compression of the reference's compressed_allreduce). One codec —
+    ops/quant_core.sign_quantize — shared with the comm wire formats."""
+    return sign_quantize(x)
 
 
 def sign_decompress(sign, scale):
-    return sign.astype(jnp.float32) * scale
+    return sign_dequantize(sign, scale)
 
 
 def sign_compress_with_error(x, error):
@@ -59,7 +60,7 @@ def onebit_allreduce(x, worker_error, server_error,
     for the chunk this member owns.
     Returns (avg [n], new_worker_error [n], new_server_error [n/world]).
     """
-    world = lax.axis_size(axis_name)
+    world = int(lax.psum(1, axis_name))  # folds statically at trace time
     n = x.shape[0]
     assert n % world == 0, f"size {n} not divisible by axis {world}"
     chunk = n // world
@@ -92,23 +93,19 @@ def int8_allreduce(x, axis_name: str = "data"):
     """Quantized AVERAGE: int8 reduce-scatter + int8 allgather (the
     ZeRO++-style quantized gradient collective, zero_quantized_gradients).
     Per-tensor scales; lossy but unbiased-ish per call; no error state."""
-    world = lax.axis_size(axis_name)
+    world = int(lax.psum(1, axis_name))  # folds statically at trace time
     n = x.shape[0]
     assert n % world == 0
     chunk = n // world
-    # quantize locally (per-tensor scale), exchange int8
-    absmax = jnp.max(jnp.abs(x))
-    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-    q = jnp.clip(jnp.rint(x / scale), -127, 127).astype(jnp.int8)
+    # quantize locally (per-tensor scale, quant_core codec), exchange int8
+    q, scale = pertensor_int8(x)
     recv = lax.all_to_all(q.reshape(world, chunk), axis_name, split_axis=0,
                           concat_axis=0, tiled=False)
     scales = lax.all_gather(scale, axis_name)
     chunk_avg = jnp.sum(recv.astype(jnp.float32) * scales[:, None],
                         axis=0) / world
     # re-quantize the reduced chunk for the gather leg
-    cmax = jnp.max(jnp.abs(chunk_avg))
-    cscale = jnp.where(cmax > 0, cmax / 127.0, 1.0)
-    cq = jnp.clip(jnp.rint(chunk_avg / cscale), -127, 127).astype(jnp.int8)
+    cq, cscale = pertensor_int8(chunk_avg)
     gathered = lax.all_gather(cq, axis_name)
     cscales = lax.all_gather(cscale, axis_name)
     return (gathered.astype(jnp.float32) * cscales[:, None]).reshape(n)

@@ -13,6 +13,9 @@ Layout: x is reshaped to [groups, -1]; scales (and zero points for
 asymmetric) are per-group fp32. int8/int4 target widths supported; int4
 values live in an int8 carrier in [-8, 7] (packing is a storage concern the
 caller owns, as in the reference's quantization_utils.h).
+
+The scale/round/clip math itself lives in ops/quant_core.py — the shared
+core the compressed collectives and comm wire codecs also use.
 """
 
 from functools import partial
@@ -21,12 +24,8 @@ from types import SimpleNamespace
 import jax
 import jax.numpy as jnp
 
-
-def _qrange(bits, symmetric):
-    if symmetric:
-        qmax = float(2 ** (bits - 1) - 1)
-        return -qmax, qmax          # symmetric keeps zero exact
-    return 0.0, float(2 ** bits - 1)
+from .quant_core import (absmean_scale, asymmetric_scale_zero, qrange,
+                         round_clip, symmetric_scale)
 
 
 @partial(jax.jit, static_argnums=(1, 2, 3, 4))
@@ -37,28 +36,18 @@ def quantize(x, groups: int = 1, bits: int = 8, symmetric: bool = True,
             (q int8/uint8, scale, zero_point) for asymmetric."""
     orig_shape = x.shape
     xg = x.reshape(groups, -1).astype(jnp.float32)
-    qmin, qmax = _qrange(bits, symmetric)
+    qmin, qmax = qrange(bits, symmetric)
     if symmetric:
         absmax = jnp.max(jnp.abs(xg), axis=1, keepdims=True)
-        scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+        scale = symmetric_scale(absmax, qmax)
         scaled = xg / scale
     else:
         lo = jnp.min(xg, axis=1, keepdims=True)
         hi = jnp.max(xg, axis=1, keepdims=True)
-        scale = jnp.where(hi > lo, (hi - lo) / (qmax - qmin), 1.0)
-        zero = qmin - lo / scale
+        scale, zero = asymmetric_scale_zero(lo, hi, qmin, qmax)
         scaled = xg / scale + zero
-    if stochastic:
-        if rng is None:
-            raise ValueError(
-                "stochastic=True requires an rng key — a fixed key would "
-                "add the SAME noise every call, biasing the rounding")
-        noise = jax.random.uniform(rng, scaled.shape) - 0.5
-        q = jnp.floor(scaled + 0.5 + noise)
-    else:
-        q = jnp.rint(scaled)
     carrier = jnp.int8 if symmetric else jnp.uint8  # asym range is [0, 2^b-1]
-    q = jnp.clip(q, qmin, qmax).astype(carrier)
+    q = round_clip(scaled, qmin, qmax, carrier, stochastic, rng)
     q = q.reshape(orig_shape)
     if symmetric:
         return q, scale.reshape(groups)
@@ -101,7 +90,7 @@ def binary_quantize(x, groups: int = 1):
     (reference compression/utils.py:189 BinaryQuantizer): per-group
     alpha = mean(|x|), value = alpha * sign(x)."""
     xg = x.reshape(groups, -1).astype(jnp.float32)
-    alpha = jnp.mean(jnp.abs(xg), axis=1, keepdims=True)
+    alpha = absmean_scale(xg, axis=1, keepdims=True)
     deq = (alpha * jnp.sign(xg)).reshape(x.shape).astype(x.dtype)
     return x + jax.lax.stop_gradient(deq - x)
 
@@ -111,7 +100,7 @@ def ternary_quantize(x, groups: int = 1):
     (reference compression/utils.py:148 TernaryQuantizer): per-group
     threshold 0.7 * mean(|x|); alpha = mean(|x|) over surviving weights."""
     xg = x.reshape(groups, -1).astype(jnp.float32)
-    thres = 0.7 * jnp.mean(jnp.abs(xg), axis=1, keepdims=True)
+    thres = 0.7 * absmean_scale(xg, axis=1, keepdims=True)
     mask = (jnp.abs(xg) > thres).astype(jnp.float32)
     denom = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
     alpha = jnp.sum(jnp.abs(xg) * mask, axis=1, keepdims=True) / denom

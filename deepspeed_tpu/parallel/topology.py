@@ -37,6 +37,26 @@ MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
 DP_AXES = (DATA_AXIS, EXPERT_AXIS)
 
 
+def hierarchical_axis_groups(axis_size: int, devices_per_host: int):
+    """Split a flat mesh axis into (host, local) subaxes for two-level
+    collectives (the ZeRO++ hierarchical exchange, comm/quantized.py).
+
+    Returns ``(intra_groups, inter_groups)`` as ``axis_index_groups`` lists
+    for the ``jax.lax`` collectives: intra groups hold the ``devices_per_
+    host`` consecutive members that share a host (host-major member order —
+    exactly how ``initialize_mesh`` lays out ``jax.devices()``, which is
+    process-major); inter groups hold the members at the same local offset
+    across hosts. ``(None, None)`` when no meaningful split exists
+    (devices_per_host <= 1, >= axis_size, or not a divisor)."""
+    local = int(devices_per_host)
+    if local <= 1 or local >= axis_size or axis_size % local:
+        return None, None
+    hosts = axis_size // local
+    intra = [[h * local + l for l in range(local)] for h in range(hosts)]
+    inter = [[h * local + l for h in range(hosts)] for l in range(local)]
+    return intra, inter
+
+
 def default_devices():
     """Device list for mesh construction, via the accelerator facade so that
     DSTPU_ACCELERATOR=cpu (the test harness) selects the virtual CPU devices
@@ -147,6 +167,15 @@ class DeviceMeshManager:
 
     def batch_sharding(self, shard_seq: bool = True) -> NamedSharding:
         return NamedSharding(self.mesh, self.batch_spec(shard_seq))
+
+    def data_host_groups(self, devices_per_host: int = 0):
+        """(intra, inter) ``axis_index_groups`` splitting the 'data' axis
+        into (host, local) subaxes for hierarchical collectives;
+        ``devices_per_host`` 0 = this process's local device count."""
+        if devices_per_host == 0:
+            devices_per_host = jax.local_device_count()
+        return hierarchical_axis_groups(self.axis_size(DATA_AXIS),
+                                        devices_per_host)
 
     def __enter__(self):
         self._ctx = self.mesh

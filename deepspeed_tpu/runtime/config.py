@@ -327,6 +327,12 @@ class DeepSpeedConfig:
         self.activation_checkpointing = ActivationCheckpointingConfig.from_dict(
             pd.get(C.ACTIVATION_CHECKPOINTING, {}))
         self.comms_logger = CommsLoggerConfig.from_dict(pd.get(C.COMMS_LOGGER, {}))
+        # quantized/hierarchical collective policy (deepspeed_tpu/comm/
+        # compression.py, docs/comm.md): per-collective off|fp32|int8|
+        # fp8_block wire formats behind the comm dispatch
+        from ..comm.compression import CommCompressionConfig
+        self.comm_compression = CommCompressionConfig.from_dict(
+            pd.get(C.COMM_COMPRESSION, {}))
         self.tensorboard = MonitorSinkConfig.from_dict(pd.get(C.TENSORBOARD, {}))
         self.wandb = MonitorSinkConfig.from_dict(pd.get(C.WANDB, {}))
         self.csv_monitor = MonitorSinkConfig.from_dict(pd.get(C.CSV_MONITOR, {}))

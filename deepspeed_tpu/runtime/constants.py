@@ -75,6 +75,7 @@ STEPS_PER_PRINT = "steps_per_print"
 WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
 DUMP_STATE = "dump_state"
 COMMS_LOGGER = "comms_logger"
+COMM_COMPRESSION = "comm_compression"
 MEMORY_BREAKDOWN = "memory_breakdown"
 TENSORBOARD = "tensorboard"
 WANDB = "wandb"

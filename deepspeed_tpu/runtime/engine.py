@@ -484,6 +484,34 @@ class DeepSpeedEngine:
                 # /healthz so the operator's probe sees it
                 self.statusz.register_health("hosts", self._hostagg.health)
 
+        # ---- comm compression (comm/compression.py, docs/comm.md):
+        #      quantized/hierarchical wire formats behind the collective
+        #      dispatch. When a ZeRO-relevant policy is active the micro-
+        #      gradient computation routes through the explicit shard_map
+        #      exchange (runtime/zero/compressed_step.py) so param gathers
+        #      and grad reduce-scatters genuinely move compressed bytes;
+        #      with every policy "off" the GSPMD path is byte-identical
+        #      to an uncompressed build.
+        from ..comm.compression import configure_comm_compression
+        configure_comm_compression(cfg.comm_compression)
+        self._cc_zero_active = (cfg.comm_compression.zero_path_active and
+                                self.mesh_manager.dp_world_size > 1)
+        self._compressed_grad_fns: Dict[Any, Any] = {}
+        if self._cc_zero_active:
+            from .config_utils import ConfigError
+            from .zero.compressed_step import compression_scope_error
+            err = compression_scope_error(cfg, self)
+            if err:
+                raise ConfigError(err)
+            log_dist(
+                "comm_compression: explicit ZeRO exchange active "
+                f"(all_gather={cfg.comm_compression.all_gather} "
+                f"reduce_scatter={cfg.comm_compression.reduce_scatter} "
+                f"all_reduce={cfg.comm_compression.all_reduce} "
+                f"block={cfg.comm_compression.block_size} "
+                f"hierarchical={cfg.comm_compression.hierarchical})",
+                ranks=[0])
+
         self._grad_acc_buffer = None
         self._grad_acc_count = 0
         self._pending_batch = None
@@ -623,6 +651,16 @@ class DeepSpeedEngine:
             max_hysteresis=cfg.fp16.hysteresis)
         return new_params, new_opt, new_scaler, finite, grad_norm, applied
 
+    def _compressed_micro_grad(self, ltd_keep):
+        """The shard_map'd explicit-ZeRO micro-gradient (runtime/zero/
+        compressed_step.py), cached per random-LTD token budget like the
+        jitted step fns."""
+        if ltd_keep not in self._compressed_grad_fns:
+            from .zero.compressed_step import make_compressed_micro_grad
+            self._compressed_grad_fns[ltd_keep] = \
+                make_compressed_micro_grad(self, ltd_keep)
+        return self._compressed_grad_fns[ltd_keep]
+
     def _compile_fns(self):
         if self._param_runner is not None:
             # the param-offload runner owns its own per-stage jits; the
@@ -652,12 +690,21 @@ class DeepSpeedEngine:
             # step instead of once per micro step.
             pc = _cast_tree(params, self._compute_dtype)
 
-            def scaled_loss(pc_, mb, r):
-                return self._micro_loss(pc_, mb, r, precast=True,
-                                        pld_theta=pld_theta,
-                                        ltd_keep=ltd_keep) * scale
+            if self._cc_zero_active:
+                # explicit (policy-dispatched) ZeRO exchange: quantized
+                # param gathers + hierarchical grad reduce-scatters run
+                # through comm/ instead of GSPMD-inserted collectives
+                cfn = self._compressed_micro_grad(ltd_keep)
 
-            grad_fn = jax.value_and_grad(scaled_loss)
+                def grad_fn(pc_, mb, r):
+                    return cfn(pc_, mb, r, scale, pld_theta)
+            else:
+                def scaled_loss(pc_, mb, r):
+                    return self._micro_loss(pc_, mb, r, precast=True,
+                                            pld_theta=pld_theta,
+                                            ltd_keep=ltd_keep) * scale
+
+                grad_fn = jax.value_and_grad(scaled_loss)
             grad_specs = jax.tree.map(lambda s: s.spec, self.grad_shardings)
 
             if gas == 1:
@@ -747,10 +794,16 @@ class DeepSpeedEngine:
         # --- micro grad (forward/backward API path) ---
         def make_micro_grad(ltd_keep):
             def micro_grad(params, mb, rng, scale, pld_theta):
-                def scaled_loss(p):
-                    return self._micro_loss(p, mb, rng, pld_theta=pld_theta,
-                                            ltd_keep=ltd_keep) * scale
-                loss, g = jax.value_and_grad(scaled_loss)(params)
+                if self._cc_zero_active:
+                    pc = _cast_tree(params, self._compute_dtype)
+                    loss, g = self._compressed_micro_grad(ltd_keep)(
+                        pc, mb, rng, scale, pld_theta)
+                else:
+                    def scaled_loss(p):
+                        return self._micro_loss(p, mb, rng,
+                                                pld_theta=pld_theta,
+                                                ltd_keep=ltd_keep) * scale
+                    loss, g = jax.value_and_grad(scaled_loss)(params)
                 g = jax.tree.map(lambda x: x.astype(jnp.float32), g)
                 g = lax.with_sharding_constraint(
                     g, jax.tree.map(lambda s: s.spec, self.grad_shardings))

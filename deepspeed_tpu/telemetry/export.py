@@ -94,12 +94,20 @@ def chrome_trace_slice(tracer: Optional[Tracer] = None,
 
 def _bw_args(sp) -> Dict[str, float]:
     """Derived bandwidth for a comm span (GB/s, from measured duration —
-    trace-time spans have ~0 duration and report 0)."""
+    trace-time spans have ~0 duration and report 0). When the span carries
+    ``wire_bytes`` (the dispatch's per-member link-byte model, compressed
+    size when a codec ran) the bus bandwidth is wire_bytes ÷ duration
+    directly; the analytic ring factors are only applied to legacy spans
+    that lack it."""
     args = sp.args or {}
     nbytes = int(args.get("bytes", 0))
     n = int(args.get("participants", 0)) or 1
-    algbw, busbw = _calc_bw(args.get("op", sp.name), nbytes,
-                            sp.dur_us / 1e6, n)
+    dur_s = sp.dur_us / 1e6
+    wire = args.get("wire_bytes")
+    if wire is not None and dur_s > 0:
+        return {"algbw_gbps": round(nbytes / dur_s / 1e9, 3),
+                "busbw_gbps": round(int(wire) / dur_s / 1e9, 3)}
+    algbw, busbw = _calc_bw(args.get("op", sp.name), nbytes, dur_s, n)
     return {"algbw_gbps": round(algbw, 3), "busbw_gbps": round(busbw, 3)}
 
 
@@ -132,17 +140,29 @@ def comm_table(tracer: Optional[Tracer] = None) -> Dict[str, Any]:
             continue
         args = sp.args or {}
         op = args.get("op", sp.name)
-        rec = out.setdefault(op, {"calls": 0, "bytes": 0, "total_ms": 0.0,
+        rec = out.setdefault(op, {"calls": 0, "bytes": 0, "wire_bytes": 0,
+                                  "total_ms": 0.0,
                                   "participants": int(
                                       args.get("participants", 0))})
         rec["calls"] += 1
         rec["bytes"] += int(args.get("bytes", 0))
+        rec["wire_bytes"] += int(args.get("wire_bytes", 0))
+        pol = args.get("policy")
+        if pol:
+            rec["policy"] = pol
         rec["total_ms"] += sp.dur_us / 1e3
     for op, rec in out.items():
-        algbw, busbw = _calc_bw(op, rec["bytes"], rec["total_ms"] / 1e3,
-                                max(rec["participants"], 1))
-        rec["algbw_gbps"] = round(algbw, 3)
-        rec["busbw_gbps"] = round(busbw, 3)
+        dur_s = rec["total_ms"] / 1e3
+        if rec["wire_bytes"] and dur_s > 0:
+            # wire bytes come from the dispatch's link model (compressed
+            # size when a codec ran): bus bw is wire ÷ time directly
+            rec["algbw_gbps"] = round(rec["bytes"] / dur_s / 1e9, 3)
+            rec["busbw_gbps"] = round(rec["wire_bytes"] / dur_s / 1e9, 3)
+        else:
+            algbw, busbw = _calc_bw(op, rec["bytes"], dur_s,
+                                    max(rec["participants"], 1))
+            rec["algbw_gbps"] = round(algbw, 3)
+            rec["busbw_gbps"] = round(busbw, 3)
         rec["total_ms"] = round(rec["total_ms"], 4)
     return out
 

@@ -126,10 +126,13 @@ class FlightRecorder:
                 record["goodput"] = deltas
         stats = self._comm_stats()
         if stats is not None:
-            prev = self._last_comm or {"ops": 0, "bytes": 0}
+            # diff every counter comm_stats exposes (ops + wire/logical/
+            # inter-host/intra-host bytes) so the record shows the step's
+            # actual link traffic, compressed size included
+            prev = self._last_comm or {}
             self._last_comm = stats
-            record["comm"] = {"ops": stats["ops"] - prev["ops"],
-                              "bytes": stats["bytes"] - prev["bytes"]}
+            record["comm"] = {k: v - prev.get(k, 0)
+                              for k, v in stats.items()}
         if extra:
             record.update(extra)
         self._records.append(record)
