@@ -57,7 +57,8 @@ def make_model(family: str, seq: int):
 
 
 def train(stage: int, steps: int, seq: int, prefix: str, micro_bs: int,
-          log_every: int = 10, family: str = "gpt2", extra_config=None):
+          log_every: int = 10, family: str = "gpt2", extra_config=None,
+          collect=None):
     import jax
     import deepspeed_tpu
     from deepspeed_tpu.parallel import topology
@@ -93,6 +94,10 @@ def train(stage: int, steps: int, seq: int, prefix: str, micro_bs: int,
         losses.append(loss)
         if log_every and step % log_every == 0:
             print(f"  zero{stage} step {step}: loss {loss:.4f}", flush=True)
+    if collect is not None and engine._compile_plane is not None:
+        collect["compile_plane"] = engine._compile_plane.summary()
+        if engine._hbm is not None:
+            collect["memory"] = engine._hbm.summary()
     return losses
 
 
@@ -285,6 +290,13 @@ def main():
     ap.add_argument("--policy", default="int8",
                     choices=["int8", "fp8_block"],
                     help="--comm-compression wire format")
+    ap.add_argument("--compile-plane", action="store_true",
+                    dest="compile_plane",
+                    help="enable the compile/memory plane during the "
+                         "ZeRO-stage runs and record compile events + HBM "
+                         "role coverage per stage (asserts roles within "
+                         "10%% of the high-water gauge where the backend "
+                         "reports memory_stats)")
     ap.add_argument("--only", default=None,
                     help="--features subset, e.g. --only combined "
                          "(baseline always runs)")
@@ -326,11 +338,18 @@ def main():
     print(f"corpus: {n_tokens / 1e6:.2f}M byte tokens, "
           f"{n_samples} samples of seq {args.seq}", flush=True)
 
-    curves = {}
+    cp_extra = {"compile_plane": {"enabled": True}} \
+        if args.compile_plane else None
+    curves, planes = {}, {}
     for stage in args.stages:
         print(f"training ZeRO-{stage} for {args.steps} steps", flush=True)
+        collect = {} if args.compile_plane else None
         curves[f"zero{stage}"] = train(stage, args.steps, args.seq, prefix,
-                                       args.micro_bs, family=args.model)
+                                       args.micro_bs, family=args.model,
+                                       extra_config=cp_extra,
+                                       collect=collect)
+        if collect:
+            planes[f"zero{stage}"] = collect
 
     keys = list(curves)
     report = {
@@ -339,6 +358,17 @@ def main():
         "init_loss": curves[keys[0]][0],
         "final_loss": {k: float(np.mean(v[-10:])) for k, v in curves.items()},
     }
+    if planes:
+        report["compile_plane"] = planes
+        for name, doc in planes.items():
+            mem = doc.get("memory", {})
+            # acceptance: the role gauges explain the allocator high-water
+            # to within 10% — only checkable where the backend reports
+            # memory_stats (the TPU runtime; the CPU test backend doesn't)
+            if "coverage" in mem:
+                assert 0.9 <= mem["coverage"] <= 1.1, (
+                    f"{name}: HBM roles cover {mem['coverage']:.2f} of the "
+                    f"high-water gauge (want within 10%)")
     if len(keys) >= 2:
         a = np.asarray(curves[keys[0]])
         b = np.asarray(curves[keys[1]])

@@ -15,7 +15,6 @@ Writes benchmarks/hlo_audit.json.
 
 import json
 import os
-import re
 import sys
 
 import numpy as np
@@ -34,42 +33,18 @@ hermetic = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(hermetic)
 hermetic.force_cpu(device_count=8)
 
-_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
-                "s8": 1, "u8": 1, "pred": 1, "f64": 8, "s64": 8, "u64": 8}
+# the shared HLO cost core (telemetry/hlo_cost.py — stdlib-only, so the
+# same file-path load works): one parser for this gate, the flight
+# recorder's cost capture, and the compile ledger
+_hc_spec = importlib.util.spec_from_file_location(
+    "_dstpu_hlo_cost",
+    os.path.join(REPO, "deepspeed_tpu", "telemetry", "hlo_cost.py"))
+hlo_cost = importlib.util.module_from_spec(_hc_spec)
+_hc_spec.loader.exec_module(hlo_cost)
 
-_COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather", "all-to-all",
-                "collective-permute")
-
-
-def _collect(hlo_text: str):
-    """{op: {count, bytes}} over the compiled module (fusion-internal
-    shapes included via the op's result shape)."""
-    out = {}
-    # single-result form only ('= f32[...] all-reduce('); tuple results
-    # ('= (f32[...], ...) all-reduce(') are handled by pat_tuple below —
-    # anchoring at '= <dtype>[' keeps the two disjoint
-    pat = re.compile(
-        r"=\s*(\w+)\[([\d,]*)\]\S*\s+(" +
-        "|".join(_COLLECTIVES) + r")\(")
-    for m in pat.finditer(hlo_text):
-        dtype, dims, op = m.group(1), m.group(2), m.group(3)
-        numel = int(np.prod([int(d) for d in dims.split(",") if d] or [1]))
-        rec = out.setdefault(op, {"count": 0, "bytes": 0})
-        rec["count"] += 1
-        rec["bytes"] += numel * _DTYPE_BYTES.get(dtype, 4)
-    # tuple-result collectives (all-reduce of N tensors) print as
-    # `(f32[...], f32[...]) all-reduce(` — catch those too
-    pat_tuple = re.compile(
-        r"=\s*\(([^)]+)\)\s+(" + "|".join(_COLLECTIVES) + r")\(")
-    for m in pat_tuple.finditer(hlo_text):
-        shapes, op = m.group(1), m.group(2)
-        rec = out.setdefault(op, {"count": 0, "bytes": 0})
-        rec["count"] += 1
-        for sm in re.finditer(r"(\w+)\[([\d,]*)\]", shapes):
-            numel = int(np.prod([int(d) for d in
-                                 sm.group(2).split(",") if d] or [1]))
-            rec["bytes"] += numel * _DTYPE_BYTES.get(sm.group(1), 4)
-    return out
+#: behavior-identical alias — the collective parser now lives in the
+#: shared core; tests and older callers keep the old name
+_collect = hlo_cost.collect_collectives
 
 
 def audit(name, mesh_kw, config_over, n_devices=8, with_flops=False):
@@ -120,15 +95,12 @@ def audit(name, mesh_kw, config_over, n_devices=8, with_flops=False):
         # vs total collective payload. bytes_per_gflop is the scale-free
         # number that catches an accidental resharding (dropping a grad
         # out-sharding ~doubles it) with no TPU in the loop.
-        try:
-            cost = compiled.cost_analysis()
-            cost = cost[0] if isinstance(cost, (list, tuple)) else cost
-            flops = float(cost.get("flops", 0.0))
-        except Exception as e:
-            print(f"WARNING: cost_analysis unavailable ({e!r}) — "
+        flops = float(hlo_cost.cost_summary(
+            compiled.cost_analysis()).get("flops", 0.0))
+        if not flops:
+            print(f"WARNING: cost_analysis reported no flops — "
                   f"bytes/GFLOP roofline gate is DISABLED for {name}",
                   file=sys.stderr)
-            flops = 0.0
         total_bytes = sum(v["bytes"] for v in stats.values())
         stats = dict(stats)
         stats["_roofline"] = {
@@ -136,10 +108,17 @@ def audit(name, mesh_kw, config_over, n_devices=8, with_flops=False):
             "collective_bytes": total_bytes,
             "bytes_per_gflop": (total_bytes / (flops / 1e9)) if flops else None,
         }
+    # overlap column (ROADMAP item 2's before/after instrument): what
+    # fraction of the schedule's collectives are emitted in async
+    # start/done form — 0.0 on the fully synchronous CPU lowering, and
+    # the number item 2 exists to raise on the TPU backend
+    stats = dict(stats)
+    stats["_overlap"] = hlo_cost.hlo_overlap_summary(hlo)
     shown = {k: v for k, v in stats.items() if not k.startswith("_")}
-    print(f"{name}: " + ", ".join(
+    line = (f"{name}: " + ", ".join(
         f"{op} x{v['count']} ({v['bytes']/2**20:.1f} MiB)"
         for op, v in sorted(shown.items())) if shown else f"{name}: none")
+    print(line + f" | async overlap {stats['_overlap']['async_fraction']:.2f}")
     return stats
 
 

@@ -1,13 +1,15 @@
 """Telemetry overhead benchmark: tracer-on vs tracer-off step time.
 
-Runs the same tiny-GPT2 `train_batch` loop four times — telemetry
+Runs the same tiny-GPT2 `train_batch` loop five times — telemetry
 disabled; enabled (spans + MFU counters + recompile watchdog + ring
 buffer); enabled WITH the goodput ledger and the statusz server (an HTTP
-thread parked on a live port); and the full observability plane PLUS the
+thread parked on a live port); the full observability plane PLUS the
 flight recorder (per-step ring records + trigger rules armed, no trigger
-firing) — and writes benchmarks/telemetry_overhead.json with median step
-times and the relative overheads. Asserts every enabled mode costs < 2%
-of step time (the low-overhead contract of deepspeed_tpu/telemetry/).
+firing); and all of that PLUS the compile plane (per-step argument
+fingerprints, the HBM role ledger, the overlap analyzer) — and writes
+benchmarks/telemetry_overhead.json with median step times and the
+relative overheads. Asserts every enabled mode costs < 2% of step time
+(the low-overhead contract of deepspeed_tpu/telemetry/).
 
 Both loops block on the loss every step, so the comparison isolates the
 tracer's span machinery from the device sync it performs by design
@@ -52,7 +54,7 @@ THRESHOLD_PCT = float(os.environ.get("TEL_THRESHOLD_PCT", 2.0))
 
 
 def build_engine(telemetry_enabled: bool, full: bool = False,
-                 recorder_dir: str = ""):
+                 recorder_dir: str = "", compile_plane: bool = False):
     model = GPT2Model(GPT2Config(
         vocab_size=256, n_positions=128,
         n_embd=int(os.environ.get("TEL_EMBD", 128)),
@@ -80,6 +82,11 @@ def build_engine(telemetry_enabled: bool, full: bool = False,
         "flight_recorder": {"enabled": bool(recorder_dir),
                             "dir": recorder_dir or "unused",
                             "slow_step_factor": 1000.0},
+        # cp mode: the compile/memory plane — per-step arg fingerprints,
+        # the HBM role ledger, the overlap analyzer, at their default
+        # cadences. Compile events only happen during warmup; what this
+        # measures is the steady-state fingerprint + ledger cost.
+        "compile_plane": {"enabled": compile_plane},
     })
     return engine
 
@@ -111,19 +118,26 @@ def main():
     import tempfile
     tracer = get_tracer()
     rec_dir = tempfile.mkdtemp(prefix="dstpu_overhead_rec_")
+    cp_dir = tempfile.mkdtemp(prefix="dstpu_overhead_cp_")
 
     # one engine per mode; steps run in INTERLEAVED round-robin blocks so
     # machine drift (thermal, co-tenants) hits all modes equally —
     # sequential loops showed several % of drift, swamping the real cost
-    modes = {"off": (False, False, ""), "on": (True, False, ""),
-             "full": (True, True, ""), "rec": (True, True, rec_dir)}
+    modes = {"off": (False, False, "", False),
+             "on": (True, False, "", False),
+             "full": (True, True, "", False),
+             "rec": (True, True, rec_dir, False),
+             "cp": (True, True, cp_dir, True)}
     engines, times = {}, {name: [] for name in modes}
-    for name, (tel, full, rdir) in modes.items():
-        engines[name] = build_engine(tel, full=full, recorder_dir=rdir)
+    for name, (tel, full, rdir, cp) in modes.items():
+        engines[name] = build_engine(tel, full=full, recorder_dir=rdir,
+                                     compile_plane=cp)
     assert engines["full"].statusz is not None and \
         engines["full"].statusz.port > 0
     assert engines["rec"]._recorder is not None
-    for name, (tel, full, _rdir) in modes.items():   # compile + warmup
+    assert engines["cp"]._compile_plane is not None and \
+        engines["cp"]._hbm is not None
+    for name, (tel, full, _rdir, _cp) in modes.items():  # compile + warmup
         _apply_mode(tel, full)
         run_block(engines[name], WARMUP)
 
@@ -131,7 +145,7 @@ def main():
     done = 0
     while done < STEPS:
         n = min(block, STEPS - done)
-        for name, (tel, full, _rdir) in modes.items():
+        for name, (tel, full, _rdir, _cp) in modes.items():
             _apply_mode(tel, full)
             run_block(engines[name], n, collect=times[name])
         done += n
@@ -144,8 +158,12 @@ def main():
     # wrote nothing to disk
     assert len(engines["rec"]._recorder._records) >= STEPS
     assert engines["rec"]._recorder.bundles() == []
+    # the compile plane saw exactly the warmup compile, then went quiet
+    cp_ledger = engines["cp"]._compile_plane
+    assert cp_ledger.compiles >= 1 and cp_ledger.recompiles == 0
     t_off, t_on = times["off"], times["on"]
     t_full, t_rec = times["full"], times["rec"]
+    t_cp = times["cp"]
     for engine in engines.values():
         engine.close()
 
@@ -153,22 +171,27 @@ def main():
     on_ms = statistics.median(t_on) * 1e3
     full_ms = statistics.median(t_full) * 1e3
     rec_ms = statistics.median(t_rec) * 1e3
+    cp_ms = statistics.median(t_cp) * 1e3
     overhead_pct = 100.0 * (on_ms - off_ms) / off_ms
     overhead_full_pct = 100.0 * (full_ms - off_ms) / off_ms
     overhead_rec_pct = 100.0 * (rec_ms - off_ms) / off_ms
+    overhead_cp_pct = 100.0 * (cp_ms - off_ms) / off_ms
     result = {
         "steps": STEPS,
         "step_ms_tracer_off_p50": round(off_ms, 4),
         "step_ms_tracer_on_p50": round(on_ms, 4),
         "step_ms_full_p50": round(full_ms, 4),
         "step_ms_recorder_p50": round(rec_ms, 4),
+        "step_ms_compile_plane_p50": round(cp_ms, 4),
         "step_ms_tracer_off_mean": round(statistics.mean(t_off) * 1e3, 4),
         "step_ms_tracer_on_mean": round(statistics.mean(t_on) * 1e3, 4),
         "step_ms_full_mean": round(statistics.mean(t_full) * 1e3, 4),
         "step_ms_recorder_mean": round(statistics.mean(t_rec) * 1e3, 4),
+        "step_ms_compile_plane_mean": round(statistics.mean(t_cp) * 1e3, 4),
         "overhead_pct": round(overhead_pct, 3),
         "overhead_full_pct": round(overhead_full_pct, 3),
         "overhead_recorder_pct": round(overhead_rec_pct, 3),
+        "overhead_compile_plane_pct": round(overhead_cp_pct, 3),
         "threshold_pct": THRESHOLD_PCT,
         "spans_recorded": len(tracer.spans()),
         "devices": jax.device_count(),
@@ -188,9 +211,14 @@ def main():
         f"total observability overhead (tracer+ledger+statusz+flight "
         f"recorder) {overhead_rec_pct:.2f}% exceeds the "
         f"{THRESHOLD_PCT}% budget")
+    assert overhead_cp_pct < THRESHOLD_PCT, (
+        f"total observability overhead with the compile plane "
+        f"(fingerprints + HBM ledger + overlap analyzer) "
+        f"{overhead_cp_pct:.2f}% exceeds the {THRESHOLD_PCT}% budget")
     print(f"OK: tracer-on overhead {overhead_pct:.2f}%, + goodput "
           f"ledger + statusz server {overhead_full_pct:.2f}%, + flight "
-          f"recorder {overhead_rec_pct:.2f}% — all < {THRESHOLD_PCT}%")
+          f"recorder {overhead_rec_pct:.2f}%, + compile plane "
+          f"{overhead_cp_pct:.2f}% — all < {THRESHOLD_PCT}%")
 
 
 if __name__ == "__main__":

@@ -130,6 +130,11 @@ class InferenceEngine:
         # step must compile exactly once per pool shape.
         self._fns: "OrderedDict[Any, Any]" = OrderedDict()
         self._slot_fns: Dict[Any, Any] = {}
+        # compile ledger (telemetry/compileplane.py), attached by the
+        # serving layer when its compile_plane block is on: every serving
+        # program (forward, generate bucket, prefill bucket, fused decode,
+        # pool init) becomes a compile event with an arg fingerprint
+        self.compile_plane = None
         n_params = sum(int(np.prod(s.shape))
                        for s in jax.tree.leaves(param_shapes))
         log_dist(f"InferenceEngine initialized: params={n_params/1e6:.1f}M "
@@ -177,6 +182,19 @@ class InferenceEngine:
         planner = ZeroShardingPlanner(self.mesh_manager, stage=0,
                                       rules=self._cache_rules)
         return planner.param_shardings(cache_shapes)
+
+    def _observe_compile(self, label, fn, args, names=None):
+        """Compile-ledger hook: no-op unless the serving layer attached a
+        ledger. Serving programs don't donate their inputs, so observing
+        before the call (args live either way) keeps one code path with
+        the training engine."""
+        cp = self.compile_plane
+        if cp is None:
+            return
+        try:
+            cp.observe(label, fn, args, names=names, mesh=self.mesh)
+        except Exception as e:   # observability must never fail a request
+            logger.warning(f"compile plane: observe failed: {e}")
 
     def _fn_get(self, key):
         """LRU lookup in the compiled-program cache."""
@@ -227,6 +245,8 @@ class InferenceEngine:
             fn = self._fn_put(key, jax.jit(
                 fwd, in_shardings=(self.param_shardings,
                                    self._batch_sharding(input_ids.shape[0]))))
+        self._observe_compile("fwd", fn, (self.params, input_ids),
+                              names=("params", "input_ids"))
         with self.mesh:
             return fn(self.params, input_ids)
 
@@ -321,16 +341,18 @@ class InferenceEngine:
                     top_p, eos_token_id, padded=pad_counts is not None)
             self._fn_put(key, fn)
         tr = get_tracer()
+        gen_key = jax.random.PRNGKey(seed)
+        gen_args = (self.params, input_ids, gen_key) if num_beams > 1 else \
+            (self.params, input_ids, gen_key, pad_counts)
+        self._observe_compile("generate", fn, gen_args,
+                              names=("params", "input_ids", "rng",
+                                     "pad_counts"))
         with tr.span("generate", cat="inference",
                      args={"batch": b, "prompt_len": t,
                            "max_new_tokens": max_new_tokens,
                            "num_beams": num_beams}) as sp:
             with self.mesh:
-                if num_beams > 1:
-                    out = fn(self.params, input_ids, jax.random.PRNGKey(seed))
-                else:
-                    out = fn(self.params, input_ids,
-                             jax.random.PRNGKey(seed), pad_counts)
+                out = fn(*gen_args)
             if tr.sync_spans:
                 sp.sync_on(out)
         return out
@@ -554,6 +576,7 @@ class InferenceEngine:
                 lambda: self.module.init_kv_cache(num_slots, max_len,
                                                   dtype=self.dtype),
                 out_shardings=self._pool_shardings(num_slots, max_len))
+        self._observe_compile("slot_pool", fn, ())
         with self.mesh:
             return fn()
 
@@ -597,10 +620,13 @@ class InferenceEngine:
                 None), out_shardings=(pool_shardings, None))
         if key is None:
             key = jax.random.PRNGKey(0)
+        pf_args = (self.params, jnp.asarray(ids), pool, jnp.int32(slot),
+                   jnp.int32(t - 1), jnp.float32(temperature), key)
+        self._observe_compile("slot_prefill", fn, pf_args,
+                              names=("params", "ids", "pool", "slot",
+                                     "last_idx", "temperature", "rng"))
         with self.mesh:
-            pool, tok = fn(self.params, jnp.asarray(ids), pool,
-                           jnp.int32(slot), jnp.int32(t - 1),
-                           jnp.float32(temperature), key)
+            pool, tok = fn(*pf_args)
         return pool, int(tok)
 
     def slot_decode_step(self, pool, toks, positions, temps, key=None):
@@ -629,11 +655,14 @@ class InferenceEngine:
                 out_shardings=(pool_shardings, None))
         if key is None:
             key = jax.random.PRNGKey(0)
+        dec_args = (self.params, pool, jnp.asarray(toks, jnp.int32),
+                    jnp.asarray(positions, jnp.int32),
+                    jnp.asarray(temps, jnp.float32), key)
+        self._observe_compile("slot_decode", fn, dec_args,
+                              names=("params", "pool", "toks", "positions",
+                                     "temps", "rng"))
         with self.mesh:
-            pool, nxt = fn(self.params, pool,
-                           jnp.asarray(toks, jnp.int32),
-                           jnp.asarray(positions, jnp.int32),
-                           jnp.asarray(temps, jnp.float32), key)
+            pool, nxt = fn(*dec_args)
         return pool, np.asarray(nxt)
 
     def slot_decode_executables(self, num_slots: int, max_len: int) -> int:

@@ -174,13 +174,13 @@ class FlopsProfiler:
         if hasattr(fn, "lower"):
             # cost_analysis on the LOWERED stage only (no .compile() — an
             # AOT compile would NOT hit the jit executable cache and can
-            # cost minutes on a real model mid-training)
+            # cost minutes on a real model mid-training); normalized by
+            # the shared HLO cost core (telemetry/hlo_cost.py), the same
+            # parser hlo_audit and the compile ledger consume
             try:
-                cost = fn.lower(*args, **kwargs).cost_analysis()
-                if isinstance(cost, (list, tuple)):
-                    cost = cost[0] if cost else None
-                if cost:
-                    xla_flops = cost.get("flops")
+                from ..telemetry.hlo_cost import cost_summary
+                cost = cost_summary(fn.lower(*args, **kwargs).cost_analysis())
+                xla_flops = cost.get("flops")
             except Exception:
                 pass
         closed = jax.make_jaxpr(lambda *a, **k: fn(*a, **k))(*args, **kwargs)

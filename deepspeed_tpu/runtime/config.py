@@ -243,6 +243,50 @@ class HostAggConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class CompilePlaneConfig(DeepSpeedConfigModel):
+    """The ``"compile_plane"`` config block (telemetry/compileplane.py +
+    telemetry/overlap.py): compile ledger with recompile diffs, HBM
+    role ledger, and the collective-overlap analyzer. Disabled (the
+    default) allocates nothing — no ledger objects, no per-call
+    fingerprints, no gauges.
+
+    - ``history``: compile events kept in memory (each carries the arg
+      fingerprint, recompile diff, and cost/memory summaries).
+    - ``memory_analysis``: AOT-compile each new executable once to
+      capture ``memory_analysis()`` (per-device arg/output/temp bytes),
+      the isolated compile wall time, and the optimized HLO's
+      collective/async-overlap summary. Costs one extra XLA compile per
+      compile *event* (steady state pays nothing); turn off on very
+      large models where doubling each compile event is unacceptable.
+    - ``hbm`` / ``hbm_interval_steps``: the HBM role ledger
+      (``dstpu_mem_*`` gauges + Perfetto waterline) and its update
+      cadence.
+    - ``overlap`` / ``overlap_interval_steps`` / ``overlap_window_ms``:
+      the trace-ring overlap gauge and its cadence/window."""
+    enabled: bool = False
+    history: int = 32
+    memory_analysis: bool = True
+    hbm: bool = True
+    hbm_interval_steps: int = 8
+    overlap: bool = True
+    overlap_interval_steps: int = 16
+    overlap_window_ms: float = 30_000.0
+
+    def validate(self):
+        if self.history < 1:
+            raise ConfigError("compile_plane.history must be >= 1")
+        if self.hbm_interval_steps < 1:
+            raise ConfigError(
+                "compile_plane.hbm_interval_steps must be >= 1")
+        if self.overlap_interval_steps < 1:
+            raise ConfigError(
+                "compile_plane.overlap_interval_steps must be >= 1")
+        if self.overlap_window_ms <= 0:
+            raise ConfigError(
+                "compile_plane.overlap_window_ms must be > 0")
+
+
+@dataclasses.dataclass
 class FlopsProfilerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     profile_step: int = 1
@@ -342,6 +386,8 @@ class DeepSpeedConfig:
         self.flight_recorder = FlightRecorderConfig.from_dict(
             pd.get(C.FLIGHT_RECORDER, {}))
         self.hostagg = HostAggConfig.from_dict(pd.get(C.HOSTAGG, {}))
+        self.compile_plane = CompilePlaneConfig.from_dict(
+            pd.get(C.COMPILE_PLANE, {}))
         self.flops_profiler = FlopsProfilerConfig.from_dict(pd.get(C.FLOPS_PROFILER, {}))
         self.checkpoint_config = CheckpointConfig.from_dict(pd.get(C.CHECKPOINT, {}))
         # fault tolerance: checkpoint integrity/fallback, preemption

@@ -85,6 +85,7 @@ TELEMETRY = "telemetry"
 STATUSZ = "statusz"
 FLIGHT_RECORDER = "flight_recorder"
 HOSTAGG = "hostagg"
+COMPILE_PLANE = "compile_plane"
 FLOPS_PROFILER = "flops_profiler"
 RESILIENCE = "resilience"
 

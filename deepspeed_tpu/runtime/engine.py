@@ -434,6 +434,28 @@ class DeepSpeedEngine:
             from ..telemetry.hostagg import HostAggregator
             self._hostagg = HostAggregator(cfg.hostagg, tracer=self.tracer,
                                            owner=self)
+        # compile/memory plane (telemetry/compileplane.py + overlap.py):
+        # compile ledger with recompile diffs + cost/memory analysis, HBM
+        # role ledger, collective-overlap analyzer. Off by default = no
+        # objects, no per-call fingerprints, no gauges.
+        self._compile_plane = None
+        self._hbm = None
+        self._overlap = None
+        cpcfg = cfg.compile_plane
+        if cpcfg.enabled:
+            from ..telemetry.compileplane import CompileLedger, HBMLedger
+            self._compile_plane = CompileLedger(cpcfg, tracer=self.tracer,
+                                                owner=self)
+            if cpcfg.hbm:
+                self._hbm = HBMLedger(tracer=self.tracer, owner=self)
+            if cpcfg.overlap:
+                from ..telemetry.overlap import OverlapAnalyzer
+                self._overlap = OverlapAnalyzer(
+                    tracer=self.tracer, owner=self,
+                    interval_steps=cpcfg.overlap_interval_steps,
+                    window_ms=cpcfg.overlap_window_ms)
+            if self._recorder is not None:
+                self._recorder.attach_compile_plane(self._compile_plane)
         # per-engine monitor-event buffer (bounded: survives a disabled
         # monitor without growing) — NOT the tracer's global queue, so two
         # engines in one process can't drain each other's events
@@ -483,6 +505,13 @@ class DeepSpeedEngine:
                 # a host with a heartbeat gap is a pod problem: flip
                 # /healthz so the operator's probe sees it
                 self.statusz.register_health("hosts", self._hostagg.health)
+            if self._compile_plane is not None:
+                self.statusz.register("compile_plane",
+                                      self._compile_plane.summary)
+            if self._hbm is not None:
+                self.statusz.register("memory", self._hbm.summary)
+            if self._overlap is not None:
+                self.statusz.register("overlap", self._overlap.summary)
 
         # ---- comm compression (comm/compression.py, docs/comm.md):
         #      quantized/hierarchical wire formats behind the collective
@@ -932,12 +961,20 @@ class DeepSpeedEngine:
             fn = self._micro_grad_fn if keep is None else \
                 self._train_step_cache.setdefault(
                     ("micro", keep), self._make_micro_grad(keep))
+            cp_ev = self._observe_compile(
+                "fwd", fn, (self.params, self._pending_batch, rng, scale,
+                            theta),
+                names=("params", "batch", "rng", "scale", "pld_theta"))
+            t_cp = time.perf_counter() if cp_ev is not None else 0.0
             with tr.span("dispatch", cat="train"):
                 with self.mesh:
                     loss, grads = fn(self.params, self._pending_batch, rng,
                                      scale, theta)
             if tr.sync_spans:
                 sp.sync_on(loss)
+        if cp_ev is not None:
+            self._compile_plane.finish(
+                cp_ev, (time.perf_counter() - t_cp) * 1e3)
         first_sight = not self._watchdog.seen(fn)
         if self._watchdog.observe(fn, tracer=tr, label="fwd", owner=self):
             g_iv.reclassify("recompile")
@@ -1125,6 +1162,8 @@ class DeepSpeedEngine:
                             args={"step": self.global_steps})
         g_iv = self._ledger.track("productive_step")
         fn = None
+        cp_ev = None      # pending compile-ledger event (compile plane)
+        t_cp = 0.0
         with g_iv, step_span as sp:
             if self._offload is not None:
                 # denom = the batch's ACTUAL gas dim (accum_grads derives gas
@@ -1137,6 +1176,13 @@ class DeepSpeedEngine:
                 self._maybe_telemetry_flops(
                     fn, (self.params, self.scaler_state, batch, rng, theta,
                          loss_mul))
+                cp_ev = self._observe_compile(
+                    "train_batch", fn,
+                    (self.params, self.scaler_state, batch, rng, theta,
+                     loss_mul),
+                    names=("params", "scaler_state", "batch", "rng",
+                           "pld_theta", "loss_mul"))
+                t_cp = time.perf_counter() if cp_ev is not None else 0.0
                 if self._offload_pipelined:
                     metrics = self._pipelined_offload_step(fn, batch, rng,
                                                            theta, float(gas),
@@ -1157,6 +1203,14 @@ class DeepSpeedEngine:
                 self._maybe_telemetry_flops(
                     fn, (self.params, self.opt_state, self.scaler_state,
                          batch, lr, rng, theta, loss_mul))
+                cp_ev = self._observe_compile(
+                    "train_batch", fn,
+                    (self.params, self.opt_state, self.scaler_state, batch,
+                     lr, rng, theta, loss_mul),
+                    names=("params", "opt_state", "scaler_state", "batch",
+                           "lr", "rng", "pld_theta", "loss_mul"),
+                    donated=(0, 1, 2))
+                t_cp = time.perf_counter() if cp_ev is not None else 0.0
                 with tr.span("dispatch", cat="train"):
                     with self.mesh:
                         (self.params, self.opt_state, self.scaler_state,
@@ -1165,6 +1219,12 @@ class DeepSpeedEngine:
                                        theta, loss_mul)
             if tr.sync_spans:
                 sp.sync_on(metrics)
+        if cp_ev is not None:
+            # the wall time of the step that paid this compile event
+            self._compile_plane.finish(
+                cp_ev, (time.perf_counter() - t_cp) * 1e3)
+            if self._overlap is not None and cp_ev.get("overlap"):
+                self._overlap.note_hlo(cp_ev["overlap"])
         # goodput classification: a step that paid the initial XLA compile
         # or a watchdog-flagged recompile was not productive step time —
         # the first sight is read BEFORE _telemetry_step_end registers fn
@@ -1304,6 +1364,51 @@ class DeepSpeedEngine:
             logger.warning(f"telemetry: step flops profile failed: {e}")
             self._step_flops[id(fn)] = 0
 
+    def _observe_compile(self, label, fn, args, names=None, donated=()):
+        """Compile-ledger hook (telemetry/compileplane.py): fingerprint
+        this call's arguments BEFORE the step runs (the step donates its
+        inputs) and record a compile/recompile event — with the diff
+        naming the changed argument — when the signature is new. No-op
+        without the ``compile_plane`` config block."""
+        cp = self._compile_plane
+        if cp is None or fn is None:
+            return None
+        try:
+            return cp.observe(label, fn, args, names=names, donated=donated,
+                              step=self.global_steps, mesh=self.mesh)
+        except Exception as e:   # observability must never fail the step
+            logger.warning(f"compile plane: observe failed: {e}")
+            return None
+
+    def _update_hbm(self):
+        """HBM role ledger update: per-device live bytes of the state
+        trees plus the active executable's temp allocation — the
+        ``dstpu_mem_*`` gauges and the Perfetto waterline sample."""
+        hbm = self._hbm
+        if hbm is None:
+            return
+        try:
+            roles = {"params": hbm.device_bytes(self.params)}
+            if self.opt_state is not None:
+                roles["optimizer_state"] = hbm.device_bytes(self.opt_state)
+            grads = 0
+            if self._grad_acc_buffer is not None:
+                grads += hbm.device_bytes(self._grad_acc_buffer)
+            if self._pending_grads is not None:
+                grads += hbm.device_bytes(self._pending_grads)
+            roles["grads"] = grads
+            # activations/temps: the compiled step's per-device temp
+            # allocation from memory_analysis (grads and activations live
+            # there inside the fused step); 0 when analysis is off
+            ev = self._compile_plane.last_event("train_batch") \
+                if self._compile_plane is not None else None
+            mem = (ev or {}).get("memory") or {}
+            roles["activations"] = int(mem.get("temp", 0))
+            stats = jax.local_devices()[0].memory_stats() or {}
+            hbm.update(roles, peak_bytes=stats.get("peak_bytes_in_use"))
+        except Exception as e:
+            logger.warning(f"compile plane: HBM ledger update failed: {e}")
+
     def _telemetry_step_end(self, fn, span):
         """Per-step gauges after the synced train_batch span: step time,
         MFU, live-memory high-water, recompile watchdog."""
@@ -1328,6 +1433,14 @@ class DeepSpeedEngine:
         if peak:
             gauge("telemetry/peak_hbm_gib", peak / 2**30)
         flops = self._step_flops.get(id(fn), 0) if fn is not None else 0
+        if not flops and fn is not None and self._compile_plane is not None:
+            # MFU fallback: with the flops profiler off (telemetry.mfu
+            # false, or a failed trace), derive step FLOPs from the
+            # compile ledger's cost_analysis of the active executable so
+            # telemetry/mfu keeps reporting instead of silently reading 0
+            flops = int(self._compile_plane.step_flops("train_batch", fn))
+            if flops:
+                self._step_flops[id(fn)] = flops
         if flops and dur_s > 0:
             achieved = flops / dur_s
             gauge("telemetry/step_tflops", achieved / 1e12)
@@ -1355,8 +1468,16 @@ class DeepSpeedEngine:
     def _xla_cost_summary(self) -> dict:
         """Bundle section: the XLA cost-analysis summary of the compiled
         executable the last step ran (captured when the MFU profiler
-        traced it; empty when telemetry.mfu is off)."""
-        return dict(self._step_cost.get(self._last_fn_id, {}))
+        traced it), falling back to the compile ledger's cost capture
+        when telemetry.mfu is off."""
+        out = dict(self._step_cost.get(self._last_fn_id, {}))
+        if not out and self._compile_plane is not None:
+            ev = self._compile_plane.last_event("train_batch")
+            if ev is not None and ev.get("cost"):
+                out = {"flops": ev["cost"].get("flops"),
+                       "xla_cost": ev["cost"],
+                       "source": "compile_plane"}
+        return out
 
     def _flight_record(self, dur_ms, compiled, recompiled):
         """Feed one finished step to the flight recorder (ring record,
@@ -1367,11 +1488,15 @@ class DeepSpeedEngine:
             rec.record_step(self.global_steps, dur_ms, compile=compiled,
                             recompile=recompiled)
             if recompiled:
-                rec.trigger(
-                    "recompile",
-                    f"step {self.global_steps}: jit cache grew "
-                    f"({self._watchdog.recompiles} recompiles total)",
-                    step=self.global_steps)
+                detail = (f"step {self.global_steps}: jit cache grew "
+                          f"({self._watchdog.recompiles} recompiles total)")
+                cp = self._compile_plane
+                if cp is not None and cp.last_recompile is not None:
+                    # name the cause, not just the count: the compile
+                    # ledger's fingerprint diff of the changed argument
+                    detail += " — " + "; ".join(
+                        cp.last_recompile["diff"][:3])
+                rec.trigger("recompile", detail, step=self.global_steps)
         agg = self._hostagg
         if agg is not None:
             dw_ms = 0.0
@@ -1628,6 +1753,12 @@ class DeepSpeedEngine:
                 self._config.steps_per_print and \
                 self.global_steps % self._config.steps_per_print == 0:
             self._log_memory_breakdown()
+        cpcfg = self._config.compile_plane
+        if self._hbm is not None and \
+                self.global_steps % cpcfg.hbm_interval_steps == 0:
+            self._update_hbm()
+        if self._overlap is not None:
+            self._overlap.maybe_update(self.global_steps)
         tcfg = self._config.telemetry
         if tcfg.enabled and tcfg.export_interval and \
                 self.global_steps % tcfg.export_interval == 0:

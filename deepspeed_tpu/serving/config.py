@@ -92,6 +92,12 @@ class ServingConfig(DeepSpeedConfigModel):
     # on SLO burn-rate spikes, preemption, and /debug/capture
     flight_recorder: Any = None
 
+    # compile_plane (dict -> runtime.config.CompilePlaneConfig): compile
+    # ledger over the serving programs (prefill buckets, fused decode,
+    # pool init) with fingerprint diffs + cost/memory analysis, and the
+    # HBM role ledger (params / kv_slots -> dstpu_mem_* gauges)
+    compile_plane: Any = None
+
     # resilience (dict -> resilience.config.ResilienceConfig): with
     # handle_signals, SIGTERM/SIGINT stops admissions and drains in-flight
     # requests at the next tick (running slots complete, queued requests
@@ -141,6 +147,12 @@ class ServingConfig(DeepSpeedConfigModel):
                 self.flight_recorder)
         elif self.flight_recorder is None:
             self.flight_recorder = FlightRecorderConfig()
+        from ..runtime.config import CompilePlaneConfig
+        if isinstance(self.compile_plane, dict):
+            self.compile_plane = CompilePlaneConfig.from_dict(
+                self.compile_plane)
+        elif self.compile_plane is None:
+            self.compile_plane = CompilePlaneConfig()
         from ..resilience.config import ResilienceConfig
         if isinstance(self.resilience, dict):
             self.resilience = ResilienceConfig.from_dict(self.resilience)

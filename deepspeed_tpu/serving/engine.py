@@ -75,6 +75,25 @@ class ServingEngine:
             self._recorder = FlightRecorder(config.flight_recorder,
                                             tracer=self.tracer)
             self._recorder.add_provider("serving", self._statusz_section)
+        # compile/memory plane (telemetry/compileplane.py): compile ledger
+        # over the serving programs — each prefill bucket, the fused
+        # decode step, pool init — plus the HBM role ledger attributing
+        # per-device bytes to params vs the KV slot pool. Off by default
+        # = nothing allocated, no per-call fingerprints.
+        self._compile_plane = None
+        self._hbm = None
+        self._hbm_interval = 8
+        cpcfg = getattr(config, "compile_plane", None)
+        if getattr(cpcfg, "enabled", False):
+            from ..telemetry.compileplane import CompileLedger, HBMLedger
+            self._compile_plane = CompileLedger(cpcfg, tracer=self.tracer,
+                                                owner=self)
+            engine.compile_plane = self._compile_plane
+            if cpcfg.hbm:
+                self._hbm = HBMLedger(tracer=self.tracer, owner=self)
+                self._hbm_interval = int(cpcfg.hbm_interval_steps)
+            if self._recorder is not None:
+                self._recorder.attach_compile_plane(self._compile_plane)
         self.statusz = None
         if getattr(config.statusz, "enabled", False):
             from ..telemetry.statusz import StatuszServer
@@ -83,6 +102,11 @@ class ServingEngine:
             self.statusz.register_health("serving", self._health_check)
             if self._recorder is not None:
                 self.statusz.attach_recorder(self._recorder)
+            if self._compile_plane is not None:
+                self.statusz.register("compile_plane",
+                                      self._compile_plane.summary)
+            if self._hbm is not None:
+                self.statusz.register("memory", self._hbm.summary)
         self.scheduler = ContinuousBatchingScheduler(
             engine, config, metrics=self.metrics, clock=clock, seed=seed)
         self._requests: Dict[int, Request] = {}
@@ -148,9 +172,28 @@ class ServingEngine:
         with self._ledger.track(bucket):
             in_flight = self.scheduler.tick()
         self.metrics.flush()
+        if self._hbm is not None and \
+                self.metrics.ticks % self._hbm_interval == 0:
+            self._update_hbm()
         if rec is not None:
             self._flight_record((time.perf_counter() - t0) * 1e3)
         return in_flight
+
+    def _update_hbm(self):
+        """HBM role ledger update: the serving replica's per-device bytes
+        are the weights plus the slot-pool KV cache — the
+        ``dstpu_mem_params_gib`` / ``dstpu_mem_kv_slots_gib`` gauges."""
+        try:
+            roles = {"params": self._hbm.device_bytes(self.engine.params),
+                     "kv_slots": self._hbm.device_bytes(
+                         self.scheduler.pool.cache)}
+            import jax
+            stats = jax.local_devices()[0].memory_stats() or {}
+            self._hbm.update(roles,
+                             peak_bytes=stats.get("peak_bytes_in_use"))
+        except Exception as e:
+            log_dist(f"compile plane: HBM ledger update failed: {e}",
+                     ranks=[0])
 
     def _flight_record(self, dur_ms: float):
         """One scheduler tick into the flight recorder. Tick times swing
@@ -277,6 +320,11 @@ class ServingEngine:
         # gauge lifecycle: a closed engine's queue depth / TTFT must not
         # survive in prometheus_dump() or /metrics as if it were live
         self.metrics.close()
+        if self._compile_plane is not None and \
+                getattr(self.engine, "compile_plane", None) \
+                is self._compile_plane:
+            self.engine.compile_plane = None   # detach from the shared
+                                               # InferenceEngine
         self.tracer.release_counters(self)
 
     # ------------------------------------------------------------- statusz

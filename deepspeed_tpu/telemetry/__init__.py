@@ -26,6 +26,9 @@ from .goodput import GoodputLedger, get_ledger, configure_ledger
 from .statusz import StatuszServer
 from .flight_recorder import FlightRecorder
 from .hostagg import HostAggregator
+from .compileplane import (CompileLedger, HBMLedger, fingerprint_args,
+                           diff_fingerprints)
+from .overlap import OverlapAnalyzer, interval_overlap, overlap_from_events
 
 __all__ = ["Span", "Tracer", "RecompileWatchdog", "get_tracer",
            "configure_tracer", "chrome_trace", "write_chrome_trace",
@@ -33,4 +36,6 @@ __all__ = ["Span", "Tracer", "RecompileWatchdog", "get_tracer",
            "prometheus_dump", "span_aggregates", "comm_table",
            "TelemetryMonitor", "GoodputLedger", "get_ledger",
            "configure_ledger", "StatuszServer", "FlightRecorder",
-           "HostAggregator"]
+           "HostAggregator", "CompileLedger", "HBMLedger",
+           "fingerprint_args", "diff_fingerprints", "OverlapAnalyzer",
+           "interval_overlap", "overlap_from_events"]

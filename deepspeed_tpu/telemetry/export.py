@@ -220,6 +220,14 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
             host_lines.append(f"# TYPE {prefix}_host_{name} gauge")
             host_lines.append(f"{prefix}_host_{name} {fval}")
             continue
+        if tag.startswith("mem/"):
+            # HBM role attribution (telemetry/compileplane.py HBMLedger):
+            # dedicated dstpu_mem_* series so a dashboard stacks
+            # params/grads/optimizer/activations/kv_slots directly
+            name = _prom(tag[len("mem/"):])
+            host_lines.append(f"# TYPE {prefix}_mem_{name} gauge")
+            host_lines.append(f"{prefix}_mem_{name} {fval}")
+            continue
         lines.append(f'{prefix}_metric{{tag="{_prom(tag)}"}} {fval}')
     lines.extend(host_lines)
     aggs = span_aggregates(tracer)

@@ -80,6 +80,7 @@ class FlightRecorder:
         #: name -> callable() -> dict; one bundle "status" section each
         self._providers: Dict[str, Callable[[], dict]] = {}
         self._cost_provider: Optional[Callable[[], dict]] = None
+        self._compile_plane = None       # CompileLedger (attach_compile_plane)
         self.ema_ms = 0.0
         self._baseline_steps = 0       # records feeding the EMA
         self._last_goodput: Dict[str, float] = {}
@@ -102,6 +103,14 @@ class FlightRecorder:
         compiled executable (the engine captures it when the MFU profiler
         traces the step fn)."""
         self._cost_provider = provider
+        return self
+
+    def attach_compile_plane(self, ledger):
+        """Embed the compile ledger (telemetry/compileplane.py) in every
+        bundle: fingerprints, recompile diffs, and per-event cost/memory
+        summaries — a recompile bundle then names the exact argument
+        whose shape changed instead of just counting the recompile."""
+        self._compile_plane = ledger
         return self
 
     # ------------------------------------------------------------ recording
@@ -217,6 +226,11 @@ class FlightRecorder:
                 doc["cost"] = self._cost_provider()
             except Exception as e:
                 doc["cost"] = {"error": str(e)}
+        if self._compile_plane is not None:
+            try:
+                doc["compile_plane"] = self._compile_plane.bundle_section()
+            except Exception as e:
+                doc["compile_plane"] = {"error": str(e)}
         os.makedirs(self.dir, exist_ok=True)
         fname = f"bundle-{bid:06d}-{kind}.json"
         path = os.path.join(self.dir, fname)

@@ -1,0 +1,168 @@
+"""Shared HLO cost core — one parser for every XLA-cost consumer.
+
+Three consumers used to carry private copies of this logic:
+``benchmarks/hlo_audit.py`` (the collective-schedule regression gate),
+the flight recorder's "XLA cost summary" capture, and the compile ledger
+(telemetry/compileplane.py). They now all read from here, so a change to
+the HLO text format (an XLA upgrade renaming an op, a new async form) is
+fixed in exactly one place — and the future schedule autotuner (ROADMAP
+item 2/5) scores candidate plans with the same numbers the gate enforces.
+
+Contents:
+
+- ``collect_collectives(hlo_text)`` — {op: {count, bytes}} over a
+  compiled module's *synchronous* collectives (single-result and
+  tuple-result forms), payload bytes from the printed result shapes.
+- ``collect_async(hlo_text)`` — per-op counts of collectives emitted in
+  async start/done form (``all-gather-start`` … ``all-gather-done``, or
+  the generic ``async-start`` wrapper) — the ops XLA's latency-hiding
+  scheduler *can* overlap with compute.
+- ``hlo_overlap_summary(hlo_text)`` — sync vs async collective counts
+  and the ``async_fraction`` in [0, 1]: the static half of the
+  collective-overlap instrument (telemetry/overlap.py layers the
+  trace-measured half on top).
+- ``cost_summary(raw)`` — normalize a ``cost_analysis()`` result
+  (dict, or the list/tuple wrapping older jax returns) to a flat dict
+  of floats with python-identifier keys.
+- ``memory_summary(stats)`` — normalize a ``memory_analysis()``
+  ``CompiledMemoryStats`` to a plain dict of the ``*_in_bytes`` fields.
+
+This module is deliberately standalone — stdlib-only, no package
+imports — so ``benchmarks/hlo_audit.py`` can load it by file path before
+the deepspeed_tpu package (and its backend-touching ``__init__`` chain)
+is imported, the same way it loads ``utils/hermetic.py``.
+"""
+
+import math
+import re
+from typing import Any, Dict, Optional
+
+__all__ = ["DTYPE_BYTES", "COLLECTIVES", "collect_collectives",
+           "collect_async", "hlo_overlap_summary", "cost_summary",
+           "memory_summary"]
+
+#: HLO shape-prefix dtype -> bytes per element (unknown dtypes assume 4)
+DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+               "s8": 1, "u8": 1, "pred": 1, "f64": 8, "s64": 8, "u64": 8,
+               "s16": 2, "u16": 2, "f8e4m3fn": 1, "f8e5m2": 1}
+
+#: the collective-op vocabulary the audit and the overlap analyzer track
+COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather", "all-to-all",
+               "collective-permute")
+
+_PAT_SINGLE = re.compile(
+    r"=\s*(\w+)\[([\d,]*)\]\S*\s+(" + "|".join(COLLECTIVES) + r")\(")
+_PAT_TUPLE = re.compile(
+    r"=\s*\(([^)]+)\)\s+(" + "|".join(COLLECTIVES) + r")\(")
+_PAT_SHAPE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    numel = math.prod([int(d) for d in dims.split(",") if d] or [1])
+    return numel * DTYPE_BYTES.get(dtype, 4)
+
+
+def collect_collectives(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """{op: {count, bytes}} over the compiled module (fusion-internal
+    shapes included via the op's result shape). Synchronous forms only —
+    async start/done pairs are ``collect_async``'s domain."""
+    out: Dict[str, Dict[str, int]] = {}
+    # single-result form only ('= f32[...] all-reduce('); tuple results
+    # ('= (f32[...], ...) all-reduce(') are handled by _PAT_TUPLE below —
+    # anchoring at '= <dtype>[' keeps the two disjoint
+    for m in _PAT_SINGLE.finditer(hlo_text):
+        dtype, dims, op = m.group(1), m.group(2), m.group(3)
+        rec = out.setdefault(op, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += _shape_bytes(dtype, dims)
+    # tuple-result collectives (all-reduce of N tensors) print as
+    # `(f32[...], f32[...]) all-reduce(` — catch those too
+    for m in _PAT_TUPLE.finditer(hlo_text):
+        shapes, op = m.group(1), m.group(2)
+        rec = out.setdefault(op, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        for sm in _PAT_SHAPE.finditer(shapes):
+            rec["bytes"] += _shape_bytes(sm.group(1), sm.group(2))
+    return out
+
+
+def collect_async(hlo_text: str) -> Dict[str, int]:
+    """Per-op counts of collectives in async start/done form. XLA prints
+    dedicated pairs for some ops (``all-gather-start(``) and wraps the
+    rest in generic ``async-start`` instructions whose line names the
+    wrapped op; both count."""
+    out: Dict[str, int] = {}
+    for op in COLLECTIVES:
+        n = len(re.findall(rf"\b{op}-start\(", hlo_text))
+        n += len(re.findall(rf"\basync-start[^\n]*\b{op}\b", hlo_text))
+        if n:
+            out[op] = n
+    return out
+
+
+def hlo_overlap_summary(hlo_text: str) -> Dict[str, Any]:
+    """The static overlap instrument: how much of the module's collective
+    schedule is even *overlappable*. ``async_fraction`` is async ops over
+    all collective ops, in [0, 1] — 0 on a fully synchronous schedule
+    (the CPU backend), 1 when every collective has a start/done pair the
+    latency-hiding scheduler can move compute between. The wall-clock
+    half (did the overlap actually happen) comes from a device trace via
+    telemetry/overlap.py."""
+    sync = collect_collectives(hlo_text)
+    async_ = collect_async(hlo_text)
+    n_sync = sum(v["count"] for v in sync.values())
+    n_async = sum(async_.values())
+    total = n_sync + n_async
+    return {
+        "collectives": total,
+        "sync": n_sync,
+        "async": n_async,
+        "async_fraction": round(n_async / total, 6) if total else 0.0,
+        "sync_bytes": sum(v["bytes"] for v in sync.values()),
+        "per_op_sync": {op: v["count"] for op, v in sorted(sync.items())},
+        "per_op_async": dict(sorted(async_.items())),
+    }
+
+
+def cost_summary(raw: Any) -> Dict[str, float]:
+    """Normalize a ``cost_analysis()`` result to {identifier: float}.
+    Handles the list/tuple wrapping of older jax versions, drops
+    non-numeric values, and rewrites keys like ``"bytes accessed"`` to
+    ``bytes_accessed`` (the per-operand ``bytes accessed0{}`` entries are
+    dropped — consumers want module totals)."""
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else None
+    if not raw:
+        return {}
+    out: Dict[str, float] = {}
+    for key, val in dict(raw).items():
+        try:
+            fval = float(val)
+        except (TypeError, ValueError):
+            continue
+        name = re.sub(r"[^0-9a-zA-Z]+", "_", str(key)).strip("_")
+        if re.search(r"\d", name):      # per-operand entries: skip
+            continue
+        out[name] = fval
+    return out
+
+
+def memory_summary(stats: Any) -> Optional[Dict[str, int]]:
+    """``memory_analysis()`` CompiledMemoryStats -> plain dict of the
+    per-device ``*_in_bytes`` fields (argument/output/temp/alias/
+    generated_code, plus the host-memory variants when non-zero).
+    Returns None when the backend reports nothing."""
+    if stats is None:
+        return None
+    out: Dict[str, int] = {}
+    for attr in dir(stats):
+        if not attr.endswith("_size_in_bytes"):
+            continue
+        try:
+            val = int(getattr(stats, attr))
+        except (TypeError, ValueError):
+            continue
+        if attr.startswith("host_") and val == 0:
+            continue                     # host fields are usually all-zero
+        out[attr[:-len("_size_in_bytes")]] = val
+    return out or None

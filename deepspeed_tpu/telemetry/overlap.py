@@ -1,0 +1,240 @@
+"""Collective-overlap analyzer — is communication hidden under compute?
+
+ROADMAP item 2 (T3-style fine-grained overlap, arxiv 2401.16677;
+DeepCompile schedule autotuning, arxiv 2504.09983) needs a before/after
+instrument: the **overlap fraction** — of every second the interconnect
+is busy moving collectives, how much runs concurrently with compute.
+This module computes it from three sources, cheapest to deepest:
+
+1. **HLO async start/done pairs** (static, CPU-runnable): what fraction
+   of the compiled module's collectives are even *overlappable* —
+   ``hlo_overlap_summary`` in telemetry/hlo_cost.py, re-exported here
+   and captured per compile event by the compile ledger. This is the
+   ``benchmarks/hlo_audit.py`` column.
+2. **The span ring** (host-side, always on with the tracer): interval
+   overlap between ``cat="comm"`` spans and compute spans. Honest for
+   the explicit shard_map comm path and host-orchestrated work; under a
+   single fused XLA step the host ring only sees dispatch, so the gauge
+   is labelled by its source.
+3. **A device trace** (``jax.profiler`` Perfetto file): per-op device
+   wall time, collectives classified by op name — the ground truth on
+   hardware, same file ``profiling/flops_profiler.py`` reads for wall
+   fractions.
+
+All three reduce through one pure function, ``interval_overlap``:
+merge the compute intervals, clip each comm interval against the merged
+set, ``overlap_fraction = overlapped_comm_time / comm_time`` ∈ [0, 1].
+
+``OverlapAnalyzer`` is the engine-facing wrapper: throttled ring
+analysis, the ``overlap/fraction`` gauge, and a statusz section.
+"""
+
+import gzip
+import json
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .hlo_cost import hlo_overlap_summary  # noqa: F401  (re-export)
+from .trace import get_tracer
+
+__all__ = ["interval_overlap", "overlap_from_events", "overlap_from_tracer",
+           "overlap_from_trace_file", "hlo_overlap_summary",
+           "OverlapAnalyzer"]
+
+#: device/trace op names that are communication (XLA op names, jax
+#: primitive names, and this repo's comm-span op labels)
+COMM_NAME_RE = re.compile(
+    r"all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute|"
+    r"all_reduce|all_gather|reduce_scatter|all_to_all|psum|ppermute|"
+    r"send|recv-", re.IGNORECASE)
+
+#: span categories never counted as compute
+_NON_COMPUTE_CATS = ("comm", "warning", "async", "mem")
+
+
+def interval_overlap(comm: Sequence[Tuple[float, float]],
+                     compute: Sequence[Tuple[float, float]]) \
+        -> Dict[str, float]:
+    """Overlap of ``comm`` intervals against the union of ``compute``
+    intervals (each a (start, end) pair, any consistent unit). Returns
+    comm/compute busy time, the overlapped comm time, and
+    ``overlap_fraction`` = overlapped / comm ∈ [0, 1] (0.0 when there is
+    no communication at all)."""
+
+    def merged(ivs):
+        out = []
+        for s, e in sorted((s, e) for s, e in ivs if e > s):
+            if out and s <= out[-1][1]:
+                out[-1][1] = max(out[-1][1], e)
+            else:
+                out.append([s, e])
+        return out
+
+    comp = merged(compute)
+    comm_m = merged(comm)
+    comm_t = sum(e - s for s, e in comm_m)
+    comp_t = sum(e - s for s, e in comp)
+    overlapped = 0.0
+    ci = 0
+    for s, e in comm_m:
+        while ci < len(comp) and comp[ci][1] <= s:
+            ci += 1
+        j = ci
+        while j < len(comp) and comp[j][0] < e:
+            overlapped += min(e, comp[j][1]) - max(s, comp[j][0])
+            j += 1
+    return {
+        "comm_s": comm_t,
+        "compute_s": comp_t,
+        "overlapped_s": overlapped,
+        "overlap_fraction": round(overlapped / comm_t, 6) if comm_t else 0.0,
+    }
+
+
+def _default_is_comm(ev: Dict[str, Any]) -> bool:
+    return "comm" in str(ev.get("cat", "")) or \
+        bool(COMM_NAME_RE.search(str(ev.get("name", ""))))
+
+
+def _default_is_compute(ev: Dict[str, Any]) -> bool:
+    return str(ev.get("cat", "")) not in _NON_COMPUTE_CATS
+
+
+def overlap_from_events(events: Sequence[Dict[str, Any]],
+                        is_comm: Optional[Callable] = None,
+                        is_compute: Optional[Callable] = None) \
+        -> Dict[str, float]:
+    """Overlap over Chrome trace-event dicts (ph="X" complete events,
+    ``ts``/``dur`` in µs). Default classification: an event is comm when
+    its category contains "comm" or its name matches a collective; every
+    other complete event with positive duration is compute. Nested
+    compute spans are handled by the interval union."""
+    is_comm = is_comm or _default_is_comm
+    is_compute = is_compute or _default_is_compute
+    comm: List[Tuple[float, float]] = []
+    compute: List[Tuple[float, float]] = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        dur = float(ev.get("dur", 0.0))
+        if dur <= 0:
+            continue
+        ts = float(ev.get("ts", 0.0))
+        if is_comm(ev):
+            comm.append((ts, ts + dur))
+        elif is_compute(ev):
+            compute.append((ts, ts + dur))
+    out = interval_overlap(comm, compute)
+    return {k: (round(v / 1e6, 6) if k.endswith("_s") else v)
+            for k, v in out.items()}
+
+
+def overlap_from_tracer(tracer=None, last_ms: Optional[float] = None) \
+        -> Dict[str, float]:
+    """Overlap over the host span ring (comm spans vs everything else).
+    ``last_ms`` restricts to the most recent window. Iterates the span
+    records directly — no Chrome-event dicts are built, so this stays
+    cheap enough for a per-N-steps gauge cadence on a full ring. Ring
+    spans are classified by category alone (the comm layer always tags
+    its spans ``cat="comm"``); the name regex is for foreign traces."""
+    import time as _time
+    tracer = tracer or get_tracer()
+    cutoff = None if last_ms is None else \
+        _time.perf_counter_ns() / 1e3 - float(last_ms) * 1e3
+    comm: List[Tuple[float, float]] = []
+    compute: List[Tuple[float, float]] = []
+    stale = 0
+    for sp in reversed(tracer.spans()):
+        if sp.ph != "X" or sp.dur_us <= 0:
+            continue
+        end = sp.ts_us + sp.dur_us
+        if cutoff is not None and end < cutoff:
+            # the ring is (near-)ordered by end time: once a run of spans
+            # falls before the window, the rest does too — stop scanning
+            # instead of walking a full 65k-span ring every update
+            stale += 1
+            if stale > 32:
+                break
+            continue
+        stale = 0
+        if sp.cat == "comm":
+            comm.append((sp.ts_us, end))
+        elif sp.cat not in _NON_COMPUTE_CATS:
+            compute.append((sp.ts_us, end))
+    out = interval_overlap(comm, compute)
+    return {k: (round(v / 1e6, 6) if k.endswith("_s") else v)
+            for k, v in out.items()}
+
+
+def overlap_from_trace_file(path: str) -> Dict[str, float]:
+    """Overlap from a ``jax.profiler`` device trace (.trace.json or
+    .trace.json.gz): device-op events only ("XLA Ops" threads), comm
+    classified by op name — the measured half of ROADMAP item 2's
+    success metric."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    tid_names = {(e.get("pid"), e.get("tid")): e["args"]["name"]
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    xla_ops = [e for e in events if e.get("ph") == "X" and
+               tid_names.get((e.get("pid"), e.get("tid"))) == "XLA Ops"]
+    if xla_ops:
+        events = xla_ops
+    return overlap_from_events(
+        events,
+        is_comm=lambda ev: bool(COMM_NAME_RE.search(
+            str(ev.get("name", "")) + " " +
+            " ".join(str(v) for v in (ev.get("args") or {}).values()))),
+        is_compute=lambda ev: True)
+
+
+class OverlapAnalyzer:
+    """Engine-facing wrapper: recompute the ring overlap every
+    ``interval_steps`` steps, keep the ``overlap/fraction`` gauge warm,
+    and serve the statusz section. The compile ledger feeds the static
+    HLO side through ``note_hlo``."""
+
+    def __init__(self, tracer=None, owner: Any = None,
+                 interval_steps: int = 16,
+                 window_ms: float = 30_000.0):
+        self.tracer = tracer or get_tracer()
+        self._owner = owner
+        self.interval_steps = max(1, int(interval_steps))
+        self.window_ms = float(window_ms)
+        self.last: Optional[Dict[str, float]] = None
+        self.last_hlo: Optional[Dict[str, Any]] = None
+
+    def maybe_update(self, step: int) -> Optional[Dict[str, float]]:
+        if step % self.interval_steps != 0:
+            return None
+        res = overlap_from_tracer(self.tracer, last_ms=self.window_ms)
+        self.last = res
+        if res["comm_s"] > 0:
+            self.tracer.set_counter("overlap/fraction",
+                                    res["overlap_fraction"],
+                                    owner=self._owner)
+        return res
+
+    def note_hlo(self, summary: Dict[str, Any]):
+        """Record the active executable's static overlap summary (the
+        compile ledger calls in on each compile event)."""
+        self.last_hlo = summary
+        self.tracer.set_counter("overlap/hlo_async_fraction",
+                                summary.get("async_fraction", 0.0),
+                                owner=self._owner)
+
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.last is not None:
+            out["trace_overlap_fraction"] = self.last["overlap_fraction"]
+            out["trace_comm_s"] = self.last["comm_s"]
+            out["trace_overlapped_s"] = self.last["overlapped_s"]
+        if self.last_hlo is not None:
+            out["hlo_async_fraction"] = self.last_hlo["async_fraction"]
+            out["hlo_collectives"] = self.last_hlo["collectives"]
+            out["hlo_async"] = self.last_hlo["async"]
+        if not out:
+            out["status"] = "no overlap data yet"
+        return out

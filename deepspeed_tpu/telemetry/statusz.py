@@ -222,6 +222,15 @@ class StatuszServer:
                 f"{esc(str(last.get('detail', '')))} "
                 f"(<a href='/debug/bundles'>{fr.get('bundles', 0)} "
                 f"bundle(s)</a>)</p>")
+        # compile-plane recompile banner: the diff names WHICH argument
+        # changed shape — the single most actionable line on this page
+        # when a job silently recompiles (telemetry/compileplane.py)
+        cp = (doc.get("sections") or {}).get("compile_plane") or {}
+        if cp.get("last_recompile"):
+            parts.append(
+                f"<p class='bad'><b>recompile "
+                f"{cp.get('last_recompile_age_s', '?')}s ago</b>: "
+                f"{esc(str(cp['last_recompile']))}</p>")
         if "goodput" in doc:
             g = doc["goodput"]
             parts.append("<h2>goodput</h2>")

@@ -224,6 +224,17 @@ class Tracer:
         sp.ts_us = time.perf_counter_ns() / 1e3
         self._record(sp)
 
+    def counter_track(self, name: str, values: Dict[str, float],
+                      cat: str = "mem"):
+        """Record a Chrome counter sample (``ph='C'``): Perfetto renders
+        successive samples of the same ``name`` as a stacked counter
+        track — the HBM ledger's waterline timeline rides this."""
+        if not self.enabled:
+            return
+        sp = Span(self, name, cat=cat, args=dict(values), ph="C")
+        sp.ts_us = time.perf_counter_ns() / 1e3
+        self._record(sp)
+
     def _record(self, span: Span):
         with self._lock:
             self._ring[self._head] = span

@@ -1,0 +1,488 @@
+"""Compile/memory plane tests (telemetry/compileplane.py, overlap.py,
+hlo_cost.py).
+
+Contracts under test: a recompile event's diff names the EXACT argument
+whose signature changed, with both shapes; the HBM ledger's role gauges
+are real per-device byte accounting (params/optimizer state match an
+independent shard-walk, roles sum to the total gauge, and coverage
+against an allocator high-water is within tolerance); the overlap
+analyzer's fraction is exact on a synthetic trace with known overlap and
+stays in [0, 1] on a real compiled step's HLO; the whole plane is off by
+default and allocates nothing; the recompile diff round-trips through
+both the statusz JSON and a flight-recorder recompile bundle; the MFU
+gauge stays populated from the compile ledger's cost_analysis when the
+flops profiler is off; and ds_tpu_top renders the new sections while
+degrading cleanly on pre-compile-plane snapshots."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.telemetry import get_tracer, prometheus_dump
+from deepspeed_tpu.telemetry.compileplane import (CompileLedger, HBMLedger,
+                                                  diff_fingerprints,
+                                                  fingerprint_args)
+from deepspeed_tpu.telemetry.hlo_cost import (collect_async,
+                                              collect_collectives,
+                                              cost_summary,
+                                              hlo_overlap_summary)
+from deepspeed_tpu.telemetry.overlap import (interval_overlap,
+                                             overlap_from_events)
+
+TINY = GPT2Config(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+                  n_head=4, pad_vocab_to_multiple=8)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture
+def tracer():
+    tr = get_tracer()
+    prev_enabled, prev_sync = tr.enabled, tr.sync_spans
+    tr.clear()
+    tr.configure(enabled=True, buffer_size=4096, sync_spans=True)
+    yield tr
+    tr.clear()
+    tr.configure(enabled=prev_enabled, sync_spans=prev_sync)
+
+
+def _engine(over=None):
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "telemetry": {"enabled": True, "mfu": False},
+        "compile_plane": {"enabled": True, "hbm_interval_steps": 1},
+    }
+    cfg.update(over or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2Model(TINY),
+                                               config=cfg)
+    return engine
+
+
+def _batch(seqlen=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 255, size=(1, 8, seqlen),
+                                      dtype=np.int32)}
+
+
+# ------------------------------------------------------- fingerprints / diffs
+
+def test_fingerprint_diff_names_exact_changed_leaf():
+    import jax.numpy as jnp
+    a = {"input_ids": jnp.zeros((8, 512), jnp.int32)}
+    b = {"input_ids": jnp.zeros((8, 640), jnp.int32)}
+    x = jnp.zeros((4,), jnp.float32)
+    old = fingerprint_args((x, a), names=("params", "batch"))
+    new = fingerprint_args((x, b), names=("params", "batch"))
+    diff = diff_fingerprints(old, new)
+    assert len(diff) == 1
+    line = diff[0]
+    assert "arg 1 (batch)" in line and "input_ids" in line
+    assert "s32[8,512]" in line and "s32[8,640]" in line
+    # unchanged args never appear in the diff
+    assert "arg 0" not in line
+
+
+def test_fingerprint_diff_added_and_removed():
+    import jax.numpy as jnp
+    # a None arg turning into an array is a CHANGE of the same arg slot
+    old = fingerprint_args((jnp.zeros((2,)), None), names=("x", "y"))
+    new = fingerprint_args((jnp.zeros((2,)), jnp.zeros((3,), jnp.int32)),
+                           names=("x", "y"))
+    diff = diff_fingerprints(old, new)
+    assert diff == ["arg 1 (y): None -> s32[3]"]
+    # a new pytree KEY is added/removed
+    old = fingerprint_args(({"a": jnp.zeros((2,))},), names=("batch",))
+    new = fingerprint_args(
+        ({"a": jnp.zeros((2,)), "b": jnp.zeros((3,), jnp.int32)},),
+        names=("batch",))
+    diff = diff_fingerprints(old, new)
+    assert any("added" in d and "s32[3]" in d for d in diff)
+    rdiff = diff_fingerprints(new, old)
+    assert any("removed" in d for d in rdiff)
+
+
+def test_fingerprint_records_donation_and_dtype():
+    import jax.numpy as jnp
+    fp = fingerprint_args((jnp.zeros((2, 2), jnp.bfloat16),),
+                          names=("params",), donated=(0,))
+    assert fp[0][1] == "bf16[2,2] donated"
+
+
+# ------------------------------------------------------ engine compile ledger
+
+def test_engine_recompile_diff_names_changed_arg(tracer):
+    """The acceptance scenario: an injected shape change produces a
+    recompile event whose diff names the changed argument and both
+    shapes — and a re-seen old shape is a fresh signature change, not a
+    spurious double event."""
+    engine = _engine()
+    engine.train_batch(batch=_batch(seqlen=16))
+    engine.train_batch(batch=_batch(seqlen=16, seed=1))   # steady state
+    engine.train_batch(batch=_batch(seqlen=8))            # shape change
+    cp = engine._compile_plane
+    assert [e["kind"] for e in cp.events()] == ["compile", "recompile"]
+    ev = cp.events()[-1]
+    assert ev["diff"] == \
+        ["arg 3 (batch)['input_ids']: s32[1,8,16] -> s32[1,8,8]"]
+    assert ev["step"] == 2 and ev["wall_ms"] > 0
+    # analysis capture: XLA's own cost + per-device memory breakdown +
+    # the compiled HLO's collective/overlap summary
+    assert ev["cost"]["flops"] > 0
+    assert ev["memory"]["temp"] > 0 and ev["memory"]["argument"] > 0
+    assert ev["collectives"]           # ZeRO-0 dp grad mean reduces
+    assert 0.0 <= ev["overlap"]["async_fraction"] <= 1.0
+    assert ev["compile_ms"] > 0
+    # the fingerprint itself names every arg, donation flags included
+    assert any("donated" in line for line in ev["fingerprint"])
+    # counters mirror the ledger
+    assert tracer.counter_value("compileplane/compiles") == 1.0
+    assert tracer.counter_value("compileplane/recompiles") == 1.0
+    summary = cp.summary()
+    assert "s32[1,8,16] -> s32[1,8,8]" in summary["last_recompile"]
+    engine.close()
+    assert "compileplane/compiles" not in tracer.counters()
+
+
+def test_compile_ledger_steady_state_no_events(tracer):
+    engine = _engine()
+    for i in range(4):
+        engine.train_batch(batch=_batch(seed=i))
+    assert [e["kind"] for e in engine._compile_plane.events()] == ["compile"]
+    engine.close()
+
+
+def test_micro_api_fwd_compiles_are_recorded(tracer):
+    engine = _engine()
+    loss = engine.forward(_batch()["input_ids"][0])
+    engine.backward(loss)
+    engine.step()
+    labels = {e["label"] for e in engine._compile_plane.events()}
+    assert "fwd" in labels
+    engine.close()
+
+
+# ------------------------------------------------------------- MFU fallback
+
+def test_mfu_gauge_falls_back_to_compile_ledger(tracer):
+    """With the flops profiler off (telemetry.mfu false), step FLOPs come
+    from the compile ledger's cost_analysis so telemetry/mfu keeps
+    reporting instead of silently reading 0."""
+    engine = _engine(over={"telemetry": {"enabled": True, "mfu": False,
+                                         "peak_tflops_per_device": 1.0}})
+    engine.train_batch(batch=_batch())
+    engine.train_batch(batch=_batch(seed=1))
+    assert tracer.counter_value("telemetry/step_tflops", 0.0) > 0
+    assert tracer.counter_value("telemetry/mfu", 0.0) > 0
+    engine.close()
+
+
+def test_mfu_absent_without_compile_plane(tracer):
+    engine = _engine(over={"compile_plane": {"enabled": False},
+                           "telemetry": {"enabled": True, "mfu": False,
+                                         "peak_tflops_per_device": 1.0}})
+    engine.train_batch(batch=_batch())
+    engine.train_batch(batch=_batch(seed=1))
+    assert tracer.counter_value("telemetry/step_tflops") is None
+    engine.close()
+
+
+# ---------------------------------------------------------------- HBM ledger
+
+def test_hbm_roles_match_independent_accounting(tracer):
+    import jax
+    engine = _engine()
+    engine.train_batch(batch=_batch())
+    counters = tracer.counters()
+    hbm = engine._hbm
+
+    def manual_device_bytes(tree):
+        dev = jax.local_devices()[0]
+        total = 0
+        for leaf in jax.tree.leaves(tree):
+            for s in leaf.addressable_shards:
+                if s.device == dev:
+                    total += s.data.nbytes
+        return total
+
+    params_gib = counters["mem/params_gib"][0]
+    opt_gib = counters["mem/optimizer_state_gib"][0]
+    # gauges are rounded to 1e-6 GiB (~1 KiB)
+    assert params_gib == pytest.approx(
+        manual_device_bytes(engine.params) / 2**30, abs=1e-6)
+    assert opt_gib == pytest.approx(
+        manual_device_bytes(engine.opt_state) / 2**30, abs=1e-6)
+    # roles sum to the total gauge exactly (same accounting)
+    role_sum = sum(v[0] for k, v in counters.items()
+                   if k.startswith("mem/") and k.endswith("_gib")
+                   and k != "mem/total_gib")
+    assert counters["mem/total_gib"][0] == pytest.approx(role_sum, abs=5e-6)
+    # activations role carries the executable's per-device temp bytes
+    assert counters["mem/activations_gib"][0] > 0
+    # Prometheus: dedicated dstpu_mem_* series
+    dump = prometheus_dump(tracer)
+    assert "dstpu_mem_params_gib" in dump
+    assert "dstpu_mem_total_gib" in dump
+    # the waterline counter-track sample landed in the span ring
+    assert any(s.ph == "C" and s.name == "hbm_gib"
+               for s in tracer.spans())
+    engine.close()
+
+
+def test_hbm_roles_sum_within_tolerance_of_high_water(tracer):
+    """The acceptance check, with an injected allocator high-water (the
+    CPU backend reports no memory_stats): roles summing to within 10% of
+    the peak yields coverage in [0.9, 1.0]."""
+    hbm = HBMLedger(tracer=tracer)
+    roles = {"params": 800, "grads": 100, "optimizer_state": 50,
+             "activations": 40}
+    out = hbm.update(roles, peak_bytes=1000)
+    assert out["total_bytes"] == 990
+    assert out["coverage"] == pytest.approx(0.99)
+    assert abs(out["total_bytes"] - 1000) / 1000 <= 0.10
+    assert tracer.counter_value("mem/coverage") == pytest.approx(0.99)
+
+
+def test_serving_hbm_attributes_kv_slots(tracer):
+    from deepspeed_tpu.serving.engine import ServingEngine
+    eng = deepspeed_tpu.init_inference(GPT2Model(TINY),
+                                       config={"dtype": "float32"})
+    srv = ServingEngine(eng, {"num_slots": 2, "max_model_len": 32,
+                              "compile_plane": {"enabled": True,
+                                                "hbm_interval_steps": 1}})
+    from deepspeed_tpu.serving import SamplingParams
+    srv.submit(np.arange(1, 5), SamplingParams(max_new_tokens=8))
+    srv.run_until_idle()
+    counters = tracer.counters()
+    assert counters["mem/kv_slots_gib"][0] > 0
+    assert counters["mem/params_gib"][0] > 0
+    # serving compile events: prefill bucket + fused decode + pool init
+    labels = {e["label"] for e in srv._compile_plane.events()}
+    assert {"slot_pool", "slot_prefill", "slot_decode"} <= labels
+    # a second, longer prompt compiles a new prefill bucket whose diff
+    # names the ids argument
+    srv2_events = len(srv._compile_plane.events())
+    srv.shutdown()
+    assert "mem/kv_slots_gib" not in tracer.counters()
+    assert eng.compile_plane is None
+    assert srv2_events >= 3
+
+
+# ------------------------------------------------------------------- overlap
+
+def test_interval_overlap_exact_on_synthetic_trace():
+    """Known-overlap synthetic trace: comm [0,10]+[20,30]ms, compute
+    [5,25]ms -> 10 of 20 comm ms overlapped = 0.5 exactly."""
+    res = interval_overlap([(0.0, 10.0), (20.0, 30.0)], [(5.0, 25.0)])
+    assert res["comm_s"] == pytest.approx(20.0)
+    assert res["overlapped_s"] == pytest.approx(10.0)
+    assert res["overlap_fraction"] == pytest.approx(0.5)
+
+
+def test_overlap_from_chrome_events_pins_value():
+    events = [
+        {"ph": "X", "cat": "comm", "name": "all-reduce", "ts": 0.0,
+         "dur": 10_000.0},
+        {"ph": "X", "cat": "comm", "name": "all-gather", "ts": 20_000.0,
+         "dur": 10_000.0},
+        {"ph": "X", "cat": "train", "name": "fwd", "ts": 5_000.0,
+         "dur": 20_000.0},
+        {"ph": "M", "name": "process_name"},          # metadata: ignored
+        {"ph": "i", "cat": "warning", "name": "recompile", "ts": 1.0},
+    ]
+    res = overlap_from_events(events)
+    assert res["overlap_fraction"] == pytest.approx(0.5)
+    assert res["comm_s"] == pytest.approx(0.02)
+    assert res["overlapped_s"] == pytest.approx(0.01)
+
+
+def test_overlap_edge_cases():
+    assert interval_overlap([], [(0, 1)])["overlap_fraction"] == 0.0
+    # fully hidden comm
+    assert interval_overlap([(2, 3)], [(0, 10)])["overlap_fraction"] == 1.0
+    # overlapping compute intervals are unioned, not double-counted
+    res = interval_overlap([(0, 10)], [(0, 6), (4, 10)])
+    assert res["overlap_fraction"] == pytest.approx(1.0)
+    assert res["compute_s"] == pytest.approx(10.0)
+
+
+def test_hlo_overlap_summary_bounds_and_counts():
+    hlo = """
+  %ar = f32[64]{0} all-reduce(f32[64]{0} %x), replica_groups={}
+  %ags = (f32[128]{0}, f32[128]{0}) all-gather-start(f32[128]{0} %y)
+  %agd = f32[128]{0} all-gather-done((f32[128]{0}, f32[128]{0}) %ags)
+"""
+    s = hlo_overlap_summary(hlo)
+    assert s["sync"] == 1 and s["async"] == 1 and s["collectives"] == 2
+    assert s["async_fraction"] == pytest.approx(0.5)
+    assert 0.0 <= s["async_fraction"] <= 1.0
+    assert collect_async(hlo) == {"all-gather": 1}
+
+
+def test_overlap_in_bounds_on_real_zero3_step_hlo(tracer):
+    """The acceptance criterion: the overlap analyzer reports a fraction
+    in [0, 1] on a real compiled ZeRO-3 train step's HLO (captured by the
+    compile ledger's analysis pass)."""
+    engine = _engine(over={"zero_optimization": {
+        "stage": 3, "stage3_param_persistence_threshold": 0}})
+    engine.train_batch(batch=_batch())
+    ev = engine._compile_plane.last_event("train_batch")
+    ov = ev["overlap"]
+    assert 0.0 <= ov["async_fraction"] <= 1.0
+    assert ov["collectives"] > 0       # ZeRO-3 gathers + grad reduce
+    assert tracer.counter_value("overlap/hlo_async_fraction") is not None
+    engine.close()
+
+
+# ------------------------------------------------------------ hlo cost core
+
+def test_collect_collectives_counts_and_bytes():
+    hlo = """
+  %ar = f32[1024]{0} all-reduce(f32[1024]{0} %x)
+  %t = (bf16[8,16]{1,0}, bf16[8,16]{1,0}) all-reduce(%a, %b)
+  %ag = f32[256]{0} all-gather(f32[32]{0} %y)
+"""
+    out = collect_collectives(hlo)
+    assert out["all-reduce"]["count"] == 2
+    assert out["all-reduce"]["bytes"] == 1024 * 4 + 2 * 8 * 16 * 2
+    assert out["all-gather"] == {"count": 1, "bytes": 256 * 4}
+
+
+def test_hlo_audit_uses_shared_core():
+    """Satellite: benchmarks/hlo_audit.py delegates its parser to
+    telemetry/hlo_cost.py — behavior-identical under the old name."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_hlo_audit_cp", os.path.join(REPO, "benchmarks", "hlo_audit.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod._collect is mod.hlo_cost.collect_collectives
+    hlo = "%ar = f32[100]{0} all-reduce(f32[100]{0} %x)"
+    assert mod._collect(hlo) == {"all-reduce": {"count": 1, "bytes": 400}}
+
+
+def test_cost_summary_normalizes():
+    raw = [{"flops": 12.0, "bytes accessed": 34.0,
+            "bytes accessed0{}": 9.0, "utilization1{}": 1.0,
+            "not-a-number": "x"}]
+    out = cost_summary(raw)
+    assert out == {"flops": 12.0, "bytes_accessed": 34.0}
+    assert cost_summary(None) == {}
+    assert cost_summary([]) == {}
+
+
+# ------------------------------------------------- disabled allocates nothing
+
+def test_disabled_allocates_nothing(tracer):
+    engine = _engine(over={"compile_plane": {"enabled": False}})
+    engine.train_batch(batch=_batch())
+    assert engine._compile_plane is None
+    assert engine._hbm is None
+    assert engine._overlap is None
+    assert not any(k.startswith(("compileplane/", "mem/", "overlap/"))
+                   for k in tracer.counters())
+    engine.close()
+    # serving: no block means nothing attached to the inference engine
+    from deepspeed_tpu.serving.engine import ServingEngine
+    eng = deepspeed_tpu.init_inference(GPT2Model(TINY),
+                                       config={"dtype": "float32"})
+    srv = ServingEngine(eng, {"num_slots": 2, "max_model_len": 32})
+    assert srv._compile_plane is None and srv._hbm is None
+    assert eng.compile_plane is None
+    srv.shutdown()
+
+
+# ---------------------------------------------- statusz / bundle round-trips
+
+def test_statusz_and_bundle_roundtrip_carry_recompile_diff(tracer, tmp_path):
+    engine = _engine(over={
+        "statusz": {"enabled": True, "port": 0},
+        "flight_recorder": {"enabled": True, "dir": str(tmp_path / "fb"),
+                            "debounce_s": 0.0},
+    })
+    try:
+        engine.train_batch(batch=_batch(seqlen=16))
+        engine.train_batch(batch=_batch(seqlen=16, seed=1))
+        engine.train_batch(batch=_batch(seqlen=8))        # recompile
+        with urllib.request.urlopen(
+                f"{engine.statusz.url}/statusz?format=json",
+                timeout=5.0) as r:
+            doc = json.load(r)
+        cp = doc["sections"]["compile_plane"]
+        assert cp["recompiles"] == 1
+        assert "s32[1,8,16] -> s32[1,8,8]" in cp["last_recompile"]
+        assert doc["sections"]["memory"]["params_gib"] > 0
+        assert "overlap" in doc["sections"]
+        # the HTML page shows the recompile banner
+        with urllib.request.urlopen(engine.statusz.url + "/statusz",
+                                    timeout=5.0) as r:
+            html = r.read().decode()
+        assert "recompile" in html and "s32[1,8,16]" in html
+        # the recompile trigger wrote a bundle embedding the ledger, and
+        # the trigger detail itself names the changed argument
+        bundles = engine._recorder.bundles()
+        assert any(b["kind"] == "recompile" for b in bundles)
+        bid = [b["id"] for b in bundles if b["kind"] == "recompile"][0]
+        doc = json.loads(engine._recorder.read_bundle(bid))
+        assert "s32[1,8,16] -> s32[1,8,8]" in doc["detail"]
+        evs = doc["compile_plane"]["events"]
+        assert evs[-1]["kind"] == "recompile"
+        assert evs[-1]["diff"] == \
+            ["arg 3 (batch)['input_ids']: s32[1,8,16] -> s32[1,8,8]"]
+        assert doc["compile_plane"]["summary"]["recompiles"] == 1
+    finally:
+        engine.close()
+
+
+# ----------------------------------------------------------------- ds_tpu_top
+
+def _run_top(snapshot_path):
+    top = os.path.join(REPO, "bin", "ds_tpu_top")
+    return subprocess.run(
+        [sys.executable, top, "--once", "--snapshot", str(snapshot_path)],
+        capture_output=True, text=True, timeout=30)
+
+
+def test_ds_tpu_top_renders_compile_plane_fields(tmp_path):
+    snap = {"counters": {"compileplane/compiles": 3.0,
+                         "compileplane/recompiles": 1.0,
+                         "overlap/fraction": 0.42,
+                         "mem/params_gib": 1.5, "mem/grads_gib": 0.5,
+                         "mem/total_gib": 2.0, "mem/coverage": 0.95},
+            "goodput": None}
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(snap))
+    out = _run_top(path)
+    assert out.returncode == 0, out.stderr
+    assert "compile plane" in out.stdout
+    assert "recompiles" in out.stdout
+    assert "overlap frac" in out.stdout
+    assert "HBM roles" in out.stdout and "params" in out.stdout
+    assert "coverage" in out.stdout
+
+
+def test_ds_tpu_top_degrades_on_pre_pr7_snapshot(tmp_path):
+    """Old-snapshot compat: a pre-compile-plane snapshot (counters +
+    goodput only) renders with none of the new sections and no crash."""
+    snap = {"counters": {"telemetry/step_time_ms": 12.0},
+            "goodput": {"goodput_fraction": 0.9, "wall_s": 10.0,
+                        "buckets": {"productive_step": 9.0}}}
+    path = tmp_path / "old.json"
+    path.write_text(json.dumps(snap))
+    out = _run_top(path)
+    assert out.returncode == 0, out.stderr
+    assert "compile plane" not in out.stdout
+    assert "HBM roles" not in out.stdout
+    assert "goodput" in out.stdout
