@@ -54,6 +54,19 @@ def _sample_tokens(logits, temps, key, vocab):
     return jnp.where(temps <= 0.0, greedy, sampled)
 
 
+def _lane_slice(leaf, slot_idx):
+    """One slot's lane of a pool leaf (slot axis is 1): ``[d0, 1, ...]``."""
+    start = (0, slot_idx) + (0,) * (leaf.ndim - 2)
+    sizes = (leaf.shape[0], 1) + leaf.shape[2:]
+    return lax.dynamic_slice(leaf, start, sizes)
+
+
+def _lane_update(leaf, lane, slot_idx):
+    """Write a lane back into a pool leaf at slot ``slot_idx``."""
+    start = (0, slot_idx) + (0,) * (leaf.ndim - 2)
+    return lax.dynamic_update_slice(leaf, lane.astype(leaf.dtype), start)
+
+
 class InferenceEngine:
     """Callable engine: ``engine(input_ids)`` -> logits;
     ``engine.generate(...)`` -> token ids."""
@@ -540,11 +553,14 @@ class InferenceEngine:
     # from the _fns LRU: evicting the decode step would silently recompile
     # the serving hot path.
 
-    def _pool_shardings(self, num_slots: int, max_len: int):
+    def _pool_shardings(self, num_slots: int, max_len: int,
+                        quantize: bool = False):
         """Cache-rule shardings for the slot pool, with any mesh axis that
         does not divide its dimension dropped to replication (num_slots is
         operator-chosen and rarely divides the dp axes; heads-over-'model'
-        TP is the sharding that matters for serving)."""
+        TP is the sharding that matters for serving). With ``quantize``,
+        returns a QuantizedSlotPool of shardings: q leaves keep the fp
+        spec, per-column scale leaves keep it minus the trailing hd axis."""
         shapes = jax.eval_shape(
             lambda: self.module.init_kv_cache(num_slots, max_len,
                                               dtype=self.dtype))
@@ -564,18 +580,87 @@ class InferenceEngine:
                          for ax, dim in zip(spec, leaf.shape))
             return NamedSharding(self.mesh, P(*kept))
 
-        return jax.tree.map(fix, shardings, shapes)
+        fixed = jax.tree.map(fix, shardings, shapes)
+        if not quantize:
+            return fixed
+        from .kv_quant import QuantizedSlotPool
 
-    def init_slot_pool(self, num_slots: int, max_len: int):
+        def drop_hd(sh, leaf):
+            spec = tuple(sh.spec) + (None,) * (len(leaf.shape) - len(sh.spec))
+            return NamedSharding(self.mesh, P(*spec[:-1]))
+
+        return QuantizedSlotPool(
+            q=fixed, scales=jax.tree.map(drop_hd, fixed, shapes))
+
+    @staticmethod
+    def _is_quantized_pool(pool) -> bool:
+        from .kv_quant import QuantizedSlotPool
+        return isinstance(pool, QuantizedSlotPool)
+
+    @staticmethod
+    def _pool_dims(pool):
+        """(num_slots, max_len, quantized) from any pool flavor."""
+        quantized = InferenceEngine._is_quantized_pool(pool)
+        leaf = jax.tree.leaves(pool.q if quantized else pool)[0]
+        return int(leaf.shape[1]), int(leaf.shape[-2]), quantized
+
+    def _read_lane(self, pool, slot_idx, quantized):
+        """One slot's lane as an fp mini-cache [L, 1, H, max_len, hd]
+        (jit-safe; dequantizes just the lane for quantized pools)."""
+        if not quantized:
+            return jax.tree.map(lambda leaf: _lane_slice(leaf, slot_idx),
+                                pool)
+        from .kv_quant import dequantize_kv
+        return jax.tree.map(
+            lambda qc, sc: dequantize_kv(_lane_slice(qc, slot_idx),
+                                         _lane_slice(sc, slot_idx),
+                                         self.dtype),
+            pool.q, pool.scales)
+
+    @staticmethod
+    def _write_lane(pool, mini, slot_idx, quantized):
+        """Write an fp mini-cache back into slot ``slot_idx`` (jit-safe;
+        re-quantizes only this lane for quantized pools — per-column
+        scales keep the round-trip of untouched columns exact)."""
+        if not quantized:
+            return jax.tree.map(
+                lambda pc, mc: _lane_update(pc, mc, slot_idx), pool, mini)
+        from .kv_quant import QuantizedSlotPool, quantize_kv
+        pairs = jax.tree.map(quantize_kv, mini)
+        istup = lambda t: isinstance(t, tuple)   # noqa: E731
+        mini_q = jax.tree.map(lambda p: p[0], pairs, is_leaf=istup)
+        mini_s = jax.tree.map(lambda p: p[1], pairs, is_leaf=istup)
+        return QuantizedSlotPool(
+            q=jax.tree.map(lambda pc, mc: _lane_update(pc, mc, slot_idx),
+                           pool.q, mini_q),
+            scales=jax.tree.map(
+                lambda pc, mc: _lane_update(pc, mc, slot_idx),
+                pool.scales, mini_s))
+
+    def init_slot_pool(self, num_slots: int, max_len: int,
+                       quantize: bool = False):
         """Allocate the slot-pool KV cache [L, num_slots, H, max_len, hd],
-        once, at static shape."""
-        key = ("slot_pool", num_slots, max_len)
+        once, at static shape. ``quantize=True`` allocates it int8 with
+        per-column f32 scales (inference/kv_quant.py) — ~4x the slots per
+        HBM byte; the slot programs transparently branch on the pool
+        type."""
+        key = ("slot_pool", num_slots, max_len) + \
+            (("q8",) if quantize else ())
         fn = self._slot_fns.get(key)
         if fn is None:
+            if quantize:
+                from .kv_quant import quantize_pool
+
+                def build():
+                    return quantize_pool(self.module.init_kv_cache(
+                        num_slots, max_len, dtype=self.dtype))
+            else:
+                def build():
+                    return self.module.init_kv_cache(num_slots, max_len,
+                                                     dtype=self.dtype)
             fn = self._slot_fns[key] = jax.jit(
-                lambda: self.module.init_kv_cache(num_slots, max_len,
-                                                  dtype=self.dtype),
-                out_shardings=self._pool_shardings(num_slots, max_len))
+                build, out_shardings=self._pool_shardings(
+                    num_slots, max_len, quantize=quantize))
         self._observe_compile("slot_pool", fn, ())
         with self.mesh:
             return fn()
@@ -591,26 +676,24 @@ class InferenceEngine:
         vocab = model.config.vocab_size
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         t = prompt.shape[0]
-        max_len = int(jax.tree.leaves(pool)[0].shape[-2])
+        num_slots, max_len, quantized = self._pool_dims(pool)
         if not 0 < t <= max_len:
             raise ValueError(f"prompt length {t} not in [1, {max_len}]")
         bucket = min(_next_pow2(t), max_len)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :t] = prompt
-        num_slots = int(jax.tree.leaves(pool)[0].shape[1])
-        fkey = ("slot_prefill", bucket, max_len)
+        fkey = ("slot_prefill", bucket, max_len) + \
+            (("q8",) if quantized else ())
         fn = self._slot_fns.get(fkey)
         if fn is None:
-            pool_shardings = self._pool_shardings(num_slots, max_len)
+            pool_shardings = self._pool_shardings(num_slots, max_len,
+                                                  quantize=quantized)
 
             def pf(params, ids, pool, slot_idx, last_idx, temp, key):
                 mini = model.init_kv_cache(1, max_len, dtype=self.dtype)
                 logits, mini = model.apply_with_cache(params, ids, mini,
                                                       jnp.int32(0))
-                pool = jax.tree.map(
-                    lambda pc, mc: lax.dynamic_update_slice(
-                        pc, mc.astype(pc.dtype), (0, slot_idx, 0, 0, 0)),
-                    pool, mini)
+                pool = self._write_lane(pool, mini, slot_idx, quantized)
                 last = jnp.take(logits[0], last_idx, axis=0)
                 tok = _sample_tokens(last[None], temp[None], key, vocab)[0]
                 return pool, tok
@@ -629,6 +712,159 @@ class InferenceEngine:
             pool, tok = fn(*pf_args)
         return pool, int(tok)
 
+    def slot_suffix_prefill(self, pool, slot: int, tokens, start_pos: int,
+                            temperature: float = 0.0, key=None):
+        """Prefill only the SUFFIX ``tokens`` of a prompt into slot
+        ``slot`` whose lane already holds valid K/V for cache columns
+        ``[0, start_pos)`` — the prefix-reuse fast path
+        (serving/fleet/prefix_cache.py): after ``slot_copy_lane`` from a
+        cached donor, only the tokens past the shared prefix run through
+        the stack. The suffix is right-padded to a pow2 bucket (one
+        compile per bucket, shared with every start_pos — the offset is a
+        traced scalar); callers size the bucket via
+        ``prefix_cache.reuse_plan`` so ``start_pos + bucket <= max_len``.
+        Returns (new_pool, next_token:int)."""
+        model = self.module
+        vocab = model.config.vocab_size
+        tokens = np.asarray(tokens, dtype=np.int32).reshape(-1)
+        t = tokens.shape[0]
+        num_slots, max_len, quantized = self._pool_dims(pool)
+        if t < 1:
+            raise ValueError("suffix must carry at least one token (the "
+                             "sampled next token needs a query position)")
+        bucket = min(_next_pow2(t), max_len)
+        if start_pos < 0 or start_pos + bucket > max_len:
+            raise ValueError(
+                f"suffix bucket [{start_pos}, {start_pos + bucket}) exceeds "
+                f"max_len={max_len}; plan the reuse offset with "
+                f"prefix_cache.reuse_plan")
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :t] = tokens
+        fkey = ("slot_suffix", bucket, max_len) + \
+            (("q8",) if quantized else ())
+        fn = self._slot_fns.get(fkey)
+        if fn is None:
+            pool_shardings = self._pool_shardings(num_slots, max_len,
+                                                  quantize=quantized)
+
+            def spf(params, ids, pool, slot_idx, start_pos, last_idx, temp,
+                    key):
+                mini = self._read_lane(pool, slot_idx, quantized)
+                logits, mini = model.apply_with_cache(params, ids, mini,
+                                                      start_pos)
+                pool = self._write_lane(pool, mini, slot_idx, quantized)
+                last = jnp.take(logits[0], last_idx, axis=0)
+                tok = _sample_tokens(last[None], temp[None], key, vocab)[0]
+                return pool, tok
+
+            fn = self._slot_fns[fkey] = jax.jit(spf, in_shardings=(
+                self.param_shardings, None, pool_shardings, None, None, None,
+                None, None), out_shardings=(pool_shardings, None))
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        spf_args = (self.params, jnp.asarray(ids), pool, jnp.int32(slot),
+                    jnp.int32(start_pos), jnp.int32(t - 1),
+                    jnp.float32(temperature), key)
+        self._observe_compile("slot_suffix_prefill", fn, spf_args,
+                              names=("params", "ids", "pool", "slot",
+                                     "start_pos", "last_idx", "temperature",
+                                     "rng"))
+        with self.mesh:
+            pool, tok = fn(*spf_args)
+        return pool, int(tok)
+
+    def slot_copy_lane(self, pool, src: int, dst: int):
+        """Copy slot ``src``'s whole cache lane over slot ``dst``'s —
+        device-side, no host round-trip, quantized lanes copy their q and
+        scale slices verbatim (no requantization). The prefix-reuse
+        admission path: copy the donor lane, then suffix-prefill from the
+        shared-prefix boundary; stale donor columns past the new request's
+        length are masked until decode overwrites them, exactly like a
+        fresh prefill's pad columns."""
+        num_slots, max_len, quantized = self._pool_dims(pool)
+        fkey = ("slot_copy", num_slots, max_len) + \
+            (("q8",) if quantized else ())
+        fn = self._slot_fns.get(fkey)
+        if fn is None:
+            pool_shardings = self._pool_shardings(num_slots, max_len,
+                                                  quantize=quantized)
+
+            def cp(pool, src_idx, dst_idx):
+                return jax.tree.map(
+                    lambda leaf: _lane_update(
+                        leaf, _lane_slice(leaf, src_idx), dst_idx), pool)
+
+            fn = self._slot_fns[fkey] = jax.jit(
+                cp, out_shardings=pool_shardings)
+        cp_args = (pool, jnp.int32(src), jnp.int32(dst))
+        self._observe_compile("slot_copy", fn, cp_args,
+                              names=("pool", "src", "dst"))
+        with self.mesh:
+            return fn(*cp_args)
+
+    def slot_extract_lane(self, pool, slot: int):
+        """Slot ``slot``'s cache lane as a HOST pytree (np arrays) — the
+        payload of a KVHandoff (serving/fleet/handoff.py). Quantized pools
+        hand off their int8 q + f32 scale slices directly: the wire cost
+        of a disaggregated prefill→decode transfer is the quantized lane,
+        not a dequantized copy."""
+        num_slots, max_len, quantized = self._pool_dims(pool)
+        fkey = ("slot_extract", num_slots, max_len) + \
+            (("q8",) if quantized else ())
+        fn = self._slot_fns.get(fkey)
+        if fn is None:
+            def ex(pool, idx):
+                return jax.tree.map(lambda leaf: _lane_slice(leaf, idx),
+                                    pool)
+
+            fn = self._slot_fns[fkey] = jax.jit(ex)
+        ex_args = (pool, jnp.int32(slot))
+        self._observe_compile("slot_extract", fn, ex_args,
+                              names=("pool", "slot"))
+        with self.mesh:
+            lane = fn(*ex_args)
+        return jax.device_get(lane)
+
+    def slot_insert_lane(self, pool, slot: int, lane):
+        """Insert a lane (from ``slot_extract_lane``, possibly another
+        replica's pool) into slot ``slot``. Handles every quantization
+        pairing: fp lanes quantize on the way into a quantized pool,
+        quantized lanes dequantize into an fp pool — so a prefill replica
+        and a decode replica need not share a KV storage format."""
+        num_slots, max_len, pool_q = self._pool_dims(pool)
+        lane_q = self._is_quantized_pool(lane)
+        fkey = ("slot_insert", num_slots, max_len, pool_q, lane_q)
+        fn = self._slot_fns.get(fkey)
+        if fn is None:
+            pool_shardings = self._pool_shardings(num_slots, max_len,
+                                                  quantize=pool_q)
+            from .kv_quant import (QuantizedSlotPool, dequantize_pool,
+                                   quantize_pool)
+
+            def ins(pool, lane, idx):
+                if pool_q and not lane_q:
+                    lane = quantize_pool(lane)
+                elif not pool_q and lane_q:
+                    lane = dequantize_pool(lane, self.dtype)
+                if pool_q:
+                    return QuantizedSlotPool(
+                        q=jax.tree.map(
+                            lambda pc, mc: _lane_update(pc, mc, idx),
+                            pool.q, lane.q),
+                        scales=jax.tree.map(
+                            lambda pc, mc: _lane_update(pc, mc, idx),
+                            pool.scales, lane.scales))
+                return jax.tree.map(
+                    lambda pc, mc: _lane_update(pc, mc, idx), pool, lane)
+
+            fn = self._slot_fns[fkey] = jax.jit(
+                ins, out_shardings=pool_shardings)
+        ins_args = (pool, lane, jnp.int32(slot))
+        self._observe_compile("slot_insert", fn, ins_args,
+                              names=("pool", "lane", "slot"))
+        with self.mesh:
+            return fn(*ins_args)
+
     def slot_decode_step(self, pool, toks, positions, temps, key=None):
         """One fused decode step over ALL slots: feed token ``toks[s]`` at
         cache column ``positions[s]`` and sample the next token per slot
@@ -637,17 +873,27 @@ class InferenceEngine:
         (new_pool, next_tokens [S])."""
         model = self.module
         vocab = model.config.vocab_size
-        num_slots = int(jax.tree.leaves(pool)[0].shape[1])
-        max_len = int(jax.tree.leaves(pool)[0].shape[-2])
-        fkey = ("slot_decode", num_slots, max_len)
+        num_slots, max_len, quantized = self._pool_dims(pool)
+        fkey = ("slot_decode", num_slots, max_len) + \
+            (("q8",) if quantized else ())
         fn = self._slot_fns.get(fkey)
         if fn is None:
-            pool_shardings = self._pool_shardings(num_slots, max_len)
+            pool_shardings = self._pool_shardings(num_slots, max_len,
+                                                  quantize=quantized)
 
             def dec(params, pool, toks, positions, temps, key):
-                logits, pool = model.decode_with_slots(
-                    params, toks[:, None], pool, positions)
+                if quantized:
+                    from .kv_quant import dequantize_pool, quantize_pool
+                    fp = dequantize_pool(pool, self.dtype)
+                else:
+                    fp = pool
+                logits, fp = model.decode_with_slots(
+                    params, toks[:, None], fp, positions)
                 nxt = _sample_tokens(logits[:, -1], temps, key, vocab)
+                # re-quantize on the way out: per-column scales make the
+                # round-trip of every column this step did not write exact,
+                # so old tokens never re-accumulate quantization error
+                pool = quantize_pool(fp) if quantized else fp
                 return pool, nxt
 
             fn = self._slot_fns[fkey] = jax.jit(dec, in_shardings=(
@@ -665,11 +911,22 @@ class InferenceEngine:
             pool, nxt = fn(*dec_args)
         return pool, np.asarray(nxt)
 
-    def slot_decode_executables(self, num_slots: int, max_len: int) -> int:
+    def slot_decode_executables(self, num_slots: int, max_len: int,
+                                quantized: Optional[bool] = None) -> int:
         """Number of compiled executables behind the fused decode step —
-        the serving tests assert this stays at 1 (compile-once decode)."""
-        fn = self._slot_fns.get(("slot_decode", num_slots, max_len))
-        return 0 if fn is None else fn._cache_size()
+        the serving tests assert this stays at 1 per pool flavor
+        (compile-once decode; fp and quantized pools are separate
+        programs). ``quantized`` selects one flavor; None sums both."""
+        keys = {None: (("slot_decode", num_slots, max_len),
+                       ("slot_decode", num_slots, max_len, "q8")),
+                False: (("slot_decode", num_slots, max_len),),
+                True: (("slot_decode", num_slots, max_len, "q8"),)}
+        total = 0
+        for fkey in keys[quantized]:
+            fn = self._slot_fns.get(fkey)
+            if fn is not None:
+                total += fn._cache_size()
+        return total
 
     # ------------------------------------------------------------- properties
     @property

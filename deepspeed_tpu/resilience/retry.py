@@ -10,11 +10,25 @@ hook so retry storms are visible in the metrics snapshot, not silent.
 
 import random
 import time
-from typing import Callable, Optional, Tuple, Type
+from typing import Callable, Iterator, Optional, Tuple, Type
 
 from ..utils.logging import logger
 
-__all__ = ["retry_io"]
+__all__ = ["retry_io", "backoff_delays"]
+
+
+def backoff_delays(base_delay: float = 0.5, max_delay: float = 8.0,
+                   rng: Optional[random.Random] = None) -> Iterator[float]:
+    """Endless jittered exponential-backoff schedule: doubling from
+    ``base_delay``, capped at ``max_delay``, uniform jitter in
+    [0.5x, 1.5x]. ``retry_io`` consumes it between attempts; the fleet
+    router (serving/fleet/replica.py) consumes it to pace health
+    re-probes of a NOT-ready replica instead of hot-looping."""
+    rng = rng or random.Random()
+    delay = base_delay
+    while True:
+        yield max(0.0, delay * (0.5 + rng.random()))
+        delay = min(max_delay, delay * 2)
 
 
 def retry_io(fn: Callable, *args,
@@ -31,15 +45,14 @@ def retry_io(fn: Callable, *args,
     ``base_delay``, capped at ``max_delay``) and uniform jitter in
     [0.5x, 1.5x]. ``on_retry(retry_index, exc)`` fires before each sleep.
     The final failure re-raises."""
-    rng = rng or random.Random()
-    delay = base_delay
+    delays = backoff_delays(base_delay, max_delay, rng)
     for attempt in range(attempts + 1):
         try:
             return fn(*args, **kwargs)
         except retry_on as e:
             if attempt >= attempts:
                 raise
-            sleep_s = max(0.0, delay * (0.5 + rng.random()))
+            sleep_s = next(delays)
             logger.warning(
                 f"{label}: attempt {attempt + 1}/{attempts + 1} failed "
                 f"({e}); retrying in {sleep_s:.2f}s")
@@ -47,4 +60,3 @@ def retry_io(fn: Callable, *args,
                 on_retry(attempt + 1, e)
             if sleep_s:
                 time.sleep(sleep_s)
-            delay = min(max_delay, delay * 2)

@@ -3,18 +3,27 @@
 The layer above InferenceEngine that the static-batch reference
 (DeepSpeed v0.9.1) does not have: slot-based KV cache (kv_slots),
 iteration-level scheduler (scheduler), the ServingEngine facade (engine),
-serving config (config), and TTFT/latency/utilization metrics (metrics).
+serving config (config), TTFT/latency/utilization metrics (metrics), and
+the fleet layer (fleet/): SLO-aware router, prefill/decode
+disaggregation over KV handoffs, and radix prefix reuse of the slot
+pool.
 """
 
-from .config import ServingConfig
+from .config import (KVQuantConfig, PrefixCacheConfig, ServingConfig,
+                     SLOConfig)
 from .engine import ServingEngine
+from .fleet import (FleetConfig, FleetRequest, FleetRouter, KVHandoff,
+                    RadixPrefixCache, ReplicaHandle, build_fleet)
 from .kv_slots import SlotPool
-from .metrics import ServingMetrics
+from .metrics import FleetMetrics, ServingMetrics
 from .scheduler import (ContinuousBatchingScheduler, QueueFull, Request,
                         RequestState, SamplingParams)
 
 __all__ = [
-    "ServingConfig", "ServingEngine", "SlotPool", "ServingMetrics",
+    "ServingConfig", "SLOConfig", "PrefixCacheConfig", "KVQuantConfig",
+    "ServingEngine", "SlotPool", "ServingMetrics", "FleetMetrics",
     "ContinuousBatchingScheduler", "QueueFull", "Request", "RequestState",
     "SamplingParams",
+    "FleetConfig", "FleetRouter", "FleetRequest", "KVHandoff",
+    "RadixPrefixCache", "ReplicaHandle", "build_fleet",
 ]

@@ -48,6 +48,39 @@ class SLOConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class PrefixCacheConfig(DeepSpeedConfigModel):
+    """The ``"prefix_cache"`` block (serving/fleet/prefix_cache.py):
+    radix-tree reuse of retired slots' KV lanes. A request whose prompt
+    shares >= ``min_prefix_len`` tokens with a cached sequence admits via
+    lane-copy + suffix prefill instead of a full prefill."""
+    enabled: bool = False
+    #: shortest shared prefix worth a lane copy (shorter prompts also
+    #: never donate their slot)
+    min_prefix_len: int = 8
+    #: cap on slots parked in the cache (0 = bounded only by the pool;
+    #: eviction is on-demand LRU either way)
+    max_cached_slots: int = 0
+
+    def validate(self):
+        if self.min_prefix_len < 1:
+            raise ConfigError("prefix_cache.min_prefix_len must be >= 1")
+        if self.max_cached_slots < 0:
+            raise ConfigError("prefix_cache.max_cached_slots must be >= 0")
+
+
+@dataclasses.dataclass
+class KVQuantConfig(DeepSpeedConfigModel):
+    """The ``"kv_quant"`` block: store the slot pool int8 with per-column
+    f32 scales (inference/kv_quant.py) — ~4x the concurrent slots per HBM
+    byte, greedy-decode parity bounded by the per-column quantization
+    error (tests/unit/test_fleet.py pins the bound)."""
+    enabled: bool = False
+
+    def validate(self):
+        pass
+
+
+@dataclasses.dataclass
 class ServingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving knobs (deepspeed_tpu/serving/)."""
 
@@ -104,6 +137,23 @@ class ServingConfig(DeepSpeedConfigModel):
     # are cancelled) — the serving half of preemption handling
     resilience: Any = None
 
+    # replica role in a disaggregated fleet: "unified" serves end-to-end;
+    # "prefill" runs prompt passes and hands KV off (handoff_sink);
+    # "decode" admits KVHandoffs into its pool and runs the token loop
+    role: str = "unified"
+
+    # prefix_cache (dict -> PrefixCacheConfig): radix reuse of retired
+    # slots — shared system-prompt prefixes skip recomputation
+    prefix_cache: Any = None
+
+    # kv_quant (dict -> KVQuantConfig): int8 slot pool, ~4x slots/HBM byte
+    kv_quant: Any = None
+
+    # fleet (dict -> fleet.config.FleetConfig): router + replica-set
+    # block read by ds_tpu_serve --fleet / benchmarks; inert (and
+    # allocating nothing) on a single replica
+    fleet: Any = None
+
     ALIASES = {"max_seq_len": "max_model_len"}
 
     def validate(self):
@@ -158,3 +208,21 @@ class ServingConfig(DeepSpeedConfigModel):
             self.resilience = ResilienceConfig.from_dict(self.resilience)
         elif self.resilience is None:
             self.resilience = ResilienceConfig()
+        if self.role not in ("unified", "prefill", "decode"):
+            raise ConfigError(
+                f"serving.role must be unified|prefill|decode, "
+                f"got {self.role!r}")
+        if isinstance(self.prefix_cache, dict):
+            self.prefix_cache = PrefixCacheConfig.from_dict(
+                self.prefix_cache)
+        elif self.prefix_cache is None:
+            self.prefix_cache = PrefixCacheConfig()
+        if isinstance(self.kv_quant, dict):
+            self.kv_quant = KVQuantConfig.from_dict(self.kv_quant)
+        elif self.kv_quant is None:
+            self.kv_quant = KVQuantConfig()
+        from .fleet.config import FleetConfig
+        if isinstance(self.fleet, dict):
+            self.fleet = FleetConfig.from_dict(self.fleet)
+        elif self.fleet is None:
+            self.fleet = FleetConfig()

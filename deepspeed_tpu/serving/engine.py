@@ -36,7 +36,9 @@ class ServingEngine:
     """Slot-based continuous-batching serving on top of InferenceEngine."""
 
     def __init__(self, engine, config: Union[ServingConfig, dict, None] = None,
-                 clock: Callable[[], float] = time.monotonic, seed: int = 0):
+                 clock: Callable[[], float] = time.monotonic, seed: int = 0,
+                 handoff_sink: Optional[Callable] = None,
+                 id_start: int = 0, id_stride: int = 1):
         if config is None:
             config = ServingConfig()
         elif isinstance(config, dict):
@@ -45,6 +47,11 @@ class ServingEngine:
             config.validate()
         self.config = config
         self.engine = engine
+        # fleet id spacing: replica i of N uses ids i, i+N, i+2N, ... so a
+        # request's async trace spans stay unique when it migrates between
+        # co-resident replicas (handoff, failover)
+        self._id_start = int(id_start)
+        self._id_stride = max(1, int(id_stride))
         self.monitor = None
         if config.monitor:
             from ..monitor.monitor import MonitorMaster
@@ -108,9 +115,10 @@ class ServingEngine:
             if self._hbm is not None:
                 self.statusz.register("memory", self._hbm.summary)
         self.scheduler = ContinuousBatchingScheduler(
-            engine, config, metrics=self.metrics, clock=clock, seed=seed)
+            engine, config, metrics=self.metrics, clock=clock, seed=seed,
+            handoff_sink=handoff_sink)
         self._requests: Dict[int, Request] = {}
-        self._next_id = 0
+        self._next_id = self._id_start
         self._draining = False
         self._preempt_drained = False
         self._preemption = None
@@ -154,8 +162,59 @@ class ServingEngine:
                       on_token=on_token)
         self.scheduler.enqueue(req)     # raises QueueFull on backpressure
         self._requests[req.request_id] = req
-        self._next_id += 1
+        self._next_id += self._id_stride
         return req.request_id
+
+    def submit_handoff(self, handoff, request: Optional[Request] = None,
+                       on_token: Optional[Callable] = None) -> int:
+        """Enqueue a completed prefill (serving/fleet/handoff.py) for
+        decode in THIS replica's pool. With ``request`` (the router path)
+        the same Request object continues — its token list, callbacks,
+        and deadline travel with the KV state; without one (direct API
+        use) a Request is reconstructed from the handoff's metadata and
+        the already-sampled first token is delivered here. Raises
+        ``QueueFull`` past ``max_queue`` (shared with the prompt queue)
+        and ``ValueError`` when the handoff cannot fit this replica's
+        pool."""
+        if self._draining:
+            raise RuntimeError("ServingEngine is draining; handoff rejected")
+        kv_len = int(handoff.kv_len)
+        max_new = (request.max_new_tokens if request is not None
+                   else int(handoff.max_new_tokens))
+        if kv_len + max_new > self.config.max_model_len:
+            raise ValueError(
+                f"handoff kv_len ({kv_len}) + max_new_tokens ({max_new}) "
+                f"exceeds serving.max_model_len={self.config.max_model_len}")
+        deliver_first = request is None
+        if request is None:
+            sampling = SamplingParams(
+                temperature=handoff.temperature,
+                max_new_tokens=handoff.max_new_tokens,
+                eos_token_id=handoff.eos_token_id)
+            request = Request(
+                request_id=self._next_id,
+                prompt=np.asarray(handoff.prompt, np.int32).reshape(-1),
+                sampling=sampling, max_new_tokens=handoff.max_new_tokens,
+                on_token=on_token)
+            self._next_id += self._id_stride
+            request.submit_time = self.scheduler.clock()
+            self.tracer.async_begin(
+                "request", request.request_id, cat="serving",
+                args={"prompt_len": int(request.prompt.size),
+                      "max_new_tokens": request.max_new_tokens,
+                      "handoff": True})
+        self.scheduler.enqueue_handoff(handoff, request)   # QueueFull here
+        self._requests[request.request_id] = request
+        if deliver_first:
+            request.state = RequestState.RUNNING
+            request.first_token_time = self.scheduler.clock()
+            request.tokens.append(int(handoff.first_token))
+            if on_token is not None:
+                try:
+                    on_token(request, int(handoff.first_token))
+                except Exception:
+                    pass
+        return request.request_id
 
     # ------------------------------------------------------------------ step
     def step(self) -> int:
@@ -352,6 +411,15 @@ class ServingEngine:
             "tokens_out": self.metrics.tokens_out,
             "draining": self._draining,
         }
+        if self.config.role != "unified":
+            out["role"] = self.config.role
+        if self.metrics.handoffs_in or self.metrics.handoffs_out:
+            out["kv_handoffs_in"] = self.metrics.handoffs_in
+            out["kv_handoffs_out"] = self.metrics.handoffs_out
+        pc = self.scheduler.prefix_cache
+        if pc is not None:
+            for k, v in pc.stats().items():
+                out[f"prefix_{k}"] = v
         for name, ps in self.metrics.percentiles().items():
             if ps["n"]:
                 out[f"{name}_p50/p95/p99"] = \
@@ -378,6 +446,8 @@ class ServingEngine:
 
     def decode_executables(self) -> int:
         """Compiled-executable count of the fused decode step (the
-        compile-once contract: stays 1 across differing prompt lengths)."""
+        compile-once contract: stays 1 across differing prompt lengths),
+        for THIS engine's pool flavor (fp vs quantized)."""
         return self.engine.slot_decode_executables(
-            self.config.num_slots, self.config.max_model_len)
+            self.config.num_slots, self.config.max_model_len,
+            quantized=self.scheduler.pool.quantized)

@@ -1,0 +1,21 @@
+"""Fleet-scale serving (docs/serving.md, "From one replica to a fleet").
+
+The layer above ServingEngine that turns one excellent replica into a
+fleet: SLO-aware routing over live /healthz+/statusz signals with
+drain-aware failover (router), prefill/decode role disaggregation over a
+serializable KV handoff (handoff), and cross-request radix prefix reuse
+of the slot KV pool (prefix_cache) — plus the fleet config block
+(config) and per-replica probe/backoff handles (replica).
+"""
+
+from .config import FleetConfig
+from .handoff import InProcessTransport, KVHandoff
+from .prefix_cache import PrefixHit, RadixPrefixCache, reuse_plan
+from .replica import ReplicaHandle
+from .router import FleetRequest, FleetRouter, build_fleet
+
+__all__ = [
+    "FleetConfig", "KVHandoff", "InProcessTransport",
+    "RadixPrefixCache", "PrefixHit", "reuse_plan",
+    "ReplicaHandle", "FleetRouter", "FleetRequest", "build_fleet",
+]

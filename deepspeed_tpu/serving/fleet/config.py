@@ -1,0 +1,102 @@
+"""Fleet config — the ``"fleet"`` block of a serving JSON.
+
+One serving JSON describes both a replica (the existing ServingConfig
+knobs) and the fleet built from it (this block): ``ds_tpu_serve --fleet``
+reads the same file the single-replica path does and instantiates
+``replicas`` ServingEngines behind a ``FleetRouter``. Role split is by
+count: ``prefill_replicas`` + ``decode_replicas`` (both zero = all
+replicas unified, the default).
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+from ...runtime.config_utils import ConfigError, DeepSpeedConfigModel
+
+__all__ = ["FleetConfig"]
+
+
+@dataclasses.dataclass
+class FleetConfig(DeepSpeedConfigModel):
+    """Router + replica-set knobs (serving/fleet/)."""
+
+    #: the block is inert unless enabled — a plain replica JSON with no
+    #: fleet block behaves exactly as before (and allocates nothing)
+    enabled: bool = False
+    #: total in-process replicas ``ds_tpu_serve --fleet`` builds
+    replicas: int = 2
+    #: role disaggregation: prefill_replicas run the prompt pass and hand
+    #: KV into decode_replicas' pools; both 0 = all unified
+    prefill_replicas: int = 0
+    decode_replicas: int = 0
+
+    # ------------------------------------------------------------ probing
+    #: seconds between /healthz probes of a READY replica
+    probe_interval_s: float = 0.5
+    #: HTTP timeout per probe; a probe that TIMES OUT marks the replica
+    #: NOT-ready exactly like a 503 (a hung replica must not be routed to)
+    probe_timeout_s: float = 1.0
+    #: re-probe backoff for NOT-ready replicas (jittered exponential,
+    #: resilience/retry.py): base doubles up to max — no hot-looping
+    probe_backoff_s: float = 0.25
+    probe_backoff_max_s: float = 4.0
+    #: a replica whose last successful probe is older than this is
+    #: considered dead: evicted from routing and its in-flight requests
+    #: re-enqueued onto survivors
+    heartbeat_timeout_s: float = 10.0
+
+    # ------------------------------------------------------------- routing
+    #: load score = queue_depth + active + slo_burn_penalty * burn_rate;
+    #: requests go to the lowest-scoring ready replica
+    slo_burn_penalty: float = 4.0
+    #: router-level admission bound: unassignable requests park in the
+    #: router queue up to this depth, then submit() raises QueueFull
+    max_pending: int = 256
+    #: resubmission attempts per request across failovers
+    max_retries: int = 3
+
+    #: statusz (dict -> runtime.config.StatuszConfig): the ROUTER's own
+    #: introspection server — /statusz grows a "fleet" section with one
+    #: row per replica (what ds_tpu_top's fleet view polls); /healthz is
+    #: ready while the fleet can still accept work
+    statusz: Any = None
+
+    def validate(self):
+        if self.replicas < 1:
+            raise ConfigError("fleet.replicas must be >= 1")
+        if self.prefill_replicas < 0 or self.decode_replicas < 0:
+            raise ConfigError("fleet role counts must be >= 0")
+        if (self.prefill_replicas > 0) != (self.decode_replicas > 0):
+            raise ConfigError(
+                "disaggregation needs BOTH prefill_replicas and "
+                "decode_replicas > 0 (prefill output must land somewhere)")
+        if self.prefill_replicas + self.decode_replicas not in (
+                0, self.replicas):
+            raise ConfigError(
+                f"prefill_replicas + decode_replicas "
+                f"({self.prefill_replicas}+{self.decode_replicas}) must "
+                f"equal fleet.replicas ({self.replicas}) or both be 0")
+        for name in ("probe_interval_s", "probe_timeout_s",
+                     "probe_backoff_s", "probe_backoff_max_s",
+                     "heartbeat_timeout_s"):
+            if getattr(self, name) <= 0:
+                raise ConfigError(f"fleet.{name} must be > 0")
+        if self.slo_burn_penalty < 0:
+            raise ConfigError("fleet.slo_burn_penalty must be >= 0")
+        if self.max_pending < 1:
+            raise ConfigError("fleet.max_pending must be >= 1")
+        if self.max_retries < 0:
+            raise ConfigError("fleet.max_retries must be >= 0")
+        from ...runtime.config import StatuszConfig
+        if isinstance(self.statusz, dict):
+            self.statusz = StatuszConfig.from_dict(self.statusz)
+        elif self.statusz is None:
+            self.statusz = StatuszConfig()
+
+    def roles(self) -> list:
+        """Per-replica role list, prefill first (handoff producers warm
+        up before their consumers)."""
+        if self.prefill_replicas:
+            return (["prefill"] * self.prefill_replicas +
+                    ["decode"] * self.decode_replicas)
+        return ["unified"] * self.replicas

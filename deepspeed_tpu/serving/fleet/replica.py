@@ -1,0 +1,173 @@
+"""Replica handle — one serving replica as the router sees it.
+
+A replica is an in-process ``ServingEngine`` (``ds_tpu_serve --fleet``),
+a remote ``/healthz``+``/statusz`` endpoint, or both. The router never
+touches engine internals for *liveness*: readiness is the same signal a
+cloud load balancer uses — the ``/healthz`` probe PR 4 built, which goes
+503 the moment the replica drains or its preemption latch fires.
+
+Probe discipline (the PR-8 stale-readiness fix): a probe that **times
+out** marks the replica NOT-ready exactly like a 503 — a hung replica
+must not keep receiving traffic just because it never answered. NOT-ready
+replicas are re-probed on a jittered exponential backoff
+(``resilience/retry.backoff_delays``) instead of every router tick, so a
+dead endpoint costs one socket timeout per backoff step, not per tick.
+A replica whose last *successful* probe is older than
+``heartbeat_timeout_s`` is reported stale: the router evicts it and
+re-enqueues its in-flight requests onto survivors.
+"""
+
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+from ...resilience.retry import backoff_delays
+from ...utils.logging import logger
+
+__all__ = ["ReplicaHandle"]
+
+
+class ReplicaHandle:
+    """Probe schedule + load signals for one replica."""
+
+    def __init__(self, name: str, engine=None, url: Optional[str] = None,
+                 role: str = "unified", config=None,
+                 clock: Callable[[], float] = time.monotonic, rng=None):
+        if engine is None and url is None:
+            raise ValueError(f"replica {name!r} needs an engine or a url")
+        self.name = name
+        self.engine = engine
+        # in-process replicas with a live statusz server are probed over
+        # real HTTP — the same path a remote replica takes
+        if url is None and getattr(engine, "statusz", None) is not None:
+            url = engine.statusz.url
+        self.url = url.rstrip("/") if url else None
+        self.role = role
+        self._cfg = config
+        self._clock = clock
+        self._rng = rng
+        self.ready = False
+        self.failed = False           # hard eviction (router decision)
+        self.last_ready_at: Optional[float] = None
+        self.last_detail = "unprobed"
+        self.probes = 0
+        self.probe_failures = 0
+        self._next_probe = float("-inf")
+        self._backoff = None
+
+    def _p(self, key, default):
+        return getattr(self._cfg, key, default) if self._cfg is not None \
+            else default
+
+    # -------------------------------------------------------------- probing
+    def probe(self, now: Optional[float] = None) -> bool:
+        """Readiness, refreshing on schedule: ready replicas re-probe
+        every ``probe_interval_s``; NOT-ready replicas on the jittered
+        backoff. Between due times the cached verdict stands."""
+        if self.failed:
+            return False
+        now = self._clock() if now is None else now
+        if now < self._next_probe:
+            return self.ready
+        self.probes += 1
+        ok, detail = self._probe_once()
+        self.last_detail = detail
+        if ok:
+            self.ready = True
+            self.last_ready_at = now
+            self._backoff = None
+            self._next_probe = now + float(self._p("probe_interval_s", 0.5))
+        else:
+            if self.ready or self._backoff is None:
+                self._backoff = backoff_delays(
+                    float(self._p("probe_backoff_s", 0.25)),
+                    float(self._p("probe_backoff_max_s", 4.0)), self._rng)
+            self.ready = False
+            self.probe_failures += 1
+            self._next_probe = now + next(self._backoff)
+        return self.ready
+
+    def _probe_once(self):
+        if self.url is not None:
+            try:
+                with urllib.request.urlopen(
+                        self.url + "/healthz",
+                        timeout=float(self._p("probe_timeout_s", 1.0))) as r:
+                    return r.status == 200, "ok"
+            except urllib.error.HTTPError as e:
+                # 503 = the replica SAYS it is not ready (drain/preempt)
+                return False, f"healthz {e.code}"
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                # timeout / refused / unreachable: NOT ready — same verdict
+                # as a 503, different root cause (the stale-readiness fix)
+                return False, f"probe failed: {getattr(e, 'reason', e)}"
+        ok, detail = self.engine._health_check()
+        return ok, detail
+
+    def stale(self, now: Optional[float] = None) -> bool:
+        """True when the last successful probe is too old to trust: the
+        replica is presumed dead (vs merely not-ready) and the router
+        fails its requests over. A replica that was never ready goes
+        stale ``heartbeat_timeout_s`` after construction."""
+        now = self._clock() if now is None else now
+        timeout = float(self._p("heartbeat_timeout_s", 10.0))
+        anchor = self.last_ready_at
+        if anchor is None:
+            anchor = getattr(self, "_born", None)
+            if anchor is None:
+                self._born = now
+                return False
+        return now - anchor > timeout
+
+    def preempted(self) -> bool:
+        return self.engine is not None and \
+            bool(getattr(self.engine, "preempted", False))
+
+    # ---------------------------------------------------------------- load
+    def load(self) -> dict:
+        """Queue/occupancy/burn signals for routing. In-process replicas
+        read the engine directly (always fresh, no socket); url-only
+        replicas poll ``/statusz?format=json``."""
+        if self.engine is not None:
+            m = self.engine.metrics
+            burn = m.last_burn_rate
+            return {"queue_depth": self.engine.queue_depth,
+                    "active_requests": self.engine.active_requests,
+                    "slot_occupancy": round(
+                        self.engine.active_requests /
+                        self.engine.config.num_slots, 3),
+                    "slo_burn_rate": burn}
+        try:
+            import json
+            with urllib.request.urlopen(
+                    self.url + "/statusz?format=json",
+                    timeout=float(self._p("probe_timeout_s", 1.0))) as r:
+                doc = json.load(r)
+            srv = (doc.get("sections") or {}).get("serving") or {}
+            return {"queue_depth": srv.get("queue_depth", 0),
+                    "active_requests": srv.get("active_requests", 0),
+                    "slot_occupancy": srv.get("slot_occupancy", 0.0),
+                    "slo_burn_rate": srv.get("slo_burn_rate")}
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            logger.warning(f"fleet: statusz poll of {self.name} failed: {e}")
+            return {"queue_depth": 0, "active_requests": 0,
+                    "slot_occupancy": 0.0, "slo_burn_rate": None}
+
+    def score(self) -> float:
+        """Routing score — lower is better."""
+        sig = self.load()
+        burn = sig.get("slo_burn_rate") or 0.0
+        return (sig["queue_depth"] + sig["active_requests"] +
+                float(self._p("slo_burn_penalty", 4.0)) * float(burn))
+
+    def summary(self) -> dict:
+        """One /statusz fleet-table row."""
+        out = {"role": self.role, "ready": self.ready,
+               "failed": self.failed, "detail": self.last_detail,
+               "probes": self.probes, "probe_failures": self.probe_failures}
+        if self.url:
+            out["url"] = self.url
+        if self.engine is not None:
+            out.update(self.load())
+        return out

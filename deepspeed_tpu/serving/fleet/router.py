@@ -1,0 +1,477 @@
+"""SLO-aware fleet router — load balancing, failover, disaggregation.
+
+The front-end of an N-replica serving fleet. Requests enter here, not at
+a replica: ``submit()`` picks the lowest-loaded READY replica (readiness
+= the live ``/healthz`` probe, load = queue depth + active slots +
+``slo_burn_penalty`` x the replica's SLO burn rate), and ``step()``
+drives the whole fleet — probing on schedule, ticking in-process
+replicas, and handling the three failure signals:
+
+- **preemption latch** — the replica's SIGTERM handler fired; its drain
+  completes running work, the router re-enqueues what was still queued;
+- **stale heartbeat** — no successful probe within
+  ``heartbeat_timeout_s``: the replica is presumed dead mid-stream, its
+  in-flight requests resubmit to survivors (greedy decode makes the
+  replay deterministic; the delivery adapter deduplicates streamed
+  tokens, so a client sees each position exactly once);
+- **explicit kill** — tests and ops mark a replica failed directly.
+
+Every failover bumps ``fleet/failovers``, emits a ``failover`` span, and
+fires the flight recorder (kind ``failover``) when one is attached.
+
+With role disaggregation (``fleet.prefill_replicas``), new requests
+route to *prefill* replicas; each completed prompt pass comes back
+through the handoff sink and is forwarded — KV lane and Request object
+together — to the least-loaded *decode* replica, which continues the
+token loop in its own slot pool.
+"""
+
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ...telemetry.trace import get_tracer
+from ...utils.logging import log_dist, logger
+from ..metrics import FleetMetrics
+from ..scheduler import QueueFull, RequestState, SamplingParams
+from .config import FleetConfig
+from .replica import ReplicaHandle
+
+__all__ = ["FleetRouter", "FleetRequest", "build_fleet"]
+
+_DONE_STATES = (RequestState.FINISHED, RequestState.TIMEOUT)
+
+
+class FleetRequest:
+    """Router-side view of one request across replica assignments."""
+
+    def __init__(self, fleet_id: int, prompt, sampling, on_token):
+        self.fleet_id = fleet_id
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.sampling = sampling
+        self.on_token = on_token
+        self.replica: Optional[str] = None
+        self.request = None        # live serving.Request on that replica
+        self.attempts = 0
+        self.delivered = 0         # token positions streamed to the user
+        self.failed_reason: Optional[str] = None
+
+    # The delivery adapter: replays after failover re-generate tokens the
+    # user already saw (greedy decode is deterministic), so only positions
+    # past the high-water mark are forwarded.
+    def _adapter(self, req, tok):
+        pos = len(req.tokens)
+        if pos <= self.delivered:
+            return
+        self.delivered = pos
+        if self.on_token is not None:
+            self.on_token(req, tok)
+
+    @property
+    def done(self) -> bool:
+        if self.failed_reason is not None:
+            return True
+        return self.request is not None and self.request.state in _DONE_STATES
+
+    @property
+    def state(self) -> str:
+        if self.failed_reason is not None:
+            return "failed"
+        if self.request is None:
+            return "pending"
+        return self.request.state.value
+
+    @property
+    def output_ids(self):
+        if self.request is not None:
+            return self.request.output_ids
+        return self.prompt
+
+    @property
+    def tokens(self) -> list:
+        return self.request.tokens if self.request is not None else []
+
+
+class FleetRouter:
+    """Front-end over N ReplicaHandles."""
+
+    def __init__(self, replicas: List[ReplicaHandle],
+                 config: Optional[FleetConfig] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 tracer=None, recorder=None):
+        self.config = config or FleetConfig(enabled=True)
+        self.replicas: Dict[str, ReplicaHandle] = {
+            r.name: r for r in replicas}
+        if len(self.replicas) != len(replicas):
+            raise ValueError("replica names must be unique")
+        self.clock = clock
+        self.tracer = tracer or get_tracer()
+        self.recorder = recorder
+        self.metrics = FleetMetrics(tracer=self.tracer)
+        self._fleet_requests: Dict[int, FleetRequest] = {}
+        self._next_fid = 0
+        self._pending: "deque[FleetRequest]" = deque()
+        self._pending_handoffs: "deque" = deque()
+        self._shutdown = False
+        self.statusz = None
+        sz = getattr(self.config, "statusz", None)
+        if getattr(sz, "enabled", False):
+            from ...telemetry.statusz import StatuszServer
+            self.statusz = StatuszServer(sz, tracer=self.tracer)
+            self.statusz.register("fleet", self._statusz_section)
+            self.statusz.register_health("fleet", self._health_check)
+        # wire prefill replicas' handoff sinks to this router
+        for r in replicas:
+            if r.engine is not None and r.role == "prefill":
+                sched = r.engine.scheduler
+                if sched.handoff_sink is None:
+                    sched.handoff_sink = self._make_sink(r.name)
+        now = self.clock()
+        for r in replicas:
+            r.probe(now)
+        self._refresh_gauges()
+        log_dist(
+            f"FleetRouter initialized: {len(replicas)} replica(s) "
+            f"({', '.join(f'{r.name}:{r.role}' for r in replicas)})",
+            ranks=[0])
+
+    # ---------------------------------------------------------------- roles
+    def _entry_replicas(self) -> List[ReplicaHandle]:
+        """Where NEW requests go: prefill replicas when disaggregated,
+        else unified."""
+        pre = [r for r in self.replicas.values()
+               if r.role == "prefill" and not r.failed]
+        if pre:
+            return pre
+        return [r for r in self.replicas.values()
+                if r.role == "unified" and not r.failed]
+
+    def _decode_replicas(self) -> List[ReplicaHandle]:
+        return [r for r in self.replicas.values()
+                if r.role == "decode" and not r.failed]
+
+    @staticmethod
+    def _pick(cands: List[ReplicaHandle]) -> List[ReplicaHandle]:
+        ready = [r for r in cands if r.ready]
+        return sorted(ready, key=lambda r: r.score())
+
+    # --------------------------------------------------------------- submit
+    def submit(self, prompt, sampling: Optional[SamplingParams] = None,
+               on_token: Optional[Callable] = None) -> int:
+        """Route one request into the fleet; returns its fleet id.
+        Raises QueueFull when no replica can take it AND the router's
+        own pending queue is at ``max_pending`` (fleet-wide
+        backpressure)."""
+        if self._shutdown:
+            raise RuntimeError("FleetRouter is shut down; submit rejected")
+        sampling = sampling or SamplingParams()
+        freq = FleetRequest(self._next_fid, prompt, sampling, on_token)
+        self._next_fid += 1
+        self.metrics.submitted += 1
+        if not self._try_assign(freq):
+            if len(self._pending) >= self.config.max_pending:
+                self.metrics.submitted -= 1
+                raise QueueFull(
+                    f"fleet pending queue at capacity "
+                    f"({self.config.max_pending}) and no replica ready")
+            self._pending.append(freq)
+        self._fleet_requests[freq.fleet_id] = freq
+        return freq.fleet_id
+
+    def _try_assign(self, freq: FleetRequest) -> bool:
+        for r in self._pick(self._entry_replicas()):
+            try:
+                rid = r.engine.submit(freq.prompt, freq.sampling,
+                                      on_token=freq._adapter)
+            except QueueFull:
+                continue
+            freq.replica, freq.request = r.name, r.engine.result(rid)
+            freq.attempts += 1
+            with self.tracer.span("route", cat="fleet",
+                                  args={"fleet_id": freq.fleet_id,
+                                        "replica": r.name,
+                                        "attempt": freq.attempts}):
+                pass
+            return True
+        return False
+
+    # -------------------------------------------------------------- handoff
+    def _make_sink(self, source: str):
+        def sink(handoff, request):
+            handoff.source = source
+            self._route_handoff(handoff, request)
+        return sink
+
+    def _route_handoff(self, handoff, request) -> bool:
+        for r in self._pick(self._decode_replicas()):
+            try:
+                r.engine.submit_handoff(handoff, request=request)
+            except QueueFull:
+                continue
+            freq = self._freq_of(request)
+            if freq is not None:
+                freq.replica = r.name
+            self.metrics.handoffs += 1
+            with self.tracer.span(
+                    "kv_handoff", cat="fleet",
+                    args={"from": handoff.source, "to": r.name,
+                          "kv_len": int(handoff.kv_len),
+                          "bytes": handoff.nbytes()}):
+                pass
+            return True
+        self._pending_handoffs.append((handoff, request))
+        return False
+
+    def _freq_of(self, request) -> Optional[FleetRequest]:
+        for freq in self._fleet_requests.values():
+            if freq.request is request:
+                return freq
+        return None
+
+    # ----------------------------------------------------------------- step
+    def step(self) -> int:
+        """One fleet tick: probe on schedule, evict dead replicas
+        (failing their work over), retry pending assignments, tick every
+        live in-process replica. Returns requests still in flight."""
+        now = self.clock()
+        for r in self.replicas.values():
+            r.probe(now)
+        self._detect_failures(now)
+        self._retry_pending()
+        in_flight = 0
+        for r in self.replicas.values():
+            if r.failed or r.engine is None:
+                continue
+            in_flight += r.engine.step()
+        self._harvest_completions()
+        self._refresh_gauges()
+        return in_flight + len(self._pending) + len(self._pending_handoffs)
+
+    def _retry_pending(self):
+        for _ in range(len(self._pending_handoffs)):
+            handoff, request = self._pending_handoffs.popleft()
+            self._route_handoff(handoff, request)   # re-queues on failure
+            if self._pending_handoffs and \
+                    self._pending_handoffs[-1][0] is handoff:
+                break                               # still nowhere to go
+        for _ in range(len(self._pending)):
+            freq = self._pending.popleft()
+            if freq.attempts > self.config.max_retries:
+                freq.failed_reason = (
+                    f"gave up after {freq.attempts} attempts "
+                    f"(max_retries={self.config.max_retries})")
+                logger.warning(f"fleet: request {freq.fleet_id} "
+                               f"{freq.failed_reason}")
+                continue
+            if not self._try_assign(freq):
+                self._pending.append(freq)
+                break                               # no replica ready now
+
+    def _harvest_completions(self):
+        done = sum(1 for f in self._fleet_requests.values()
+                   if f.request is not None
+                   and f.request.state in _DONE_STATES)
+        self.metrics.completed = done
+
+    # ------------------------------------------------------------- failover
+    def _detect_failures(self, now: float):
+        for r in list(self.replicas.values()):
+            if r.failed:
+                continue
+            if r.preempted():
+                self._evict(r, "preemption latch fired")
+            elif r.stale(now):
+                self._evict(r, f"heartbeat stale ({r.last_detail})")
+
+    def kill(self, name: str, reason: str = "killed"):
+        """Mark a replica dead NOW (tests, ops). Its in-flight requests
+        fail over on the spot."""
+        self._evict(self.replicas[name], reason)
+
+    def _evict(self, replica: ReplicaHandle, reason: str):
+        replica.failed = True
+        replica.ready = False
+        victims = [f for f in self._fleet_requests.values()
+                   if f.replica == replica.name and not f.done]
+        for freq in victims:
+            freq.replica, freq.request = None, None
+            self._pending.append(freq)
+        self.metrics.failovers += 1
+        self.metrics.requeued += len(victims)
+        with self.tracer.span("failover", cat="fleet",
+                              args={"replica": replica.name,
+                                    "reason": reason,
+                                    "requeued": len(victims)}):
+            pass
+        if self.recorder is not None:
+            self.recorder.trigger(
+                "failover",
+                f"replica {replica.name} evicted ({reason}); "
+                f"{len(victims)} request(s) re-enqueued onto survivors",
+                force=True)
+        log_dist(
+            f"fleet: FAILOVER — replica {replica.name} evicted ({reason}); "
+            f"re-enqueued {len(victims)} in-flight request(s)", ranks=[0])
+
+    # -------------------------------------------------------------- results
+    def result(self, fleet_id: int) -> FleetRequest:
+        return self._fleet_requests[fleet_id]
+
+    def run_until_idle(self, max_ticks: int = 100_000) -> int:
+        """Tick until every accepted request reached a terminal state (or
+        nothing can make progress). Returns ticks run."""
+        for i in range(max_ticks):
+            in_flight = self.step()
+            open_reqs = [f for f in self._fleet_requests.values()
+                         if not f.done]
+            if not open_reqs:
+                return i + 1
+            if in_flight == 0 and not any(
+                    r.ready for r in self._entry_replicas()):
+                logger.warning(
+                    f"fleet: {len(open_reqs)} request(s) stranded with no "
+                    f"ready replica; giving up run_until_idle")
+                return i + 1
+        return max_ticks
+
+    # ------------------------------------------------------------ lifecycle
+    def drain(self, max_ticks: int = 100_000):
+        """Finish in-flight fleet work, then drain every live replica."""
+        self.run_until_idle(max_ticks=max_ticks)
+        for r in self.replicas.values():
+            if not r.failed and r.engine is not None:
+                r.engine.drain()
+
+    def shutdown(self):
+        """Drain, shut every live replica down, release the fleet gauges
+        and dead replicas' lingering gauges, stop the router statusz."""
+        if self._shutdown:
+            return
+        self.drain()
+        self._shutdown = True
+        for r in self.replicas.values():
+            if r.engine is None:
+                continue
+            if r.failed:
+                self._dispose_failed(r.engine)
+            else:
+                r.engine.shutdown()
+        if self.statusz is not None:
+            self.statusz.close()
+        self.metrics.close()
+        self.tracer.release_counters(self)
+
+    @staticmethod
+    def _dispose_failed(engine):
+        """Best-effort gauge/server cleanup of a replica that was marked
+        dead without a drain (a real dead process takes its /metrics with
+        it; an in-process 'corpse' must not leave gauges looking live)."""
+        try:
+            engine.metrics.close()
+            if engine.statusz is not None:
+                engine.statusz.close()
+            engine.tracer.release_counters(engine)
+        except Exception as e:
+            logger.warning(f"fleet: disposing failed replica: {e}")
+
+    # -------------------------------------------------------------- statusz
+    def _prefix_totals(self):
+        hits = lookups = 0
+        for r in self.replicas.values():
+            if r.engine is None:
+                continue
+            pc = r.engine.scheduler.prefix_cache
+            if pc is not None:
+                hits += pc.hits
+                lookups += pc.lookups
+        return hits, lookups
+
+    def _refresh_gauges(self):
+        hits, lookups = self._prefix_totals()
+        self.metrics.update(
+            replicas=len(self.replicas),
+            ready=sum(1 for r in self.replicas.values()
+                      if r.ready and not r.failed),
+            pending=len(self._pending) + len(self._pending_handoffs),
+            prefix_hits=hits, prefix_lookups=lookups)
+
+    def _health_check(self):
+        if self._shutdown:
+            return False, "shut down"
+        entry = [r for r in self._entry_replicas() if r.ready]
+        if not entry:
+            return False, "no ready entry replica"
+        if self.config.prefill_replicas and not any(
+                r.ready for r in self._decode_replicas()):
+            return False, "no ready decode replica"
+        return True, f"{len(entry)} ready"
+
+    def _statusz_section(self) -> dict:
+        hits, lookups = self._prefix_totals()
+        out = {
+            "replicas": len(self.replicas),
+            "ready": sum(1 for r in self.replicas.values()
+                         if r.ready and not r.failed),
+            "failed": sum(1 for r in self.replicas.values() if r.failed),
+            "pending_requests": len(self._pending),
+            "pending_handoffs": len(self._pending_handoffs),
+            "submitted": self.metrics.submitted,
+            "completed": self.metrics.completed,
+            "failovers": self.metrics.failovers,
+            "requeued": self.metrics.requeued,
+            "kv_handoffs": self.metrics.handoffs,
+            "prefix_hit_rate": round(hits / lookups, 4) if lookups else 0.0,
+        }
+        # nested per-replica rows: ds_tpu_top's fleet view renders these
+        # and polls each replica's url for its own /statusz detail
+        out["replica_table"] = {name: r.summary()
+                                for name, r in self.replicas.items()}
+        return out
+
+
+def build_fleet(engine, serving_config, clock=time.monotonic,
+                seed: int = 0) -> FleetRouter:
+    """One InferenceEngine (weights are shared — replicas differ only in
+    their slot pools) + one serving JSON -> a running in-process fleet.
+    Per-replica ServingConfigs are derived from the base config: role
+    from ``fleet.roles()``, a fresh ephemeral statusz port per replica
+    (a fixed port cannot be bound N times), and id spacing so request
+    ids stay fleet-unique."""
+    from ..config import ServingConfig
+    from ..engine import ServingEngine
+    if isinstance(serving_config, dict):
+        serving_config = ServingConfig.from_dict(serving_config)
+    else:
+        serving_config.validate()
+    import os
+    fleet_cfg = serving_config.fleet
+    roles = fleet_cfg.roles()
+    n = len(roles)
+    replicas = []
+    recorder = None
+    rec_cfg = serving_config.flight_recorder
+    if getattr(rec_cfg, "enabled", False):
+        # router and replicas each get their own bundle subdirectory —
+        # recorders number bundles independently and must not collide
+        from ...telemetry.flight_recorder import FlightRecorder
+        from ...runtime.config import FlightRecorderConfig
+        router_rec = FlightRecorderConfig.from_dict(rec_cfg.to_dict())
+        router_rec.dir = os.path.join(str(rec_cfg.dir), "router")
+        recorder = FlightRecorder(router_rec)
+    for i, role in enumerate(roles):
+        cfg = ServingConfig.from_dict(serving_config.to_dict())
+        cfg.role = role
+        if getattr(cfg.statusz, "enabled", False):
+            cfg.statusz.port = 0          # ephemeral per replica
+        if getattr(cfg.flight_recorder, "enabled", False):
+            cfg.flight_recorder.dir = os.path.join(
+                str(rec_cfg.dir), f"r{i}")
+        srv = ServingEngine(engine, cfg, clock=clock, seed=seed + i,
+                            id_start=i, id_stride=n)
+        replicas.append(ReplicaHandle(
+            f"r{i}", engine=srv, role=role, config=fleet_cfg, clock=clock))
+    router = FleetRouter(replicas, fleet_cfg, clock=clock,
+                         recorder=recorder)
+    return router

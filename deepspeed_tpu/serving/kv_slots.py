@@ -22,17 +22,23 @@ import numpy as np
 class SlotPool:
     """Fixed pool of decode slots over one static KV cache."""
 
-    def __init__(self, engine, num_slots: int, max_model_len: int):
+    def __init__(self, engine, num_slots: int, max_model_len: int,
+                 quantize: bool = False):
         self.engine = engine
         self.num_slots = num_slots
         self.max_model_len = max_model_len
-        self.cache = engine.init_slot_pool(num_slots, max_model_len)
+        self.quantized = bool(quantize)
+        self.cache = engine.init_slot_pool(num_slots, max_model_len,
+                                           quantize=self.quantized)
         # host-side slot registers, mirrored into device arrays each tick
         self.lengths = np.zeros((num_slots,), np.int32)   # tokens in cache
         self.pending = np.zeros((num_slots,), np.int32)   # next token to feed
         self.temps = np.zeros((num_slots,), np.float32)
         self.requests: List[Optional[object]] = [None] * num_slots
         self._free = list(range(num_slots - 1, -1, -1))   # pop() -> slot 0 first
+        #: slots parked in the prefix cache: not free, not active — their
+        #: lanes stay resident as reusable prefixes until LRU eviction
+        self.cached: set = set()
         self.total_allocs = 0
 
     # ------------------------------------------------------------ lifecycle
@@ -46,16 +52,30 @@ class SlotPool:
 
     def free(self, slot: int):
         """Retire a slot back to the free list (EOS / max-tokens /
-        timeout). The lane's stale K/V needs no scrubbing: the next
-        prefill overwrites the whole lane and the decode mask never looks
-        past the new request's length."""
+        timeout / prefix-cache eviction). The lane's stale K/V needs no
+        scrubbing: the next prefill overwrites the whole lane and the
+        decode mask never looks past the new request's length."""
         if self.requests[slot] is None and slot in self._free:
             return
         self.requests[slot] = None
         self.lengths[slot] = 0
         self.pending[slot] = 0
         self.temps[slot] = 0.0
+        self.cached.discard(slot)
         self._free.append(slot)
+
+    def retire_to_cache(self, slot: int):
+        """Park a finished request's slot in the prefix cache: detached
+        from decode (no request, nothing pending) but NOT freed — the
+        lane's K/V stays resident as a reusable prefix. ``lengths`` keeps
+        the valid-column count; the per-tick dummy decode write for a
+        parked slot lands at column ``lengths[slot]`` — one column past
+        the cached content, exactly where a reusing request prefills or
+        decodes first, so the cached prefix itself is never clobbered."""
+        self.requests[slot] = None
+        self.pending[slot] = 0
+        self.temps[slot] = 0.0
+        self.cached.add(slot)
 
     def bind(self, slot: int, request, length: int, first_token: int,
              temperature: float):

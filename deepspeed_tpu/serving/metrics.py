@@ -57,6 +57,8 @@ class ServingMetrics:
         self.timeouts = 0
         self.tokens_out = 0
         self.ticks = 0
+        self.handoffs_in = 0      # KV lanes received into this pool
+        self.handoffs_out = 0     # KV lanes extracted and handed off
         #: last computed SLO burn rate (refreshed every monitor_interval
         #: ticks by _emit_slo_gauges); None until targets produce one.
         #: The per-tick flight-recorder path reads this instead of
@@ -98,6 +100,23 @@ class ServingMetrics:
             e2e = (finish - submit) * 1e3
             self.e2e_ms.append(e2e)
             self._emit("serving/e2e_ms", e2e)
+
+    def record_handoff_in(self):
+        self.handoffs_in += 1
+        self._emit("serving/kv_handoffs_in", self.handoffs_in)
+
+    def record_handoff_out(self):
+        self.handoffs_out += 1
+        self._emit("serving/kv_handoffs_out", self.handoffs_out)
+
+    def record_prefix_cache(self, cache):
+        """Mirror the radix cache's counters into gauges (throttled to
+        the monitor cadence like the queue/utilization gauges)."""
+        if self.ticks % self.monitor_interval == 0 or self.ticks == 1:
+            self._gauge("serving/prefix_cache_hit_rate", cache.hit_rate)
+            self._gauge("serving/prefix_cache_hits", cache.hits)
+            self._gauge("serving/prefix_cached_slots", cache.cached_slots)
+            self._gauge("serving/prefix_tokens_saved", cache.tokens_saved)
 
     def record_tick(self, queue_depth: int, slot_utilization: float):
         self.ticks += 1
@@ -197,6 +216,8 @@ class ServingMetrics:
             "timeouts": self.timeouts,
             "tokens_out": self.tokens_out,
             "ticks": self.ticks,
+            "kv_handoffs_in": self.handoffs_in,
+            "kv_handoffs_out": self.handoffs_out,
             "ttft_ms_p50": pct["ttft_ms"]["p50"],
             "ttft_ms_p95": pct["ttft_ms"]["p95"],
             "ttft_ms_p99": pct["ttft_ms"]["p99"],
@@ -211,3 +232,43 @@ class ServingMetrics:
         if wall_seconds:
             out["tokens_per_s"] = round(self.tokens_out / wall_seconds, 2)
         return out
+
+
+class FleetMetrics:
+    """Router-level gauges: the ``fleet/*`` tags get a dedicated
+    ``dstpu_fleet_*`` Prometheus series (telemetry/export.py), the same
+    treatment as ``host/*`` and ``mem/*`` — a dashboard alerts on
+    ``dstpu_fleet_ready_replicas`` without label-matching through the
+    generic gauge. Gauges are owned by this instance and retracted on
+    ``close()``: two co-resident fleets in one process keep disjoint
+    live values, and a shut-down router's replica counts do not linger
+    in ``/metrics`` (the PR-4 gauge-lifecycle contract)."""
+
+    def __init__(self, tracer=None):
+        self.tracer = tracer or get_tracer()
+        self.submitted = 0
+        self.completed = 0
+        self.failovers = 0
+        self.requeued = 0
+        self.handoffs = 0
+        self._closed = False
+
+    def update(self, *, replicas: int, ready: int, pending: int,
+               prefix_hits: int = 0, prefix_lookups: int = 0):
+        hit_rate = prefix_hits / prefix_lookups if prefix_lookups else 0.0
+        for tag, val in (("fleet/replicas", replicas),
+                         ("fleet/ready_replicas", ready),
+                         ("fleet/pending_requests", pending),
+                         ("fleet/submitted", self.submitted),
+                         ("fleet/completed", self.completed),
+                         ("fleet/failovers", self.failovers),
+                         ("fleet/requeued", self.requeued),
+                         ("fleet/kv_handoffs", self.handoffs),
+                         ("fleet/prefix_cache_hit_rate", hit_rate)):
+            self.tracer.set_counter(tag, float(val), owner=self)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self.tracer.release_counters(self)

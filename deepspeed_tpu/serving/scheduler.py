@@ -82,16 +82,39 @@ class Request:
 
 
 class ContinuousBatchingScheduler:
-    """Admission queue + slot pool + fused decode tick."""
+    """Admission queue + slot pool + fused decode tick.
+
+    Three roles share this loop (config.role): ``unified`` admits
+    prompts, prefills, and decodes; ``prefill`` admits prompts, prefills,
+    then extracts the slot lane into a KVHandoff for ``handoff_sink``
+    instead of binding for decode; ``decode`` additionally drains a
+    handoff queue — inserting received lanes into its own pool — and
+    runs the token loop. With ``prefix_cache.enabled``, finished slots
+    are donated to a radix cache and admissions that share a cached
+    prefix take the lane-copy + suffix-prefill fast path.
+    """
 
     def __init__(self, engine, config, metrics: ServingMetrics = None,
-                 clock: Callable[[], float] = time.monotonic, seed: int = 0):
+                 clock: Callable[[], float] = time.monotonic, seed: int = 0,
+                 handoff_sink: Optional[Callable] = None):
         self.engine = engine
         self.config = config
         self.clock = clock
+        self.role = getattr(config, "role", "unified")
+        self.handoff_sink = handoff_sink
         self.metrics = metrics or ServingMetrics()
-        self.pool = SlotPool(engine, config.num_slots, config.max_model_len)
+        quantize = bool(getattr(getattr(config, "kv_quant", None),
+                                "enabled", False))
+        self.pool = SlotPool(engine, config.num_slots, config.max_model_len,
+                             quantize=quantize)
         self.queue: "deque[Request]" = deque()
+        #: (KVHandoff, Request) pairs awaiting a slot (decode/unified role)
+        self.handoff_queue: "deque" = deque()
+        self.prefix_cache = None
+        pc_cfg = getattr(config, "prefix_cache", None)
+        if getattr(pc_cfg, "enabled", False):
+            from .fleet.prefix_cache import RadixPrefixCache
+            self.prefix_cache = RadixPrefixCache(pc_cfg)
         self._base_key = jax.random.PRNGKey(seed)
         self._tick_no = 0
         # per-request async spans (queue → prefill → decode → complete)
@@ -121,6 +144,20 @@ class ContinuousBatchingScheduler:
         tr.async_begin("request/queued", request.request_id, cat="serving")
         self.metrics.record_submit()
 
+    def enqueue_handoff(self, handoff, request: Request):
+        """Admission control for the handoff path (decode role): the
+        handoff queue shares ``max_queue`` with the prompt queue."""
+        if len(self.handoff_queue) + len(self.queue) >= self.config.max_queue:
+            self.metrics.record_reject()
+            raise QueueFull(
+                f"serving handoff queue at capacity "
+                f"({self.config.max_queue}); retry with backoff")
+        self.handoff_queue.append((handoff, request))
+        self.tracer.async_begin("request/handoff_queued",
+                                request.request_id, cat="serving",
+                                args={"kv_len": int(handoff.kv_len),
+                                      "source": handoff.source})
+
     # ----------------------------------------------------------------- tick
     def tick(self) -> int:
         """One scheduling iteration. Returns the number of requests still
@@ -128,10 +165,46 @@ class ContinuousBatchingScheduler:
         self._tick_no += 1
         now = self.clock()
         self._expire(now)
+        self._admit_handoffs(now)
         self._admit(now)
         self._decode()
         self.metrics.record_tick(len(self.queue), self.pool.utilization)
-        return len(self.queue) + len(self.pool.active_slots)
+        if self.prefix_cache is not None:
+            self.metrics.record_prefix_cache(self.prefix_cache)
+        return (len(self.queue) + len(self.handoff_queue) +
+                len(self.pool.active_slots))
+
+    def _alloc_slot(self) -> Optional[int]:
+        """Claim a slot, evicting the LRU prefix-cache entry when the
+        free list is dry — live admissions always outrank cached
+        prefixes (pinned entries excepted)."""
+        slot = self.pool.alloc()
+        if slot is None and self.prefix_cache is not None:
+            victim = self.prefix_cache.evict_lru()
+            if victim is not None:
+                self.pool.free(victim)
+                slot = self.pool.alloc()
+        return slot
+
+    def _release_slot(self, slot: int, req: Request,
+                      donate_seq=None):
+        """Retire a slot: donate its lane to the prefix cache when it
+        holds reusable K/V — a FINISHED request's full sequence, or the
+        prompt a prefill-role scheduler just handed off — else return it
+        to the free list."""
+        cache = self.prefix_cache
+        kv_len = int(self.pool.lengths[slot])
+        if cache is not None and donate_seq is None and \
+                req.state is RequestState.FINISHED:
+            donate_seq = req.output_ids[:kv_len]
+        if cache is not None and donate_seq is not None:
+            accepted, evicted = cache.donate(slot, donate_seq, kv_len)
+            if evicted is not None:
+                self.pool.free(evicted)
+            if accepted:
+                self.pool.retire_to_cache(slot)
+                return
+        self.pool.free(slot)
 
     def _expire(self, now: float):
         """Deadline enforcement for both queued and running requests."""
@@ -148,29 +221,58 @@ class ContinuousBatchingScheduler:
                 self._finish(req, RequestState.TIMEOUT, now)
                 self.pool.free(slot)
 
+    def _admit_handoffs(self, now: float):
+        """Insert received KV lanes into free slots (decode/unified
+        role): no prefill — the prompt's K/V arrives precomputed, only
+        the lane insert and the bind happen here."""
+        tr = self.tracer
+        while self.handoff_queue:
+            slot = self._alloc_slot()
+            if slot is None:
+                return
+            handoff, req = self.handoff_queue.popleft()
+            tr.async_end("request/handoff_queued", req.request_id,
+                         cat="serving")
+            tr.async_begin("request/decode", req.request_id, cat="serving",
+                           args={"slot": slot, "handoff": True})
+            with tr.span("kv_handoff_in", cat="serving",
+                         args={"request_id": req.request_id, "slot": slot,
+                               "kv_len": int(handoff.kv_len),
+                               "bytes": handoff.nbytes(),
+                               "source": handoff.source}):
+                self.pool.cache = self.engine.slot_insert_lane(
+                    self.pool.cache, slot, handoff.lane)
+            req.state = RequestState.RUNNING
+            self.metrics.record_handoff_in()
+            if self._should_finish(req, handoff.first_token):
+                self._finish(req, RequestState.FINISHED, self.clock())
+                self._release_slot(slot, req)
+            else:
+                self.pool.bind(slot, req, int(handoff.kv_len),
+                               int(handoff.first_token),
+                               req.sampling.temperature)
+
     def _admit(self, now: float):
         """Move queued requests into free slots, prefilling each prompt
         into its slot's cache lane (bounded per tick so admission bursts
-        cannot starve in-flight decode)."""
+        cannot starve in-flight decode). With a prefix cache, a prompt
+        sharing a cached prefix admits via lane-copy + suffix prefill —
+        only the unshared tail runs through the stack. A ``prefill``-role
+        scheduler extracts the lane into a KVHandoff for ``handoff_sink``
+        instead of binding for decode."""
         admitted = 0
         tr = self.tracer
-        while (self.queue and self.pool.free_count > 0 and
-               admitted < self.config.max_prefills_per_tick):
-            slot = self.pool.alloc()
+        while self.queue and admitted < self.config.max_prefills_per_tick:
+            slot = self._alloc_slot()
+            if slot is None:
+                return
             req = self.queue.popleft()
             tr.async_end("request/queued", req.request_id, cat="serving")
             tr.async_begin("request/decode", req.request_id, cat="serving",
                            args={"slot": slot})
             key = jax.random.fold_in(
                 jax.random.fold_in(self._base_key, self._tick_no), slot + 1)
-            with tr.span("prefill", cat="serving",
-                         args={"request_id": req.request_id, "slot": slot,
-                               "prompt_len": int(req.prompt.size)}):
-                # slot_prefill returns the first token as a python int —
-                # already device-synced, so the span duration is honest
-                self.pool.cache, first = self.engine.slot_prefill(
-                    self.pool.cache, slot, req.prompt,
-                    temperature=req.sampling.temperature, key=key)
+            first = self._prefill_into(slot, req, key)
             t_first = self.clock()
             req.state = RequestState.RUNNING
             req.first_token_time = t_first
@@ -178,11 +280,87 @@ class ContinuousBatchingScheduler:
             self._deliver(req, first)
             if self._should_finish(req, first):
                 self._finish(req, RequestState.FINISHED, t_first)
-                self.pool.free(slot)
+                self._release_slot(slot, req)
+            elif self.role == "prefill":
+                self._hand_off(slot, req, first)
             else:
                 self.pool.bind(slot, req, len(req.prompt), first,
                                req.sampling.temperature)
             admitted += 1
+
+    def _prefill_into(self, slot: int, req: Request, key) -> int:
+        """Full prefill, or the prefix-reuse fast path when the radix
+        cache holds a shared prefix. Returns the first sampled token."""
+        tr = self.tracer
+        hit = None
+        if self.prefix_cache is not None:
+            hit = self.prefix_cache.lookup(req.prompt)
+        if hit is not None:
+            from .fleet.prefix_cache import reuse_plan
+            offset, _suffix = reuse_plan(int(req.prompt.size), hit.matched,
+                                         self.config.max_model_len)
+            if offset > 0:
+                try:
+                    with tr.span("prefix_reuse", cat="serving",
+                                 args={"request_id": req.request_id,
+                                       "slot": slot, "src_slot": hit.slot,
+                                       "matched": hit.matched,
+                                       "reused": offset,
+                                       "suffix": int(req.prompt.size)
+                                       - offset}):
+                        self.pool.cache = self.engine.slot_copy_lane(
+                            self.pool.cache, hit.slot, slot)
+                        self.pool.cache, first = \
+                            self.engine.slot_suffix_prefill(
+                                self.pool.cache, slot, req.prompt[offset:],
+                                offset,
+                                temperature=req.sampling.temperature,
+                                key=key)
+                    return first
+                finally:
+                    self.prefix_cache.release(hit, used_tokens=offset)
+            self.prefix_cache.release(hit, used_tokens=0)
+        with tr.span("prefill", cat="serving",
+                     args={"request_id": req.request_id, "slot": slot,
+                           "prompt_len": int(req.prompt.size)}):
+            # slot_prefill returns the first token as a python int —
+            # already device-synced, so the span duration is honest
+            self.pool.cache, first = self.engine.slot_prefill(
+                self.pool.cache, slot, req.prompt,
+                temperature=req.sampling.temperature, key=key)
+        return first
+
+    def _hand_off(self, slot: int, req: Request, first: int):
+        """Prefill role: package the freshly prefilled lane as a
+        KVHandoff, release the slot (donating to the prefix cache —
+        prompt lanes are exactly what it wants), and deliver to the
+        sink. The Request object travels WITH the handoff: the decode
+        side keeps appending to the same token list and callbacks."""
+        from .fleet.handoff import KVHandoff
+        tr = self.tracer
+        with tr.span("kv_handoff_out", cat="serving",
+                     args={"request_id": req.request_id, "slot": slot,
+                           "kv_len": int(req.prompt.size)}):
+            lane = self.engine.slot_extract_lane(self.pool.cache, slot)
+        handoff = KVHandoff(
+            prompt=req.prompt, first_token=int(first),
+            kv_len=int(req.prompt.size), lane=lane,
+            temperature=req.sampling.temperature,
+            max_new_tokens=req.max_new_tokens,
+            eos_token_id=req.sampling.eos_token_id,
+            request_id=req.request_id)
+        tr.async_end("request/decode", req.request_id, cat="serving",
+                     args={"handed_off": True})
+        # the lane was only written, never bound: park it in the prefix
+        # cache (or free it) before the sink possibly re-enters us
+        self.pool.lengths[slot] = int(req.prompt.size)
+        self._release_slot(slot, req, donate_seq=req.prompt)
+        self.metrics.record_handoff_out()
+        if self.handoff_sink is None:
+            raise RuntimeError(
+                "role=prefill needs a handoff_sink (router wiring) — "
+                "a prefill replica has nowhere to send completed KV state")
+        self.handoff_sink(handoff, req)
 
     def _decode(self):
         """One fused decode step over all slots; retire on EOS/max."""
@@ -210,7 +388,7 @@ class ContinuousBatchingScheduler:
             self._deliver(req, tok)
             if self._should_finish(req, tok):
                 self._finish(req, RequestState.FINISHED, now)
-                self.pool.free(slot)
+                self._release_slot(slot, req)
 
     # -------------------------------------------------------------- helpers
     def _deliver(self, req: Request, tok: int):

@@ -228,6 +228,14 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
             host_lines.append(f"# TYPE {prefix}_mem_{name} gauge")
             host_lines.append(f"{prefix}_mem_{name} {fval}")
             continue
+        if tag.startswith("fleet/"):
+            # fleet router gauges (serving/metrics.py FleetMetrics):
+            # dstpu_fleet_ready_replicas / _failovers / _kv_handoffs /
+            # _prefix_cache_hit_rate as first-class alerting series
+            name = _prom(tag[len("fleet/"):])
+            host_lines.append(f"# TYPE {prefix}_fleet_{name} gauge")
+            host_lines.append(f"{prefix}_fleet_{name} {fval}")
+            continue
         lines.append(f'{prefix}_metric{{tag="{_prom(tag)}"}} {fval}')
     lines.extend(host_lines)
     aggs = span_aggregates(tracer)

@@ -52,7 +52,7 @@ __all__ = ["FlightRecorder", "TRIGGER_KINDS"]
 
 #: the trigger-rule vocabulary (bundle filenames carry the kind)
 TRIGGER_KINDS = ("slow_step", "recompile", "sentinel", "slo_burn",
-                 "preemption", "straggler", "manual")
+                 "preemption", "straggler", "failover", "manual")
 
 
 class FlightRecorder:

@@ -1,0 +1,565 @@
+"""Fleet serving tests (deepspeed_tpu/serving/fleet/).
+
+Contracts under test: routing and replica multiplexing are invisible in
+the tokens (router-served == direct generate(), bitwise, greedy); a
+prefix-cache hit admits via lane-copy + suffix prefill and SKIPS the
+full prefill (span + compiled-program evidence); ref-count pinning
+blocks LRU eviction of in-use cache entries; killing a replica
+mid-stream fails its requests over to a survivor which completes them
+with no duplicated or missing streamed tokens; a probe that TIMES OUT
+marks a replica NOT-ready and re-probes on jittered backoff (never
+hot-loops); disaggregated prefill/decode hands KV state across pools
+byte-for-byte; quantized KV slots stay within the greedy-parity bound at
+>= 2x capacity; fleet gauges ride the owner=/release lifecycle; and a
+disabled fleet/prefix/quant config allocates nothing.
+"""
+
+import http.server
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.serving import (FleetConfig, KVHandoff, QueueFull,
+                                   RadixPrefixCache, ReplicaHandle,
+                                   RequestState, SamplingParams,
+                                   ServingConfig, ServingEngine,
+                                   build_fleet)
+from deepspeed_tpu.serving.fleet.prefix_cache import reuse_plan
+from deepspeed_tpu.telemetry import get_tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+VOCAB = 96
+
+
+@pytest.fixture(scope="module")
+def engine():
+    model = GPT2Model(GPT2Config(vocab_size=VOCAB, n_positions=64, n_embd=64,
+                                 n_layer=2, n_head=4, pad_vocab_to_multiple=1,
+                                 dtype="float32"))
+    return deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+
+
+@pytest.fixture
+def tracer():
+    tr = get_tracer()
+    prev = tr.enabled
+    tr.clear()
+    tr.configure(enabled=True, buffer_size=4096)
+    yield tr
+    tr.clear()
+    tr.configure(enabled=prev)
+
+
+def _prompts(lengths, seed=0, vocab=VOCAB):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (t,), dtype=np.int32) for t in lengths]
+
+
+def _fleet_cfg(engine_cfg=None, **fleet):
+    cfg = {"num_slots": 2, "max_model_len": 64}
+    cfg.update(engine_cfg or {})
+    cfg["fleet"] = {"enabled": True, "heartbeat_timeout_s": 60.0, **fleet}
+    return cfg
+
+
+# ------------------------------------------------------------------ routing
+
+def test_router_greedy_parity_vs_direct(engine):
+    """Tokens served through the router over 2 replicas are bitwise what
+    a standalone generate() produces, for every request."""
+    router = build_fleet(engine, _fleet_cfg(replicas=2))
+    prompts = _prompts((5, 9, 3, 12, 7, 6))
+    fids = [router.submit(p, SamplingParams(max_new_tokens=6))
+            for p in prompts]
+    router.run_until_idle()
+    used = set()
+    for fid, p in zip(fids, prompts):
+        fr = router.result(fid)
+        assert fr.state == "finished"
+        ref = np.asarray(engine.generate(p[None], max_new_tokens=6))[0]
+        np.testing.assert_array_equal(fr.output_ids, ref)
+        used.add(fr.replica)
+    assert used == {"r0", "r1"}       # load actually spread
+    router.shutdown()
+    # gauge lifecycle: a shut-down fleet's gauges leave the counter space
+    assert not any(t.startswith("fleet/") for t in get_tracer().counters())
+
+
+def test_router_backpressure_and_disabled_fleet_allocates_nothing(engine):
+    """Fleet-wide QueueFull once no replica can take work and the router
+    pending queue is full; and a default (fleet-disabled) ServingEngine
+    builds none of the fleet machinery."""
+    router = build_fleet(engine, _fleet_cfg(
+        {"max_queue": 1, "max_prefills_per_tick": 1},
+        replicas=1, max_pending=1))
+    big = _prompts((4,) * 8, seed=3)
+    router.submit(big[0], SamplingParams(max_new_tokens=4))
+    accepted = 1
+    with pytest.raises(QueueFull):
+        for p in big[1:]:
+            router.submit(p, SamplingParams(max_new_tokens=4))
+            accepted += 1
+    assert accepted < 8
+    router.run_until_idle()
+    router.shutdown()
+
+    srv = ServingEngine(engine, {"num_slots": 1, "max_model_len": 32})
+    assert srv.scheduler.prefix_cache is None
+    assert not srv.scheduler.pool.quantized
+    assert not srv.scheduler.pool.cached
+    assert len(srv.scheduler.handoff_queue) == 0
+    assert srv.config.fleet.enabled is False
+    assert not any(t.startswith("fleet/") for t in get_tracer().counters())
+    srv.shutdown()
+
+
+# ------------------------------------------------------------ prefix cache
+
+def test_prefix_cache_hit_skips_prefill(engine, tracer):
+    """Span + compiled-program evidence that a shared prefix skips the
+    full prompt pass: the hit request emits prefix_reuse (with the
+    matched length) and NO prefill span, compiles a suffix program
+    instead of a new prefill bucket, and its tokens stay bitwise equal
+    to generate()."""
+    shared = _prompts((24,), seed=11)[0]
+    tail_a, tail_b = _prompts((4, 5), seed=12)
+    p_a = np.concatenate([shared, tail_a]).astype(np.int32)
+    p_b = np.concatenate([shared, tail_b]).astype(np.int32)
+    srv = ServingEngine(engine, {
+        "num_slots": 4, "max_model_len": 64,
+        "prefix_cache": {"enabled": True, "min_prefix_len": 8}})
+    pc = srv.scheduler.prefix_cache
+    ra = srv.submit(p_a, SamplingParams(max_new_tokens=4))
+    srv.run_until_idle()
+    assert pc.cached_slots == 1       # finished slot donated, not freed
+    prefill_spans_before = sum(
+        1 for s in tracer.spans() if s.name == "prefill")
+    rb = srv.submit(p_b, SamplingParams(max_new_tokens=4))
+    srv.run_until_idle()
+    assert pc.hits == 1 and pc.lookups >= 2
+    reuse = [s for s in tracer.spans() if s.name == "prefix_reuse"]
+    assert len(reuse) == 1
+    assert reuse[0].args["matched"] == 24
+    assert reuse[0].args["src_slot"] != reuse[0].args["slot"]
+    prefill_spans_after = sum(
+        1 for s in tracer.spans() if s.name == "prefill")
+    assert prefill_spans_after == prefill_spans_before  # NO full prefill
+    # compiled-program evidence: the hit ran the suffix program; the
+    # donated lane came from the only full prefill (bucket 32)
+    assert any(k[0] == "slot_suffix" for k in engine._slot_fns)
+    for rid, p in ((ra, p_a), (rb, p_b)):
+        ref = np.asarray(engine.generate(p[None], max_new_tokens=4))[0]
+        np.testing.assert_array_equal(srv.result(rid).output_ids, ref)
+    srv.shutdown()
+
+
+def test_prefix_cache_pinning_blocks_eviction(engine):
+    """A pinned (in-use) entry survives allocation pressure that evicts
+    every unpinned entry; unpinning makes it evictable again."""
+    srv = ServingEngine(engine, {
+        "num_slots": 2, "max_model_len": 64,
+        "prefix_cache": {"enabled": True, "min_prefix_len": 4}})
+    pc = srv.scheduler.prefix_cache
+    pa, pb = _prompts((8, 9), seed=21)
+    for p in (pa, pb):
+        srv.submit(p, SamplingParams(max_new_tokens=3))
+        srv.run_until_idle()
+    assert pc.cached_slots == 2       # both slots parked in the cache
+    pinned_entry = pc.lookup(np.concatenate([pa, [1, 2, 3]]))
+    assert pinned_entry is not None   # pinned from here on
+    # allocation pressure: both slots are cached, so admissions must
+    # evict — only the UNPINNED entry may go
+    rc = srv.submit(_prompts((10,), seed=22)[0],
+                    SamplingParams(max_new_tokens=3))
+    srv.run_until_idle()
+    assert srv.result(rc).state is RequestState.FINISHED
+    assert pinned_entry.entry.slot in pc.entries       # survived
+    assert pc.evictions >= 1
+    # direct check: with every entry pinned, evict_lru refuses
+    for slot in list(pc.entries):
+        pc.pin(slot)
+    assert pc.evict_lru() is None
+    for slot in list(pc.entries):
+        pc.unpin(slot)
+    pc.release(pinned_entry)
+    assert pc.evict_lru() is not None
+    srv.shutdown()
+
+
+def test_radix_tree_partial_match_and_reuse_plan():
+    """Pure trie mechanics: mid-edge divergence matches the shared
+    prefix, not the full entry; reuse_plan never lets the suffix bucket
+    cross max_len."""
+    pc = RadixPrefixCache(config=None)
+    pc.min_prefix_len = 2
+    ok, _ = pc.donate(0, [1, 2, 3, 4, 5, 6], 6)
+    assert ok
+    hit = pc.lookup([1, 2, 3, 9, 9, 9])    # diverges mid-edge at depth 3
+    assert hit is not None and hit.slot == 0 and hit.matched == 3
+    pc.release(hit, used_tokens=3)
+    # a second entry splitting the edge
+    ok, _ = pc.donate(1, [1, 2, 7, 7], 4)
+    assert ok
+    hit = pc.lookup([1, 2, 7, 7, 8])
+    assert hit.slot == 1 and hit.matched == 4
+    pc.release(hit)
+    # full-prompt match is capped at len-1 (one token must prefill)
+    hit = pc.lookup([1, 2, 3, 4, 5, 6])
+    assert hit.matched == 5
+    pc.release(hit)
+    # duplicate donation is rejected; the slot goes back to the pool
+    ok, _ = pc.donate(2, [1, 2, 3, 4, 5, 6], 6)
+    assert not ok
+    # reuse_plan: offset + pow2(suffix) always fits max_len
+    for prompt_len, matched, max_len in ((60, 33, 64), (64, 63, 64),
+                                         (50, 48, 64), (16, 8, 16)):
+        offset, suffix = reuse_plan(prompt_len, matched, max_len)
+        assert offset + suffix == prompt_len
+        bucket = 1 << max(0, (suffix - 1)).bit_length()
+        assert offset + min(bucket, max_len) <= max_len
+
+
+# ----------------------------------------------------------------- failover
+
+def test_kill_replica_mid_stream_completes_on_survivor(engine):
+    """Mid-stream replica death: in-flight requests re-enqueue onto the
+    survivor, finish with bitwise-correct tokens, and the streaming
+    callback delivers every position exactly once (greedy replay is
+    deduplicated)."""
+    router = build_fleet(engine, _fleet_cfg(replicas=2))
+    prompts = _prompts((6, 8, 5, 7), seed=31)
+    streamed = {i: [] for i in range(len(prompts))}
+    fids = [router.submit(p, SamplingParams(max_new_tokens=8),
+                          on_token=lambda r, t, i=i: streamed[i].append(t))
+            for i, p in enumerate(prompts)]
+    for _ in range(3):                 # requests mid-stream on both
+        router.step()
+    victim = next(router.result(f).replica for f in fids
+                  if router.result(f).replica is not None)
+    router.kill(victim)
+    router.run_until_idle()
+    assert router.metrics.failovers == 1
+    assert router.metrics.requeued >= 1
+    for i, fid in enumerate(fids):
+        fr = router.result(fid)
+        assert fr.state == "finished", fr.failed_reason
+        ref = np.asarray(
+            engine.generate(prompts[i][None], max_new_tokens=8))[0]
+        np.testing.assert_array_equal(fr.output_ids, ref)
+        assert streamed[i] == list(ref[len(prompts[i]):])   # no dup/gap
+    router.shutdown()
+
+
+def test_preemption_latch_evicts_replica(engine):
+    """The resilience preemption latch is a fleet eviction signal: the
+    preempted replica drains (running work completes), its queued work
+    re-enqueues, and /healthz-equivalent readiness drops."""
+    router = build_fleet(engine, _fleet_cfg(
+        {"max_prefills_per_tick": 1, "num_slots": 1},
+        replicas=2))
+    prompts = _prompts((5, 6, 7, 8), seed=41)
+    fids = [router.submit(p, SamplingParams(max_new_tokens=6))
+            for p in prompts]
+    router.step()
+    victim = next(router.result(f).replica for f in fids
+                  if router.result(f).replica is not None)
+    veng = router.replicas[victim].engine
+    # simulate SIGTERM delivery on that replica only
+    from deepspeed_tpu.resilience.preemption import PreemptionHandler
+    veng._preemption = PreemptionHandler.install()
+    veng._preemption.signal()
+    router.run_until_idle()
+    assert router.replicas[victim].failed
+    assert router.metrics.failovers == 1
+    for fid, p in zip(fids, prompts):
+        fr = router.result(fid)
+        assert fr.state == "finished", fr.failed_reason
+        ref = np.asarray(engine.generate(p[None], max_new_tokens=6))[0]
+        np.testing.assert_array_equal(fr.output_ids, ref)
+    router.shutdown()
+
+
+# ----------------------------------------------------- probe/backoff (fix)
+
+_HANG_RELEASE = threading.Event()
+
+
+class _HangingHealthz(http.server.BaseHTTPRequestHandler):
+    """A replica that accepted the TCP connection and then never
+    answers — the stale-readiness window the router must treat as
+    NOT-ready."""
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        _HANG_RELEASE.wait(timeout=30)
+
+
+def test_probe_timeout_marks_not_ready_with_jittered_backoff():
+    # Threading server: the hung handler must not wedge shutdown()
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                            _HangingHealthz)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        now = [0.0]
+        cfg = FleetConfig.from_dict({
+            "enabled": True, "replicas": 1, "probe_timeout_s": 0.2,
+            "probe_backoff_s": 0.5, "probe_backoff_max_s": 4.0})
+        r = ReplicaHandle(
+            "hung", url=f"http://127.0.0.1:{httpd.server_address[1]}",
+            config=cfg, clock=lambda: now[0])
+        t0 = time.perf_counter()
+        assert r.probe() is False          # timeout => NOT ready
+        assert time.perf_counter() - t0 < 2.0   # the timeout bit, fast
+        assert "probe failed" in r.last_detail
+        # jittered backoff, not hot-looping: the next probe is scheduled
+        # strictly later, and within [0.5x, 1.5x] of the base delay
+        assert 0.25 <= r._next_probe - now[0] <= 0.75
+        probes = r.probes
+        assert r.probe() is False          # before the backoff: cached
+        assert r.probes == probes          # no network call made
+        # walk the schedule: delays double (with jitter) up to the cap
+        delays = []
+        for _ in range(5):
+            now[0] = r._next_probe
+            r.probe()
+            delays.append(r._next_probe - now[0])
+        assert delays[1] <= 2 * 1.5 and delays[-1] <= 4.0 * 1.5
+        assert delays[-1] >= delays[0]     # growing, capped
+    finally:
+        _HANG_RELEASE.set()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_probe_503_and_recovery(engine):
+    """A draining replica's real /healthz 503 drops readiness over HTTP;
+    readiness returns when probed after the condition clears."""
+    srv = ServingEngine(engine, {"num_slots": 1, "max_model_len": 32,
+                                 "statusz": {"enabled": True, "port": 0}})
+    cfg = FleetConfig.from_dict({"enabled": True, "replicas": 1,
+                                 "probe_interval_s": 0.0001,
+                                 "probe_backoff_s": 0.0001})
+    r = ReplicaHandle("r", engine=srv, config=cfg)
+    assert r.url == srv.statusz.url    # in-process + HTTP probing
+    assert r.probe() is True
+    srv._draining = True               # -> /healthz 503
+    time.sleep(0.001)
+    assert r.probe() is False
+    assert "healthz 503" in r.last_detail
+    srv._draining = False
+    time.sleep(0.001)
+    assert r.probe() is True
+    srv.shutdown()
+
+
+# ------------------------------------------------------------ handoff/roles
+
+def test_disaggregated_prefill_decode_parity(engine):
+    """1 prefill + 1 decode replica: every request's KV state crosses
+    pools through a KVHandoff and the tokens stay bitwise-parity with
+    generate(); the decode replica never runs a prompt prefill."""
+    router = build_fleet(engine, _fleet_cfg(
+        {"num_slots": 3}, replicas=2,
+        prefill_replicas=1, decode_replicas=1))
+    prompts = _prompts((5, 9, 12, 7), seed=51)
+    fids = [router.submit(p, SamplingParams(max_new_tokens=6))
+            for p in prompts]
+    router.run_until_idle()
+    assert router.metrics.handoffs == len(prompts)
+    pre = router.replicas["r0"].engine
+    dec = router.replicas["r1"].engine
+    assert pre.config.role == "prefill" and dec.config.role == "decode"
+    assert pre.metrics.handoffs_out == len(prompts)
+    assert dec.metrics.handoffs_in == len(prompts)
+    assert pre.metrics.completed == 0 and dec.metrics.completed == len(
+        prompts)
+    for fid, p in zip(fids, prompts):
+        fr = router.result(fid)
+        assert fr.state == "finished" and fr.replica == "r1"
+        ref = np.asarray(engine.generate(p[None], max_new_tokens=6))[0]
+        np.testing.assert_array_equal(fr.output_ids, ref)
+    router.shutdown()
+
+
+def test_kv_handoff_bytes_round_trip(engine):
+    """The RDMA-shaped framing reconstructs the lane bit-exactly, and a
+    directly-submitted handoff decodes to the same tokens."""
+    pool = engine.init_slot_pool(2, 32)
+    prompt = _prompts((10,), seed=61)[0]
+    pool, first = engine.slot_prefill(pool, 0, prompt)
+    lane = engine.slot_extract_lane(pool, 0)
+    h = KVHandoff(prompt=prompt, first_token=first, kv_len=10, lane=lane,
+                  max_new_tokens=5, source="r0")
+    blob = h.to_bytes()
+    h2 = KVHandoff.from_bytes(blob)
+    assert h2.first_token == first and h2.kv_len == 10
+    assert h2.source == "r0" and h2.nbytes() == h.nbytes()
+    np.testing.assert_array_equal(h2.prompt, prompt)
+    for a, b in zip(np.asarray(list(h.lane.values())),
+                    np.asarray(list(h2.lane.values()))):
+        np.testing.assert_array_equal(a, b)
+    # a decode-only engine continues from the deserialized state
+    srv = ServingEngine(engine, {"num_slots": 2, "max_model_len": 32,
+                                 "role": "decode"})
+    seen = []
+    rid = srv.submit_handoff(h2, on_token=lambda r, t: seen.append(t))
+    srv.run_until_idle()
+    req = srv.result(rid)
+    assert req.state is RequestState.FINISHED
+    ref = np.asarray(engine.generate(prompt[None], max_new_tokens=5))[0]
+    np.testing.assert_array_equal(req.output_ids, ref)
+    assert seen == req.tokens[:len(seen)] and len(seen) >= 1
+    srv.shutdown()
+
+
+# ------------------------------------------------------------- quantized KV
+
+def test_quantized_kv_parity_bound_and_capacity(engine):
+    """int8 slots: >= 2x slots per HBM byte, greedy tokens within the
+    parity bound (bitwise for this model — the bound the benchmark
+    enforces fleet-wide is 0.9)."""
+    from deepspeed_tpu.inference.kv_quant import pool_nbytes
+    fp = engine.init_slot_pool(2, 64)
+    q = engine.init_slot_pool(2, 64, quantize=True)
+    assert pool_nbytes(fp) / pool_nbytes(q) >= 2.0
+    srv = ServingEngine(engine, {"num_slots": 2, "max_model_len": 64,
+                                 "kv_quant": {"enabled": True}})
+    assert srv.scheduler.pool.quantized
+    prompts = _prompts((6, 9, 5), seed=71)
+    rids = [srv.submit(p, SamplingParams(max_new_tokens=8))
+            for p in prompts]
+    srv.run_until_idle()
+    total = matches = 0
+    for rid, p in zip(rids, prompts):
+        req = srv.result(rid)
+        assert req.state is RequestState.FINISHED
+        ref = np.asarray(engine.generate(p[None], max_new_tokens=8))[0]
+        gen = ref[len(p):]
+        matches += sum(int(a == b) for a, b in zip(req.tokens, gen))
+        total += len(gen)
+    assert matches / total >= 0.9, f"agreement {matches}/{total}"
+    # compile-once holds for the quantized decode flavor too
+    assert srv.decode_executables() == 1
+    srv.shutdown()
+
+
+def test_quantized_roundtrip_is_column_stable(engine):
+    """Re-quantizing an untouched column is exact: pushing a pool
+    through N decode steps only ever quantizes each column once."""
+    from deepspeed_tpu.inference.kv_quant import (dequantize_pool,
+                                                  quantize_pool)
+    import jax.numpy as jnp
+    rng = np.random.default_rng(5)
+    x = {"k": jnp.asarray(rng.normal(size=(2, 2, 2, 8, 4)), jnp.float32),
+         "v": jnp.asarray(rng.normal(size=(2, 2, 2, 8, 4)), jnp.float32)}
+    q1 = quantize_pool(x)
+    q2 = quantize_pool(dequantize_pool(q1))
+    for a, b in ((q1.q["k"], q2.q["k"]), (q1.scales["v"], q2.scales["v"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------- gauges / telemetry
+
+def test_fleet_gauges_dedicated_prom_series_and_lifecycle(tracer):
+    """dstpu_fleet_* are first-class Prometheus series; two co-resident
+    fleets keep last-writer-wins ownership and close() retracts."""
+    from deepspeed_tpu.serving.metrics import FleetMetrics
+    from deepspeed_tpu.telemetry import prometheus_dump
+    m1, m2 = FleetMetrics(tracer=tracer), FleetMetrics(tracer=tracer)
+    m1.failovers = 2
+    m1.update(replicas=3, ready=2, pending=1, prefix_hits=3,
+              prefix_lookups=4)
+    dump = prometheus_dump(tracer)
+    assert "dstpu_fleet_ready_replicas 2.0" in dump
+    assert "dstpu_fleet_failovers 2.0" in dump
+    assert "dstpu_fleet_prefix_cache_hit_rate 0.75" in dump
+    assert 'tag="fleet' not in dump            # dedicated, not generic
+    m2.update(replicas=1, ready=1, pending=0)  # last writer wins
+    assert tracer.counter_value("fleet/ready_replicas") == 1.0
+    m2.close()                                 # m1's mirrors stay owned
+    m1.update(replicas=3, ready=3, pending=0)
+    assert tracer.counter_value("fleet/ready_replicas") == 3.0
+    m1.close()
+    assert not any(t.startswith("fleet/") for t in tracer.counters())
+
+
+def test_router_statusz_fleet_section_and_top_renders(engine):
+    """The router's own /statusz carries the fleet section ds_tpu_top's
+    fleet view polls; ds_tpu_top renders it live and degrades on a
+    pre-fleet snapshot."""
+    import urllib.request
+    router = build_fleet(engine, _fleet_cfg(
+        {"statusz": {"enabled": True, "port": 0}},
+        replicas=2, statusz={"enabled": True, "port": 0}))
+    router.submit(_prompts((6,), seed=81)[0],
+                  SamplingParams(max_new_tokens=3))
+    router.run_until_idle()
+    with urllib.request.urlopen(
+            router.statusz.url + "/statusz?format=json", timeout=5) as r:
+        doc = json.load(r)
+    fleet = doc["sections"]["fleet"]
+    assert fleet["replicas"] == 2 and fleet["ready"] == 2
+    assert set(fleet["replica_table"]) == {"r0", "r1"}
+    assert all(row["url"] for row in fleet["replica_table"].values())
+    top = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_tpu_top"),
+         "--once", "--url", router.statusz.url],
+        capture_output=True, text=True, timeout=60)
+    assert top.returncode == 0, top.stderr
+    assert "fleet" in top.stdout and "r0" in top.stdout
+    assert "ready" in top.stdout
+    router.shutdown()
+
+
+def test_ds_tpu_top_degrades_on_single_replica_snapshot(tmp_path):
+    """PR 5/7-style compat: a pre-fleet snapshot renders with no fleet
+    section and no crash."""
+    snap = {"counters": {"serving/queue_depth": 1.0,
+                         "serving/ttft_ms_p50": 12.0},
+            "goodput": None}
+    path = tmp_path / "old.json"
+    path.write_text(json.dumps(snap))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_tpu_top"),
+         "--once", "--snapshot", str(path)],
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode == 0, out.stderr
+    assert "fleet" not in out.stdout
+    assert "queue depth" in out.stdout
+
+
+# ---------------------------------------------------------------- config
+
+def test_fleet_config_validation():
+    with pytest.raises(Exception):
+        FleetConfig.from_dict({"replicas": 0})
+    with pytest.raises(Exception):
+        FleetConfig.from_dict({"replicas": 2, "prefill_replicas": 2})
+    with pytest.raises(Exception):
+        FleetConfig.from_dict({"replicas": 3, "prefill_replicas": 1,
+                               "decode_replicas": 1})
+    cfg = FleetConfig.from_dict({"replicas": 3, "prefill_replicas": 1,
+                                 "decode_replicas": 2})
+    assert cfg.roles() == ["prefill", "decode", "decode"]
+    assert FleetConfig.from_dict({"replicas": 2}).roles() == \
+        ["unified", "unified"]
+    scfg = ServingConfig.from_dict({
+        "prefix_cache": {"enabled": True, "min_prefix_len": 4},
+        "kv_quant": {"enabled": True},
+        "role": "prefill",
+        "fleet": {"enabled": True, "replicas": 2}})
+    assert scfg.prefix_cache.enabled and scfg.kv_quant.enabled
+    assert scfg.fleet.replicas == 2
+    with pytest.raises(Exception):
+        ServingConfig.from_dict({"role": "proxy"})
