@@ -24,7 +24,12 @@ benchmarks/serving_fleet.json with three asserted experiments:
    prefix cache on vs off: hit rate > 0 and measurably lower TTFT;
 3. **quantized KV capacity** — int8 slot pool admits >= 2x the
    concurrent slots of fp32 at matched HBM budget, with greedy-decode
-   token agreement above the tested bound.
+   token agreement above the tested bound;
+4. **critical path** — a disaggregated (1 prefill + 1 decode) fleet with
+   distributed tracing: the per-stage critical-path table (route / queue
+   / prefill / handoff serialize+transfer+insert / decode / stream)
+   lands in serving_fleet.json and each request's stage sum matches its
+   independently measured e2e within 5% at the p50.
 """
 
 import argparse
@@ -311,6 +316,75 @@ def _fleet_quant(engine, args):
     return out
 
 
+def _fleet_disttrace(engine, args):
+    """Experiment 4: disaggregated fleet with tracing armed — per-stage
+    critical-path table; per-request stage sums match independently
+    measured e2e within 5% at p50."""
+    from deepspeed_tpu.serving import SamplingParams, build_fleet
+    rng = np.random.default_rng(args.seed + 3)
+    prompts = [rng.integers(0, 256, (args.prompt_len,), dtype=np.int32)
+               for _ in range(args.requests)]
+    arrivals = np.cumsum(
+        rng.exponential(1.0 / args.rate, args.requests)).tolist()
+    router = build_fleet(engine, {
+        "num_slots": args.slots,
+        "max_model_len": args.prompt_len + args.max_new,
+        "max_queue": args.requests, "max_prefills_per_tick": 2,
+        "fleet": {"enabled": True, "replicas": 2, "prefill_replicas": 1,
+                  "decode_replicas": 1, "heartbeat_timeout_s": 60.0}})
+    warm = router.submit(prompts[0], SamplingParams(max_new_tokens=2))
+    router.run_until_idle()
+    assert router.result(warm).done
+    # independent e2e: wall clock from submit to observed completion,
+    # measured OUTSIDE the trace-context marks it is compared against
+    t_submit, t_done = {}, {}
+    t0 = time.perf_counter()
+    pending = list(zip(arrivals, prompts))
+    fids = []
+    while pending or any(f not in t_done for f in fids):
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            _, p = pending.pop(0)
+            fid = router.submit(p, SamplingParams(max_new_tokens=args.max_new))
+            t_submit[fid] = time.perf_counter()
+            fids.append(fid)
+        router.step()
+        for fid in fids:
+            if fid not in t_done and router.result(fid).done:
+                t_done[fid] = time.perf_counter()
+        if not pending and all(router.result(f).done for f in fids):
+            break
+    rel_errs, paths = [], []
+    for fid in fids:
+        fr = router.result(fid)
+        assert fr.state == "finished", fr.state
+        ctx = fr.trace
+        path = ctx.critical_path()
+        stage_sum = sum(path.values())
+        e2e = (t_done[fid] - t_submit[fid]) * 1e3
+        rel_errs.append(abs(stage_sum - e2e) / e2e)
+        paths.append(path)
+    summary = router.aggregator.critical_path_summary()
+    router.shutdown()
+    rel_err_p50 = _pctl(rel_errs, 0.50)
+    out = {
+        "replicas": "1 prefill + 1 decode",
+        "requests": len(fids),
+        "e2e_ms_p50": summary["e2e_ms_p50"],
+        "e2e_ms_mean": summary["e2e_ms_mean"],
+        "stage_sum_ms_mean": summary["stage_sum_ms_mean"],
+        "stage_table": {name: rec for name, rec
+                        in summary["stages"].items()},
+        "stage_sum_vs_measured_e2e_rel_err_p50": round(rel_err_p50, 4),
+    }
+    assert rel_err_p50 < 0.05, \
+        f"critical-path stages do not sum to measured e2e: {out}"
+    mean_err = abs(summary["stage_sum_ms_mean"] - summary["e2e_ms_mean"])
+    assert mean_err <= 0.05 * max(summary["e2e_ms_mean"], 1e-9), \
+        f"aggregated stage means diverge from mean e2e: {out}"
+    return out
+
+
 def main_fleet(args):
     engine = _tiny_engine()
     report = {
@@ -322,12 +396,16 @@ def main_fleet(args):
         "resilience_kill_mid_run": _fleet_resilience(engine, args),
         "prefix_reuse": _fleet_prefix(engine, args),
         "quantized_kv": _fleet_quant(engine, args),
+        "critical_path": _fleet_disttrace(engine, args),
         "note": ("resilience: 3 replicas, busiest killed after half the "
                  "submissions — accepted requests re-enqueue onto "
                  "survivors and greedy replay keeps tokens identical; "
                  "prefix_reuse: N requests sharing a system prompt, radix "
                  "cache on vs off; quantized_kv: int8+per-column-scale "
-                 "pool vs fp32 at matched HBM bytes"),
+                 "pool vs fp32 at matched HBM bytes; critical_path: "
+                 "1 prefill + 1 decode replica with distributed tracing — "
+                 "per-stage p50 table, per-request stage sums vs "
+                 "independently measured e2e within 5% at p50"),
     }
     path = os.path.join(REPO, "benchmarks", "serving_fleet.json")
     with open(path, "w") as f:

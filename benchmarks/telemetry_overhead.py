@@ -11,6 +11,14 @@ benchmarks/telemetry_overhead.json with median step times and the
 relative overheads. Asserts every enabled mode costs < 2% of step time
 (the low-overhead contract of deepspeed_tpu/telemetry/).
 
+A sixth interleaved comparison, "dt", covers the serving plane: two
+identical 2-replica fleets run the same request rounds, one with every
+instrument dark, one with distributed tracing + fleet aggregation armed
+(span stamping with trace args, per-request critical-path marks, the
+router aggregator folding completed paths into dstpu_fleet_path_*
+gauges, flight recorder recording every tick) — and asserts the armed
+fleet's median decode tick stays < 2% slower.
+
 Both loops block on the loss every step, so the comparison isolates the
 tracer's span machinery from the device sync it performs by design
 (`sync_spans` would otherwise make the "on" loop LOOK slower merely by
@@ -114,6 +122,86 @@ def run_block(engine, n_steps: int, collect=None):
             collect.append(dt)
 
 
+def _dt_mode():
+    """The "dt" comparison: identical serving fleets, observability dark
+    vs distributed tracing + aggregation + flight recorder armed. The
+    measured unit is the fused decode TICK (median over interleaved
+    rounds), the serving analogue of the training modes' step — at a
+    realistic tick size, like the training loop's ~20ms step, so the
+    per-tick fixed cost of the armed plane is compared against real
+    work, not against an artificially tiny model. Returns
+    (off_ms_p50, dt_ms_p50, overhead_pct, requests)."""
+    import tempfile
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.serving import SamplingParams, build_fleet
+    from deepspeed_tpu.telemetry import configure_ledger, get_tracer
+
+    rounds = int(os.environ.get("TEL_DT_ROUNDS", 5))
+    per_round = int(os.environ.get("TEL_DT_REQUESTS", 8))
+    max_new = int(os.environ.get("TEL_DT_NEW", 48))
+    model = GPT2Model(GPT2Config(
+        vocab_size=256, n_positions=96,
+        n_embd=int(os.environ.get("TEL_DT_EMBD", 256)),
+        n_layer=int(os.environ.get("TEL_DT_LAYERS", 4)),
+        n_head=4, pad_vocab_to_multiple=1, dtype="float32"))
+    engine = ds.init_inference(model, config={"dtype": "float32"})
+    rec_dir = tempfile.mkdtemp(prefix="dstpu_overhead_dt_")
+    base = {"num_slots": per_round, "max_model_len": 96,
+            "max_queue": per_round + 1,
+            "max_prefills_per_tick": per_round}
+    routers = {
+        "off": build_fleet(engine, {
+            **base, "telemetry": {"enabled": False},
+            "fleet": {"enabled": True, "replicas": 2, "disttrace": False,
+                      "heartbeat_timeout_s": 600.0}}),
+        "dt": build_fleet(engine, {
+            **base, "telemetry": {"enabled": True, "mfu": False},
+            "flight_recorder": {"enabled": True, "dir": rec_dir,
+                                "slow_step_factor": 1000.0},
+            "fleet": {"enabled": True, "replicas": 2, "disttrace": True,
+                      "heartbeat_timeout_s": 600.0}}),
+    }
+    modes = {"off": (False, False), "dt": (True, True)}
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 256, (12,), dtype=np.int32)
+               for _ in range(per_round)]
+
+    def run_round(router, ticks):
+        fids = [router.submit(p, SamplingParams(max_new_tokens=max_new))
+                for p in prompts]
+        while True:
+            t0 = time.perf_counter()
+            n = router.step()
+            if ticks is not None:
+                ticks.append(time.perf_counter() - t0)
+            if not n:
+                break
+        assert all(router.result(f).state == "finished" for f in fids)
+
+    ticks = {name: [] for name in routers}
+    for name, router in routers.items():          # compile + warmup
+        _apply_mode(*modes[name])
+        run_round(router, None)
+    for _ in range(rounds):                        # interleaved rounds
+        for name, router in routers.items():
+            _apply_mode(*modes[name])
+            run_round(router, ticks[name])
+    _apply_mode(True, True)
+    agg = routers["dt"].aggregator
+    assert agg is not None and agg.observed >= rounds * per_round
+    assert routers["off"].aggregator is None      # dark fleet built none
+    assert agg.critical_path_summary()["stages"]["prefill"]["n"] > 0
+    for router in routers.values():
+        router.shutdown()
+    configure_ledger(enabled=False)
+    get_tracer().configure(enabled=False)
+    off_ms = statistics.median(ticks["off"]) * 1e3
+    dt_ms = statistics.median(ticks["dt"]) * 1e3
+    return off_ms, dt_ms, 100.0 * (dt_ms - off_ms) / off_ms, \
+        rounds * per_round
+
+
 def main():
     import tempfile
     tracer = get_tracer()
@@ -167,6 +255,10 @@ def main():
     for engine in engines.values():
         engine.close()
 
+    # dt mode: the serving plane with distributed tracing + aggregation
+    # armed vs dark, interleaved the same way
+    dt_off_ms, dt_ms, overhead_dt_pct, dt_requests = _dt_mode()
+
     off_ms = statistics.median(t_off) * 1e3
     on_ms = statistics.median(t_on) * 1e3
     full_ms = statistics.median(t_full) * 1e3
@@ -192,6 +284,10 @@ def main():
         "overhead_full_pct": round(overhead_full_pct, 3),
         "overhead_recorder_pct": round(overhead_rec_pct, 3),
         "overhead_compile_plane_pct": round(overhead_cp_pct, 3),
+        "serving_tick_ms_dark_p50": round(dt_off_ms, 4),
+        "serving_tick_ms_disttrace_p50": round(dt_ms, 4),
+        "overhead_disttrace_pct": round(overhead_dt_pct, 3),
+        "disttrace_requests": dt_requests,
         "threshold_pct": THRESHOLD_PCT,
         "spans_recorded": len(tracer.spans()),
         "devices": jax.device_count(),
@@ -215,10 +311,15 @@ def main():
         f"total observability overhead with the compile plane "
         f"(fingerprints + HBM ledger + overlap analyzer) "
         f"{overhead_cp_pct:.2f}% exceeds the {THRESHOLD_PCT}% budget")
+    assert overhead_dt_pct < THRESHOLD_PCT, (
+        f"serving observability overhead with distributed tracing + "
+        f"fleet aggregation armed {overhead_dt_pct:.2f}% exceeds the "
+        f"{THRESHOLD_PCT}% budget")
     print(f"OK: tracer-on overhead {overhead_pct:.2f}%, + goodput "
           f"ledger + statusz server {overhead_full_pct:.2f}%, + flight "
           f"recorder {overhead_rec_pct:.2f}%, + compile plane "
-          f"{overhead_cp_pct:.2f}% — all < {THRESHOLD_PCT}%")
+          f"{overhead_cp_pct:.2f}%, serving fleet w/ distributed "
+          f"tracing {overhead_dt_pct:.2f}% — all < {THRESHOLD_PCT}%")
 
 
 if __name__ == "__main__":

@@ -31,12 +31,15 @@ class SentinelError(RuntimeError):
 
 class TrainingSentinel:
 
-    def __init__(self, config, tracer=None, recorder=None):
+    def __init__(self, config, tracer=None, recorder=None, owner=None):
         self.policy = config.sentinel_policy
         self.patience = int(config.sentinel_patience)
         self.grad_norm_threshold = float(config.sentinel_grad_norm_threshold)
         self.max_rollbacks = int(config.max_rollbacks)
         self.tracer = tracer
+        # gauge ownership: the engine passes itself so its close()
+        # retracts the sentinel's resilience/* gauges with the rest
+        self._owner = owner if owner is not None else self
         # flight recorder (telemetry/flight_recorder.py): a bad step is a
         # postmortem trigger — capture the evidence before the rollback
         # path rewrites the state
@@ -74,7 +77,8 @@ class TrainingSentinel:
             f"policy={self.policy})")
         if self.tracer is not None:
             self.tracer.set_counter("resilience/sentinel_bad_steps",
-                                    float(self.bad_steps), step)
+                                    float(self.bad_steps), step,
+                                    owner=self._owner)
             self.tracer.instant("sentinel_bad_step", cat="resilience",
                                 args={"reason": reason, "step": step})
         if self.recorder is not None:
@@ -91,6 +95,7 @@ class TrainingSentinel:
                     f"{self.max_rollbacks}); aborting")
             if self.tracer is not None:
                 self.tracer.set_counter("resilience/rollbacks",
-                                        float(self.rollbacks), step)
+                                        float(self.rollbacks), step,
+                                        owner=self._owner)
             return "rollback"
         return self.policy if self.policy in ("warn", "skip") else "warn"

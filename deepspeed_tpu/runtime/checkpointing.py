@@ -56,16 +56,18 @@ def _rcfg(config) -> ResilienceConfig:
     return r if r is not None else ResilienceConfig()
 
 
-def _bump(tracer, tag: str, n: int = 1):
-    """Increment a monotonic telemetry counter (gauge holds the total)."""
+def _bump(tracer, tag: str, n: int = 1, owner=None):
+    """Increment a monotonic telemetry counter (gauge holds the total).
+    ``owner`` ties the tag to the engine so its close() retracts it."""
     if tracer is None:
         return
     cur = tracer.counters().get(tag)
     val = (cur[0] if isinstance(cur, tuple) else cur or 0.0) + n
-    tracer.set_counter(tag, float(val))
+    tracer.set_counter(tag, float(val), owner=owner)
 
 
-def _retrying(ckpt_engine, rcfg: ResilienceConfig, tracer, attempts: int):
+def _retrying(ckpt_engine, rcfg: ResilienceConfig, tracer, attempts: int,
+              owner=None):
     """Engine save/load calls wrapped in jittered-backoff retry; each retry
     bumps ``resilience/ckpt_retries``."""
 
@@ -74,7 +76,8 @@ def _retrying(ckpt_engine, rcfg: ResilienceConfig, tracer, attempts: int):
             fn, *args, attempts=attempts,
             base_delay=rcfg.retry_backoff_s,
             max_delay=rcfg.retry_max_backoff_s,
-            on_retry=lambda i, e: _bump(tracer, "resilience/ckpt_retries"),
+            on_retry=lambda i, e: _bump(tracer, "resilience/ckpt_retries",
+                                        owner=owner),
             label=label)
 
     return call
@@ -135,7 +138,8 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     rcfg = _rcfg(engine._config)
     tracer = getattr(engine, "tracer", None)
     ckpt_engine = get_checkpoint_engine(engine._config)
-    _save = _retrying(ckpt_engine, rcfg, tracer, rcfg.save_retries)
+    _save = _retrying(ckpt_engine, rcfg, tracer, rcfg.save_retries,
+                      owner=engine)
     ckpt_dir = os.path.join(save_dir, str(tag))
     is_writer = jax.process_index() == 0
     span = tracer.span("save_checkpoint", cat="resilience",
@@ -306,7 +310,7 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
                 continue
             if i > 0:
                 # rolled back past the (corrupt) latest to an older tag
-                _bump(tracer, "resilience/rollbacks")
+                _bump(tracer, "resilience/rollbacks", owner=engine)
                 log_dist(
                     f"checkpoint fallback: tag '{candidates[0]}' invalid; "
                     f"restored older valid tag '{cand}'", ranks=[0])
@@ -326,7 +330,8 @@ def _load_tag(engine, ckpt_dir, rcfg, tracer, load_optimizer_states,
     ckpt_engine = _engine_for_layout(engine._config,
                                      os.path.join(ckpt_dir,
                                                   "model_states.msgpack"))
-    _load = _retrying(ckpt_engine, rcfg, tracer, rcfg.load_retries)
+    _load = _retrying(ckpt_engine, rcfg, tracer, rcfg.load_retries,
+                      owner=engine)
     offload = getattr(engine, "_offload", None)
     need_optim = (load_optimizer_states and not load_module_only and
                   (engine.opt_state is not None or offload is not None))

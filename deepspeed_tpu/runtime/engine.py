@@ -479,7 +479,8 @@ class DeepSpeedEngine:
         if rcfg.sentinel_policy != "off":
             from ..resilience.sentinel import TrainingSentinel
             self._sentinel = TrainingSentinel(rcfg, tracer=self.tracer,
-                                              recorder=self._recorder)
+                                              recorder=self._recorder,
+                                              owner=self)
         self._preemption = None
         if rcfg.handle_signals:
             from ..resilience.preemption import PreemptionHandler
@@ -1806,6 +1807,8 @@ class DeepSpeedEngine:
             self.statusz.close()
         if self.monitor is not None:
             self.monitor.close()
+        if self._recorder is not None:
+            self._recorder.close()
         self.tracer.release_counters(self)
 
     def _health_check(self):

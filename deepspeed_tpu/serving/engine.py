@@ -38,7 +38,8 @@ class ServingEngine:
     def __init__(self, engine, config: Union[ServingConfig, dict, None] = None,
                  clock: Callable[[], float] = time.monotonic, seed: int = 0,
                  handoff_sink: Optional[Callable] = None,
-                 id_start: int = 0, id_stride: int = 1):
+                 id_start: int = 0, id_stride: int = 1,
+                 replica_name: Optional[str] = None):
         if config is None:
             config = ServingConfig()
         elif isinstance(config, dict):
@@ -47,6 +48,10 @@ class ServingEngine:
             config.validate()
         self.config = config
         self.engine = engine
+        # fleet lane identity: the name build_fleet gave this replica (or
+        # "serving" standalone) — stamped on every span so the fleet
+        # aggregator can split the shared span ring into per-replica lanes
+        self.replica = replica_name or "serving"
         # fleet id spacing: replica i of N uses ids i, i+N, i+2N, ... so a
         # request's async trace spans stay unique when it migrates between
         # co-resident replicas (handoff, failover)
@@ -82,6 +87,9 @@ class ServingEngine:
             self._recorder = FlightRecorder(config.flight_recorder,
                                             tracer=self.tracer)
             self._recorder.add_provider("serving", self._statusz_section)
+            # bundles embed the trace ids in flight on THIS replica, so
+            # the router can correlate same-trace bundles across members
+            self._recorder.set_trace_provider(self._traces_in_flight)
         # compile/memory plane (telemetry/compileplane.py): compile ledger
         # over the serving programs — each prefill bucket, the fused
         # decode step, pool init — plus the HBM role ledger attributing
@@ -116,7 +124,7 @@ class ServingEngine:
                 self.statusz.register("memory", self._hbm.summary)
         self.scheduler = ContinuousBatchingScheduler(
             engine, config, metrics=self.metrics, clock=clock, seed=seed,
-            handoff_sink=handoff_sink)
+            handoff_sink=handoff_sink, replica_name=self.replica)
         self._requests: Dict[int, Request] = {}
         self._next_id = self._id_start
         self._draining = False
@@ -138,11 +146,13 @@ class ServingEngine:
 
     # ---------------------------------------------------------------- submit
     def submit(self, prompt, sampling: Optional[SamplingParams] = None,
-               on_token: Optional[Callable] = None) -> int:
+               on_token: Optional[Callable] = None, trace=None) -> int:
         """Enqueue one request. Returns its request_id; raises ``QueueFull``
         when the bounded admission queue is at capacity (backpressure — the
         caller sheds load or retries with backoff) and ``RuntimeError``
-        after shutdown/drain began."""
+        after shutdown/drain began. ``trace`` carries an existing
+        distributed TraceContext (the fleet router's) — without one the
+        scheduler mints a fresh per-request context at enqueue."""
         if self._draining:
             raise RuntimeError("ServingEngine is draining; submit rejected")
         sampling = sampling or SamplingParams()
@@ -159,7 +169,7 @@ class ServingEngine:
                 f"exceeds serving.max_model_len={self.config.max_model_len}")
         req = Request(request_id=self._next_id, prompt=prompt,
                       sampling=sampling, max_new_tokens=max_new,
-                      on_token=on_token)
+                      on_token=on_token, trace=trace)
         self.scheduler.enqueue(req)     # raises QueueFull on backpressure
         self._requests[req.request_id] = req
         self._next_id += self._id_stride
@@ -191,18 +201,26 @@ class ServingEngine:
                 temperature=handoff.temperature,
                 max_new_tokens=handoff.max_new_tokens,
                 eos_token_id=handoff.eos_token_id)
+            trace = None
+            if handoff.trace is not None:
+                # a deserialized frame carries the producing side's trace
+                # identity: decode continues the SAME trace (marks restart
+                # in this process's clock domain)
+                from ..telemetry.disttrace import TraceContext
+                trace = TraceContext.from_header(handoff.trace)
             request = Request(
                 request_id=self._next_id,
                 prompt=np.asarray(handoff.prompt, np.int32).reshape(-1),
                 sampling=sampling, max_new_tokens=handoff.max_new_tokens,
-                on_token=on_token)
+                on_token=on_token, trace=trace)
             self._next_id += self._id_stride
             request.submit_time = self.scheduler.clock()
             self.tracer.async_begin(
                 "request", request.request_id, cat="serving",
                 args={"prompt_len": int(request.prompt.size),
                       "max_new_tokens": request.max_new_tokens,
-                      "handoff": True})
+                      "handoff": True, "replica": self.replica,
+                      **(trace.span_args() if trace is not None else {})})
         self.scheduler.enqueue_handoff(handoff, request)   # QueueFull here
         self._requests[request.request_id] = request
         if deliver_first:
@@ -376,6 +394,8 @@ class ServingEngine:
                 log_dist(f"serving telemetry export failed: {e}", ranks=[0])
         if self.statusz is not None:
             self.statusz.close()
+        if self._recorder is not None:
+            self._recorder.close()
         # gauge lifecycle: a closed engine's queue depth / TTFT must not
         # survive in prometheus_dump() or /metrics as if it were live
         self.metrics.close()
@@ -385,6 +405,17 @@ class ServingEngine:
             self.engine.compile_plane = None   # detach from the shared
                                                # InferenceEngine
         self.tracer.release_counters(self)
+
+    def _traces_in_flight(self):
+        """Trace ids of every request still moving through THIS replica
+        (queued, awaiting handoff insert, or decoding) — embedded in this
+        replica's flight-recorder bundles for cross-replica correlation."""
+        sched = self.scheduler
+        reqs = list(sched.queue)
+        reqs += [req for _h, req in list(sched.handoff_queue)]
+        reqs += [sched.pool.requests[s] for s in sched.pool.active_slots]
+        return sorted({req.trace.trace_id for req in reqs
+                       if req is not None and req.trace is not None})
 
     # ------------------------------------------------------------- statusz
     def _health_check(self):

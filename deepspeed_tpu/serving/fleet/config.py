@@ -55,6 +55,16 @@ class FleetConfig(DeepSpeedConfigModel):
     #: resubmission attempts per request across failovers
     max_retries: int = 3
 
+    #: fleet-wide distributed tracing (telemetry/disttrace.py): trace
+    #: contexts minted at router admission, per-replica Perfetto lanes
+    #: merged by the FleetAggregator, ``dstpu_fleet_path_*`` critical-path
+    #: gauges, the router /statusz ``critical_path`` section and
+    #: ``/fleet/trace`` endpoint, and cross-replica bundle correlation.
+    #: False builds no aggregator and exports no path gauges (requests
+    #: still carry their per-replica trace contexts — those are request
+    #: metadata, not an observability plane)
+    disttrace: bool = True
+
     #: statusz (dict -> runtime.config.StatuszConfig): the ROUTER's own
     #: introspection server — /statusz grows a "fleet" section with one
     #: row per replica (what ds_tpu_top's fleet view polls); /healthz is

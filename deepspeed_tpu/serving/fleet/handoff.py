@@ -74,6 +74,10 @@ class KVHandoff:
     eos_token_id: Optional[int] = None
     request_id: Optional[int] = None
     source: Optional[str] = None    # producing replica name
+    #: distributed trace context header (TraceContext.to_header()) — the
+    #: request's fleet-wide identity rides the frame so the decode side
+    #: continues the SAME trace, not a fresh one
+    trace: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------- framing
     def to_bytes(self) -> bytes:
@@ -89,6 +93,7 @@ class KVHandoff:
             "eos_token_id": self.eos_token_id,
             "request_id": self.request_id,
             "source": self.source,
+            "trace": self.trace,
             "quantized": quantized,
             "buffers": [{"path": p, "dtype": a.dtype.str,
                          "shape": list(a.shape)} for p, a in pairs],
@@ -123,7 +128,8 @@ class KVHandoff:
             max_new_tokens=header["max_new_tokens"],
             eos_token_id=header["eos_token_id"],
             request_id=header["request_id"],
-            source=header["source"])
+            source=header["source"],
+            trace=header.get("trace"))
 
     def nbytes(self) -> int:
         """Payload bytes a transport would move (lane buffers only)."""

@@ -47,7 +47,8 @@ _DONE_STATES = (RequestState.FINISHED, RequestState.TIMEOUT)
 class FleetRequest:
     """Router-side view of one request across replica assignments."""
 
-    def __init__(self, fleet_id: int, prompt, sampling, on_token):
+    def __init__(self, fleet_id: int, prompt, sampling, on_token,
+                 trace=None):
         self.fleet_id = fleet_id
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.sampling = sampling
@@ -57,6 +58,11 @@ class FleetRequest:
         self.attempts = 0
         self.delivered = 0         # token positions streamed to the user
         self.failed_reason: Optional[str] = None
+        #: distributed trace context minted at router admission — every
+        #: replica assignment (including failover replays) continues it
+        self.trace = trace
+        self._path_observed = False   # critical path folded into the
+                                      # aggregator exactly once
 
     # The delivery adapter: replays after failover re-generate tokens the
     # user already saw (greedy decode is deterministic), so only positions
@@ -115,6 +121,17 @@ class FleetRouter:
         self._pending: "deque[FleetRequest]" = deque()
         self._pending_handoffs: "deque" = deque()
         self._shutdown = False
+        # fleet-wide distributed tracing (telemetry/disttrace.py): trace
+        # contexts minted per request, merged per-replica Perfetto lanes,
+        # per-stage critical-path gauges. fleet.disttrace=False builds
+        # none of it (requests still carry per-replica contexts).
+        self.aggregator = None
+        if getattr(self.config, "disttrace", True):
+            from ...telemetry.disttrace import FleetAggregator
+            self.aggregator = FleetAggregator(self, tracer=self.tracer)
+        if self.recorder is not None and self.aggregator is not None:
+            self.recorder.set_trace_provider(
+                self.aggregator.in_flight_trace_ids)
         self.statusz = None
         sz = getattr(self.config, "statusz", None)
         if getattr(sz, "enabled", False):
@@ -122,6 +139,12 @@ class FleetRouter:
             self.statusz = StatuszServer(sz, tracer=self.tracer)
             self.statusz.register("fleet", self._statusz_section)
             self.statusz.register_health("fleet", self._health_check)
+            if self.aggregator is not None:
+                self.statusz.register("critical_path",
+                                      self.aggregator.statusz_section)
+                self.statusz.attach_aggregator(self.aggregator)
+            if self.recorder is not None:
+                self.statusz.attach_recorder(self.recorder)
         # wire prefill replicas' handoff sinks to this router
         for r in replicas:
             if r.engine is not None and r.role == "prefill":
@@ -167,7 +190,11 @@ class FleetRouter:
         if self._shutdown:
             raise RuntimeError("FleetRouter is shut down; submit rejected")
         sampling = sampling or SamplingParams()
-        freq = FleetRequest(self._next_fid, prompt, sampling, on_token)
+        from ...telemetry.disttrace import TraceContext
+        ctx = TraceContext.mint(origin="router")
+        ctx.mark("submit")
+        freq = FleetRequest(self._next_fid, prompt, sampling, on_token,
+                            trace=ctx)
         self._next_fid += 1
         self.metrics.submitted += 1
         if not self._try_assign(freq):
@@ -184,15 +211,22 @@ class FleetRouter:
         for r in self._pick(self._entry_replicas()):
             try:
                 rid = r.engine.submit(freq.prompt, freq.sampling,
-                                      on_token=freq._adapter)
+                                      on_token=freq._adapter,
+                                      trace=freq.trace)
             except QueueFull:
                 continue
             freq.replica, freq.request = r.name, r.engine.result(rid)
             freq.attempts += 1
-            with self.tracer.span("route", cat="fleet",
-                                  args={"fleet_id": freq.fleet_id,
-                                        "replica": r.name,
-                                        "attempt": freq.attempts}):
+            # "to", not "replica": router spans stay on the router's lane
+            # in the merged timeline (the aggregator partitions by the
+            # "replica" arg). "assignments", not "attempt": the latter is
+            # the trace context's replay counter (span_args).
+            with self.tracer.span(
+                    "route", cat="fleet",
+                    args={"fleet_id": freq.fleet_id, "to": r.name,
+                          "assignments": freq.attempts,
+                          **(freq.trace.span_args()
+                             if freq.trace is not None else {})}):
                 pass
             return True
         return False
@@ -214,11 +248,14 @@ class FleetRouter:
             if freq is not None:
                 freq.replica = r.name
             self.metrics.handoffs += 1
+            trace = getattr(request, "trace", None)
             with self.tracer.span(
                     "kv_handoff", cat="fleet",
                     args={"from": handoff.source, "to": r.name,
                           "kv_len": int(handoff.kv_len),
-                          "bytes": handoff.nbytes()}):
+                          "bytes": handoff.nbytes(),
+                          **(trace.span_args() if trace is not None
+                             else {})}):
                 pass
             return True
         self._pending_handoffs.append((handoff, request))
@@ -270,10 +307,18 @@ class FleetRouter:
                 break                               # no replica ready now
 
     def _harvest_completions(self):
-        done = sum(1 for f in self._fleet_requests.values()
-                   if f.request is not None
-                   and f.request.state in _DONE_STATES)
+        done = 0
+        for f in self._fleet_requests.values():
+            if f.request is None or f.request.state not in _DONE_STATES:
+                continue
+            done += 1
+            if self.aggregator is not None and not f._path_observed:
+                f._path_observed = True
+                self.aggregator.observe(f)
+        newly = done != self.metrics.completed
         self.metrics.completed = done
+        if newly and self.aggregator is not None:
+            self.aggregator.export_gauges()
 
     # ------------------------------------------------------------- failover
     def _detect_failures(self, now: float):
@@ -295,15 +340,22 @@ class FleetRouter:
         replica.ready = False
         victims = [f for f in self._fleet_requests.values()
                    if f.replica == replica.name and not f.done]
+        trace_ids = []
         for freq in victims:
+            if freq.trace is not None:
+                # the replayed attempt is a CHILD span of the one that
+                # just died — same trace_id, linked parent, attempt+1
+                freq.trace.replay()
+                trace_ids.append(freq.trace.trace_id)
             freq.replica, freq.request = None, None
             self._pending.append(freq)
         self.metrics.failovers += 1
         self.metrics.requeued += len(victims)
         with self.tracer.span("failover", cat="fleet",
-                              args={"replica": replica.name,
+                              args={"member": replica.name,
                                     "reason": reason,
-                                    "requeued": len(victims)}):
+                                    "requeued": len(victims),
+                                    "trace_ids": trace_ids[:16]}):
             pass
         if self.recorder is not None:
             self.recorder.trigger(
@@ -311,6 +363,14 @@ class FleetRouter:
                 f"replica {replica.name} evicted ({reason}); "
                 f"{len(victims)} request(s) re-enqueued onto survivors",
                 force=True)
+            if self.aggregator is not None:
+                # stitch same-trace bundles across the router's and the
+                # replicas' bundle dirs into one cross-replica postmortem
+                try:
+                    self.aggregator.cross_replica_postmortem()
+                except Exception as e:
+                    logger.warning(
+                        f"fleet: cross-replica postmortem failed: {e}")
         log_dist(
             f"fleet: FAILOVER — replica {replica.name} evicted ({reason}); "
             f"re-enqueued {len(victims)} in-flight request(s)", ranks=[0])
@@ -360,6 +420,8 @@ class FleetRouter:
                 r.engine.shutdown()
         if self.statusz is not None:
             self.statusz.close()
+        if self.recorder is not None:
+            self.recorder.close()
         self.metrics.close()
         self.tracer.release_counters(self)
 
@@ -372,6 +434,8 @@ class FleetRouter:
             engine.metrics.close()
             if engine.statusz is not None:
                 engine.statusz.close()
+            if engine._recorder is not None:
+                engine._recorder.close()
             engine.tracer.release_counters(engine)
         except Exception as e:
             logger.warning(f"fleet: disposing failed replica: {e}")
@@ -469,7 +533,8 @@ def build_fleet(engine, serving_config, clock=time.monotonic,
             cfg.flight_recorder.dir = os.path.join(
                 str(rec_cfg.dir), f"r{i}")
         srv = ServingEngine(engine, cfg, clock=clock, seed=seed + i,
-                            id_start=i, id_stride=n)
+                            id_start=i, id_stride=n,
+                            replica_name=f"r{i}")
         replicas.append(ReplicaHandle(
             f"r{i}", engine=srv, role=role, config=fleet_cfg, clock=clock))
     router = FleetRouter(replicas, fleet_cfg, clock=clock,

@@ -68,6 +68,10 @@ class Request:
     deadline: Optional[float] = None
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    #: distributed trace context (telemetry/disttrace.py) — minted by the
+    #: fleet router (or lazily at enqueue) and carried through every
+    #: replica boundary this request crosses
+    trace: Optional[object] = None
 
     @property
     def done(self) -> bool:
@@ -96,11 +100,16 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, engine, config, metrics: ServingMetrics = None,
                  clock: Callable[[], float] = time.monotonic, seed: int = 0,
-                 handoff_sink: Optional[Callable] = None):
+                 handoff_sink: Optional[Callable] = None,
+                 replica_name: Optional[str] = None):
         self.engine = engine
         self.config = config
         self.clock = clock
         self.role = getattr(config, "role", "unified")
+        # lane identity for the merged fleet timeline: every span this
+        # scheduler emits carries it, so the aggregator can partition the
+        # shared span ring into per-replica Perfetto process lanes
+        self.replica_name = replica_name or "serving"
         self.handoff_sink = handoff_sink
         self.metrics = metrics or ServingMetrics()
         quantize = bool(getattr(getattr(config, "kv_quant", None),
@@ -137,11 +146,22 @@ class ContinuousBatchingScheduler:
         if timeout is not None:
             request.deadline = now + timeout
         self.queue.append(request)
+        if request.trace is None:
+            from ..telemetry.disttrace import TraceContext
+            request.trace = TraceContext.mint(origin=self.replica_name)
+        ctx = request.trace
+        ctx.bind_span(request.request_id)
+        ctx.hop(self.replica_name)
+        ctx.mark("queued")
         tr = self.tracer
         tr.async_begin("request", request.request_id, cat="serving",
                        args={"prompt_len": int(request.prompt.size),
-                             "max_new_tokens": request.max_new_tokens})
-        tr.async_begin("request/queued", request.request_id, cat="serving")
+                             "max_new_tokens": request.max_new_tokens,
+                             "replica": self.replica_name,
+                             **ctx.span_args()})
+        tr.async_begin("request/queued", request.request_id, cat="serving",
+                       args={"replica": self.replica_name,
+                             "trace_id": ctx.trace_id})
         self.metrics.record_submit()
 
     def enqueue_handoff(self, handoff, request: Request):
@@ -153,10 +173,16 @@ class ContinuousBatchingScheduler:
                 f"serving handoff queue at capacity "
                 f"({self.config.max_queue}); retry with backoff")
         self.handoff_queue.append((handoff, request))
-        self.tracer.async_begin("request/handoff_queued",
-                                request.request_id, cat="serving",
-                                args={"kv_len": int(handoff.kv_len),
-                                      "source": handoff.source})
+        ctx = request.trace
+        if ctx is not None:
+            ctx.hop(self.replica_name)
+            ctx.mark("handoff_queued")
+        self.tracer.async_begin(
+            "request/handoff_queued", request.request_id, cat="serving",
+            args={"kv_len": int(handoff.kv_len),
+                  "source": handoff.source,
+                  "replica": self.replica_name,
+                  **(ctx.span_args() if ctx is not None else {})})
 
     # ----------------------------------------------------------------- tick
     def tick(self) -> int:
@@ -231,17 +257,23 @@ class ContinuousBatchingScheduler:
             if slot is None:
                 return
             handoff, req = self.handoff_queue.popleft()
+            ctx = req.trace
+            targs = ctx.span_args() if ctx is not None else {}
             tr.async_end("request/handoff_queued", req.request_id,
                          cat="serving")
             tr.async_begin("request/decode", req.request_id, cat="serving",
-                           args={"slot": slot, "handoff": True})
+                           args={"slot": slot, "handoff": True,
+                                 "replica": self.replica_name, **targs})
             with tr.span("kv_handoff_in", cat="serving",
                          args={"request_id": req.request_id, "slot": slot,
                                "kv_len": int(handoff.kv_len),
                                "bytes": handoff.nbytes(),
-                               "source": handoff.source}):
+                               "source": handoff.source,
+                               "replica": self.replica_name, **targs}):
                 self.pool.cache = self.engine.slot_insert_lane(
                     self.pool.cache, slot, handoff.lane)
+            if ctx is not None:
+                ctx.mark("handoff_inserted")
             req.state = RequestState.RUNNING
             self.metrics.record_handoff_in()
             if self._should_finish(req, handoff.first_token):
@@ -267,12 +299,20 @@ class ContinuousBatchingScheduler:
             if slot is None:
                 return
             req = self.queue.popleft()
+            ctx = req.trace
+            if ctx is not None:
+                ctx.mark("admitted")
             tr.async_end("request/queued", req.request_id, cat="serving")
             tr.async_begin("request/decode", req.request_id, cat="serving",
-                           args={"slot": slot})
+                           args={"slot": slot,
+                                 "replica": self.replica_name,
+                                 **(ctx.span_args() if ctx is not None
+                                    else {})})
             key = jax.random.fold_in(
                 jax.random.fold_in(self._base_key, self._tick_no), slot + 1)
             first = self._prefill_into(slot, req, key)
+            if ctx is not None:
+                ctx.mark("first_token")
             t_first = self.clock()
             req.state = RequestState.RUNNING
             req.first_token_time = t_first
@@ -307,7 +347,11 @@ class ContinuousBatchingScheduler:
                                        "matched": hit.matched,
                                        "reused": offset,
                                        "suffix": int(req.prompt.size)
-                                       - offset}):
+                                       - offset,
+                                       "replica": self.replica_name,
+                                       **(req.trace.span_args()
+                                          if req.trace is not None
+                                          else {})}):
                         self.pool.cache = self.engine.slot_copy_lane(
                             self.pool.cache, hit.slot, slot)
                         self.pool.cache, first = \
@@ -322,7 +366,10 @@ class ContinuousBatchingScheduler:
             self.prefix_cache.release(hit, used_tokens=0)
         with tr.span("prefill", cat="serving",
                      args={"request_id": req.request_id, "slot": slot,
-                           "prompt_len": int(req.prompt.size)}):
+                           "prompt_len": int(req.prompt.size),
+                           "replica": self.replica_name,
+                           **(req.trace.span_args()
+                              if req.trace is not None else {})}):
             # slot_prefill returns the first token as a python int —
             # already device-synced, so the span duration is honest
             self.pool.cache, first = self.engine.slot_prefill(
@@ -338,9 +385,12 @@ class ContinuousBatchingScheduler:
         side keeps appending to the same token list and callbacks."""
         from .fleet.handoff import KVHandoff
         tr = self.tracer
+        ctx = req.trace
         with tr.span("kv_handoff_out", cat="serving",
                      args={"request_id": req.request_id, "slot": slot,
-                           "kv_len": int(req.prompt.size)}):
+                           "kv_len": int(req.prompt.size),
+                           "replica": self.replica_name,
+                           **(ctx.span_args() if ctx is not None else {})}):
             lane = self.engine.slot_extract_lane(self.pool.cache, slot)
         handoff = KVHandoff(
             prompt=req.prompt, first_token=int(first),
@@ -348,7 +398,10 @@ class ContinuousBatchingScheduler:
             temperature=req.sampling.temperature,
             max_new_tokens=req.max_new_tokens,
             eos_token_id=req.sampling.eos_token_id,
-            request_id=req.request_id)
+            request_id=req.request_id,
+            trace=ctx.to_header() if ctx is not None else None)
+        if ctx is not None:
+            ctx.mark("handoff_out")
         tr.async_end("request/decode", req.request_id, cat="serving",
                      args={"handed_off": True})
         # the lane was only written, never bound: park it in the prefix
@@ -373,7 +426,8 @@ class ContinuousBatchingScheduler:
         t0 = self.clock()
         with self.tracer.span("decode_step", cat="serving",
                               args={"n_active": len(active),
-                                    "tick": self._tick_no}):
+                                    "tick": self._tick_no,
+                                    "replica": self.replica_name}):
             # slot_decode_step returns host ndarrays (already synced)
             self.pool.cache, nxt = self.engine.slot_decode_step(
                 self.pool.cache, toks, positions, temps, key=key)
@@ -385,8 +439,13 @@ class ContinuousBatchingScheduler:
             tok = int(nxt[slot])
             self.pool.lengths[slot] += 1      # fed token's K/V is in cache
             self.pool.pending[slot] = tok
+            finishing = self._should_finish(req, tok, pending=1)
+            if finishing and req.trace is not None:
+                # the token loop ends here; what follows (final delivery,
+                # bookkeeping) is the critical path's "stream" tail
+                req.trace.mark("decode_done")
             self._deliver(req, tok)
-            if self._should_finish(req, tok):
+            if finishing:
                 self._finish(req, RequestState.FINISHED, now)
                 self._release_slot(slot, req)
 
@@ -401,14 +460,20 @@ class ContinuousBatchingScheduler:
                     f"serving: on_token callback failed for request "
                     f"{req.request_id}: {e}")
 
-    def _should_finish(self, req: Request, tok: int) -> bool:
+    def _should_finish(self, req: Request, tok: int,
+                       pending: int = 0) -> bool:
+        """``pending`` counts tokens sampled but not yet appended — the
+        decode loop asks BEFORE delivering, so the critical-path mark
+        lands ahead of the final callback."""
         eos = req.sampling.eos_token_id
-        return (len(req.tokens) >= req.max_new_tokens or
+        return (len(req.tokens) + pending >= req.max_new_tokens or
                 (eos is not None and tok == eos))
 
     def _finish(self, req: Request, state: RequestState, now: float):
         req.state = state
         req.finish_time = now
+        if req.trace is not None:
+            req.trace.mark("finished")
         tr = self.tracer
         if req.first_token_time is None:
             # expired straight out of the queue: close the queued phase
@@ -418,8 +483,11 @@ class ContinuousBatchingScheduler:
         tr.async_end(
             "request", req.request_id, cat="serving",
             args={"state": state.value, "tokens": len(req.tokens),
+                  "replica": self.replica_name,
                   "ttft_ms": None if req.first_token_time is None else
-                  round((req.first_token_time - req.submit_time) * 1e3, 3)})
+                  round((req.first_token_time - req.submit_time) * 1e3, 3),
+                  **(req.trace.span_args()
+                     if req.trace is not None else {})})
         if state is RequestState.TIMEOUT:
             self.metrics.record_timeout()
         elif state is RequestState.FINISHED:

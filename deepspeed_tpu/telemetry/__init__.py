@@ -29,6 +29,8 @@ from .hostagg import HostAggregator
 from .compileplane import (CompileLedger, HBMLedger, fingerprint_args,
                            diff_fingerprints)
 from .overlap import OverlapAnalyzer, interval_overlap, overlap_from_events
+from .disttrace import (TraceContext, FleetAggregator, merge_chrome_traces,
+                        split_events_by_replica, CRITICAL_PATH_STAGES)
 
 __all__ = ["Span", "Tracer", "RecompileWatchdog", "get_tracer",
            "configure_tracer", "chrome_trace", "write_chrome_trace",
@@ -38,4 +40,6 @@ __all__ = ["Span", "Tracer", "RecompileWatchdog", "get_tracer",
            "configure_ledger", "StatuszServer", "FlightRecorder",
            "HostAggregator", "CompileLedger", "HBMLedger",
            "fingerprint_args", "diff_fingerprints", "OverlapAnalyzer",
-           "interval_overlap", "overlap_from_events"]
+           "interval_overlap", "overlap_from_events",
+           "TraceContext", "FleetAggregator", "merge_chrome_traces",
+           "split_events_by_replica", "CRITICAL_PATH_STAGES"]

@@ -1,0 +1,502 @@
+"""Fleet-wide distributed tracing — request trace context + aggregation.
+
+PR 8 made serving a fleet; every instrument before this file was
+per-process. A request that crosses three replicas (router admission →
+prefill replica → KV handoff → decode replica, possibly replayed after a
+failover) used to leave three disconnected span fragments and no answer
+to "which stage ate the TTFT budget". This module is the cross-process
+layer:
+
+- ``TraceContext`` — the request-scoped identity minted by
+  ``FleetRouter.submit`` (or lazily by a standalone scheduler): a fleet-
+  unique ``trace_id``, the current span id (the live Request's id on its
+  current replica), the replay lineage after failovers (the replayed
+  attempt is a *child span* of the original attempt, never a new trace),
+  the replicas visited, and an ordered list of **marks** — wall-clock
+  waypoints stamped at every propagation point (router submit, scheduler
+  enqueue, slot admission, first token, handoff serialize / transfer /
+  insert, decode completion, finish). Marks are consecutive intervals,
+  so the per-request critical path sums to the request's end-to-end time
+  *by construction*.
+- ``to_header()`` / ``from_header()`` — the JSON-able context that rides
+  the ``KVHandoff`` frame header across a real interconnect (marks are
+  ``perf_counter`` timestamps and stay process-local; identity, lineage,
+  and hop history cross the wire).
+- ``merge_chrome_traces`` — N replica chrome-trace slices into ONE
+  Perfetto document with a stable pid lane per replica and explicit
+  ``process_name`` / ``thread_name`` metadata events, fixing the
+  co-resident-engine pid collision (every in-process replica used to
+  land on ``jax.process_index()``'s lane and interleave).
+- ``FleetAggregator`` — the router-side consumer: merged fleet timeline
+  (in-process replicas partition the shared span ring by the ``replica``
+  span arg; url replicas are fetched over ``/trace``), per-request
+  critical-path windows exported as ``dstpu_fleet_path_*`` gauges and a
+  router ``/statusz`` section, in-flight trace ids for flight-recorder
+  bundles, and cross-replica postmortem correlation: bundles in the
+  router's and every replica's bundle dir that share a trace id are
+  merged into one document — the postmortem for a request, not for a
+  process.
+"""
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["TraceContext", "FleetAggregator", "merge_chrome_traces",
+           "split_events_by_replica", "CRITICAL_PATH_STAGES"]
+
+#: canonical stage order for critical-path reports (queue / route+probe /
+#: prefill / handoff serialize+transfer+insert / decode / stream, plus
+#: the failover re-enqueue gap when a replay happened)
+CRITICAL_PATH_STAGES = ("route", "queue", "prefill", "handoff_serialize",
+                        "handoff_transfer", "handoff_insert", "decode",
+                        "stream", "failover")
+
+_MINT_LOCK = threading.Lock()
+_MINT_SEQ = itertools.count()
+_MINT_SALT = os.urandom(4).hex()
+
+
+def _now_us() -> float:
+    return time.perf_counter_ns() / 1e3
+
+
+def _stage_of(prev: Optional[str], end: str) -> Optional[str]:
+    """Stage bucket for the interval ENDING at mark ``end``. A few ends
+    are disambiguated by what preceded them: ``finished`` directly after
+    ``queued`` is a queue-expiry (timeout), not decode."""
+    if end == "queued":
+        return "route"
+    if end == "admitted":
+        return "queue"
+    if end == "first_token":
+        return "prefill"
+    if end == "handoff_out":
+        return "handoff_serialize"
+    if end == "handoff_queued":
+        return "handoff_transfer"
+    if end == "handoff_inserted":
+        return "handoff_insert"
+    if end == "decode_done":
+        return "decode"
+    if end == "requeued":
+        return "failover"
+    if end == "finished":
+        if prev == "decode_done":
+            return "stream"
+        if prev in ("queued", "handoff_queued", "requeued", "submit"):
+            return "queue"
+        return "decode"
+    return None
+
+
+class TraceContext:
+    """One request's identity and timeline across the fleet."""
+
+    __slots__ = ("trace_id", "origin", "span_ids", "replays",
+                 "replay_parent", "hops", "marks")
+
+    def __init__(self, trace_id: str, origin: str,
+                 span_ids: Optional[List[int]] = None, replays: int = 0,
+                 replay_parent: Optional[int] = None,
+                 hops: Optional[List[str]] = None):
+        self.trace_id = trace_id
+        self.origin = origin
+        self.span_ids = list(span_ids or [])
+        self.replays = int(replays)
+        self.replay_parent = replay_parent
+        self.hops = list(hops or [])
+        self.marks: List[tuple] = []        # (label, t_us), process-local
+
+    # ------------------------------------------------------------- minting
+    @classmethod
+    def mint(cls, origin: str) -> "TraceContext":
+        """A fleet-unique context. The id mixes pid + a per-process random
+        salt + a counter, so co-resident routers and separate hosts can
+        mint concurrently without coordination."""
+        with _MINT_LOCK:
+            seq = next(_MINT_SEQ)
+        return cls(trace_id=f"{os.getpid():x}-{_MINT_SALT}-{seq:x}",
+                   origin=origin)
+
+    # ---------------------------------------------------------- propagation
+    @property
+    def span_id(self) -> Optional[int]:
+        """The live attempt's span id (its Request id on its replica)."""
+        return self.span_ids[-1] if self.span_ids else None
+
+    def bind_span(self, request_id: int):
+        """A replica admitted this request under ``request_id`` — the
+        id becomes the current span of the trace."""
+        if not self.span_ids or self.span_ids[-1] != request_id:
+            self.span_ids.append(int(request_id))
+
+    def hop(self, replica: str):
+        """Record a replica boundary crossing (dedup consecutive)."""
+        if not self.hops or self.hops[-1] != replica:
+            self.hops.append(replica)
+
+    def replay(self):
+        """The current attempt died (failover): the NEXT bound span is a
+        child of the attempt that just failed — same trace, linked
+        parent — never a fresh trace."""
+        self.replays += 1
+        self.replay_parent = self.span_id
+        self.mark("requeued")
+
+    def mark(self, label: str):
+        self.marks.append((label, _now_us()))
+
+    def span_args(self) -> Dict[str, Any]:
+        """The args every span touching this request carries — what the
+        aggregator (and a human in Perfetto) joins on."""
+        out: Dict[str, Any] = {"trace_id": self.trace_id,
+                               "origin": self.origin}
+        if self.span_ids:
+            out["span_id"] = self.span_ids[-1]
+        if self.replays:
+            out["attempt"] = self.replays
+            out["replay_of"] = self.replay_parent
+        return out
+
+    # -------------------------------------------------------------- framing
+    def to_header(self) -> Dict[str, Any]:
+        """JSON-able identity for the KVHandoff frame header. Marks stay
+        behind: they are ``perf_counter`` timestamps, meaningless in
+        another process's clock domain."""
+        return {"trace_id": self.trace_id, "origin": self.origin,
+                "span_ids": list(self.span_ids), "replays": self.replays,
+                "replay_parent": self.replay_parent,
+                "hops": list(self.hops)}
+
+    @classmethod
+    def from_header(cls, header: Dict[str, Any]) -> "TraceContext":
+        return cls(trace_id=str(header["trace_id"]),
+                   origin=str(header.get("origin", "?")),
+                   span_ids=header.get("span_ids"),
+                   replays=header.get("replays", 0),
+                   replay_parent=header.get("replay_parent"),
+                   hops=header.get("hops"))
+
+    # -------------------------------------------------------- critical path
+    def total_ms(self) -> float:
+        """First mark to last mark — the trace-clock end-to-end time."""
+        if len(self.marks) < 2:
+            return 0.0
+        return (self.marks[-1][1] - self.marks[0][1]) / 1e3
+
+    def critical_path(self) -> Dict[str, float]:
+        """Per-stage milliseconds. Stages are consecutive mark intervals,
+        so ``sum(critical_path().values()) == total_ms()`` exactly (a
+        replayed request accumulates its second pass into the same
+        buckets, plus a ``failover`` stage for the re-enqueue gap)."""
+        out: Dict[str, float] = {}
+        prev_label: Optional[str] = None
+        prev_t: Optional[float] = None
+        for label, t in self.marks:
+            if prev_t is not None:
+                stage = _stage_of(prev_label, label)
+                if stage is not None:
+                    out[stage] = out.get(stage, 0.0) + (t - prev_t) / 1e3
+                else:
+                    out["other"] = out.get("other", 0.0) + (t - prev_t) / 1e3
+            prev_label, prev_t = label, t
+        return out
+
+
+# --------------------------------------------------------------------------
+# chrome-trace merging (the pid/tid collision fix)
+# --------------------------------------------------------------------------
+
+def split_events_by_replica(events: List[Dict[str, Any]],
+                            default_lane: str = "router"
+                            ) -> Dict[str, List[Dict[str, Any]]]:
+    """Partition one process's trace events by the ``replica`` span arg.
+    Co-resident replicas share the process-global span ring; the arg is
+    the only thing that says whose lane an event belongs to. Events
+    without one (router spans, training spans) go to ``default_lane``."""
+    lanes: Dict[str, List[Dict[str, Any]]] = {}
+    for ev in events:
+        if ev.get("ph") == "M":
+            continue                       # lane metadata is re-emitted
+        rep = (ev.get("args") or {}).get("replica", default_lane)
+        lanes.setdefault(str(rep), []).append(ev)
+    return lanes
+
+
+def merge_chrome_traces(slices: Dict[str, Dict[str, Any]],
+                        labels: Optional[Dict[str, str]] = None
+                        ) -> Dict[str, Any]:
+    """N chrome-trace documents (one per lane) -> ONE Perfetto-loadable
+    document with a stable pid per lane and explicit ``process_name`` /
+    ``thread_name`` metadata, so merged views never interleave unrelated
+    replicas on one process row. Lane order is deterministic: ``router``
+    first, then sorted replica names."""
+    labels = labels or {}
+    order = sorted(slices, key=lambda n: (n != "router", n))
+    events: List[Dict[str, Any]] = []
+    dropped = 0
+    for pid, lane in enumerate(order):
+        doc = slices[lane] or {}
+        lane_events = [ev for ev in doc.get("traceEvents", [])
+                       if ev.get("ph") != "M"]
+        dropped += int((doc.get("otherData") or {}).get("dropped_spans", 0))
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": labels.get(lane, lane)}})
+        events.append({"name": "process_sort_index", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"sort_index": pid}})
+        tids = []
+        for ev in lane_events:
+            ev = dict(ev)
+            ev["pid"] = pid
+            tid = ev.get("tid", 0)
+            if tid not in tids:
+                tids.append(tid)
+            events.append(ev)
+        for j, tid in enumerate(tids):
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid,
+                           "args": {"name": f"{lane}/t{j}"}})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"lanes": {lane: i for i, lane
+                                    in enumerate(order)},
+                          "dropped_spans": dropped}}
+
+
+# --------------------------------------------------------------------------
+# router-side aggregation
+# --------------------------------------------------------------------------
+
+class FleetAggregator:
+    """Merged fleet timeline + SLO critical-path attribution, owned by
+    the router. Built only when ``fleet.disttrace`` is on."""
+
+    def __init__(self, router, tracer=None, window: int = 512):
+        self.router = router
+        self.tracer = tracer if tracer is not None else router.tracer
+        self._stage_windows: Dict[str, deque] = {}
+        self._e2e_window: deque = deque(maxlen=window)
+        self._window = int(window)
+        self.observed = 0
+
+    # --------------------------------------------------------- merged trace
+    def merged_trace(self, last_ms: Optional[float] = None
+                     ) -> Dict[str, Any]:
+        """ONE Perfetto document for the whole fleet. In-process replicas
+        share the router's span ring and partition by the ``replica``
+        span arg; url-only replicas are polled over their ``/trace``
+        endpoint (best effort — an unreachable replica simply contributes
+        no lane)."""
+        from .export import chrome_trace_slice
+        doc = chrome_trace_slice(self.tracer, last_ms=last_ms)
+        slices = {lane: {"traceEvents": evs}
+                  for lane, evs in split_events_by_replica(
+                      doc["traceEvents"]).items()}
+        labels = {"router": "fleet router"}
+        for name, handle in self.router.replicas.items():
+            labels[name] = f"replica {name} [{handle.role}]"
+            if name in slices or handle.engine is not None:
+                continue
+            remote = self._fetch_remote_trace(handle, last_ms)
+            if remote is not None:
+                slices[name] = remote
+        merged = merge_chrome_traces(slices, labels=labels)
+        merged["otherData"]["dropped_spans"] = doc.get(
+            "otherData", {}).get("dropped_spans", 0)
+        return merged
+
+    def _fetch_remote_trace(self, handle, last_ms):
+        if not getattr(handle, "url", None):
+            return None
+        import urllib.request
+        url = handle.url + "/trace"
+        if last_ms is not None:
+            url += f"?last_ms={float(last_ms):g}"
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=float(handle._p("probe_timeout_s",
+                                                 1.0))) as r:
+                return json.load(r)
+        except Exception:
+            return None
+
+    # -------------------------------------------------------- critical path
+    def observe(self, freq):
+        """Fold one COMPLETED fleet request's critical path into the
+        sliding stage windows (the router calls this exactly once per
+        request, at harvest time). Every known stage window gets a sample
+        per request (0.0 when the request skipped the stage), so the
+        windows stay ALIGNED: the sum of stage means equals the mean e2e
+        by linearity — the decomposition check is not vacuous."""
+        ctx = getattr(freq, "trace", None)
+        if ctx is None or len(ctx.marks) < 2:
+            return
+        path = ctx.critical_path()
+        for stage in set(CRITICAL_PATH_STAGES) | set(path) | \
+                set(self._stage_windows):
+            self._stage_windows.setdefault(
+                stage, deque(maxlen=self._window)).append(
+                    path.get(stage, 0.0))
+        self._e2e_window.append(ctx.total_ms())
+        self.observed += 1
+
+    @staticmethod
+    def _p50(window) -> float:
+        vals = sorted(window)
+        return vals[min(len(vals) - 1, len(vals) // 2)] if vals else 0.0
+
+    def critical_path_summary(self) -> Dict[str, Any]:
+        """Per-stage p50/mean over the recent window, in canonical stage
+        order. ``stage_sum_ms_mean`` (sum of aligned stage means) matches
+        ``e2e_ms_mean`` by construction — the sum-to-e2e contract a
+        consumer can verify. Stage *p50s* are reported per stage and do
+        NOT sum to the e2e p50 under skew (quantiles are not linear);
+        per-request decomposition is always exact."""
+        stages: Dict[str, Any] = {}
+        names = [s for s in CRITICAL_PATH_STAGES
+                 if s in self._stage_windows]
+        names += [s for s in self._stage_windows if s not in names]
+        for name in names:
+            w = self._stage_windows[name]
+            if w and max(w) <= 0:
+                continue                  # stage never exercised
+            stages[name] = {
+                "p50_ms": round(self._p50(w), 3),
+                "mean_ms": round(sum(w) / len(w), 3) if w else 0.0,
+                "n": len(w),
+            }
+        e2e = self._e2e_window
+        return {"requests": self.observed,
+                "e2e_ms_p50": round(self._p50(e2e), 3),
+                "e2e_ms_mean": round(sum(e2e) / len(e2e), 3)
+                if e2e else 0.0,
+                "stage_sum_ms_mean": round(
+                    sum(s["mean_ms"] for s in stages.values()), 3),
+                "stages": stages}
+
+    def export_gauges(self):
+        """Mirror the stage p50s into ``fleet/path_*`` gauges — the
+        dedicated ``dstpu_fleet_path_<stage>_ms_p50`` Prometheus series.
+        Owned by the router's FleetMetrics so shutdown retracts them."""
+        owner = self.router.metrics
+        for stage, w in self._stage_windows.items():
+            self.tracer.set_counter(f"fleet/path_{stage}_ms_p50",
+                                    round(self._p50(w), 3), owner=owner)
+        if self._e2e_window:
+            self.tracer.set_counter("fleet/path_e2e_ms_p50",
+                                    round(self._p50(self._e2e_window), 3),
+                                    owner=owner)
+
+    def statusz_section(self) -> Dict[str, Any]:
+        """The router /statusz ``critical_path`` section: one flat row
+        per stage (tables render flat dicts)."""
+        summary = self.critical_path_summary()
+        out: Dict[str, Any] = {
+            "requests": summary["requests"],
+            "e2e_ms_p50": summary["e2e_ms_p50"],
+            "e2e_ms_mean": summary["e2e_ms_mean"],
+            "stage_sum_ms_mean": summary["stage_sum_ms_mean"],
+        }
+        for stage, rec in summary["stages"].items():
+            out[f"{stage}_ms_p50"] = rec["p50_ms"]
+        return out
+
+    # ----------------------------------------------------------- recorders
+    def in_flight_trace_ids(self) -> List[str]:
+        """Trace ids with work still moving through the fleet — what a
+        flight-recorder bundle embeds so postmortems correlate."""
+        ids = []
+        for freq in self.router._fleet_requests.values():
+            ctx = getattr(freq, "trace", None)
+            if ctx is not None and not freq.done:
+                ids.append(ctx.trace_id)
+        return sorted(set(ids))
+
+    def _bundle_dirs(self) -> Dict[str, str]:
+        dirs: Dict[str, str] = {}
+        rec = getattr(self.router, "recorder", None)
+        if rec is not None:
+            dirs["router"] = rec.dir
+        for name, handle in self.router.replicas.items():
+            eng_rec = getattr(handle.engine, "_recorder", None)
+            if eng_rec is not None:
+                dirs[name] = eng_rec.dir
+        return dirs
+
+    def correlate_bundles(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Scan the router's and every replica's bundle dirs and group
+        bundles by the trace ids they embedded: trace_id -> [bundle ref].
+        A trace that appears in bundles from two different members is the
+        cross-replica incident this module exists to stitch together."""
+        by_trace: Dict[str, List[Dict[str, Any]]] = {}
+        for member, bdir in self._bundle_dirs().items():
+            try:
+                names = sorted(os.listdir(bdir))
+            except OSError:
+                continue
+            for name in names:
+                if not (name.startswith("bundle-") and
+                        name.endswith(".json")):
+                    continue
+                path = os.path.join(bdir, name)
+                try:
+                    with open(path) as f:
+                        doc = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                ref = {"member": member, "file": name, "path": path,
+                       "kind": doc.get("kind"), "detail": doc.get("detail"),
+                       "time": doc.get("time")}
+                for tid in doc.get("in_flight_traces", []) or []:
+                    by_trace.setdefault(str(tid), []).append(ref)
+        return by_trace
+
+    def cross_replica_postmortem(self, trace_ids: Optional[List[str]]
+                                 = None, write: bool = True
+                                 ) -> Optional[Dict[str, Any]]:
+        """One document correlating same-trace bundles across every
+        member's bundle dir. ``trace_ids=None`` keeps traces that appear
+        in bundles from >= 2 distinct members (plus everything in the
+        router's newest bundle). Returns None when there is nothing to
+        correlate; otherwise writes ``crossrep-NNNN.json`` next to the
+        router's bundles (when ``write``) and returns the document."""
+        rec = getattr(self.router, "recorder", None)
+        by_trace = self.correlate_bundles()
+        if trace_ids is None:
+            keep = {tid for tid, refs in by_trace.items()
+                    if len({r["member"] for r in refs}) >= 2}
+            last = getattr(rec, "last_fire", None) if rec is not None \
+                else None
+            if last is not None:
+                for tid, refs in by_trace.items():
+                    if any(r["member"] == "router" and
+                           r["file"] == os.path.basename(last["path"])
+                           for r in refs):
+                        keep.add(tid)
+            trace_ids = sorted(keep)
+        traces = {tid: by_trace.get(tid, []) for tid in trace_ids
+                  if by_trace.get(tid)}
+        if not traces:
+            return None
+        doc = {"kind": "cross_replica_postmortem",
+               "time": time.time(),
+               "members": sorted(self._bundle_dirs()),
+               "traces": traces}
+        if write and rec is not None:
+            try:
+                os.makedirs(rec.dir, exist_ok=True)
+                seq = len([n for n in os.listdir(rec.dir)
+                           if n.startswith("crossrep-")]) + 1
+                path = os.path.join(rec.dir, f"crossrep-{seq:04d}.json")
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(doc, f, default=str)
+                os.replace(tmp, path)
+                doc["path"] = path
+            except OSError:
+                pass
+        return doc

@@ -50,9 +50,12 @@ def chrome_trace(tracer: Optional[Tracer] = None) -> Dict[str, Any]:
         "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
         "args": {"name": f"deepspeed_tpu rank {pid}"},
     }]
+    tids: List[int] = []
     for sp in tracer.spans():
         ev: Dict[str, Any] = {"name": sp.name, "cat": sp.cat, "ph": sp.ph,
                               "ts": sp.ts_us, "pid": pid, "tid": sp.tid}
+        if sp.tid not in tids:
+            tids.append(sp.tid)
         if sp.ph == "X":
             ev["dur"] = sp.dur_us
         if sp.ph in ("b", "e"):
@@ -65,6 +68,14 @@ def chrome_trace(tracer: Optional[Tracer] = None) -> Dict[str, Any]:
         if args:
             ev["args"] = args
         events.append(ev)
+    # readable thread rows: raw thread idents are meaningless 15-digit
+    # numbers in the Perfetto UI (the fleet-merged view re-labels lanes
+    # per replica on top of this — telemetry/disttrace.py)
+    for j, tid in enumerate(tids):
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid, "args": {"name": f"thread {j}"}})
+        events.append({"name": "thread_sort_index", "ph": "M", "pid": pid,
+                       "tid": tid, "args": {"sort_index": j}})
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"dropped_spans": tracer.dropped}}
 

@@ -81,6 +81,11 @@ class FlightRecorder:
         self._providers: Dict[str, Callable[[], dict]] = {}
         self._cost_provider: Optional[Callable[[], dict]] = None
         self._compile_plane = None       # CompileLedger (attach_compile_plane)
+        #: callable() -> [trace_id, ...]: the distributed-trace ids in
+        #: flight on this member at capture time — what lets a router
+        #: correlate same-trace bundles across replica bundle dirs
+        self._trace_provider: Optional[Callable[[], list]] = None
+        self._closed = False
         self.ema_ms = 0.0
         self._baseline_steps = 0       # records feeding the EMA
         self._last_goodput: Dict[str, float] = {}
@@ -111,6 +116,14 @@ class FlightRecorder:
         summaries — a recompile bundle then names the exact argument
         whose shape changed instead of just counting the recompile."""
         self._compile_plane = ledger
+        return self
+
+    def set_trace_provider(self, provider: Callable[[], list]):
+        """Callable returning the distributed trace ids currently in
+        flight on this member (telemetry/disttrace.py); every bundle
+        embeds them as ``in_flight_traces`` so cross-replica postmortems
+        join on the request, not on wall-clock proximity."""
+        self._trace_provider = provider
         return self
 
     # ------------------------------------------------------------ recording
@@ -214,6 +227,12 @@ class FlightRecorder:
                          in self.tracer.counters().items()},
             "status": {},
         }
+        if self._trace_provider is not None:
+            try:
+                doc["in_flight_traces"] = list(self._trace_provider())
+            except Exception as e:
+                doc["in_flight_traces"] = []
+                doc["trace_provider_error"] = str(e)
         if self._ledger.enabled:
             doc["goodput"] = self._ledger.snapshot()
         for name, provider in list(self._providers.items()):
@@ -243,7 +262,7 @@ class FlightRecorder:
                           "step": step, "time": doc["time"], "path": path}
         self.tracer.set_counter("recorder/bundles",
                                 float(sum(self.trigger_counts.values())
-                                      - self.suppressed))
+                                      - self.suppressed), owner=self)
         self.tracer.instant(f"flight_recorder:{kind}", cat="warning",
                             args={"detail": detail, "bundle": fname})
         return path
@@ -292,6 +311,17 @@ class FlightRecorder:
                 except OSError:
                     return None
         return None
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self):
+        """Retract this recorder's gauges from the shared counter space
+        (the owning engine/router's shutdown path) — a closed member's
+        bundle count must not linger in /metrics as if it were live.
+        Bundles on disk are untouched. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.tracer.release_counters(self)
 
     # -------------------------------------------------------------- summary
     def summary(self) -> Dict[str, Any]:

@@ -136,6 +136,11 @@ class GoodputLedger:
             self.enabled = bool(enabled)
             if self.enabled and not was:
                 self.reset()
+            if was and not self.enabled:
+                # gauge lifecycle: a disabled ledger's goodput/* mirror
+                # must not read as live in prometheus_dump()//metrics
+                from .trace import get_tracer
+                get_tracer().release_counters(self)
         return self
 
     def reset(self):
@@ -238,13 +243,15 @@ class GoodputLedger:
             items = list(self._buckets.items())
         productive = 0.0
         for name, secs in items:
-            tracer.set_counter(f"goodput/{name}_s", round(secs, 6))
+            tracer.set_counter(f"goodput/{name}_s", round(secs, 6),
+                               owner=self)
             if name in PRODUCTIVE_BUCKETS:
                 productive += secs
         if wall > 0:
-            tracer.set_counter("goodput/wall_s", round(wall, 6))
+            tracer.set_counter("goodput/wall_s", round(wall, 6),
+                               owner=self)
             tracer.set_counter("goodput/fraction",
-                               round(productive / wall, 6))
+                               round(productive / wall, 6), owner=self)
 
 
 _LEDGER: Optional[GoodputLedger] = None

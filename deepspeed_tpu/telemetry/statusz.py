@@ -22,6 +22,10 @@ human with a browser all read the same live state:
   flight-recorder surface (telemetry/flight_recorder.py) when one is
   attached: list the on-disk postmortem bundles, download one, or force
   an explicit capture (a trigger rule in its own right).
+- ``/fleet/trace?last_ms=N`` — the MERGED fleet timeline (one Perfetto
+  document, one stable pid lane per replica) when a FleetAggregator
+  (telemetry/disttrace.py) is attached — the router's statusz carries
+  this; a plain replica answers 404.
 
 Malformed query parameters (``/trace?last_ms=-5``, ``?last_ms=abc``, an
 unknown ``?format=``) answer HTTP 400 with a one-line message — a typo'd
@@ -67,6 +71,7 @@ class StatuszServer:
         self._health: Dict[str, Callable[[], Tuple[bool, str]]] = {}
         self._recorder = None     # FlightRecorder (the /debug/* surface)
         self._hostagg = None      # HostAggregator (the straggler table)
+        self._aggregator = None   # FleetAggregator (/fleet/trace)
         self._t_start = time.time()
         self._httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self._httpd.daemon_threads = True
@@ -104,6 +109,13 @@ class StatuszServer:
         """Expose a HostAggregator: the ``hosts`` document in the statusz
         JSON and the straggler table on the HTML page."""
         self._hostagg = hostagg
+        return self
+
+    def attach_aggregator(self, aggregator):
+        """Expose a FleetAggregator (telemetry/disttrace.py): the
+        ``/fleet/trace`` merged-timeline endpoint on the router's
+        statusz."""
+        self._aggregator = aggregator
         return self
 
     # ------------------------------------------------------------ lifecycle
@@ -301,6 +313,24 @@ def _make_handler(server: StatuszServer):
             self._send(400, msg.splitlines()[0] + "\n",
                        "text/plain; charset=utf-8")
 
+        @staticmethod
+        def _parse_last_ms(qs):
+            """(error_message, value): shared ``last_ms=`` validation for
+            /trace and /fleet/trace."""
+            raw = qs.get("last_ms", [None])[0]
+            if raw is None:
+                return None, None
+            try:
+                last_ms = float(raw)
+            except ValueError:
+                return (f"bad last_ms={raw!r}: want a number of "
+                        f"milliseconds"), None
+            if not (last_ms >= 0) or last_ms != last_ms or \
+                    last_ms == float("inf"):
+                return (f"bad last_ms={raw!r}: want a finite "
+                        f"number >= 0"), None
+            return None, last_ms
+
         def do_GET(self):
             try:
                 url = urlparse(self.path)
@@ -328,21 +358,22 @@ def _make_handler(server: StatuszServer):
                         self._send(200, server.status_html(),
                                    "text/html; charset=utf-8")
                 elif path == "/trace":
-                    raw = qs.get("last_ms", [None])[0]
-                    last_ms = None
-                    if raw is not None:
-                        try:
-                            last_ms = float(raw)
-                        except ValueError:
-                            return self._bad(
-                                f"bad last_ms={raw!r}: want a number of "
-                                f"milliseconds")
-                        if not (last_ms >= 0) or last_ms != last_ms or \
-                                last_ms == float("inf"):
-                            return self._bad(
-                                f"bad last_ms={raw!r}: want a finite "
-                                f"number >= 0")
+                    err, last_ms = self._parse_last_ms(qs)
+                    if err is not None:
+                        return self._bad(err)
                     doc = server.trace_slice(last_ms)
+                    self._send(200, json.dumps(doc), "application/json")
+                elif path == "/fleet/trace":
+                    agg = server._aggregator
+                    if agg is None:
+                        return self._send(
+                            404, "no fleet aggregator attached (this is "
+                            "not a router statusz, or fleet.disttrace "
+                            "is off)\n", "text/plain; charset=utf-8")
+                    err, last_ms = self._parse_last_ms(qs)
+                    if err is not None:
+                        return self._bad(err)
+                    doc = agg.merged_trace(last_ms=last_ms)
                     self._send(200, json.dumps(doc), "application/json")
                 elif path == "/debug/bundles":
                     rec = server._recorder
@@ -385,7 +416,8 @@ def _make_handler(server: StatuszServer):
                                "application/json")
                 else:
                     self._send(404, "not found: try /healthz /metrics "
-                               "/statusz /trace /debug/bundles\n",
+                               "/statusz /trace /fleet/trace "
+                               "/debug/bundles\n",
                                "text/plain; charset=utf-8")
             except BrokenPipeError:      # client went away mid-response
                 pass

@@ -1,0 +1,454 @@
+"""Fleet-wide distributed tracing tests (telemetry/disttrace.py).
+
+Contracts under test: a TraceContext's critical-path stages are
+consecutive intervals that sum to its end-to-end time EXACTLY; the
+context header survives the KVHandoff byte framing; merging per-replica
+chrome traces assigns each replica a stable pid lane with explicit
+process_name/thread_name metadata (the co-resident-engine collision
+fix); one disaggregated request's spans land on >= 2 replica lanes under
+a single trace_id; a failover replay continues the SAME trace as a child
+span (replay-parent link, attempt counter) with every streamed token
+delivered exactly once and the critical path covering both attempts;
+flight-recorder bundles embed the in-flight trace ids and the router
+correlates same-trace bundles across member bundle dirs into one
+cross-replica postmortem; the router statusz serves /fleet/trace (with
+/trace-grade 400 hardening) and a critical_path section; and ds_tpu_top
+polls fleet replicas concurrently so one hung endpoint degrades its own
+row instead of stalling the refresh.
+"""
+
+import http.server
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.serving import (KVHandoff, RequestState, SamplingParams,
+                                   ServingEngine, build_fleet)
+from deepspeed_tpu.telemetry import get_tracer
+from deepspeed_tpu.telemetry.disttrace import (CRITICAL_PATH_STAGES,
+                                               TraceContext,
+                                               merge_chrome_traces,
+                                               split_events_by_replica)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+VOCAB = 96
+
+
+@pytest.fixture(scope="module")
+def engine():
+    model = GPT2Model(GPT2Config(vocab_size=VOCAB, n_positions=64, n_embd=64,
+                                 n_layer=2, n_head=4, pad_vocab_to_multiple=1,
+                                 dtype="float32"))
+    return deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+
+
+@pytest.fixture
+def tracer():
+    tr = get_tracer()
+    prev = tr.enabled
+    tr.clear()
+    tr.configure(enabled=True, buffer_size=8192)
+    yield tr
+    tr.clear()
+    tr.configure(enabled=prev)
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, VOCAB, (t,), dtype=np.int32) for t in lengths]
+
+
+def _fleet_cfg(engine_cfg=None, **fleet):
+    cfg = {"num_slots": 2, "max_model_len": 64}
+    cfg.update(engine_cfg or {})
+    cfg["fleet"] = {"enabled": True, "heartbeat_timeout_s": 60.0, **fleet}
+    return cfg
+
+
+# --------------------------------------------------------------- context
+
+def test_trace_context_mint_marks_and_critical_path():
+    """Unique ids; stages are consecutive intervals summing to total_ms
+    exactly; header round-trips identity (not process-local marks)."""
+    ids = {TraceContext.mint("router").trace_id for _ in range(64)}
+    assert len(ids) == 64
+    ctx = TraceContext.mint("router")
+    for label in ("submit", "queued", "admitted", "first_token",
+                  "handoff_out", "handoff_queued", "handoff_inserted",
+                  "decode_done", "finished"):
+        ctx.mark(label)
+    path = ctx.critical_path()
+    for stage in ("route", "queue", "prefill", "handoff_serialize",
+                  "handoff_transfer", "handoff_insert", "decode",
+                  "stream"):
+        assert stage in path, stage
+        assert stage in CRITICAL_PATH_STAGES
+    assert abs(sum(path.values()) - ctx.total_ms()) < 1e-9
+    # timeout straight out of the queue attributes to "queue", not decode
+    t = TraceContext.mint("r0")
+    t.mark("queued")
+    t.mark("finished")
+    assert list(t.critical_path()) == ["queue"]
+    # header round trip
+    ctx.bind_span(7)
+    ctx.hop("r0")
+    ctx.replay()
+    ctx.bind_span(9)
+    h = ctx.to_header()
+    back = TraceContext.from_header(json.loads(json.dumps(h)))
+    assert back.trace_id == ctx.trace_id
+    assert back.span_ids == [7, 9] and back.replay_parent == 7
+    assert back.replays == 1 and back.hops == ["r0"]
+    assert back.marks == []          # marks never cross a process boundary
+    assert back.span_args()["attempt"] == 1
+    assert back.span_args()["replay_of"] == 7
+
+
+def test_kv_handoff_frame_carries_trace(engine):
+    """The RDMA-shaped framing round-trips the trace header, and a
+    decode-only engine continues the SAME trace from the frame."""
+    pool = engine.init_slot_pool(2, 32)
+    prompt = _prompts((10,), seed=3)[0]
+    pool, first = engine.slot_prefill(pool, 0, prompt)
+    lane = engine.slot_extract_lane(pool, 0)
+    ctx = TraceContext.mint("r0")
+    ctx.bind_span(4)
+    ctx.hop("r0")
+    h = KVHandoff(prompt=prompt, first_token=first, kv_len=10, lane=lane,
+                  max_new_tokens=4, source="r0", trace=ctx.to_header())
+    h2 = KVHandoff.from_bytes(h.to_bytes())
+    assert h2.trace["trace_id"] == ctx.trace_id
+    srv = ServingEngine(engine, {"num_slots": 2, "max_model_len": 32,
+                                 "role": "decode"},
+                        replica_name="dec0")
+    rid = srv.submit_handoff(h2)
+    srv.run_until_idle()
+    req = srv.result(rid)
+    assert req.state is RequestState.FINISHED
+    assert req.trace.trace_id == ctx.trace_id      # same trace, new span
+    assert req.trace.hops[-1] == "dec0"
+    assert "handoff_insert" in req.trace.critical_path()
+
+
+# ------------------------------------------------------------ lane merge
+
+def test_merge_chrome_traces_stable_pid_lanes():
+    """Co-resident slices land on distinct pids with process_name /
+    thread_name metadata — no interleaving on one shared lane."""
+    mk = lambda name, tid: {"name": name, "cat": "serving", "ph": "X",
+                            "ts": 1.0, "dur": 2.0, "pid": 0, "tid": tid,
+                            "args": {"replica": None}}
+    slices = {
+        "router": {"traceEvents": [mk("route", 11)]},
+        "r0": {"traceEvents": [mk("prefill", 11), mk("decode_step", 12)]},
+        "r1": {"traceEvents": [mk("decode_step", 11)]},
+    }
+    merged = merge_chrome_traces(slices, labels={"r0": "replica r0 [p]"})
+    lanes = merged["otherData"]["lanes"]
+    assert lanes["router"] == 0                 # router lane first, stable
+    assert set(lanes.values()) == {0, 1, 2}
+    by_pid = {}
+    for ev in merged["traceEvents"]:
+        by_pid.setdefault(ev["pid"], []).append(ev)
+    # same original (pid=0, tid=11) events are now on THREE distinct lanes
+    assert {ev["name"] for ev in by_pid[lanes["r0"]]
+            if ev["ph"] == "X"} == {"prefill", "decode_step"}
+    assert {ev["name"] for ev in by_pid[lanes["r1"]]
+            if ev["ph"] == "X"} == {"decode_step"}
+    names = {(ev["pid"], ev["args"]["name"]) for ev in merged["traceEvents"]
+             if ev["ph"] == "M" and ev["name"] == "process_name"}
+    assert (lanes["r0"], "replica r0 [p]") in names
+    assert (lanes["r1"], "r1") in names
+    # every lane got thread_name metadata for each tid it uses
+    tn = [(ev["pid"], ev["tid"]) for ev in merged["traceEvents"]
+          if ev["ph"] == "M" and ev["name"] == "thread_name"]
+    assert (lanes["r0"], 11) in tn and (lanes["r0"], 12) in tn
+    # partitioning helper: replica arg routes, absent -> default lane
+    lanes2 = split_events_by_replica(
+        [{"ph": "X", "args": {"replica": "rX"}}, {"ph": "X"}])
+    assert set(lanes2) == {"rX", "router"}
+
+
+# ------------------------------------------------- end-to-end fleet trace
+
+def test_disaggregated_request_spans_two_lanes_one_trace(engine, tracer):
+    """One prefill->decode request: a single trace_id, spans on >= 2
+    replica lanes in the merged Perfetto doc, handoff stages in the
+    critical path, and the router statusz section reporting them."""
+    router = build_fleet(engine, _fleet_cfg(
+        {"num_slots": 3}, replicas=2,
+        prefill_replicas=1, decode_replicas=1))
+    prompts = _prompts((6, 9), seed=11)
+    fids = [router.submit(p, SamplingParams(max_new_tokens=5))
+            for p in prompts]
+    router.run_until_idle()
+    for fid in fids:
+        fr = router.result(fid)
+        assert fr.state == "finished"
+        ctx = fr.trace
+        assert ctx is not None and ctx.hops == ["r0", "r1"]
+        path = ctx.critical_path()
+        for stage in ("route", "queue", "prefill", "handoff_serialize",
+                      "handoff_transfer", "handoff_insert", "decode"):
+            assert stage in path, (stage, path)
+        assert abs(sum(path.values()) - ctx.total_ms()) < 1e-6
+    merged = router.aggregator.merged_trace()
+    lanes = merged["otherData"]["lanes"]
+    assert {"router", "r0", "r1"} <= set(lanes)
+    tid = router.result(fids[0]).trace.trace_id
+    pids = {ev["pid"] for ev in merged["traceEvents"]
+            if (ev.get("args") or {}).get("trace_id") == tid}
+    assert len(pids) >= 2, f"trace confined to one lane: {pids}"
+    # per-replica process metadata names the role
+    pnames = {ev["args"]["name"] for ev in merged["traceEvents"]
+              if ev["ph"] == "M" and ev["name"] == "process_name"}
+    assert "replica r0 [prefill]" in pnames
+    assert "replica r1 [decode]" in pnames
+    summary = router.aggregator.critical_path_summary()
+    assert summary["requests"] == len(fids)
+    assert summary["stages"]["handoff_insert"]["n"] == len(fids)
+    assert summary["e2e_ms_p50"] > 0
+    # the decomposition contract: aligned stage means sum to mean e2e
+    assert abs(summary["stage_sum_ms_mean"] - summary["e2e_ms_mean"]) \
+        <= 0.05 * summary["e2e_ms_mean"]
+    # gauges: dedicated dstpu_fleet_path_* series while live, gone after
+    from deepspeed_tpu.telemetry import prometheus_dump
+    dump = prometheus_dump(tracer)
+    assert "dstpu_fleet_path_prefill_ms_p50" in dump
+    assert "dstpu_fleet_path_e2e_ms_p50" in dump
+    router.shutdown()
+    assert not any(t.startswith("fleet/path_")
+                   for t in tracer.counters())
+
+
+# ---------------------------------------------------- failover propagation
+
+def test_failover_replay_is_child_span_same_trace(engine, tracer):
+    """Kill a replica mid-stream: the survivor's spans share the original
+    trace_id with a replay-parent link; every streamed token position is
+    delivered exactly once; the critical path covers both attempts (a
+    ``failover`` stage) and sums to the trace e2e within tolerance."""
+    router = build_fleet(engine, _fleet_cfg(replicas=2))
+    prompts = _prompts((6, 8, 5, 7), seed=31)
+    streamed = {i: [] for i in range(len(prompts))}
+    fids = [router.submit(p, SamplingParams(max_new_tokens=8),
+                          on_token=lambda r, t, i=i:
+                          streamed[i].append(len(r.tokens)))
+            for i, p in enumerate(prompts)]
+    for _ in range(3):                       # requests mid-stream
+        router.step()
+    victim = next(router.result(f).replica for f in fids
+                  if router.result(f).replica is not None)
+    router.kill(victim)
+    router.run_until_idle()
+    replayed = [router.result(f) for f in fids
+                if router.result(f).trace.replays]
+    assert replayed, "the kill never caught a request mid-flight"
+    for i, fid in enumerate(fids):
+        fr = router.result(fid)
+        assert fr.state == "finished", fr.failed_reason
+        # exactly-once delivery: token positions strictly increasing
+        assert streamed[i] == sorted(set(streamed[i]))
+        assert streamed[i][-1] == len(fr.tokens)
+    for fr in replayed:
+        ctx = fr.trace
+        assert len(ctx.span_ids) == 2        # original + replay attempt
+        assert ctx.replay_parent == ctx.span_ids[0]
+        path = ctx.critical_path()
+        assert path.get("failover", 0) > 0   # the re-enqueue gap is visible
+        assert abs(sum(path.values()) - ctx.total_ms()) \
+            <= max(1e-6, 0.05 * ctx.total_ms())
+        # survivor spans: same trace_id, attempt=1, linked to the dead
+        # attempt's span id — a child, not a new trace
+        linked = [s for s in tracer.spans()
+                  if (s.args or {}).get("trace_id") == ctx.trace_id
+                  and (s.args or {}).get("attempt") == 1]
+        assert linked, "no replay-linked spans on the survivor"
+        assert all(s.args["replay_of"] == ctx.span_ids[0] for s in linked)
+        survivor = {s.args.get("replica") for s in linked} - {None}
+        assert survivor and victim not in survivor
+        # every streamed position came from exactly one request span:
+        # the two attempts' spans never overlap in delivered positions
+        first_attempt = [s for s in tracer.spans()
+                         if (s.args or {}).get("trace_id") == ctx.trace_id
+                         and (s.args or {}).get("span_id")
+                         == ctx.span_ids[0]]
+        assert first_attempt, "original attempt left no spans"
+    router.shutdown()
+
+
+# -------------------------------------------- recorder bundle correlation
+
+def test_cross_replica_postmortem_correlates_bundles(engine, tracer,
+                                                     tmp_path):
+    """Bundles embed in-flight trace ids; the router stitches same-trace
+    bundles from its own and the replicas' bundle dirs into one
+    cross-replica postmortem document."""
+    rec_dir = str(tmp_path / "bundles")
+    router = build_fleet(engine, _fleet_cfg(
+        {"flight_recorder": {"enabled": True, "dir": rec_dir}},
+        replicas=2))
+    prompts = _prompts((6, 8, 7), seed=41)
+    fids = [router.submit(p, SamplingParams(max_new_tokens=8))
+            for p in prompts]
+    for _ in range(3):
+        router.step()
+    victim = next(router.result(f).replica for f in fids
+                  if router.result(f).replica is not None)
+    vrec = router.replicas[victim].engine._recorder
+    bundle = vrec.trigger("manual", "pre-failure capture", force=True)
+    assert bundle is not None
+    with open(bundle) as f:
+        vdoc = json.load(f)
+    assert vdoc["in_flight_traces"], "replica bundle embedded no traces"
+    router.kill(victim)           # router failover bundle + correlation
+    router.run_until_idle()
+    by_trace = router.aggregator.correlate_bundles()
+    cross = {tid: refs for tid, refs in by_trace.items()
+             if len({r["member"] for r in refs}) >= 2}
+    assert cross, "no trace seen by both the router and a replica"
+    members = {r["member"] for refs in cross.values() for r in refs}
+    assert "router" in members and victim in members
+    # the failover wrote the merged postmortem next to the router bundles
+    crossfiles = [n for n in os.listdir(os.path.join(rec_dir, "router"))
+                  if n.startswith("crossrep-")]
+    assert crossfiles
+    with open(os.path.join(rec_dir, "router", crossfiles[0])) as f:
+        doc = json.load(f)
+    assert doc["kind"] == "cross_replica_postmortem"
+    assert any(len({r["member"] for r in refs}) >= 2
+               for refs in doc["traces"].values())
+    router.shutdown()
+    # recorder gauges retract with the fleet (owner= lifecycle)
+    assert "recorder/bundles" not in tracer.counters()
+
+
+# ----------------------------------------------------- statusz endpoints
+
+def test_router_statusz_fleet_trace_endpoint(engine, tracer):
+    import urllib.error
+    import urllib.request
+    router = build_fleet(engine, _fleet_cfg(
+        replicas=2, statusz={"enabled": True, "port": 0}))
+    # two requests so BOTH unified replicas serve (and emit lane spans)
+    for p in _prompts((6, 7), seed=51):
+        router.submit(p, SamplingParams(max_new_tokens=3))
+    router.run_until_idle()
+    base = router.statusz.url
+    with urllib.request.urlopen(base + "/fleet/trace?last_ms=60000",
+                                timeout=5) as r:
+        doc = json.load(r)
+    lanes = doc["otherData"]["lanes"]
+    assert {"router", "r0", "r1"} <= set(lanes)
+    assert any(ev["ph"] == "M" and ev["name"] == "process_name"
+               for ev in doc["traceEvents"])
+    # /trace-grade 400 hardening on the new endpoint
+    for bad in ("last_ms=-5", "last_ms=abc", "last_ms=inf"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/fleet/trace?{bad}", timeout=5)
+        assert ei.value.code == 400
+    # statusz JSON carries the critical_path section
+    with urllib.request.urlopen(base + "/statusz?format=json",
+                                timeout=5) as r:
+        sdoc = json.load(r)
+    cpath = sdoc["sections"]["critical_path"]
+    assert cpath["requests"] >= 1 and "prefill_ms_p50" in cpath
+    # a plain replica's statusz (no aggregator) answers 404
+    rep_url = router.replicas["r0"].engine.statusz
+    if rep_url is None:      # replicas only get statusz when configured
+        srv = ServingEngine(engine, {"num_slots": 1, "max_model_len": 32,
+                                     "statusz": {"enabled": True,
+                                                 "port": 0}})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.statusz.url + "/fleet/trace",
+                                   timeout=5)
+        assert ei.value.code == 404
+        srv.shutdown()
+    router.shutdown()
+
+
+# ------------------------------------------- ds_tpu_top concurrent polling
+
+_HANG_RELEASE = threading.Event()
+
+
+class _HangingStatusz(http.server.BaseHTTPRequestHandler):
+    """Accepts the connection, never answers — the hung replica."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        _HANG_RELEASE.wait(timeout=30)
+
+
+class _RouterStatusz(http.server.BaseHTTPRequestHandler):
+    """Serves a crafted router /statusz doc whose fleet table points at
+    the hung replicas (set on the server as ``doc``)."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        body = json.dumps(self.server.doc).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_ds_tpu_top_polls_hung_replicas_concurrently():
+    """Four hung replica endpoints, 1s per-probe timeout: the fleet
+    refresh degrades their rows and completes in ~one timeout, not four
+    (the serial loop this replaces stalled N x timeout)."""
+    hung = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                           _HangingStatusz)
+    hung.daemon_threads = True
+    threading.Thread(target=hung.serve_forever, daemon=True).start()
+    hung_url = f"http://127.0.0.1:{hung.server_address[1]}"
+    table = {f"r{i}": {"role": "unified", "ready": True, "failed": False,
+                       "url": hung_url, "queue_depth": 0,
+                       "active_requests": 0}
+             for i in range(4)}
+    doc = {"process": {"pid": 1, "uptime_s": 1.0, "healthy": True,
+                       "health_detail": "ok"},
+           "counters": {}, "spans": [],
+           "sections": {"fleet": {"replicas": 4, "ready": 4,
+                                  "failovers": 0, "kv_handoffs": 0,
+                                  "pending_requests": 0,
+                                  "replica_table": table}}}
+    router_srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                 _RouterStatusz)
+    router_srv.doc = doc
+    router_srv.daemon_threads = True
+    threading.Thread(target=router_srv.serve_forever, daemon=True).start()
+    try:
+        t0 = time.perf_counter()
+        top = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_tpu_top"),
+             "--once", "--timeout", "1.0",
+             "--url", f"http://127.0.0.1:{router_srv.server_address[1]}"],
+            capture_output=True, text=True, timeout=60)
+        elapsed = time.perf_counter() - t0
+        assert top.returncode == 0, top.stderr
+        # concurrent: ~1 probe timeout + interpreter startup; the serial
+        # loop this test guards against took >= 4s of probing alone
+        assert elapsed < 3.5, f"fleet poll not concurrent: {elapsed:.1f}s"
+        assert top.stdout.count("DEGRADED") == 4
+        assert "r0" in top.stdout and "r3" in top.stdout
+    finally:
+        _HANG_RELEASE.set()
+        hung.shutdown()
+        hung.server_close()
+        router_srv.shutdown()
+        router_srv.server_close()

@@ -1,0 +1,144 @@
+"""Gauge-lifecycle lint sweep (the leak class PRs 4 and 8 fixed by hand).
+
+Every ``dstpu_*`` gauge family a producer registers in the shared
+telemetry counter space must (a) carry an ``owner=`` so it is tied to a
+closable producer, and (b) vanish from ``tracer.counters()`` — and
+therefore from ``prometheus_dump()`` / ``/metrics`` — when that producer
+shuts down. A closed engine's queue depth, a dead fleet's replica count,
+or a disabled ledger's goodput fraction reading as *live* is a silent
+dashboard lie.
+
+The sweep exercises the real producers (training engine with sentinel +
+flight recorder + goodput ledger; serving fleet with router metrics,
+path gauges, SLO gauges, recorder) and then asserts, at the tracer
+level, that every registered tag had an owner and that shutdown retracts
+everything. New gauge families added without an owner fail here instead
+of in a hand-audit five PRs later.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.serving import SamplingParams, build_fleet
+from deepspeed_tpu.telemetry import configure_ledger, get_tracer
+
+VOCAB = 96
+
+#: tags allowed to live without an owner: none today. The monitor-sink
+#: mirror is ownerless BY DESIGN but only re-writes tags its producing
+#: engine already owns, so it never creates an orphan family.
+OWNERLESS_ALLOWED: frozenset = frozenset()
+
+
+@pytest.fixture
+def tracer():
+    tr = get_tracer()
+    prev = tr.enabled
+    tr.clear()
+    tr.configure(enabled=True, buffer_size=4096)
+    yield tr
+    configure_ledger(enabled=False)
+    tr.clear()
+    tr.configure(enabled=prev)
+
+
+def _assert_all_owned(tracer, context: str):
+    orphans = [tag for tag in tracer._counters
+               if tag not in tracer._counter_owners
+               and tag not in OWNERLESS_ALLOWED]
+    assert not orphans, (
+        f"{context}: gauge families registered WITHOUT an owner= "
+        f"(their values would outlive their producer): {sorted(orphans)}")
+
+
+def test_training_engine_gauges_owned_and_released(tracer, tmp_path):
+    model = GPT2Model(GPT2Config(vocab_size=64, n_positions=32, n_embd=32,
+                                 n_layer=1, n_head=2,
+                                 pad_vocab_to_multiple=8))
+    import jax
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": jax.device_count() * 2,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "telemetry": {"enabled": True, "mfu": False},
+        "flight_recorder": {"enabled": True,
+                            "dir": str(tmp_path / "rec"),
+                            "slow_step_factor": 1000.0},
+        "resilience": {"sentinel_policy": "warn",
+                       "handle_signals": False},
+    })
+    rng = np.random.default_rng(0)
+    for _ in range(2):
+        engine.train_batch(batch={"input_ids": rng.integers(
+            0, 63, size=(1, engine.train_batch_size, 16),
+            dtype=np.int32)})
+    # a sentinel observation and a forced bundle register their gauges
+    engine._sentinel.observe(float("nan"), 1.0, step=1)
+    engine._recorder.trigger("manual", "lifecycle sweep", force=True)
+    engine.save_checkpoint(tmp_path / "ckpt")
+    assert "resilience/sentinel_bad_steps" in tracer.counters()
+    assert "recorder/bundles" in tracer.counters()
+    assert any(t.startswith("goodput/") for t in tracer.counters())
+    _assert_all_owned(tracer, "training engine live")
+    engine.close()
+    configure_ledger(enabled=False)   # the ledger is process-global; a
+                                      # disabled ledger retracts its mirror
+    leftovers = {t for t in tracer.counters() if t not in OWNERLESS_ALLOWED}
+    assert not leftovers, (
+        f"gauges survived engine.close() + ledger disable as if live: "
+        f"{sorted(leftovers)}")
+
+
+def test_fleet_gauges_owned_and_released(tracer, tmp_path):
+    model = GPT2Model(GPT2Config(vocab_size=VOCAB, n_positions=64,
+                                 n_embd=64, n_layer=2, n_head=4,
+                                 pad_vocab_to_multiple=1,
+                                 dtype="float32"))
+    inf = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    router = build_fleet(inf, {
+        "num_slots": 2, "max_model_len": 64,
+        "slo": {"ttft_ms": 1.0, "window": 16},     # burn gauges populate
+        "flight_recorder": {"enabled": True,
+                            "dir": str(tmp_path / "fleet_rec")},
+        "fleet": {"enabled": True, "replicas": 2,
+                  "heartbeat_timeout_s": 60.0}})
+    rng = np.random.default_rng(1)
+    fids = [router.submit(rng.integers(0, VOCAB, (t,), dtype=np.int32),
+                          SamplingParams(max_new_tokens=4))
+            for t in (5, 8, 6)]
+    router.step()
+    victim = next(router.result(f).replica for f in fids
+                  if router.result(f).replica is not None)
+    router.kill(victim)               # failover bundle + requeue gauges
+    router.run_until_idle()
+    counters = tracer.counters()
+    assert any(t.startswith("fleet/") for t in counters)
+    assert any(t.startswith("fleet/path_") for t in counters)
+    assert any(t.startswith("serving/") for t in counters)
+    assert "recorder/bundles" in counters
+    _assert_all_owned(tracer, "fleet live")
+    router.shutdown()
+    configure_ledger(enabled=False)
+    leftovers = {t for t in tracer.counters() if t not in OWNERLESS_ALLOWED}
+    assert not leftovers, (
+        f"gauges survived router.shutdown() as if live: "
+        f"{sorted(leftovers)}")
+
+
+def test_prometheus_dump_reflects_retraction(tracer):
+    """The exported text is the user-visible surface of the contract: a
+    family present while live must be absent after its producer closes."""
+    from deepspeed_tpu.serving.metrics import FleetMetrics
+    from deepspeed_tpu.telemetry import prometheus_dump
+    m = FleetMetrics(tracer=tracer)
+    m.update(replicas=2, ready=2, pending=0)
+    tracer.set_counter("fleet/path_prefill_ms_p50", 3.25, owner=m)
+    assert "dstpu_fleet_path_prefill_ms_p50 3.25" in prometheus_dump(tracer)
+    m.close()
+    dump = prometheus_dump(tracer)
+    assert "dstpu_fleet_path_prefill_ms_p50" not in dump
+    assert "dstpu_fleet_ready_replicas" not in dump
