@@ -272,6 +272,58 @@ def run_comm_compression(args):
           f"(inter-host bytes {ratio:.2f}x fewer)")
 
 
+def run_overlap_schedule(args):
+    """Bucketed-overlap vs monolithic ZeRO-3 loss parity (ROADMAP item
+    2's convergence half; benchmarks/overlap.py holds the HLO half):
+    same corpus, same sample order, the explicit exchange once as ONE
+    fused bucket per direction (``overlap: false``) and once as
+    size-targeted layer-order buckets. The two paths are the same math —
+    the coalesced collectives are exact (or per-leaf-codec identical
+    under quantized policies) — so the curves must agree to ~float
+    noise; the gate is |final delta| < 1e-4."""
+    prefix = os.path.join("/tmp", "ds_convergence_corpus")
+    n_samples, n_tokens = build_corpus(prefix, args.seq)
+    print(f"corpus: {n_tokens / 1e6:.2f}M byte tokens, "
+          f"{n_samples} samples of seq {args.seq}", flush=True)
+
+    def sched(overlap):
+        return {"overlap_schedule": {
+            "enabled": True, "overlap": overlap,
+            "bucket_bytes": 256 << 10}}
+
+    print(f"training ZeRO-3 monolithic schedule for {args.steps} steps",
+          flush=True)
+    mono = train(3, args.steps, args.seq, prefix, args.micro_bs,
+                 family=args.model, extra_config=sched(False))
+    print(f"training ZeRO-3 bucketed schedule for {args.steps} steps",
+          flush=True)
+    bucketed = train(3, args.steps, args.seq, prefix, args.micro_bs,
+                     family=args.model, extra_config=sched(True))
+
+    a, b = np.asarray(mono), np.asarray(bucketed)
+    report = {
+        "mode": "overlap_schedule", "steps": args.steps, "seq": args.seq,
+        "model": make_model(args.model, args.seq)[1],
+        "curves": {"monolithic": mono, "bucketed": bucketed},
+        "init_loss": mono[0],
+        "final_loss": {"monolithic": float(np.mean(a[-10:])),
+                       "bucketed": float(np.mean(b[-10:]))},
+        "final_delta": float(np.mean(b[-10:]) - np.mean(a[-10:])),
+        "max_step_delta": float(np.max(np.abs(a - b))),
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps({k: v for k, v in report.items() if k != "curves"},
+                     indent=2))
+    assert np.mean(a[-10:]) < a[0] * 0.75, "monolithic failed to learn"
+    assert abs(report["final_delta"]) < 1e-4, (
+        f"bucketed schedule diverged from the monolithic path: "
+        f"final delta {report['final_delta']:+.6f} (must be < 1e-4)")
+    print(f"OVERLAP-SCHEDULE PARITY OK (final delta "
+          f"{report['final_delta']:+.2e})")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=300)
@@ -290,6 +342,11 @@ def main():
     ap.add_argument("--policy", default="int8",
                     choices=["int8", "fp8_block"],
                     help="--comm-compression wire format")
+    ap.add_argument("--overlap-schedule", action="store_true",
+                    dest="overlap_schedule",
+                    help="bucketed-vs-monolithic ZeRO-3 loss-parity mode "
+                         "(runtime/zero/overlap_schedule.py; asserts "
+                         "|final delta| < 1e-4)")
     ap.add_argument("--compile-plane", action="store_true",
                     dest="compile_plane",
                     help="enable the compile/memory plane during the "
@@ -308,6 +365,8 @@ def main():
             suffix = "_features" + suffix
         if args.comm_compression:
             suffix = "_comm_compression" + suffix
+        if args.overlap_schedule:
+            suffix = "_overlap" + suffix
         args.out = os.path.join(REPO, "benchmarks",
                                 f"convergence{suffix}.json")
     if args.cpu:
@@ -322,7 +381,8 @@ def main():
         # the comm-compression parity mode measures a multi-member wire:
         # give it the 8-device virtual mesh (2 members/host in the
         # default config -> 4 modeled hosts)
-        hermetic.force_cpu(device_count=8 if args.comm_compression
+        hermetic.force_cpu(device_count=8 if (args.comm_compression or
+                                              args.overlap_schedule)
                            else None)
     import jax
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
@@ -332,6 +392,8 @@ def main():
         return run_features(args)
     if args.comm_compression:
         return run_comm_compression(args)
+    if args.overlap_schedule:
+        return run_overlap_schedule(args)
 
     prefix = os.path.join("/tmp", "ds_convergence_corpus")
     n_samples, n_tokens = build_corpus(prefix, args.seq)

@@ -1,5 +1,11 @@
-"""Autotuning (reference deepspeed/autotuning)."""
+"""Autotuning (reference deepspeed/autotuning) + the DeepCompile-style
+schedule autotuner (autotuning/schedule.py, ``bin/ds_tpu_tune``)."""
 
 from .autotuner import Autotuner, Experiment
+from .cost_model import ScheduleCostModel
+from .schedule import (SchedulePlan, ScheduleTuner, default_plans,
+                       engine_fingerprint, plan_from_config, tune_schedule)
 
-__all__ = ["Autotuner", "Experiment"]
+__all__ = ["Autotuner", "Experiment", "ScheduleCostModel", "SchedulePlan",
+           "ScheduleTuner", "default_plans", "engine_fingerprint",
+           "plan_from_config", "tune_schedule"]

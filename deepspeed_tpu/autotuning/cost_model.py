@@ -86,6 +86,50 @@ def predict_throughput(shape: ModelShape, micro_bs: int, stage: int,
     return eff * dp / flops_per_sample
 
 
+@dataclasses.dataclass
+class ScheduleCostModel:
+    """Alpha-beta step-time model for comm-schedule plans (the
+    DeepCompile-flavored scorer, arxiv 2504.09983 §4: candidate plans
+    are ranked by a profile-free cost model before anything runs).
+
+    Inputs come from lowering the REAL step and reading XLA's own
+    accounting (telemetry/hlo_cost.py): module FLOPs from
+    ``cost_analysis``, wire bytes from the comm dispatch's trace-time
+    byte model, collective count and the dependency-level static
+    overlap fraction from the compiled HLO. The score is estimated
+    seconds/step:
+
+        compute  = flops / peak_flops
+        comm     = n_collectives * op_latency_s + wire / link_bandwidth
+        hidden   = overlap_efficiency * overlap_fraction
+                   * min(comm, compute)
+        score    = compute + comm - hidden
+
+    which prices exactly the tradeoff the bucket-size axis moves along:
+    fewer, larger collectives pay less per-op latency but expose more
+    serial comm; finer buckets overlap more but stack up issue costs.
+    Constants default to TPU-generation-plausible values; they cancel
+    in PLAN comparisons as long as they are held fixed, which is why
+    the tuner persists them alongside the winner."""
+    peak_flops: float = 100e12          # per-device sustained matmul
+    link_bandwidth: float = 40e9        # bytes/s per ICI link direction
+    op_latency_s: float = 2e-6          # fixed issue cost per collective
+    overlap_efficiency: float = 0.9     # fraction of a window truly usable
+
+    def score(self, flops: float, wire_bytes: float, n_collectives: float,
+              overlap_fraction: float) -> float:
+        compute_s = flops / self.peak_flops
+        comm_s = (n_collectives * self.op_latency_s +
+                  wire_bytes / self.link_bandwidth)
+        hidden = (self.overlap_efficiency *
+                  min(max(overlap_fraction, 0.0), 1.0) *
+                  min(comm_s, compute_s))
+        return compute_s + comm_s - hidden
+
+    def to_dict(self) -> Dict[str, float]:
+        return dataclasses.asdict(self)
+
+
 class ResidualSurrogate:
     """Least-squares correction on top of the analytic prior (the role of
     the reference's XGBoost cost model, sized for tens of trials): fits

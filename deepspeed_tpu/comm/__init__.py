@@ -1,6 +1,7 @@
 from .comm import (ReduceOp, init_distributed, is_initialized, get_rank,
                    get_world_size, get_local_rank, barrier, broadcast_object,
                    destroy_process_group, all_reduce, all_gather,
+                   all_gather_coalesced, reduce_scatter_coalesced,
                    reduce_scatter, all_to_all, broadcast, ppermute,
                    send_recv_next, send_recv_prev, axis_index, axis_size,
                    log_summary,

@@ -449,6 +449,126 @@ def reduce_scatter(x, axis_name="data", axis: int = 0, op: str = ReduceOp.SUM):
         return out
 
 
+# ---------------------------------------------------------------- coalesced
+# Bucketed forms for the overlap schedule (runtime/zero/overlap_schedule.py,
+# reference runtime/comm/coalesced_collectives.py): a BUCKET of leaves moves
+# in ONE collective. Accounting stays honest by construction — one op is
+# recorded whose logical/wire bytes are the SUMS of the per-leaf models, so
+# N buckets and N leaves log identical byte totals and differ only in the
+# op count (the delta the flight recorder diffs between schedules). Under a
+# quantized policy every leaf is encoded with exactly the per-leaf codec
+# (same blocks, same scales) and only wire payloads are concatenated, so
+# the dequantized values are bitwise identical to the per-leaf collectives.
+
+def all_gather_coalesced(xs: Sequence, axis_name="data",
+                         axes: Optional[Sequence[int]] = None):
+    """Gather a bucket of shards in one collective; returns the per-leaf
+    gathered tensors (each = ``all_gather(x, axis_name, axis)``)."""
+    xs = list(xs)
+    axes = [0] * len(xs) if axes is None else list(axes)
+    logical = sum(_size_bytes(x) for x in xs)
+    n = _participants(axis_name)
+    cc = get_comm_compression()
+    policy = cc.policy_for("all_gather", axis_name, logical) if n > 1 \
+        else "off"
+    if policy in ("int8", "fp8_block"):
+        from .quantized import (quantized_all_gather_coalesced,
+                                quantized_all_gather_coalesced_wire_bytes)
+        wire = quantized_all_gather_coalesced_wire_bytes(
+            [x.size for x in xs], n, cc.block_size)
+        _account("all_gather", logical, wire, n, axis_name)
+        with _comm_span("all_gather", logical, wire, axis_name, n, policy):
+            return quantized_all_gather_coalesced(xs, axis_name, axes, n,
+                                                  cc.block_size, policy)
+    wire = sum(_base_wire("all_gather", _size_bytes(x), n) for x in xs)
+    _account("all_gather", logical, wire, n, axis_name)
+    with _comm_span("all_gather", logical, wire, axis_name, n):
+        if n <= 1:
+            return [lax.all_gather(x, axis_name, axis=a, tiled=True)
+                    for x, a in zip(xs, axes)]
+        flat = jnp.concatenate([x.reshape(-1) for x in xs])
+        g = lax.all_gather(flat, axis_name)          # [n, total]
+        outs = []
+        off = 0
+        for x, axis in zip(xs, axes):
+            seg = g[:, off:off + x.size].reshape((n,) + x.shape)
+            off += x.size
+            out = jnp.moveaxis(seg, 0, axis)
+            shape = list(x.shape)
+            shape[axis] *= n
+            outs.append(out.reshape(shape))
+        return outs
+
+
+def reduce_scatter_coalesced(xs: Sequence, axis_name="data",
+                             axes: Optional[Sequence[int]] = None,
+                             op: str = ReduceOp.SUM):
+    """Reduce-scatter a bucket of full-size tensors in one collective;
+    returns the per-leaf reduced shards (each =
+    ``reduce_scatter(x, axis_name, axis, op)``)."""
+    xs = list(xs)
+    axes = [0] * len(xs) if axes is None else list(axes)
+    logical = sum(_size_bytes(x) for x in xs)
+    n = _participants(axis_name)
+    cc = get_comm_compression()
+    policy = cc.policy_for("reduce_scatter", axis_name, logical) \
+        if (op in _SUMLIKE and n > 1) else "off"
+    if policy in ("int8", "fp8_block") and \
+            all(x.shape[a] % n == 0 for x, a in zip(xs, axes)):
+        from .quantized import (
+            hierarchical_reduce_scatter_coalesced,
+            hierarchical_reduce_scatter_coalesced_wire_bytes,
+            quantized_reduce_scatter_coalesced,
+            quantized_reduce_scatter_coalesced_wire_bytes)
+        from ..parallel.topology import hierarchical_axis_groups
+        avg = op == ReduceOp.AVG
+        sizes = [x.size for x in xs]
+        local = cc.local_members(n) if cc.hierarchical else 0
+        if local:
+            intra_g, inter_g = hierarchical_axis_groups(n, local)
+            intra_b, inter_b = \
+                hierarchical_reduce_scatter_coalesced_wire_bytes(
+                    sizes, n, local, cc.block_size, xs[0].dtype.itemsize)
+            wire = intra_b + inter_b
+            _account("reduce_scatter", logical, wire, n, axis_name,
+                     inter=inter_b)
+            with _comm_span("reduce_scatter", logical, wire, axis_name, n,
+                            policy):
+                return hierarchical_reduce_scatter_coalesced(
+                    xs, axis_name, axes, n, local, intra_g, inter_g,
+                    cc.block_size, policy, avg)
+        wire = quantized_reduce_scatter_coalesced_wire_bytes(
+            sizes, n, cc.block_size)
+        _account("reduce_scatter", logical, wire, n, axis_name)
+        with _comm_span("reduce_scatter", logical, wire, axis_name, n,
+                        policy):
+            return quantized_reduce_scatter_coalesced(
+                xs, axis_name, axes, n, cc.block_size, policy, avg)
+    wire = sum(_base_wire("reduce_scatter", _size_bytes(x), n) for x in xs)
+    _account("reduce_scatter", logical, wire, n, axis_name)
+    with _comm_span("reduce_scatter", logical, wire, axis_name, n):
+        if n <= 1:
+            outs = [lax.psum_scatter(x, axis_name, scatter_dimension=a,
+                                     tiled=True) for x, a in zip(xs, axes)]
+        else:
+            rows = jnp.concatenate(
+                [jnp.moveaxis(x, a, 0).reshape(n, -1)
+                 for x, a in zip(xs, axes)], axis=1)       # [n, total//n]
+            red = lax.psum_scatter(rows.reshape(-1), axis_name,
+                                   scatter_dimension=0, tiled=True)
+            outs = []
+            off = 0
+            for x, a in zip(xs, axes):
+                sz = x.size // n
+                rest = tuple(s for i, s in enumerate(x.shape) if i != a)
+                seg = red[off:off + sz].reshape((x.shape[a] // n,) + rest)
+                off += sz
+                outs.append(jnp.moveaxis(seg, 0, a))
+        if op == ReduceOp.AVG:
+            outs = [o / axis_size(axis_name) for o in outs]
+        return outs
+
+
 def all_to_all(x, axis_name="expert", split_axis: int = 0, concat_axis: int = 0):
     """MoE dispatch/combine primitive (reference sharded_moe.py:90 _AllToAll)."""
     cc, policy, n, logical = _dispatch("all_to_all", x, axis_name)

@@ -177,6 +177,168 @@ def hierarchical_reduce_scatter_wire_bytes(
     return intra, inter
 
 
+# ------------------------------------------------- coalesced (bucketed) forms
+#
+# One collective per BUCKET of leaves (runtime/zero/overlap_schedule.py):
+# each leaf is quantized with EXACTLY the per-leaf codec rules above —
+# same blocks, same scales — and only the wire payloads are concatenated.
+# The exchanged bytes and the dequantized values are therefore bitwise
+# identical to running the per-leaf collectives one by one, for any
+# bucketing; what changes is the op count (N leaves -> 1 collective) and
+# the schedule structure the bucketed exchange builds from it.
+
+def quantized_all_gather_coalesced(xs, axis_name, axes, n: int,
+                                   block: int, wire: str):
+    """Blockwise-quantized tiled all-gather of a bucket of leaves in one
+    collective pair (payload + scales). Returns the per-leaf gathered
+    tensors, each bitwise identical to ``quantized_all_gather``."""
+    qs, ss = [], []
+    for x in xs:
+        q, s = quantize_blockwise(x, block, wire)
+        qs.append(q.reshape(-1))
+        ss.append(s)
+    gq = lax.all_gather(jnp.concatenate(qs), axis_name)    # [n, total]
+    gs = lax.all_gather(jnp.concatenate(ss), axis_name)    # [n, nb_total]
+    outs = []
+    off = soff = 0
+    for x, axis in zip(xs, axes):
+        nb = block_count(x.size, block)
+        q = gq[:, off:off + x.size]
+        s = gs[:, soff:soff + nb]
+        off += x.size
+        soff += nb
+        deq = q.reshape(n, nb, -1).astype(jnp.float32) * s[:, :, None]
+        deq = deq.reshape((n,) + x.shape)
+        out = jnp.moveaxis(deq, 0, axis)
+        shape = list(x.shape)
+        shape[axis] *= n
+        outs.append(out.reshape(shape).astype(x.dtype))
+    return outs
+
+
+def quantized_all_gather_coalesced_wire_bytes(sizes, n: int,
+                                              block: int) -> int:
+    return sum(quantized_all_gather_wire_bytes(s, n, block) for s in sizes)
+
+
+def _leaf_rows(x, axis, n: int):
+    """[n, x.size//n] per-member rows of one reduce-scatter leaf (row m =
+    member m's chunk along ``axis``)."""
+    return jnp.moveaxis(x, axis, 0).reshape(n, -1)
+
+
+def _unleaf_rows(total, x, axis, n: int):
+    """Inverse of :func:`_leaf_rows` for the reduced [x.size//n] chunk."""
+    rest = tuple(s for i, s in enumerate(x.shape) if i != axis)
+    out = total.reshape((x.shape[axis] // n,) + rest)
+    return jnp.moveaxis(out, 0, axis).astype(x.dtype)
+
+
+def quantized_reduce_scatter_coalesced(xs, axis_name, axes, n: int,
+                                       block: int, wire: str, avg: bool):
+    """Flat quantized reduce-scatter of a bucket in one all-to-all pair;
+    per-leaf results bitwise identical to ``quantized_reduce_scatter``."""
+    qs, ss = [], []
+    for x, axis in zip(xs, axes):
+        q, s = _rows_quantize(_leaf_rows(x, axis, n), block, wire)
+        qs.append(q)
+        ss.append(s)
+    rq = lax.all_to_all(jnp.concatenate(qs, axis=1), axis_name,
+                        split_axis=0, concat_axis=0, tiled=False)
+    rs = lax.all_to_all(jnp.concatenate(ss, axis=1), axis_name,
+                        split_axis=0, concat_axis=0, tiled=False)
+    outs = []
+    off = soff = 0
+    for x, axis in zip(xs, axes):
+        sz = x.size // n
+        nb = ss[len(outs)].shape[1]
+        q = rq[:, off:off + sz]
+        s = rs[:, soff:soff + nb]
+        off += sz
+        soff += nb
+        deq = q.reshape(n, nb, -1).astype(jnp.float32) * s[:, :, None]
+        total = jnp.sum(deq.reshape(n, sz), axis=0)
+        if avg:
+            total = total / n
+        outs.append(_unleaf_rows(total, x, axis, n))
+    return outs
+
+
+def quantized_reduce_scatter_coalesced_wire_bytes(sizes, n: int,
+                                                  block: int) -> int:
+    return sum(quantized_reduce_scatter_wire_bytes(s, n, block)
+               for s in sizes)
+
+
+def hierarchical_reduce_scatter_coalesced(xs, axis_name, axes, n: int,
+                                          local: int, intra_groups,
+                                          inter_groups, block: int,
+                                          wire: str, avg: bool):
+    """Two-level (ZeRO++ qgZ) reduce-scatter of a bucket: ONE intra-host
+    full-precision psum_scatter + ONE inter-host quantized all-to-all
+    pair for all leaves together; per-leaf results bitwise identical to
+    ``hierarchical_reduce_scatter``."""
+    hosts = n // local
+    # leg 1: per-leaf chunk-grid swap, then one intra-host reduce-scatter
+    # of the concatenated [local, dim/local * rest] rows
+    zs = []
+    for x, axis in zip(xs, axes):
+        xm = jnp.moveaxis(x, axis, 0)
+        dim = xm.shape[0]
+        chunk = dim // n
+        y = xm.reshape(hosts, local, chunk, *xm.shape[1:])
+        y = jnp.swapaxes(y, 0, 1).reshape(dim, *xm.shape[1:])
+        zs.append(y.reshape(local, -1))
+    part = lax.psum_scatter(jnp.concatenate(zs, axis=1), axis_name,
+                            scatter_dimension=0,
+                            axis_index_groups=intra_groups,
+                            tiled=True).reshape(-1)
+    # leg 2: per-leaf quantized rows, one inter-host all-to-all pair
+    qs, ss = [], []
+    offs = []
+    off = 0
+    for x, z in zip(xs, zs):
+        width = z.shape[1]               # hosts * chunk * rest values
+        rows = part[off:off + width].reshape(hosts, -1)
+        off += width
+        q, s = _rows_quantize(rows, block, wire)
+        qs.append(q)
+        ss.append(s)
+    rq = lax.all_to_all(jnp.concatenate(qs, axis=1), axis_name,
+                        split_axis=0, concat_axis=0, tiled=False,
+                        axis_index_groups=inter_groups)
+    rs = lax.all_to_all(jnp.concatenate(ss, axis=1), axis_name,
+                        split_axis=0, concat_axis=0, tiled=False,
+                        axis_index_groups=inter_groups)
+    outs = []
+    off = soff = 0
+    for i, (x, axis) in enumerate(zip(xs, axes)):
+        sz = x.size // (local * hosts)
+        nb = ss[i].shape[1]
+        q = rq[:, off:off + sz]
+        s = rs[:, soff:soff + nb]
+        off += sz
+        soff += nb
+        deq = q.reshape(hosts, nb, -1).astype(jnp.float32) * s[:, :, None]
+        total = jnp.sum(deq.reshape(hosts, sz), axis=0)
+        if avg:
+            total = total / n
+        outs.append(_unleaf_rows(total, x, axis, n))
+    return outs
+
+
+def hierarchical_reduce_scatter_coalesced_wire_bytes(
+        sizes, n: int, local: int, block: int,
+        elem_bytes: int) -> Tuple[int, int]:
+    intra = inter = 0
+    for s in sizes:
+        i, e = hierarchical_reduce_scatter_wire_bytes(
+            s, n, local, block, elem_bytes)
+        intra += i
+        inter += e
+    return intra, inter
+
+
 # ------------------------------------------------------------------ all_reduce
 
 def quantized_all_reduce(x, axis_name, n: int, block: int, wire: str,

@@ -41,6 +41,13 @@ class GPT2Config:
     pos_offset: int = 0            # learned-position offset (OPT uses 2)
     remat: bool = False            # activation checkpointing over the layer scan
     remat_policy: Optional[str] = None  # see runtime/activation_checkpointing
+    # layer-scan unroll factor (forwarded to lax.scan). 1 = rolled while
+    # loop (O(1) compile). >= n_layer inlines every layer into the step
+    # program — what the bucketed ZeRO overlap schedule
+    # (runtime/zero/overlap_schedule.py) needs so per-layer-chunk
+    # collectives get per-layer compute between issue and first use
+    # instead of one opaque while op
+    scan_unroll: int = 1
     # vocab-chunked online-softmax loss: "auto" = only when the full logits
     # tensor would be large (the chunked path trades ~one extra vocab matmul
     # of recompute for never materializing [B,T,V])
@@ -382,8 +389,10 @@ class GPT2Model(ModelSpec):
             body_fn = jax.checkpoint(body, policy=get_policy(cfg.remat_policy))
         xs = params["blocks"] if extras is None else (params["blocks"],
                                                       extras)
-        (x, _, aux_total), _ = lax.scan(body_fn, (x, 0, jnp.float32(0.0)),
-                                        xs)
+        (x, _, aux_total), _ = lax.scan(
+            body_fn, (x, 0, jnp.float32(0.0)), xs,
+            unroll=min(max(1, int(getattr(cfg, "scan_unroll", 1))),
+                       cfg.n_layer))
 
         x = self._final_norm(params, x)
         return x, aux_total / cfg.n_layer, \

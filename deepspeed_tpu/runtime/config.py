@@ -262,7 +262,13 @@ class CompilePlaneConfig(DeepSpeedConfigModel):
       (``dstpu_mem_*`` gauges + Perfetto waterline) and its update
       cadence.
     - ``overlap`` / ``overlap_interval_steps`` / ``overlap_window_ms``:
-      the trace-ring overlap gauge and its cadence/window."""
+      the trace-ring overlap gauge and its cadence/window.
+    - ``overlap_floor``: minimum acceptable HLO-static overlap fraction
+      per compiled step program. When a RECOMPILE produces a program
+      whose static fraction falls below the floor, the flight recorder
+      fires an ``overlap_drop`` bundle (a recompile that silently
+      de-overlaps the schedule is a goodput regression the MFU gauge
+      only shows as "slower"). 0 disables the check."""
     enabled: bool = False
     history: int = 32
     memory_analysis: bool = True
@@ -271,8 +277,12 @@ class CompilePlaneConfig(DeepSpeedConfigModel):
     overlap: bool = True
     overlap_interval_steps: int = 16
     overlap_window_ms: float = 30_000.0
+    overlap_floor: float = 0.0
 
     def validate(self):
+        if not 0.0 <= self.overlap_floor <= 1.0:
+            raise ConfigError(
+                "compile_plane.overlap_floor must be in [0, 1]")
         if self.history < 1:
             raise ConfigError("compile_plane.history must be >= 1")
         if self.hbm_interval_steps < 1:
@@ -377,6 +387,13 @@ class DeepSpeedConfig:
         from ..comm.compression import CommCompressionConfig
         self.comm_compression = CommCompressionConfig.from_dict(
             pd.get(C.COMM_COMPRESSION, {}))
+        # bucketed compute-communication overlap for the ZeRO exchanges
+        # (runtime/zero/overlap_schedule.py, docs/comm.md): size-targeted
+        # layer-order buckets moved through coalesced collectives, issued
+        # ahead of their consuming layers
+        from .zero.overlap_schedule import OverlapScheduleConfig
+        self.overlap_schedule = OverlapScheduleConfig.from_dict(
+            pd.get(C.OVERLAP_SCHEDULE, {}))
         self.tensorboard = MonitorSinkConfig.from_dict(pd.get(C.TENSORBOARD, {}))
         self.wandb = MonitorSinkConfig.from_dict(pd.get(C.WANDB, {}))
         self.csv_monitor = MonitorSinkConfig.from_dict(pd.get(C.CSV_MONITOR, {}))

@@ -76,6 +76,7 @@ WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
 DUMP_STATE = "dump_state"
 COMMS_LOGGER = "comms_logger"
 COMM_COMPRESSION = "comm_compression"
+OVERLAP_SCHEDULE = "overlap_schedule"
 MEMORY_BREAKDOWN = "memory_breakdown"
 TENSORBOARD = "tensorboard"
 WANDB = "wandb"

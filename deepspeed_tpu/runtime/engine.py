@@ -453,7 +453,8 @@ class DeepSpeedEngine:
                 self._overlap = OverlapAnalyzer(
                     tracer=self.tracer, owner=self,
                     interval_steps=cpcfg.overlap_interval_steps,
-                    window_ms=cpcfg.overlap_window_ms)
+                    window_ms=cpcfg.overlap_window_ms,
+                    floor=cpcfg.overlap_floor, recorder=self._recorder)
             if self._recorder is not None:
                 self._recorder.attach_compile_plane(self._compile_plane)
         # per-engine monitor-event buffer (bounded: survives a disabled
@@ -526,13 +527,38 @@ class DeepSpeedEngine:
         configure_comm_compression(cfg.comm_compression)
         self._cc_zero_active = (cfg.comm_compression.zero_path_active and
                                 self.mesh_manager.dp_world_size > 1)
+        # ---- bucketed overlap schedule (runtime/zero/overlap_schedule.py,
+        #      docs/comm.md): the explicit exchange additionally takes
+        #      schedule ownership — size-targeted layer-order buckets
+        #      through coalesced collectives, issued ahead of their first
+        #      consuming layer. Composes with comm_compression through the
+        #      same dispatch (quantized wire per bucket, per-leaf codec).
+        self._sched_active = (cfg.overlap_schedule.enabled and
+                              self.mesh_manager.dp_world_size > 1)
         self._compressed_grad_fns: Dict[Any, Any] = {}
-        if self._cc_zero_active:
+        if self._cc_zero_active or self._sched_active:
             from .config_utils import ConfigError
-            from .zero.compressed_step import compression_scope_error
-            err = compression_scope_error(cfg, self)
+            from .zero.compressed_step import explicit_scope_error
+            feature = "overlap_schedule" if self._sched_active else \
+                "comm_compression"
+            err = explicit_scope_error(self, feature)
             if err:
                 raise ConfigError(err)
+        if self._sched_active:
+            from .zero.overlap_schedule import build_schedule
+            _, _, _, sched_info = build_schedule(self, cfg.overlap_schedule)
+            self._sched_info = sched_info
+            log_dist(
+                "overlap_schedule: bucketed ZeRO exchange active "
+                f"(overlap={cfg.overlap_schedule.overlap} "
+                f"bucket_bytes={cfg.overlap_schedule.bucket_bytes} "
+                f"gather_buckets={sched_info['gather_buckets']} "
+                f"rs_buckets={sched_info['rs_buckets']} "
+                f"layer_chunks={len(sched_info['layer_chunks'])})",
+                ranks=[0])
+        else:
+            self._sched_info = None
+        if self._cc_zero_active:
             log_dist(
                 "comm_compression: explicit ZeRO exchange active "
                 f"(all_gather={cfg.comm_compression.all_gather} "
@@ -682,13 +708,19 @@ class DeepSpeedEngine:
         return new_params, new_opt, new_scaler, finite, grad_norm, applied
 
     def _compressed_micro_grad(self, ltd_keep):
-        """The shard_map'd explicit-ZeRO micro-gradient (runtime/zero/
-        compressed_step.py), cached per random-LTD token budget like the
-        jitted step fns."""
+        """The shard_map'd explicit-ZeRO micro-gradient — bucketed
+        overlap schedule (runtime/zero/overlap_schedule.py) when
+        ``overlap_schedule`` is on, else the per-leaf compressed exchange
+        (runtime/zero/compressed_step.py) — cached per random-LTD token
+        budget like the jitted step fns."""
         if ltd_keep not in self._compressed_grad_fns:
-            from .zero.compressed_step import make_compressed_micro_grad
-            self._compressed_grad_fns[ltd_keep] = \
-                make_compressed_micro_grad(self, ltd_keep)
+            if self._sched_active:
+                from .zero.overlap_schedule import make_bucketed_micro_grad
+                fn = make_bucketed_micro_grad(self, ltd_keep)
+            else:
+                from .zero.compressed_step import make_compressed_micro_grad
+                fn = make_compressed_micro_grad(self, ltd_keep)
+            self._compressed_grad_fns[ltd_keep] = fn
         return self._compressed_grad_fns[ltd_keep]
 
     def _compile_fns(self):
@@ -720,10 +752,11 @@ class DeepSpeedEngine:
             # step instead of once per micro step.
             pc = _cast_tree(params, self._compute_dtype)
 
-            if self._cc_zero_active:
+            if self._cc_zero_active or self._sched_active:
                 # explicit (policy-dispatched) ZeRO exchange: quantized
                 # param gathers + hierarchical grad reduce-scatters run
-                # through comm/ instead of GSPMD-inserted collectives
+                # through comm/ instead of GSPMD-inserted collectives;
+                # bucketed + issue-ordered when overlap_schedule is on
                 cfn = self._compressed_micro_grad(ltd_keep)
 
                 def grad_fn(pc_, mb, r):
@@ -824,7 +857,7 @@ class DeepSpeedEngine:
         # --- micro grad (forward/backward API path) ---
         def make_micro_grad(ltd_keep):
             def micro_grad(params, mb, rng, scale, pld_theta):
-                if self._cc_zero_active:
+                if self._cc_zero_active or self._sched_active:
                     pc = _cast_tree(params, self._compute_dtype)
                     loss, g = self._compressed_micro_grad(ltd_keep)(
                         pc, mb, rng, scale, pld_theta)
@@ -1225,7 +1258,12 @@ class DeepSpeedEngine:
             self._compile_plane.finish(
                 cp_ev, (time.perf_counter() - t_cp) * 1e3)
             if self._overlap is not None and cp_ev.get("overlap"):
-                self._overlap.note_hlo(cp_ev["overlap"])
+                # a recompile whose program de-overlapped the schedule
+                # trips the overlap_floor -> flight-recorder trigger
+                self._overlap.note_hlo(cp_ev["overlap"],
+                                       kind=cp_ev.get("kind", "compile"),
+                                       label=cp_ev.get("label", ""),
+                                       step=cp_ev.get("step"))
         # goodput classification: a step that paid the initial XLA compile
         # or a watchdog-flagged recompile was not productive step time —
         # the first sight is read BEFORE _telemetry_step_end registers fn
@@ -1839,6 +1877,8 @@ class DeepSpeedEngine:
                     f"ep{self.mesh_manager.ep}/sp{self.mesh_manager.sp}/"
                     f"tp{self.mesh_manager.tp}",
         }
+        if self._sched_info is not None:
+            out["overlap_schedule"] = self._sched_info
         for tag in ("telemetry/step_time_ms", "telemetry/mfu",
                     "telemetry/step_tflops", "telemetry/peak_hbm_gib"):
             val = gauge(tag)

@@ -67,21 +67,27 @@ def _dp_dim(spec) -> int:
     return -1
 
 
-def compression_scope_error(cfg, engine) -> Optional[str]:
-    """Why the compressed ZeRO path cannot run under this config, or None.
-    The engine raises this at init — accepted config = active config."""
+def explicit_scope_error(engine, feature: str) -> Optional[str]:
+    """Why an explicit (shard_map) ZeRO exchange cannot run under this
+    config, or None. The engine raises this at init — accepted config =
+    active config. ``feature`` names the block that asked for the path
+    (``comm_compression`` or ``overlap_schedule``)."""
     mm = engine.mesh_manager
     if mm.pp > 1 or mm.tp > 1 or mm.sp > 1 or mm.ep > 1:
-        return ("comm_compression: the explicit ZeRO exchange supports "
+        return (f"{feature}: the explicit ZeRO exchange supports "
                 "pure data parallelism only (pp=tp=sp=ep=1); got "
                 f"pp={mm.pp} tp={mm.tp} sp={mm.sp} ep={mm.ep}. Disable "
-                "the all_gather/reduce_scatter/all_reduce policies or "
-                "drop the model-parallel axes")
+                "the block or drop the model-parallel axes")
     if engine._offload is not None or engine._param_runner is not None:
-        return ("comm_compression: not supported together with "
+        return (f"{feature}: not supported together with "
                 "ZeRO-Offload / param offload (the offload runners own "
                 "their own step functions)")
     return None
+
+
+def compression_scope_error(cfg, engine) -> Optional[str]:
+    del cfg
+    return explicit_scope_error(engine, "comm_compression")
 
 
 def make_compressed_micro_grad(engine, ltd_keep=None):

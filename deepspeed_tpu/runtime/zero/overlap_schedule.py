@@ -1,0 +1,339 @@
+"""Bucketed compute–communication overlap schedule for ZeRO exchanges.
+
+The explicit ZeRO path (runtime/zero/compressed_step.py) moves every
+param/grad leaf in its own collective and lets XLA schedule the lot —
+monolithic per-step exchanges the latency-hiding scheduler may or may
+not hide. This module takes schedule ownership (ROADMAP item 2;
+T3-style producer-triggered collectives, arxiv 2401.16677; DeepCompile
+cost-driven planning, arxiv 2504.09983):
+
+1. **Partition** the param/grad leaves into size-targeted buckets in
+   layer order. Layer-stacked leaves (the ``blocks`` subtree the GPT-2
+   family scans over — shape ``[n_layer, ...]``) are sliced along the
+   layer dim into uniform chunk ranges first, so a bucket holds
+   "layers lo..hi of every weight kind" rather than "one weight kind
+   for all layers" — the unit a consuming layer actually waits for.
+2. **Exchange per bucket** through the coalesced comm dispatch
+   (:func:`comm.all_gather_coalesced` / ``reduce_scatter_coalesced``):
+   one collective per bucket, per-leaf codec under a quantized
+   ``comm_compression`` policy (bitwise identical to the per-leaf
+   collectives — comm/quantized.py), honest byte accounting (N buckets
+   log the same totals as N leaves; only the op count changes).
+3. **Order the issues**: stage-3 param gathers are emitted bucket-by-
+   bucket in layer order ahead of their first consuming layer, grad
+   reduce-scatters in reverse layer order as each bucket's backward
+   finishes — the dataflow structure ``telemetry/hlo_cost.py``'s
+   ``collect_schedule_overlap`` measures and a latency-hiding backend
+   exploits. ``pin_order`` additionally chains
+   ``lax.optimization_barrier`` through consecutive buckets so a
+   scheduler cannot sink an issue past the previous bucket's compute
+   (XLA:TPU honors the pin; the CPU lowering drops barriers, which is
+   why the *measured* evidence is the dependency-level metric).
+
+``overlap: false`` collapses each exchange direction to ONE fused
+bucket — the monolithic schedule, and the baseline every overlap
+number in benchmarks/overlap.py is measured against.
+
+Pair with a model whose layer scan is unrolled (``GPT2Config.
+scan_unroll >= n_layer``): a rolled ``lax.scan`` hides every layer
+inside one opaque while op, leaving no window for any schedule to fill.
+
+Scope: pure data-parallel ZeRO (pp = tp = sp = ep = 1, no offload) —
+the same scope as the compressed exchange, validated at engine init.
+"""
+
+import dataclasses
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ... import comm
+from ...parallel.topology import DATA_AXIS
+from ..config_utils import ConfigError, DeepSpeedConfigModel
+from .compressed_step import _dp_dim, _shard_map_norep
+
+__all__ = ["OverlapScheduleConfig", "Segment", "layer_chunks",
+           "partition_buckets", "build_schedule",
+           "make_bucketed_micro_grad"]
+
+
+@dataclasses.dataclass
+class OverlapScheduleConfig(DeepSpeedConfigModel):
+    """The ``"overlap_schedule"`` config block (docs/comm.md)."""
+    enabled: bool = False
+    #: target payload bytes per bucket (full-tensor bytes; a single
+    #: oversized segment still gets its own bucket)
+    bucket_bytes: int = 4 << 20
+    #: False = one fused bucket per exchange direction (the monolithic
+    #: schedule; bucket_bytes is ignored)
+    overlap: bool = True
+    #: chain lax.optimization_barrier through consecutive buckets so the
+    #: backend scheduler keeps the layer-order issue sequence
+    pin_order: bool = True
+    #: slice layer-stacked leaves ([n_layer, ...] under "blocks") along
+    #: the layer dim so buckets follow consumption order
+    layer_chunking: bool = True
+
+    def validate(self):
+        if self.bucket_bytes < 1:
+            raise ConfigError(
+                "overlap_schedule.bucket_bytes must be >= 1")
+
+
+# ---------------------------------------------------------------- partitioner
+
+#: leaf paths consumed before the layer stack (embeddings)
+_EMBED_RE = re.compile(r"wte|wpe|embed|tok_|pos_", re.IGNORECASE)
+
+
+@dataclasses.dataclass(frozen=True)
+class Segment:
+    """One schedulable slice of a leaf: the whole leaf, or layers
+    [lo, hi) of a layer-stacked leaf (sliced along dim 0)."""
+    leaf: int                    # flat leaf index
+    lo: int = -1                 # layer slice start (-1 = whole leaf)
+    hi: int = -1
+    dim: int = 0                 # gather/scatter dim (leaf dim numbering)
+    nbytes: int = 0              # full-tensor payload bytes
+    path: str = ""
+
+    @property
+    def sliced(self) -> bool:
+        return self.lo >= 0
+
+
+def layer_chunks(n_layer: int, per_layer_bytes: int,
+                 target_bytes: int) -> List[Tuple[int, int]]:
+    """Uniform [lo, hi) layer ranges whose stacked payload approaches the
+    bucket target: every stacked leaf is sliced on the SAME grid so one
+    bucket carries the same layers of every weight kind."""
+    if n_layer <= 0:
+        return []
+    per = max(1, int(round(target_bytes / max(1, per_layer_bytes))))
+    per = min(per, n_layer)
+    return [(lo, min(lo + per, n_layer))
+            for lo in range(0, n_layer, per)]
+
+
+def partition_buckets(segments: Sequence[Segment],
+                      target_bytes: int) -> List[List[Segment]]:
+    """Greedy contiguous fill: consecutive segments (already in layer
+    order) share a bucket while the payload stays under the target; an
+    oversized single segment gets its own bucket. Segment order is
+    preserved — bucket k's layers never come after bucket k+1's."""
+    buckets: List[List[Segment]] = []
+    cur: List[Segment] = []
+    cur_bytes = 0
+    for seg in segments:
+        if cur and cur_bytes + seg.nbytes > target_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(seg)
+        cur_bytes += seg.nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _leaf_meta(engine):
+    """(paths, shapes, dtype_bytes, gather_dims, scatter_dims) per flat
+    leaf of the param tree, in jax flatten order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(engine.param_shapes)
+    paths = [jax.tree_util.keystr(kp) for kp, _ in flat]
+    shapes = [tuple(s.shape) for _, s in flat]
+    gdims = jax.tree.leaves(jax.tree.map(
+        lambda s: _dp_dim(s.spec), engine.param_shardings))
+    sdims = jax.tree.leaves(jax.tree.map(
+        lambda s: _dp_dim(s.spec), engine.grad_shardings))
+    itemsize = np.dtype(engine._compute_dtype or np.float32).itemsize
+    return paths, shapes, itemsize, gdims, sdims
+
+
+def build_schedule(engine, cfg: Optional[OverlapScheduleConfig] = None):
+    """Static bucket plan for one engine: ``(gather_buckets, rs_buckets,
+    ar_leaves, info)``. Gather buckets cover dp-sharded *param* leaves
+    (ZeRO-3), rs buckets dp-sharded *grad* leaves (ZeRO-2/3), ar_leaves
+    are the replicated-grad leaves that keep per-leaf all_reduce."""
+    cfg = cfg or engine._config.overlap_schedule
+    paths, shapes, itemsize, gdims, sdims = _leaf_meta(engine)
+    n_layer = int(getattr(getattr(engine.module, "config", None),
+                          "n_layer", 0) or 0)
+    target = cfg.bucket_bytes if cfg.overlap else (1 << 62)
+
+    def stacked(i) -> bool:
+        return (cfg.layer_chunking and n_layer > 1 and
+                "blocks" in paths[i] and len(shapes[i]) >= 2 and
+                shapes[i][0] == n_layer)
+
+    def nbytes(i, lo=-1, hi=-1) -> int:
+        n = int(np.prod(shapes[i] or (1,))) * itemsize
+        if lo >= 0:
+            n = n * (hi - lo) // shapes[i][0]
+        return n
+
+    # layer-chunk grid sized from the stacked per-layer payload
+    stacked_idx = [i for i in range(len(paths)) if stacked(i)]
+    per_layer = sum(nbytes(i) for i in stacked_idx) // max(1, n_layer)
+    chunks = layer_chunks(n_layer, per_layer, target) if stacked_idx else []
+
+    def ordered_segments(dims) -> List[Segment]:
+        """Consumption-ordered segments of the leaves whose ``dims``
+        entry is dp-sharded: embeddings, then the layer chunks, then
+        the tail (final norm / head)."""
+        embed, tail, by_chunk = [], [], {c: [] for c in range(len(chunks))}
+        for i in range(len(paths)):
+            if dims[i] < 0:
+                continue
+            if stacked(i) and dims[i] != 0:
+                for c, (lo, hi) in enumerate(chunks):
+                    by_chunk[c].append(Segment(
+                        i, lo, hi, dims[i], nbytes(i, lo, hi), paths[i]))
+                continue
+            seg = Segment(i, dim=dims[i], nbytes=nbytes(i), path=paths[i])
+            (embed if _EMBED_RE.search(paths[i]) else tail).append(seg)
+        out = list(embed)
+        for c in range(len(chunks)):
+            out += by_chunk[c]
+        return out + tail
+
+    gather_buckets = partition_buckets(ordered_segments(gdims), target)
+    rs_buckets = partition_buckets(ordered_segments(sdims), target)
+    ar_leaves = [i for i in range(len(paths)) if sdims[i] < 0]
+    info = {
+        "n_leaves": len(paths),
+        "layer_chunks": chunks,
+        "gather_buckets": len(gather_buckets),
+        "rs_buckets": len(rs_buckets),
+        "all_reduce_leaves": len(ar_leaves),
+        "bucket_bytes": cfg.bucket_bytes if cfg.overlap else 0,
+        "overlap": cfg.overlap,
+    }
+    return gather_buckets, rs_buckets, ar_leaves, info
+
+
+# ------------------------------------------------------------- micro gradient
+
+def _slice_seg(x, seg: Segment):
+    if not seg.sliced:
+        return x
+    return lax.slice_in_dim(x, seg.lo, seg.hi, axis=0)
+
+
+def _rejoin(parts: List[Tuple[Segment, Any]]):
+    """Reassemble one leaf from its exchanged segments (layer slices
+    concatenate back along dim 0, in grid order)."""
+    if len(parts) == 1 and not parts[0][0].sliced:
+        return parts[0][1]
+    parts = sorted(parts, key=lambda p: p[0].lo)
+    return jnp.concatenate([p[1] for p in parts], axis=0)
+
+
+def _pin_chain(bucket_outs: List[List[Any]]):
+    """Chain ``optimization_barrier`` through consecutive buckets: every
+    consumer of bucket k's results must wait until bucket k+1 has been
+    ISSUED — the prefetch pin. A no-op on values; backends that drop
+    barriers late (the CPU lowering) are unaffected."""
+    for k in range(len(bucket_outs) - 1):
+        a, b = bucket_outs[k], bucket_outs[k + 1]
+        if not a or not b:
+            continue
+        pinned = lax.optimization_barrier(tuple(a) + tuple(b))
+        bucket_outs[k] = list(pinned[:len(a)])
+        bucket_outs[k + 1] = list(pinned[len(a):])
+    return bucket_outs
+
+
+def make_bucketed_micro_grad(engine, ltd_keep=None):
+    """Build the bucketed-overlap variant of the explicit ZeRO
+    micro-gradient: same contract as ``compressed_step.
+    make_compressed_micro_grad`` (``grad_fn(pc, mb, rng, scale,
+    pld_theta) -> (loss, grads)``), same collectives semantics (bitwise
+    identical at any bucketing — the coalesced comm ops use per-leaf
+    codecs), different schedule structure."""
+    cfg = engine._config.overlap_schedule
+    mm = engine.mesh_manager
+    mesh = mm.mesh
+    param_specs = jax.tree.map(lambda s: s.spec, engine.param_shardings)
+    grad_specs = jax.tree.map(lambda s: s.spec, engine.grad_shardings)
+    param_treedef = jax.tree.structure(engine.param_shapes)
+    gather_buckets, rs_buckets, ar_leaves, _ = build_schedule(engine, cfg)
+    batch_spec = mm.batch_spec(shard_seq=False)
+    with_pld = engine.progressive_layer_drop is not None
+    pin = cfg.pin_order and cfg.overlap
+
+    def exchange(buckets, leaves, op=None):
+        """Run one bucketed exchange direction (in the given bucket
+        order); returns {leaf: value} for every leaf a bucket touched."""
+        outs: List[List[Any]] = []
+        for b in buckets:
+            xs = [_slice_seg(leaves[s.leaf], s) for s in b]
+            if op is None:
+                outs.append(comm.all_gather_coalesced(
+                    xs, axis_name=DATA_AXIS, axes=[s.dim for s in b]))
+            else:
+                outs.append(comm.reduce_scatter_coalesced(
+                    xs, axis_name=DATA_AXIS, axes=[s.dim for s in b],
+                    op=op))
+        if pin:
+            outs = _pin_chain(outs)
+        per_leaf = {}
+        for b, bo in zip(buckets, outs):
+            for s, o in zip(b, bo):
+                per_leaf.setdefault(s.leaf, []).append((s, o))
+        return {i: _rejoin(parts) for i, parts in per_leaf.items()}
+
+    def body(pc, mb, rng, scale, pld_theta):
+        r = None if rng is None else jax.random.fold_in(
+            rng, lax.axis_index(DATA_AXIS))
+        pc_leaves = jax.tree.leaves(pc)
+
+        # 1. bucketed stage-3 param gathers, layer order, issue-pinned
+        gathered = exchange(gather_buckets, pc_leaves)
+        full_leaves = [gathered.get(i, x) for i, x in enumerate(pc_leaves)]
+        full = jax.tree.unflatten(param_treedef, full_leaves)
+
+        def scaled_loss(p):
+            return engine._micro_loss(p, mb, r, precast=True,
+                                      pld_theta=pld_theta,
+                                      ltd_keep=ltd_keep) * scale
+
+        loss, g = jax.value_and_grad(scaled_loss)(full)
+        g_leaves = jax.tree.leaves(g)
+
+        # 2. bucketed grad reduce-scatters, reverse layer order (the last
+        #    bucket's grads finish backward first), + per-leaf all_reduce
+        #    for replicated leaves — identical to the per-leaf exchange
+        scattered = exchange(list(reversed(rs_buckets)), g_leaves,
+                             op=comm.ReduceOp.AVG)
+        out_leaves = list(g_leaves)
+        for i, v in scattered.items():
+            out_leaves[i] = v
+        for i in ar_leaves:
+            out_leaves[i] = comm.all_reduce(
+                g_leaves[i], op=comm.ReduceOp.AVG, axis_name=DATA_AXIS)
+        grads = jax.tree.unflatten(param_treedef, out_leaves)
+        loss = comm.all_reduce(loss, op=comm.ReduceOp.AVG,
+                               axis_name=DATA_AXIS)
+        return loss, grads
+
+    if with_pld:
+        return _shard_map_norep(
+            body, mesh,
+            in_specs=(param_specs, batch_spec, P(), P(), P()),
+            out_specs=(P(), grad_specs))
+    inner = _shard_map_norep(
+        lambda pc, mb, rng, scale: body(pc, mb, rng, scale, None),
+        mesh,
+        in_specs=(param_specs, batch_spec, P(), P()),
+        out_specs=(P(), grad_specs))
+
+    def without_pld(pc, mb, rng, scale, pld_theta=None):
+        del pld_theta
+        return inner(pc, mb, rng, scale)
+
+    return without_pld

@@ -318,6 +318,10 @@ class CompileLedger:
             self.tracer.set_counter("overlap/hlo_async_fraction",
                                     ev["overlap"]["async_fraction"],
                                     owner=self._owner)
+            self.tracer.set_counter(
+                "overlap/hlo_static_fraction",
+                ev["overlap"].get("static_overlap_fraction", 0.0),
+                owner=self._owner)
         except Exception as e:
             ev["analysis_error"] = str(e)
 
@@ -368,6 +372,8 @@ class CompileLedger:
                 out["last_step_gflops"] = round(flops / 1e9, 3)
         if last is not None and last.get("overlap"):
             out["hlo_async_fraction"] = last["overlap"]["async_fraction"]
+            out["hlo_static_fraction"] = last["overlap"].get(
+                "static_overlap_fraction", 0.0)
         lr = self.last_recompile
         if lr is not None:
             out["last_recompile"] = (

@@ -22,6 +22,9 @@ still in memory:
   debounce: there may be no second chance to capture).
 - ``straggler``   — the host aggregator (telemetry/hostagg.py) attributed
   the step time to one slow host.
+- ``overlap_drop`` — a recompile produced a step program whose HLO
+  static overlap fraction fell below ``compile_plane.overlap_floor``
+  (telemetry/overlap.py: a schedule that silently de-overlapped).
 - ``manual``      — an explicit ``/debug/capture`` request.
 
 A bundle is ONE JSON file (atomic tmp+rename write) containing the
@@ -52,7 +55,8 @@ __all__ = ["FlightRecorder", "TRIGGER_KINDS"]
 
 #: the trigger-rule vocabulary (bundle filenames carry the kind)
 TRIGGER_KINDS = ("slow_step", "recompile", "sentinel", "slo_burn",
-                 "preemption", "straggler", "failover", "manual")
+                 "preemption", "straggler", "failover", "overlap_drop",
+                 "manual")
 
 
 class FlightRecorder:

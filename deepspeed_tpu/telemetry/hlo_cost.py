@@ -21,6 +21,14 @@ Contents:
   and the ``async_fraction`` in [0, 1]: the static half of the
   collective-overlap instrument (telemetry/overlap.py layers the
   trace-measured half on top).
+- ``collect_schedule_overlap(hlo_text)`` — the dependency-level overlap
+  instrument for backends that never emit async start/done pairs (the
+  CPU lowering): per collective, is there compute a latency-hiding
+  executor could legally run between the collective's issue point and
+  its first real consumer? Computed from ASAP dataflow levels, so it is
+  robust to the printed schedule order — this is the number the bucketed
+  ZeRO exchange (runtime/zero/overlap_schedule.py) exists to raise and
+  the schedule autotuner (autotuning/schedule.py) scores.
 - ``cost_summary(raw)`` — normalize a ``cost_analysis()`` result
   (dict, or the list/tuple wrapping older jax returns) to a flat dict
   of floats with python-identifier keys.
@@ -38,8 +46,8 @@ import re
 from typing import Any, Dict, Optional
 
 __all__ = ["DTYPE_BYTES", "COLLECTIVES", "collect_collectives",
-           "collect_async", "hlo_overlap_summary", "cost_summary",
-           "memory_summary"]
+           "collect_async", "collect_schedule_overlap",
+           "hlo_overlap_summary", "cost_summary", "memory_summary"]
 
 #: HLO shape-prefix dtype -> bytes per element (unknown dtypes assume 4)
 DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
@@ -100,6 +108,194 @@ def collect_async(hlo_text: str) -> Dict[str, int]:
     return out
 
 
+#: ops with matmul/reduction-class work — the compute a latency-hiding
+#: executor can run under an in-flight collective. Elementwise and
+#: data-movement ops are deliberately absent: they are memory-bound
+#: epilogues that attach to their producers (a dequantize multiply or a
+#: tanh fusion hides nothing by itself). A ``fusion`` counts only when
+#: its fused computation body contains one of these.
+_HEAVY_RE = re.compile(
+    r"^(dot|convolution|custom-call|reduce|reduce-window|sort|while|"
+    r"scatter|select-and-scatter|rng|rng-bit-generator|cholesky|"
+    r"triangular-solve|fft)(\.|$)")
+
+_INSTR_RE = re.compile(
+    r"^\s*(ROOT\s+)?(%?[\w.\-]+)\s*=\s*(?:\([^=]*?\)|\S+)\s+([\w\-]+)"
+    r"\(([^)]*)\)")
+_NAME_TOKEN_RE = re.compile(r"%[\w.\-]+")
+_CALLS_RE = re.compile(r"calls=(%?[\w.\-]+)")
+_COMP_HEADER_RE = re.compile(r"^(?:ENTRY\s+)?(%?[\w.\-]+)\s*(?:\(|\s)")
+
+
+def _parse_computations(hlo_text: str) -> Dict[str, list]:
+    """{computation name: [instruction lines]} for every computation in
+    an HLO module dump (ENTRY, while/cond bodies, fusion bodies)."""
+    out: Dict[str, list] = {}
+    block: list = []
+    name = None
+    depth = 0
+    for line in hlo_text.split("\n"):
+        stripped = line.strip()
+        if depth == 0:
+            if stripped.endswith("{") and "(" in stripped:
+                m = _COMP_HEADER_RE.match(stripped)
+                name = m.group(1) if m else f"_anon{len(out)}"
+                depth = 1
+                block = []
+            continue
+        depth += stripped.count("{") - stripped.count("}")
+        if depth <= 0:
+            if block:
+                out[name] = block
+            depth = 0
+            continue
+        if "=" in stripped:
+            block.append(stripped)
+    return out
+
+
+def _instr_op(line: str) -> str:
+    m = _INSTR_RE.match(line)
+    return m.group(3) if m else ""
+
+
+def _is_collective(op: str) -> bool:
+    return any(op == c or op.startswith(f"{c}.") for c in COLLECTIVES)
+
+
+def _count_between(sorted_levels, lo: int, hi: int) -> int:
+    """Heavy ops with level strictly inside (lo, hi)."""
+    import bisect
+    if hi <= lo:
+        return 0
+    return bisect.bisect_left(sorted_levels, hi) - \
+        bisect.bisect_right(sorted_levels, lo)
+
+
+def collect_schedule_overlap(hlo_text: str) -> Dict[str, Any]:
+    """Dependency-level static overlap of a compiled module's collectives.
+
+    ASAP levels count the heavy ops (matmul/reduction class, see
+    ``_HEAVY_RE``; fusions classified by their fused body) on each
+    value's critical path. For each synchronous collective C the window
+    runs from C's ready level to the minimum level of its first *real*
+    consumer — a heavy op or another collective, traced through
+    elementwise/movement ops (a dequantize epilogue does not end the
+    window; the matmul that needs the data does). C is **overlappable**
+    when a heavy op's level falls strictly inside that window: compute
+    that is independent of C by construction (ancestors sit below the
+    window, descendants at or above its end) which an async executor
+    could run while C is on the wire. Collectives already emitted in
+    async start/done form count as overlappable outright.
+
+    A single fused whole-tree exchange scores 0 (every heavy op either
+    feeds it or waits on it); a bucketed exchange issued in layer order
+    scores (nb-1)/nb-ish — the metric ``benchmarks/overlap.py`` records
+    as CPU evidence and the schedule autotuner scores."""
+    comps = _parse_computations(hlo_text)
+    # a fusion is heavy iff its fused body does real work
+    heavy_fusion: Dict[str, bool] = {}
+    for cname, block in comps.items():
+        heavy_fusion[cname.lstrip("%")] = any(
+            _HEAVY_RE.match(_instr_op(line)) for line in block)
+
+    def is_heavy(op: str, line: str) -> bool:
+        if _HEAVY_RE.match(op):
+            return True
+        if op == "fusion" or op.startswith("fusion."):
+            m = _CALLS_RE.search(line)
+            return bool(m) and heavy_fusion.get(m.group(1).lstrip("%"),
+                                                False)
+        return False
+
+    total = 0
+    overlappable = 0
+    async_n = 0
+    windows = []
+    for cname, block in comps.items():
+        if not any(_is_collective(_instr_op(l)) or "-start" in _instr_op(l)
+                   for l in block):
+            continue                     # no collectives: nothing to score
+        names: list = []
+        ops: list = []
+        heavy: list = []
+        operand_lists: list = []
+        index: Dict[str, int] = {}
+        for line in block:
+            m = _INSTR_RE.match(line)
+            if not m:
+                names.append(None)
+                ops.append("")
+                heavy.append(False)
+                operand_lists.append([])
+                continue
+            name, op, operands = m.group(2), m.group(3), m.group(4)
+            names.append(name)
+            ops.append(op)
+            heavy.append(is_heavy(op, line))
+            operand_lists.append(_NAME_TOKEN_RE.findall(operands))
+            index[name] = len(names) - 1
+        if not names:
+            continue
+        # ASAP heavy-op levels + a users index (producer idx -> consumers)
+        asap = [0] * len(names)
+        users: Dict[int, list] = {}
+        for i, operands in enumerate(operand_lists):
+            lvl = 0
+            for tok in operands:
+                j = index.get(tok)
+                if j is None:
+                    continue
+                lvl = max(lvl, asap[j])
+                users.setdefault(j, []).append(i)
+            asap[i] = lvl + 1 if heavy[i] else lvl
+        heavy_levels = sorted(asap[i] for i in range(len(names))
+                              if heavy[i])
+        max_level = max(asap) if asap else 0
+        for i, op in enumerate(ops):
+            is_async = any(op.startswith(f"{c}-start") for c in COLLECTIVES)
+            if not is_async and not _is_collective(op):
+                continue
+            total += 1
+            if is_async:
+                async_n += 1
+                overlappable += 1
+                continue
+            # first real consumer level, traced through light ops
+            frontier = [i]
+            seen = {i}
+            consumer_lvl = None
+            while frontier:
+                j = frontier.pop()
+                for k in users.get(j, ()):
+                    if k in seen:
+                        continue
+                    seen.add(k)
+                    if heavy[k] or _is_collective(ops[k]):
+                        lvl = asap[k] if heavy[k] else asap[k] + 1
+                        if consumer_lvl is None or lvl < consumer_lvl:
+                            consumer_lvl = lvl
+                    else:
+                        frontier.append(k)
+            if consumer_lvl is None:
+                consumer_lvl = max_level + 1     # consumed by the output
+            lo, hi = asap[i], consumer_lvl
+            n_hidden = _count_between(heavy_levels, lo, hi)
+            if n_hidden > 0:
+                overlappable += 1
+            windows.append({"op": op, "ready_level": lo,
+                            "consumer_level": hi,
+                            "compute_in_window": n_hidden})
+    return {
+        "collectives": total,
+        "overlappable": overlappable,
+        "async": async_n,
+        "static_overlap_fraction":
+            round(overlappable / total, 6) if total else 0.0,
+        "windows": windows[:256],
+    }
+
+
 def hlo_overlap_summary(hlo_text: str) -> Dict[str, Any]:
     """The static overlap instrument: how much of the module's collective
     schedule is even *overlappable*. ``async_fraction`` is async ops over
@@ -110,6 +306,7 @@ def hlo_overlap_summary(hlo_text: str) -> Dict[str, Any]:
     telemetry/overlap.py."""
     sync = collect_collectives(hlo_text)
     async_ = collect_async(hlo_text)
+    sched = collect_schedule_overlap(hlo_text)
     n_sync = sum(v["count"] for v in sync.values())
     n_async = sum(async_.values())
     total = n_sync + n_async
@@ -118,6 +315,12 @@ def hlo_overlap_summary(hlo_text: str) -> Dict[str, Any]:
         "sync": n_sync,
         "async": n_async,
         "async_fraction": round(n_async / total, 6) if total else 0.0,
+        # the dependency-level instrument (collect_schedule_overlap):
+        # collectives with hideable compute in their issue window — the
+        # CPU-measurable half of the overlap story, and what the bucketed
+        # ZeRO schedule raises on a backend with no async HLO forms
+        "overlappable": sched["overlappable"],
+        "static_overlap_fraction": sched["static_overlap_fraction"],
         "sync_bytes": sum(v["bytes"] for v in sync.values()),
         "per_op_sync": {op: v["count"] for op, v in sorted(sync.items())},
         "per_op_async": dict(sorted(async_.items())),

@@ -198,11 +198,18 @@ class OverlapAnalyzer:
 
     def __init__(self, tracer=None, owner: Any = None,
                  interval_steps: int = 16,
-                 window_ms: float = 30_000.0):
+                 window_ms: float = 30_000.0,
+                 floor: float = 0.0, recorder=None):
         self.tracer = tracer or get_tracer()
         self._owner = owner
         self.interval_steps = max(1, int(interval_steps))
         self.window_ms = float(window_ms)
+        #: compile_plane.overlap_floor: a RECOMPILE whose program's
+        #: static fraction falls below this fires an ``overlap_drop``
+        #: flight-recorder bundle (0 = disabled)
+        self.floor = float(floor)
+        self.recorder = recorder
+        self.floor_breaches = 0
         self.last: Optional[Dict[str, float]] = None
         self.last_hlo: Optional[Dict[str, Any]] = None
 
@@ -217,13 +224,35 @@ class OverlapAnalyzer:
                                     owner=self._owner)
         return res
 
-    def note_hlo(self, summary: Dict[str, Any]):
+    def note_hlo(self, summary: Dict[str, Any], kind: str = "compile",
+                 label: str = "", step: Optional[int] = None):
         """Record the active executable's static overlap summary (the
-        compile ledger calls in on each compile event)."""
+        compile ledger captures it; the engine calls in on each compile
+        event). ``kind="recompile"`` additionally runs the floor check:
+        a recompiled program whose dependency-level static fraction
+        dropped below ``floor`` fires an ``overlap_drop`` bundle — the
+        "my schedule silently de-overlapped" postmortem."""
         self.last_hlo = summary
         self.tracer.set_counter("overlap/hlo_async_fraction",
                                 summary.get("async_fraction", 0.0),
                                 owner=self._owner)
+        static = summary.get("static_overlap_fraction")
+        if static is not None:
+            self.tracer.set_counter("overlap/hlo_static_fraction",
+                                    float(static), owner=self._owner)
+        if (self.floor > 0.0 and kind == "recompile" and
+                static is not None and float(static) < self.floor):
+            self.floor_breaches += 1
+            detail = (f"{label or 'step'}: static overlap "
+                      f"{float(static):.3f} < floor {self.floor:.3f} "
+                      f"after recompile "
+                      f"({summary.get('overlappable', 0)}/"
+                      f"{summary.get('collectives', 0)} collectives "
+                      f"overlappable)")
+            self.tracer.instant("overlap_drop", cat="warning",
+                                args={"detail": detail})
+            if self.recorder is not None:
+                self.recorder.trigger("overlap_drop", detail, step=step)
 
     def summary(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
@@ -233,8 +262,14 @@ class OverlapAnalyzer:
             out["trace_overlapped_s"] = self.last["overlapped_s"]
         if self.last_hlo is not None:
             out["hlo_async_fraction"] = self.last_hlo["async_fraction"]
+            out["hlo_static_fraction"] = self.last_hlo.get(
+                "static_overlap_fraction", 0.0)
             out["hlo_collectives"] = self.last_hlo["collectives"]
             out["hlo_async"] = self.last_hlo["async"]
+            out["hlo_overlappable"] = self.last_hlo.get("overlappable", 0)
+        if self.floor > 0.0:
+            out["overlap_floor"] = self.floor
+            out["floor_breaches"] = self.floor_breaches
         if not out:
             out["status"] = "no overlap data yet"
         return out

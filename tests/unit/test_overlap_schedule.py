@@ -1,0 +1,535 @@
+"""Bucketed compute–communication overlap schedule + schedule autotuner.
+
+Covers the PR-10 contract (ROADMAP item 2):
+- the bucketed exchange is BITWISE identical to the monolithic explicit
+  path at lr=0 and at matched seeds, with compression off and with the
+  int8 wire (the coalesced collectives use per-leaf codecs);
+- N per-bucket ops log the same total wire/logical bytes as the
+  per-leaf monolithic exchange — only the op count differs;
+- the bucket partitioner respects size targets and layer order;
+- the dependency-level static overlap metric separates bucketed from
+  monolithic compiled programs;
+- the schedule autotuner picks the known-best plan on a rigged cost
+  model, persists the winner, and re-loads it by fingerprint without
+  re-sweeping; plans round-trip through JSON;
+- the overlap floor fires the ``overlap_drop`` flight-recorder trigger
+  after a de-overlapping recompile;
+- ``bin/ds_tpu_tune --plans 3 --steps 2`` runs end to end on CPU (the
+  tier-1 CLI smoke).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from deepspeed_tpu.autotuning.cost_model import ScheduleCostModel  # noqa: E402
+from deepspeed_tpu.autotuning.schedule import (SchedulePlan,  # noqa: E402
+                                               ScheduleTuner, default_plans,
+                                               plan_from_config)
+from deepspeed_tpu.runtime.zero.overlap_schedule import (  # noqa: E402
+    Segment, layer_chunks, partition_buckets)
+from deepspeed_tpu.telemetry.hlo_cost import (  # noqa: E402
+    collect_schedule_overlap)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    from deepspeed_tpu.comm import (reset_comm_compression,
+                                    reset_comm_stats)
+    reset_comm_stats()
+    yield
+    reset_comm_compression()
+    reset_comm_stats()
+
+
+# ------------------------------------------------------------- partitioner
+
+def _segs(sizes, paths=None):
+    return [Segment(i, dim=0, nbytes=s,
+                    path=(paths[i] if paths else f"leaf{i}"))
+            for i, s in enumerate(sizes)]
+
+
+def test_partitioner_respects_size_target():
+    buckets = partition_buckets(_segs([100, 100, 100, 100, 100]), 250)
+    assert [len(b) for b in buckets] == [2, 2, 1]
+    for b in buckets[:-1]:
+        assert sum(s.nbytes for s in b) <= 250
+
+
+def test_partitioner_oversized_segment_gets_own_bucket():
+    buckets = partition_buckets(_segs([1000, 10, 10]), 100)
+    assert [len(b) for b in buckets] == [1, 2]
+    # order preserved: segment 0 first
+    assert buckets[0][0].leaf == 0
+
+
+def test_partitioner_single_bucket_when_target_huge():
+    buckets = partition_buckets(_segs([100] * 7), 1 << 62)
+    assert len(buckets) == 1 and len(buckets[0]) == 7
+
+
+def test_layer_chunks_grid():
+    # 12 layers, 10 bytes/layer, 40-byte target -> 4-layer chunks
+    assert layer_chunks(12, 10, 40) == [(0, 4), (4, 8), (8, 12)]
+    # target below one layer still yields per-layer chunks
+    assert layer_chunks(3, 100, 10) == [(0, 1), (1, 2), (2, 3)]
+    assert layer_chunks(0, 10, 10) == []
+
+
+def test_build_schedule_layer_order():
+    """Buckets follow consumption order: embeddings first, then the
+    layer chunks in ascending order, then the tail leaves."""
+    engine = _make_engine({"overlap_schedule": {
+        "enabled": True, "bucket_bytes": 32 << 10}})
+    try:
+        from deepspeed_tpu.runtime.zero.overlap_schedule import \
+            build_schedule
+        gather_buckets, rs_buckets, ar_leaves, info = build_schedule(engine)
+        assert info["gather_buckets"] == len(gather_buckets) > 1
+        # layer lows never decrease across the gather bucket sequence
+        lows = [s.lo for b in gather_buckets for s in b if s.sliced]
+        assert lows == sorted(lows)
+        # every bucket except possibly oversized singletons respects the
+        # target
+        for b in gather_buckets:
+            if len(b) > 1:
+                assert sum(s.nbytes for s in b) <= 32 << 10
+    finally:
+        engine.close()
+
+
+# ----------------------------------------------------- engine-level parity
+
+def _make_engine(extra, lr=1e-3, n_layer=4, unroll=1, stage=3, gas=1):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.parallel import topology
+    topology.reset_mesh()
+    model = GPT2Model(GPT2Config(vocab_size=256, n_positions=33, n_embd=64,
+                                 n_layer=n_layer, n_head=4,
+                                 pad_vocab_to_multiple=8,
+                                 scan_unroll=unroll))
+    config = {
+        "train_batch_size": 16 * gas, "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": lr}},
+        "zero_optimization": {"stage": stage,
+                              "stage3_param_persistence_threshold": 0},
+        "gradient_clipping": 1.0, "steps_per_print": 0}
+    config.update(extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine
+
+
+#: identical configs are trained once per module — several tests compare
+#: against the same monolithic baseline run
+_TRAIN_MEMO = {}
+
+
+def _train(extra, steps=2, lr=1e-3, seed=7, stage=3, gas=1):
+    key = (json.dumps(extra, sort_keys=True), steps, lr, seed, stage, gas)
+    if key not in _TRAIN_MEMO:
+        _TRAIN_MEMO[key] = _train_uncached(extra, steps, lr, seed, stage,
+                                           gas)
+    return _TRAIN_MEMO[key]
+
+
+def _train_uncached(extra, steps, lr, seed, stage, gas):
+    from deepspeed_tpu import comm
+    engine = _make_engine(extra, lr=lr, stage=stage, gas=gas)
+    rng = np.random.default_rng(seed)
+    comm.reset_comm_stats()
+    losses = []
+    for _ in range(steps):
+        toks = rng.integers(0, 255, (16 * gas, 33)).astype(np.int32)
+        losses.append(float(engine.train_batch(
+            batch={"input_ids": toks.reshape(gas, 16, 33)})))
+    stats = dict(comm.comm_stats())
+    params = jax.tree.leaves(jax.tree.map(np.asarray, engine.params))
+    engine.close()
+    return losses, stats, params
+
+
+_FP32_CC = {"enabled": True, "all_gather": "fp32",
+            "reduce_scatter": "fp32", "all_reduce": "fp32"}
+_INT8_CC = {"enabled": True, "all_gather": "int8",
+            "reduce_scatter": "int8", "all_reduce": "int8",
+            "min_bytes": 0, "devices_per_host": 2}
+_BUCKETED = {"enabled": True, "bucket_bytes": 64 << 10}
+
+
+def test_bucketed_bitwise_identical_at_lr0():
+    """lr=0: parameters must not move, and the bucketed path's params +
+    losses must equal the monolithic explicit path's bit for bit."""
+    l_mono, _, p_mono = _train({"comm_compression": _FP32_CC}, lr=0.0)
+    l_b, _, p_b = _train({"comm_compression": _FP32_CC,
+                          "overlap_schedule": _BUCKETED}, lr=0.0)
+    assert l_mono == l_b
+    for a, b in zip(p_mono, p_b):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_bucketed_bitwise_identical_matched_seeds():
+    """Same seed, real lr: identical loss trajectory and bit-identical
+    params vs the per-leaf monolithic explicit exchange."""
+    l_mono, s_mono, p_mono = _train({"comm_compression": _FP32_CC})
+    l_b, s_b, p_b = _train({"comm_compression": _FP32_CC,
+                            "overlap_schedule": _BUCKETED})
+    assert l_mono == l_b
+    for a, b in zip(p_mono, p_b):
+        np.testing.assert_array_equal(a, b)
+    # the schedule alone (no compression block) is the same math too
+    l_o, _, p_o = _train({"overlap_schedule": _BUCKETED})
+    assert l_o == l_b
+    for a, b in zip(p_o, p_b):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_bucketed_int8_bitwise_identical_whole_leaf():
+    """int8 wire: whole-leaf buckets quantize every leaf with exactly
+    the per-leaf codec, so bucketed == monolithic bit for bit (layer
+    chunking changes the fallback block granularity of non-block-
+    aligned leaves and is exercised by the accounting test instead)."""
+    l_mono, s_mono, p_mono = _train({"comm_compression": _INT8_CC})
+    l_b, s_b, p_b = _train({"comm_compression": _INT8_CC,
+                            "overlap_schedule": {
+                                "enabled": True,
+                                "bucket_bytes": 256 << 10,
+                                "layer_chunking": False}})
+    assert l_mono == l_b
+    for a, b in zip(p_mono, p_b):
+        np.testing.assert_array_equal(a, b)
+    # honest wire accounting under the quantized policy too
+    assert s_mono["bytes"] == s_b["bytes"]
+    assert s_mono["logical_bytes"] == s_b["logical_bytes"]
+    assert s_mono["inter_host_bytes"] == s_b["inter_host_bytes"]
+    assert s_b["ops"] < s_mono["ops"]
+
+
+def test_bucket_accounting_totals_match_per_leaf():
+    """Satellite: N per-bucket ops log the same total wire/logical bytes
+    as the per-leaf exchange — no per-op fixed-cost inflation — while
+    the op-count delta stays visible for the flight recorder."""
+    _, s_leaf, _ = _train({"comm_compression": _FP32_CC})
+    _, s_bucket, _ = _train({"comm_compression": _FP32_CC,
+                             "overlap_schedule": _BUCKETED})
+    assert s_bucket["bytes"] == s_leaf["bytes"]
+    assert s_bucket["logical_bytes"] == s_leaf["logical_bytes"]
+    assert s_bucket["intra_host_bytes"] == s_leaf["intra_host_bytes"]
+    assert s_bucket["ops"] != s_leaf["ops"]
+    # a big bucket target coalesces aggressively: strictly fewer ops
+    _, s_big, _ = _train({"comm_compression": _FP32_CC,
+                          "overlap_schedule": {
+                              "enabled": True,
+                              "bucket_bytes": 8 << 20,
+                              "layer_chunking": False}})
+    assert s_big["ops"] < s_leaf["ops"]
+    assert s_big["bytes"] == s_leaf["bytes"]
+
+
+@pytest.mark.slow
+def test_bucketed_parity_with_accumulation_and_stage2():
+    """The bucketed micro-grad lives inside the gas scan unchanged
+    (gas=2), and at ZeRO-2 (no param gathers, grads still bucketed) the
+    schedule stays bit-identical to the per-leaf explicit path."""
+    l_mono, _, p_mono = _train({"comm_compression": _FP32_CC}, gas=2)
+    l_b, _, p_b = _train({"comm_compression": _FP32_CC,
+                          "overlap_schedule": _BUCKETED}, gas=2)
+    assert l_mono == l_b
+    for a, b in zip(p_mono, p_b):
+        np.testing.assert_array_equal(a, b)
+
+    l2_mono, s2_mono, p2_mono = _train({"comm_compression": _FP32_CC},
+                                       stage=2)
+    l2_b, s2_b, p2_b = _train({"comm_compression": _FP32_CC,
+                               "overlap_schedule": _BUCKETED}, stage=2)
+    assert l2_mono == l2_b
+    for a, b in zip(p2_mono, p2_b):
+        np.testing.assert_array_equal(a, b)
+    assert s2_mono["bytes"] == s2_b["bytes"]
+
+
+def test_scope_rejects_model_parallel():
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.parallel import topology
+    from deepspeed_tpu.runtime.config_utils import ConfigError
+    topology.reset_mesh()
+    model = GPT2Model(GPT2Config(vocab_size=256, n_positions=33, n_embd=64,
+                                 n_layer=2, n_head=4,
+                                 pad_vocab_to_multiple=8))
+    with pytest.raises(ConfigError, match="pure data parallelism"):
+        deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": 16, "train_micro_batch_size_per_gpu": 2,
+            "tensor_parallel_size": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "overlap_schedule": {"enabled": True},
+            "steps_per_print": 0})
+
+
+# ------------------------------------------------- static overlap metric
+
+def test_schedule_overlap_metric_on_synthetic_hlo():
+    """The dependency-level analyzer on a hand-written module: gather A
+    feeds the first dot directly (no window); gather B's first consumer
+    comes two dots later (window holds compute)."""
+    hlo = """
+HloModule m
+
+ENTRY %main (p0: f32[8,8], p1: f32[8,8]) -> f32[8,8] {
+  %p0 = f32[8,8]{1,0} parameter(0)
+  %p1 = f32[8,8]{1,0} parameter(1)
+  %ag.a = f32[8,8]{1,0} all-gather(%p0), dimensions={0}
+  %ag.b = f32[8,8]{1,0} all-gather(%p1), dimensions={0}
+  %dot.1 = f32[8,8]{1,0} dot(%ag.a, %p0), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %dot.2 = f32[8,8]{1,0} dot(%dot.1, %dot.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %dot.3 = f32[8,8]{1,0} dot(%dot.2, %ag.b), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+    s = collect_schedule_overlap(hlo)
+    assert s["collectives"] == 2
+    assert s["overlappable"] == 1
+    assert s["static_overlap_fraction"] == 0.5
+
+
+def test_bucketed_step_raises_static_overlap():
+    """Compiled-step evidence at test scale: the bucketed schedule's
+    static overlap fraction strictly exceeds the monolithic schedule's
+    on the same model (scan unrolled so layers are visible)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.telemetry.hlo_cost import hlo_overlap_summary
+
+    def lower(extra):
+        engine = _make_engine(extra, unroll=4)
+        try:
+            rng = np.random.default_rng(0)
+            batch = engine._to_device_batch({"input_ids": rng.integers(
+                0, 255, (1, 16, 32), dtype=np.int32)})
+            with engine.mesh:
+                hlo = engine._train_step_fn.lower(
+                    engine.params, engine.opt_state, engine.scaler_state,
+                    batch, jnp.float32(1e-3), jax.random.PRNGKey(0), None,
+                    jnp.float32(1.0)).compile().as_text()
+        finally:
+            engine.close()
+        return hlo_overlap_summary(hlo)
+
+    mono = lower({"overlap_schedule": {"enabled": True, "overlap": False}})
+    bucketed = lower({"overlap_schedule": {"enabled": True,
+                                           "bucket_bytes": 48 << 10}})
+    assert bucketed["static_overlap_fraction"] > \
+        mono["static_overlap_fraction"]
+    assert bucketed["collectives"] > mono["collectives"]
+
+
+# ------------------------------------------------------------- autotuner
+
+def _rigged_trial(metrics_by_key):
+    def trial(plan):
+        return dict(metrics_by_key[plan.key()])
+    return trial
+
+
+def test_autotuner_picks_known_best_on_rigged_cost_model(tmp_path):
+    """Three plans with rigged measurements; under the cost model the
+    middle bucket size is the analytic optimum and must win."""
+    plans = [SchedulePlan(overlap=False),
+             SchedulePlan(bucket_bytes=1 << 20),
+             SchedulePlan(bucket_bytes=8 << 20)]
+    flops = 1e12          # 10 ms of compute at 100 TFLOP/s
+    metrics = {
+        plans[0].key(): {"flops": flops, "wire_bytes": 400e6,
+                         "hlo_collectives": 4,
+                         "static_overlap_fraction": 0.0},
+        plans[1].key(): {"flops": flops, "wire_bytes": 400e6,
+                         "hlo_collectives": 4000,
+                         "static_overlap_fraction": 0.95},
+        plans[2].key(): {"flops": flops, "wire_bytes": 400e6,
+                         "hlo_collectives": 40,
+                         "static_overlap_fraction": 0.9},
+    }
+    cm = ScheduleCostModel()
+    scores = {k: cm.score(m["flops"], m["wire_bytes"],
+                          m["hlo_collectives"],
+                          m["static_overlap_fraction"])
+              for k, m in metrics.items()}
+    assert min(scores, key=scores.get) == plans[2].key()
+    tuner = ScheduleTuner(_rigged_trial(metrics), "fp-rig", plans=plans,
+                          cost_model=cm, cache_dir=str(tmp_path))
+    result = tuner.tune()
+    assert result["winner"] == plans[2].to_dict()
+    assert tuner.swept
+
+
+def test_autotuner_cache_roundtrip_no_resweep(tmp_path):
+    """Same fingerprint: the second tune() loads the persisted winner
+    without running a single trial; a different fingerprint re-sweeps;
+    force=True re-sweeps."""
+    plans = [SchedulePlan(overlap=False), SchedulePlan()]
+    calls = {"n": 0}
+
+    def trial(plan):
+        calls["n"] += 1
+        return {"flops": 1e12, "wire_bytes": 100e6,
+                "hlo_collectives": 10 if plan.overlap else 2,
+                "static_overlap_fraction": 0.8 if plan.overlap else 0.0}
+
+    t1 = ScheduleTuner(trial, "fp-a", plans=plans,
+                       cache_dir=str(tmp_path))
+    r1 = t1.tune()
+    assert t1.swept and calls["n"] == 2 and not r1["cached"]
+
+    t2 = ScheduleTuner(trial, "fp-a", plans=plans,
+                       cache_dir=str(tmp_path))
+    r2 = t2.tune()
+    assert not t2.swept and calls["n"] == 2 and r2["cached"]
+    assert r2["winner"] == r1["winner"]
+    # the persisted file round-trips the full plan
+    plan = SchedulePlan.from_dict(r2["winner"])
+    assert plan.to_dict() == r1["winner"]
+
+    t3 = ScheduleTuner(trial, "fp-b", plans=plans,
+                       cache_dir=str(tmp_path))
+    t3.tune()
+    assert t3.swept and calls["n"] == 4
+
+    t2.tune(force=True)
+    assert t2.swept and calls["n"] == 6
+
+
+def test_plan_json_roundtrip_and_config_overrides():
+    plan = SchedulePlan(bucket_bytes=2 << 20, overlap=True,
+                        compression="int8", layer_chunking=False)
+    assert SchedulePlan.from_dict(
+        json.loads(json.dumps(plan.to_dict()))) == plan
+    over = plan.config_overrides()
+    assert over["overlap_schedule"]["bucket_bytes"] == 2 << 20
+    assert over["comm_compression"]["all_gather"] == "int8"
+    # and the inverse: a config encodes a plan
+    cfg = {"overlap_schedule": {"enabled": True, "bucket_bytes": 2 << 20,
+                                "layer_chunking": False},
+           "comm_compression": {"enabled": True, "all_gather": "int8"}}
+    assert plan_from_config(cfg) == plan
+    assert plan_from_config({}) == SchedulePlan(overlap=False)
+
+
+def test_default_plans_cover_monolithic_and_ladder():
+    plans = default_plans(bucket_sizes=(1 << 20, 4 << 20),
+                          compressions=("off", "int8"))
+    keys = {p.key() for p in plans}
+    assert "monolithic/comp=off" in keys
+    assert "monolithic/comp=int8" in keys
+    assert len(plans) == 6
+
+
+# ------------------------------------------------------ overlap floor
+
+def test_overlap_floor_fires_recorder_on_deoverlapped_recompile():
+    from deepspeed_tpu.telemetry.overlap import OverlapAnalyzer
+
+    class FakeRecorder:
+        def __init__(self):
+            self.fired = []
+
+        def trigger(self, kind, detail="", step=None):
+            self.fired.append((kind, detail, step))
+
+    rec = FakeRecorder()
+    an = OverlapAnalyzer(floor=0.5, recorder=rec)
+    good = {"async_fraction": 0.0, "static_overlap_fraction": 0.8,
+            "collectives": 10, "overlappable": 8, "async": 0}
+    bad = {"async_fraction": 0.0, "static_overlap_fraction": 0.1,
+           "collectives": 10, "overlappable": 1, "async": 0}
+    an.note_hlo(good, kind="compile")          # initial compile: no fire
+    assert rec.fired == []
+    an.note_hlo(bad, kind="compile")           # first compile low: no fire
+    assert rec.fired == []
+    an.note_hlo(bad, kind="recompile", label="train_batch", step=7)
+    assert len(rec.fired) == 1
+    kind, detail, step = rec.fired[0]
+    assert kind == "overlap_drop" and step == 7
+    assert "0.100" in detail and "train_batch" in detail
+    assert an.floor_breaches == 1
+    assert an.summary()["floor_breaches"] == 1
+    # recovered schedule: no further fire
+    an.note_hlo(good, kind="recompile")
+    assert len(rec.fired) == 1
+
+
+def test_overlap_drop_is_a_known_trigger_kind():
+    from deepspeed_tpu.telemetry.flight_recorder import TRIGGER_KINDS
+    assert "overlap_drop" in TRIGGER_KINDS
+
+
+# ------------------------------------------------------------- CLI smoke
+
+def test_ds_tpu_tune_cli_smoke(tmp_path):
+    """Tier-1 CI smoke: the CLI sweeps 3 plans with 2 measured steps on
+    the tiny model, persists a winner, and the re-run is a cache hit."""
+    cmd = [sys.executable, os.path.join(REPO, "bin", "ds_tpu_tune"),
+           "--cpu", "--plans", "3", "--steps", "2",
+           "--cache-dir", str(tmp_path),
+           "--out", str(tmp_path / "tune.json")]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=560,
+                       env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "winner:" in r.stdout
+    with open(tmp_path / "tune.json") as f:
+        result = json.load(f)
+    assert len(result["table"]) == 3
+    assert all("measured_step_s" in e for e in result["table"])
+    cache_files = [p for p in os.listdir(tmp_path)
+                   if p.endswith(".json") and p != "tune.json"]
+    assert len(cache_files) == 1
+
+    r2 = subprocess.run(cmd, capture_output=True, text=True, timeout=560,
+                        env=env)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "cache hit" in r2.stdout
+
+
+@pytest.mark.slow
+def test_full_sweep_bucketed_beats_monolithic():
+    """The full default sweep on a model big enough that comm time
+    dominates per-op latency: a bucketed plan must outscore the
+    monolithic default on the stock cost model (the ds_tpu_tune
+    acceptance, benchmark-scale evidence lives in benchmarks/)."""
+    from deepspeed_tpu.autotuning.schedule import tune_schedule
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=512, n_positions=129, n_embd=256,
+                     n_layer=6, n_head=8, pad_vocab_to_multiple=128,
+                     scan_unroll=6)
+    rng = np.random.default_rng(0)
+
+    def batch_factory(gbs):
+        return {"input_ids": rng.integers(0, 500, (1, gbs, 128),
+                                          dtype=np.int32)}
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        result = tune_schedule(
+            lambda: GPT2Model(cfg),
+            {"train_micro_batch_size_per_gpu": 2,
+             "gradient_accumulation_steps": 1,
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "zero_optimization": {
+                 "stage": 3, "stage3_param_persistence_threshold": 0},
+             "steps_per_print": 0},
+            batch_factory, cache_dir=td)
+    winner = SchedulePlan.from_dict(result["winner"])
+    assert winner.overlap, result["winner_key"]
+    mono = next(e for e in result["table"]
+                if not e["plan"]["overlap"])
+    assert result["score_s"] < mono["score_s"]
