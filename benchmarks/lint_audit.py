@@ -1,0 +1,81 @@
+"""ds_tpu_lint over the bench-size artifacts — the repo-is-clean proof.
+
+Runs both planes the way CI does, but with the HLO artifacts at BENCH
+size (the 512d x 8L ZeRO-3 model benchmarks/overlap.py compiles, plus
+decode/pipe/MoE) instead of the tier-1 tiny dims, and records the full
+report: findings (all expected to be waived), per-artifact collective
+counts and comm-dispatch deltas, and the suite fingerprint. Run (CPU):
+
+    JAX_PLATFORMS=cpu python benchmarks/lint_audit.py
+
+Writes benchmarks/lint_audit.json; exits non-zero on any non-waived
+finding, so it doubles as the local pre-push gate at full size.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import importlib.util  # noqa: E402
+
+_spec = importlib.util.spec_from_file_location(
+    "_dstpu_hermetic",
+    os.path.join(REPO, "deepspeed_tpu", "utils", "hermetic.py"))
+hermetic = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(hermetic)
+hermetic.force_cpu(device_count=8)
+
+
+def main():
+    from deepspeed_tpu.analysis import (apply_waivers, default_waivers_path,
+                                        lint_fingerprint, load_waivers,
+                                        run_ast_lint, run_hlo_audit)
+    from deepspeed_tpu.analysis.artifacts import default_artifacts
+    from deepspeed_tpu.telemetry.hlo_cost import (collect_collectives,
+                                                  hlo_overlap_summary)
+
+    findings = run_ast_lint(REPO)
+    arts = default_artifacts(size="bench")
+    findings += run_hlo_audit(arts)
+    waivers = load_waivers(default_waivers_path(REPO))
+    apply_waivers(findings, waivers)
+
+    per_artifact = {}
+    for a in arts:
+        colls = collect_collectives(a.hlo_texts[0])
+        per_artifact[a.name] = {
+            "collectives": {k: v["count"] for k, v in sorted(colls.items())},
+            "static_overlap_fraction": hlo_overlap_summary(
+                a.hlo_texts[0])["static_overlap_fraction"],
+            "traced_per_op": a.traced_per_op,
+            "comm_delta": a.comm_delta,
+        }
+
+    non_waived = [f for f in findings if not f.waived]
+    report = {
+        "fingerprint": lint_fingerprint(REPO),
+        "artifact_size": "bench",
+        "findings": [f.to_dict() for f in findings],
+        "non_waived": len(non_waived),
+        "waived": sum(1 for f in findings if f.waived),
+        "artifacts": per_artifact,
+    }
+    out = os.path.join(REPO, "benchmarks", "lint_audit.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1)
+    for f_ in findings:
+        tag = "waived" if f_.waived else f_.severity
+        print(f"[{tag}] {f_.waiver_key}")
+    print(f"{len(findings)} finding(s), {len(non_waived)} non-waived "
+          f"-> {out}")
+    print(report["fingerprint"])
+    assert not non_waived, "non-waived findings at bench size: " + \
+        ", ".join(f_.waiver_key for f_ in non_waived)
+    print("LINT AUDIT OK")
+
+
+if __name__ == "__main__":
+    main()

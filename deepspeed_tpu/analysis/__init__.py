@@ -1,0 +1,31 @@
+"""deepspeed_tpu.analysis — framework-aware static analysis (ds_tpu_lint).
+
+Two planes, one finding/waiver format (docs/lint.md):
+
+- **Plane A** (:mod:`hlo_audit_rules`, fed by :mod:`artifacts`):
+  auditors over the repo's real lowered programs — async start/done
+  matching, replica-group partition/consistency, per-device issue
+  order, donation/aliasing vs HBM roles, comm dispatch conformance.
+- **Plane B** (:mod:`pylint_rules`): stdlib-``ast`` lints — raw lax
+  collectives outside comm/ and ops/, host sync inside traced code,
+  ownerless gauges, unknown config keys.
+
+``bin/ds_tpu_lint`` is the CLI; ``lint_waivers.json`` at the repo root
+keeps the tree lint-clean with reasoned waivers; the tier-1 gate is
+``tests/unit/test_lint.py``.
+"""
+
+from .findings import (Finding, RULES, RULES_VERSION,  # noqa: F401
+                       apply_waivers, default_waivers_path,
+                       lint_fingerprint, load_waivers, render_json,
+                       render_text, unused_waivers)
+from .hlo_audit_rules import (DISPATCH_ACCEPTS, HloArtifact,  # noqa: F401
+                              collect_donation, run_hlo_audit)
+from .pylint_rules import (harvest_config_keys,  # noqa: F401
+                           lint_source, run_ast_lint)
+
+__all__ = ["Finding", "RULES", "RULES_VERSION", "apply_waivers",
+           "default_waivers_path", "lint_fingerprint", "load_waivers",
+           "render_json", "render_text", "unused_waivers", "HloArtifact",
+           "DISPATCH_ACCEPTS", "collect_donation", "run_hlo_audit",
+           "harvest_config_keys", "lint_source", "run_ast_lint"]

@@ -1,0 +1,217 @@
+"""ds_tpu_lint finding/waiver core — the one report format both planes share.
+
+Every rule in the suite (AST lints in ``pylint_rules.py``, HLO auditors
+in ``hlo_audit_rules.py``) emits :class:`Finding` records: rule id,
+location (``path:line`` for source findings, ``hlo:<artifact>`` for
+compiled-program findings), severity, message, and a stable
+``waiver_key``. The key deliberately omits line numbers — a waiver
+granted for "raw lax.psum in utils/bench_cli.py" keeps working when the
+file shifts — and waiver entries in ``lint_waivers.json`` match keys by
+``fnmatch`` pattern, so one entry can cover a family of findings when a
+whole file or artifact is exempt.
+
+Waiver file format (checked in at the repo root)::
+
+    {"version": 1,
+     "waivers": [{"key": "AST001:deepspeed_tpu/utils/bench_cli.py:*",
+                  "reason": "raw-bandwidth probe times the raw lax op"}]}
+
+Every waiver MUST carry a non-empty reason string; ``load_waivers``
+rejects entries without one, so "waive it silently" is not expressible.
+
+This module is deliberately standalone — stdlib-only, no package
+imports — so ``bin/ds_tpu_lint`` can load it by file path and run the
+AST plane without importing jax or the deepspeed_tpu backend chain (the
+same bootstrap trick ``benchmarks/hlo_audit.py`` uses for hlo_cost).
+"""
+
+import fnmatch
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+__all__ = ["RULES", "RULES_VERSION", "Finding", "load_waivers",
+           "apply_waivers", "unused_waivers", "render_text", "render_json",
+           "lint_fingerprint", "default_waivers_path"]
+
+#: bump when a rule is added/removed or its detection semantics change —
+#: the /statusz fingerprint records which vintage of the suite a
+#: postmortem bundle was checked against
+RULES_VERSION = 1
+
+#: rule registry: id -> {plane, name, doc}. The CLI's --list-rules and
+#: docs/lint.md are generated views of this table.
+RULES: Dict[str, Dict[str, str]] = {
+    "AST001": {
+        "plane": "ast", "name": "raw-collective",
+        "doc": "raw lax collective (psum/pmean/pmax/pmin/psum_scatter/"
+               "all_gather/all_to_all/ppermute/pshuffle) outside comm/ and "
+               "ops/ — bypasses the compression-aware dispatch and its "
+               "wire accounting"},
+    "AST002": {
+        "plane": "ast", "name": "host-sync-in-traced",
+        "doc": "host synchronization (float(arg)/.item()/np.asarray/"
+               "np.array/time.time/time.perf_counter/jax.device_get) "
+               "inside a function jitted or shard_mapped — traces a "
+               "constant or raises under jit, and blocks async dispatch"},
+    "AST003": {
+        "plane": "ast", "name": "ownerless-gauge",
+        "doc": "tracer.set_counter(...) without owner= — leaks the gauge "
+               "past its producer's shutdown (the static form of the "
+               "test_metrics_lifecycle runtime lint)"},
+    "AST004": {
+        "plane": "ast", "name": "unknown-config-key",
+        "doc": "top-level config key (dict literal passed to initialize, "
+               "or examples/configs/*.json) not present in any registered "
+               "config block — silently ignored at runtime"},
+    "HLO001": {
+        "plane": "hlo", "name": "orphaned-async",
+        "doc": "async collective start without a matching done (or vice "
+               "versa) in the compiled module — a deadlock or a leaked "
+               "in-flight buffer"},
+    "HLO002": {
+        "plane": "hlo", "name": "replica-groups-partition",
+        "doc": "a collective's replica_groups do not exactly partition "
+               "the participating devices (overlap, gap, or unequal group "
+               "sizes) — undefined routing, hangs on real chips"},
+    "HLO003": {
+        "plane": "hlo", "name": "subaxis-inconsistency",
+        "doc": "two collectives in one module use the same group shape "
+               "with DIFFERENT partitions — inconsistent (host, local) "
+               "subaxis decomposition across hierarchical legs"},
+    "HLO004": {
+        "plane": "hlo", "name": "issue-order-divergence",
+        "doc": "collective issue order differs across per-device "
+               "programs — the static shard_map deadlock: two devices "
+               "enter different collectives first and both wait forever"},
+    "HLO005": {
+        "plane": "hlo", "name": "undonated-buffer",
+        "doc": "large state argument (grads/optimizer state/KV lanes) "
+               "not donated to an output — the program holds input and "
+               "output copies live at once, doubling that buffer's HBM"},
+    "HLO006": {
+        "plane": "hlo", "name": "dispatch-conformance",
+        "doc": "the compiled module carries a collective kind that the "
+               "comm dispatch never traced — wire bytes invisible to "
+               "comm_stats(), compression policies never consulted"},
+}
+
+
+@dataclass
+class Finding:
+    rule: str                      # "AST001" / "HLO005"
+    severity: str                  # "error" | "warning"
+    path: str                      # repo-relative path or "hlo:<artifact>"
+    line: int                      # 1-based source line; 0 for HLO findings
+    message: str
+    waiver_key: str                # "<rule>:<path-ish>:<symbol>"
+    waived: bool = False
+    waiver_reason: str = ""
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}" if self.line else self.path
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = asdict(self)
+        d["rule_name"] = RULES.get(self.rule, {}).get("name", "")
+        return d
+
+
+def make_key(rule: str, path: str, symbol: str) -> str:
+    return f"{rule}:{path}:{symbol}"
+
+
+def default_waivers_path(root: str) -> str:
+    return os.path.join(root, "lint_waivers.json")
+
+
+def load_waivers(path: Optional[str]) -> List[Dict[str, str]]:
+    """Load and validate the waiver file; [] when ``path`` is None or the
+    file does not exist. Raises ValueError on entries without a key or a
+    non-empty reason (an unreasoned waiver is a finding suppressed in
+    silence — exactly what this suite exists to prevent)."""
+    if not path or not os.path.exists(path):
+        return []
+    with open(path) as f:
+        doc = json.load(f)
+    waivers = doc.get("waivers", [])
+    for w in waivers:
+        if not w.get("key"):
+            raise ValueError(f"waiver entry without a key: {w!r}")
+        if not str(w.get("reason", "")).strip():
+            raise ValueError(f"waiver {w['key']!r} has no reason string")
+        w.setdefault("_hits", 0)
+    return waivers
+
+
+def apply_waivers(findings: Sequence[Finding],
+                  waivers: List[Dict[str, str]]) -> List[Finding]:
+    """Mark findings whose waiver_key matches a waiver pattern; mutates
+    and returns ``findings``. Waiver ``_hits`` counters are updated so
+    :func:`unused_waivers` can name stale entries."""
+    for f in findings:
+        for w in waivers:
+            if fnmatch.fnmatchcase(f.waiver_key, w["key"]):
+                f.waived = True
+                f.waiver_reason = w["reason"]
+                w["_hits"] = w.get("_hits", 0) + 1
+                break
+    return list(findings)
+
+
+def unused_waivers(waivers: List[Dict[str, str]]) -> List[str]:
+    """Keys of waivers that matched nothing in the last apply_waivers
+    pass — stale entries worth deleting (reported, never fatal: a waiver
+    for an HLO artifact is legitimately idle during an ast-only run)."""
+    return [w["key"] for w in waivers if not w.get("_hits")]
+
+
+def render_text(findings: Sequence[Finding], show_waived: bool = True) -> str:
+    lines = []
+    for f in sorted(findings, key=lambda x: (x.waived, x.rule, x.path,
+                                             x.line)):
+        if f.waived and not show_waived:
+            continue
+        tag = "waived" if f.waived else f.severity
+        name = RULES.get(f.rule, {}).get("name", "")
+        lines.append(f"[{tag:7s}] {f.rule} ({name}) {f.location}: "
+                     f"{f.message}")
+        if f.waived:
+            lines.append(f"          waiver: {f.waiver_reason}")
+    active = [f for f in findings if not f.waived]
+    lines.append(f"{len(list(findings))} finding(s), "
+                 f"{len(active)} non-waived")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding],
+                extra: Optional[Dict[str, Any]] = None) -> str:
+    doc = {
+        "rules_version": RULES_VERSION,
+        "findings": [f.to_dict() for f in findings],
+        "non_waived": sum(1 for f in findings if not f.waived),
+    }
+    if extra:
+        doc.update(extra)
+    return json.dumps(doc, indent=1, sort_keys=True)
+
+
+def lint_fingerprint(root: Optional[str] = None) -> str:
+    """One-line suite fingerprint for /statusz and postmortem bundles:
+    rules version + rule count + the checked-in waiver count, so a
+    bundle records exactly which lint vintage the build was clean
+    against. Never raises (statusz must render with a broken or absent
+    waiver file)."""
+    n_waivers = 0
+    if root is None:
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    try:
+        n_waivers = len(load_waivers(default_waivers_path(root)))
+    except Exception:
+        n_waivers = -1          # unreadable waiver file: worth noticing
+    return (f"ds_tpu_lint v{RULES_VERSION}: {len(RULES)} rules, "
+            f"{n_waivers} waivers")

@@ -1,0 +1,456 @@
+"""ds_tpu_lint Plane B — framework-aware AST lints (stdlib ``ast`` only).
+
+Four rules over the repo's python source (``deepspeed_tpu/``,
+``benchmarks/``, ``bin/``, ``examples/``; tests are exempt — they seed
+violations on purpose):
+
+AST001 raw-collective
+    ``lax.psum``/``all_gather``/``ppermute``/… called outside
+    ``deepspeed_tpu/comm/`` and ``deepspeed_tpu/ops/``. Everything else
+    must go through the compression-aware dispatch in ``comm/comm.py``
+    so int8/fp8 policies and wire accounting apply (this rule is how
+    the MoE GSPMD bypass stays *named* rather than forgotten — see the
+    HLO006 waiver in lint_waivers.json and ROADMAP item 3).
+
+AST002 host-sync-in-traced
+    ``float(arg)``, ``.item()``, ``np.asarray``/``np.array``,
+    ``time.time``/``perf_counter``, ``jax.device_get`` inside a function
+    that is jitted or shard_mapped (decorator, ``partial(jax.jit,…)``,
+    or passed by name/lambda to ``jit``/``shard_map``). Under trace
+    these either raise (concretization) or silently bake a constant,
+    and on device they force a host round-trip per step.
+
+AST003 ownerless-gauge
+    ``*.set_counter(...)`` without ``owner=`` — the static form of the
+    tests/unit/test_metrics_lifecycle.py runtime check: ownerless
+    gauges survive their producer's shutdown and leak across
+    co-resident engines.
+
+AST004 unknown-config-key
+    Top-level keys of config dict literals handed to
+    ``deepspeed_tpu.initialize(...)`` (and of ``examples/configs/*.json``)
+    must exist in the registered config blocks, harvested statically
+    from ``runtime/constants.py`` and the ``.get("…")`` reads in
+    ``runtime/config.py``, ``serving/config.py`` and
+    ``serving/fleet/config.py``. Unknown keys are silently ignored at
+    runtime — the classic "my setting did nothing" bug.
+
+Standalone-loadable: ``bin/ds_tpu_lint`` file-path-loads this module so
+the AST plane runs without importing jax or the package __init__ chain.
+"""
+
+import ast
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+try:
+    from .findings import Finding, make_key
+except ImportError:                    # loaded by file path (bin/ds_tpu_lint)
+    from _dstpu_lint_findings import Finding, make_key  # type: ignore
+
+__all__ = ["run_ast_lint", "lint_source", "harvest_config_keys",
+           "check_config_doc", "DEFAULT_SCAN_DIRS", "COLLECTIVE_FNS"]
+
+#: directories scanned by default, relative to the repo root
+DEFAULT_SCAN_DIRS = ("deepspeed_tpu", "benchmarks", "bin", "examples")
+
+#: path prefixes where raw lax collectives are the implementation layer
+RAW_COLLECTIVE_OK = ("deepspeed_tpu/comm/", "deepspeed_tpu/ops/")
+
+#: jax.lax collective callables AST001 polices
+COLLECTIVE_FNS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "all_to_all", "ppermute", "pshuffle"})
+
+_HOST_SYNC_MODS = {"np", "numpy", "onp"}
+_HOST_SYNC_NP = {"asarray", "array"}
+_TIME_FNS = {"time", "perf_counter", "perf_counter_ns", "monotonic"}
+
+
+def _dotted(node) -> str:
+    """'jax.lax.psum' for an Attribute/Name chain, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_or_shardmap(func) -> bool:
+    d = _dotted(func)
+    return (d in ("jit", "shard_map") or d.endswith(".jit") or
+            d.endswith(".shard_map"))
+
+
+def _partial_of_jit(call: ast.Call) -> bool:
+    """partial(jax.jit, ...) / functools.partial(shard_map, ...)"""
+    d = _dotted(call.func)
+    if not (d == "partial" or d.endswith(".partial")):
+        return False
+    return bool(call.args) and _is_jit_or_shardmap(call.args[0])
+
+
+class _TracedFns(ast.NodeVisitor):
+    """Names of functions wrapped for jit/shard_map anywhere in the
+    module: decorated defs, and defs/lambdas passed as the first
+    positional argument of a jit/shard_map call."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+        self.lambda_nodes: List[ast.Lambda] = []
+
+    def visit_FunctionDef(self, node):
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if _is_jit_or_shardmap(target) or (
+                    isinstance(dec, ast.Call) and _partial_of_jit(dec)):
+                self.names.add(node.name)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        if _is_jit_or_shardmap(node.func) or _partial_of_jit(node):
+            wrapped = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg in ("fun", "f", "func"):
+                    wrapped = kw.value
+            if isinstance(wrapped, ast.Name):
+                self.names.add(wrapped.id)
+            elif isinstance(wrapped, ast.Lambda):
+                self.lambda_nodes.append(wrapped)
+        self.generic_visit(node)
+
+
+def _func_params(fn) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, rel: str, findings: List[Finding],
+                 rules: Set[str], traced: _TracedFns):
+        self.rel = rel
+        self.findings = findings
+        self.rules = rules
+        self.traced = traced
+        #: stack of param-name sets while inside traced function bodies
+        self._traced_stack: List[Set[str]] = []
+        self._raw_ok = any(self.rel.startswith(p)
+                           for p in RAW_COLLECTIVE_OK)
+
+    # ------------------------------------------------------------ helpers
+    def _emit(self, rule, node, symbol, message, severity="error"):
+        self.findings.append(Finding(
+            rule=rule, severity=severity, path=self.rel,
+            line=getattr(node, "lineno", 0), message=message,
+            waiver_key=make_key(rule, self.rel, symbol)))
+
+    def _in_traced(self) -> bool:
+        return bool(self._traced_stack)
+
+    # ------------------------------------------------------- fn scoping
+    def visit_FunctionDef(self, node):
+        is_traced = self._in_traced() or node.name in self.traced.names \
+            or any(_is_jit_or_shardmap(d.func if isinstance(d, ast.Call)
+                                       else d) or
+                   (isinstance(d, ast.Call) and _partial_of_jit(d))
+                   for d in node.decorator_list)
+        if is_traced:
+            params = _func_params(node)
+            if self._traced_stack:
+                params = params | self._traced_stack[-1]
+            self._traced_stack.append(params)
+        self.generic_visit(node)
+        if is_traced:
+            self._traced_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        is_traced = self._in_traced() or node in self.traced.lambda_nodes
+        if is_traced:
+            params = _func_params(node)
+            if self._traced_stack:
+                params = params | self._traced_stack[-1]
+            self._traced_stack.append(params)
+        self.generic_visit(node)
+        if is_traced:
+            self._traced_stack.pop()
+
+    # ------------------------------------------------------------- rules
+    def visit_Call(self, node):
+        d = _dotted(node.func)
+
+        # AST001: raw lax collective outside comm/ and ops/
+        if "AST001" in self.rules and not self._raw_ok:
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in COLLECTIVE_FNS and \
+                    (d.split(".")[-2:-1] == ["lax"] or d.startswith("lax.")):
+                self._emit(
+                    "AST001", node, d.split(".", 1)[-1]
+                    if d.startswith("jax.") else d,
+                    f"raw {d}() bypasses the comm dispatch — route through "
+                    f"deepspeed_tpu.comm (compression policy + wire "
+                    f"accounting) or add a reasoned waiver")
+
+        # AST002: host sync inside traced code
+        if "AST002" in self.rules and self._in_traced():
+            sym = None
+            why = None
+            if isinstance(node.func, ast.Name) and node.func.id == "float" \
+                    and node.args and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in self._traced_stack[-1]:
+                sym, why = "float", (f"float({node.args[0].id}) forces a "
+                                     f"host sync on a traced value")
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                sym, why = ".item", ".item() forces a host sync under trace"
+            elif isinstance(node.func, ast.Attribute):
+                base = _dotted(node.func.value)
+                if base in _HOST_SYNC_MODS and \
+                        node.func.attr in _HOST_SYNC_NP:
+                    sym = f"{base}.{node.func.attr}"
+                    why = (f"{sym}() materializes a traced value on host "
+                           f"(use jnp inside jitted code)")
+                elif base == "time" and node.func.attr in _TIME_FNS:
+                    sym = f"time.{node.func.attr}"
+                    why = (f"{sym}() inside a traced function is evaluated "
+                           f"ONCE at trace time — it cannot time steps")
+                elif d == "jax.device_get":
+                    sym, why = "jax.device_get", \
+                        "device_get blocks dispatch inside traced code"
+            if sym:
+                self._emit("AST002", node, sym,
+                           f"host sync in jitted/shard_mapped code: {why}")
+
+        # AST003: ownerless gauge
+        if "AST003" in self.rules and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "set_counter":
+            if not any(kw.arg == "owner" for kw in node.keywords):
+                tag = "?"
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    tag = node.args[0].value
+                self._emit("AST003", node, tag,
+                           f"set_counter({tag!r}) without owner= — the "
+                           f"gauge outlives its producer (see "
+                           f"test_metrics_lifecycle)")
+
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------- AST004
+
+#: files whose string keys define the registered config surface
+_CONFIG_SOURCES = ("deepspeed_tpu/runtime/constants.py",
+                   "deepspeed_tpu/runtime/config.py",
+                   "deepspeed_tpu/serving/config.py",
+                   "deepspeed_tpu/serving/fleet/config.py",
+                   "deepspeed_tpu/inference/config.py")
+
+#: keys read through non-static paths (getattr loops, env, kwargs)
+_EXTRA_KNOWN = {"seed"}
+
+
+def harvest_config_keys(root: str) -> Set[str]:
+    """The statically-registered config key surface: every string
+    constant in runtime/constants.py plus every string literal read via
+    ``.get("…")`` or ``d["…"]`` in the config parsers. A superset of
+    the top-level keys (nested keys like "enabled" ride along), which
+    is exactly the safe direction for a not-registered check. Dataclass
+    config models (ServingConfig and friends) register keys as FIELD
+    names, so class-level annotated assignments count too."""
+    known: Set[str] = set(_EXTRA_KNOWN)
+    for rel in _CONFIG_SOURCES:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            try:
+                tree = ast.parse(f.read())
+            except SyntaxError:
+                continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str) and \
+                    all(isinstance(t, ast.Name) for t in node.targets):
+                known.add(node.value.value)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                known.add(node.args[0].value)
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                known.add(node.slice.value)
+            elif isinstance(node, ast.ClassDef):
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) and \
+                            isinstance(stmt.target, ast.Name):
+                        known.add(stmt.target.id)
+                    elif isinstance(stmt, ast.Assign):
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                known.add(t.id)
+    return known
+
+
+def check_config_doc(doc: dict, known: Set[str], rel: str,
+                     findings: List[Finding], line: int = 0):
+    """Flag top-level keys of a parsed config document not in the
+    registered surface."""
+    for key in doc:
+        if isinstance(key, str) and key not in known:
+            findings.append(Finding(
+                rule="AST004", severity="error", path=rel, line=line,
+                message=f"config key {key!r} is not in any registered "
+                        f"config block — it will be silently ignored",
+                waiver_key=make_key("AST004", rel, key)))
+
+
+def _config_dicts_passed_to_initialize(tree: ast.Module):
+    """(dict node, lineno) for every dict literal handed to an
+    ``initialize``/``init_inference`` call as ``config=`` (or the 2nd
+    positional arg), following one level of Name indirection."""
+    assigns: Dict[str, ast.Dict] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    assigns[t.id] = node.value
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if not (d == "initialize" or d.endswith(".initialize")):
+            continue
+        cfg = None
+        for kw in node.keywords:
+            if kw.arg == "config":
+                cfg = kw.value
+        if cfg is None and len(node.args) >= 2:
+            cfg = node.args[1]
+        if isinstance(cfg, ast.Name):
+            cfg = assigns.get(cfg.id)
+        if isinstance(cfg, ast.Dict):
+            out.append((cfg, node.lineno))
+    return out
+
+
+def _check_config_literals(tree, known, rel, findings):
+    for dct, line in _config_dicts_passed_to_initialize(tree):
+        for k in dct.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                    and k.value not in known:
+                findings.append(Finding(
+                    rule="AST004", severity="error", path=rel,
+                    line=getattr(k, "lineno", line),
+                    message=f"config key {k.value!r} passed to initialize() "
+                            f"is not in any registered config block",
+                    waiver_key=make_key("AST004", rel, k.value)))
+
+
+# ----------------------------------------------------------------- entry
+
+def lint_source(source: str, rel: str,
+                rules: Optional[Iterable[str]] = None,
+                known_config_keys: Optional[Set[str]] = None
+                ) -> List[Finding]:
+    """Run the AST rules over one python source string (``rel`` is the
+    repo-relative path used for locations and waiver keys)."""
+    active = set(rules) if rules else {"AST001", "AST002", "AST003",
+                                       "AST004"}
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return findings                  # not python (data file in bin/)
+    traced = _TracedFns()
+    traced.visit(tree)
+    _Linter(rel, findings, active, traced).visit(tree)
+    if "AST004" in active and known_config_keys:
+        _check_config_literals(tree, known_config_keys, rel, findings)
+    return findings
+
+
+def _iter_py_files(root: str, dirs: Sequence[str]):
+    for d in dirs:
+        base = os.path.join(root, d)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [x for x in dirnames
+                           if x not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                path = os.path.join(dirpath, fn)
+                if fn.endswith(".py"):
+                    yield path
+                elif d == "bin" and not fn.endswith((".json", ".md")):
+                    # bin/ scripts have no extension; sniff the shebang
+                    try:
+                        with open(path) as f:
+                            if "python" in f.readline():
+                                yield path
+                    except OSError:
+                        pass
+
+
+def run_ast_lint(root: str, files: Optional[Sequence[str]] = None,
+                 rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Plane B over the repo (or an explicit file list). Returns raw
+    findings — the caller applies waivers."""
+    root = os.path.abspath(root)
+    known = harvest_config_keys(root)
+    findings: List[Finding] = []
+    paths = [os.path.abspath(p) for p in files] if files else \
+        list(_iter_py_files(root, DEFAULT_SCAN_DIRS))
+    for path in paths:
+        rel = os.path.relpath(path, root)
+        if rel.split(os.sep)[0] == "tests":
+            continue                     # fixtures seed violations
+        try:
+            with open(path) as f:
+                src = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        if path.endswith(".json"):
+            if (not rules) or "AST004" in set(rules):
+                try:
+                    doc = json.loads(src)
+                except ValueError:
+                    continue
+                if isinstance(doc, dict):
+                    check_config_doc(doc, known, rel, findings)
+            continue
+        findings.extend(lint_source(src, rel, rules=rules,
+                                    known_config_keys=known))
+    if files is None and ((not rules) or "AST004" in set(rules)):
+        cfg_dir = os.path.join(root, "examples", "configs")
+        if os.path.isdir(cfg_dir):
+            for fn in sorted(os.listdir(cfg_dir)):
+                if not fn.endswith(".json"):
+                    continue
+                rel = os.path.join("examples", "configs", fn)
+                try:
+                    with open(os.path.join(cfg_dir, fn)) as f:
+                        doc = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                if isinstance(doc, dict):
+                    check_config_doc(doc, known, rel, findings)
+    return findings

@@ -13,7 +13,7 @@ from .comm import (ReduceOp, init_distributed, is_initialized, get_rank,
                    recv, has_all_gather_into_tensor,
                    has_reduce_scatter_tensor,
                    # compression-aware dispatch accounting
-                   comm_stats, reset_comm_stats)
+                   comm_stats, comm_per_op_stats, reset_comm_stats)
 from .compression import (CommCompressionConfig, configure_comm_compression,
                           get_comm_compression, reset_comm_compression)
 from .logging import CommsLogger, get_comms_logger, configure_comms_logger

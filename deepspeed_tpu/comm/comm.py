@@ -265,6 +265,7 @@ _COMM_WIRE_BYTES = 0
 _COMM_LOGICAL_BYTES = 0
 _COMM_INTER_BYTES = 0
 _COMM_INTRA_BYTES = 0
+_COMM_PER_OP: dict = {}
 
 
 def comm_stats():
@@ -282,11 +283,22 @@ def comm_stats():
             "intra_host_bytes": _COMM_INTRA_BYTES}
 
 
+def comm_per_op_stats():
+    """Per-op traced collective counts ({op name: count}). Kept apart
+    from :func:`comm_stats` — whose flat numeric dict the flight
+    recorder diffs per step record — so the dispatch-conformance
+    auditor (analysis/hlo_audit_rules.py HLO006) can reconcile a
+    compiled module's collective kinds against what the dispatch
+    actually traced."""
+    return dict(_COMM_PER_OP)
+
+
 def reset_comm_stats():
     global _COMM_OPS, _COMM_WIRE_BYTES, _COMM_LOGICAL_BYTES
     global _COMM_INTER_BYTES, _COMM_INTRA_BYTES
     _COMM_OPS = _COMM_WIRE_BYTES = _COMM_LOGICAL_BYTES = 0
     _COMM_INTER_BYTES = _COMM_INTRA_BYTES = 0
+    _COMM_PER_OP.clear()
 
 
 def _split_inter(wire: int, n: int) -> int:
@@ -315,6 +327,7 @@ def _account(op, logical, wire, n, axis_name, inter=None):
     _COMM_LOGICAL_BYTES += logical
     _COMM_INTER_BYTES += inter
     _COMM_INTRA_BYTES += wire - inter
+    _COMM_PER_OP[op] = _COMM_PER_OP.get(op, 0) + 1
     cl = get_comms_logger()
     if cl is not None and cl.enabled:
         cl.append(op, wire, str(axis_name))

@@ -198,9 +198,10 @@ class InferenceEngine:
 
     def _observe_compile(self, label, fn, args, names=None):
         """Compile-ledger hook: no-op unless the serving layer attached a
-        ledger. Serving programs don't donate their inputs, so observing
-        before the call (args live either way) keeps one code path with
-        the training engine."""
+        ledger. Observes BEFORE the call — the fused decode step donates
+        its pool (its args must be read while still live), and the other
+        serving programs don't donate, so before-the-call is the one
+        ordering that works for all of them."""
         cp = self.compile_plane
         if cp is None:
             return
@@ -896,9 +897,15 @@ class InferenceEngine:
                 pool = quantize_pool(fp) if quantized else fp
                 return pool, nxt
 
+            # donate the pool: decode is state-in/state-out per tick, and
+            # an undonated pool keeps TWO pool-sized buffers live across
+            # every step — the kv_slots HBM doubling ds_tpu_lint's
+            # donation auditor (HLO005) flags. Every caller rebinds the
+            # pool from the return (scheduler.py decode tick included).
             fn = self._slot_fns[fkey] = jax.jit(dec, in_shardings=(
                 self.param_shardings, pool_shardings, None, None, None, None),
-                out_shardings=(pool_shardings, None))
+                out_shardings=(pool_shardings, None),
+                donate_argnums=(1,))
         if key is None:
             key = jax.random.PRNGKey(0)
         dec_args = (self.params, pool, jnp.asarray(toks, jnp.int32),

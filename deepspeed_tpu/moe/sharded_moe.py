@@ -18,6 +18,15 @@ Gating semantics follow the reference (which follows GShard):
   - token dropping by intra-expert position (cumsum order), or
     ``drop_tokens=False`` → capacity = S (nothing dropped, more padding)
   - optional random token selection (``use_rts``) for drop fairness
+
+KNOWN GAP (ROADMAP item 3, kept visible by ds_tpu_lint): the GSPMD
+all-to-all behind the dispatch/combine einsums bypasses the
+compression-aware comm dispatch — expert traffic gets no int8/fp8 wire
+policy and no comm_stats() accounting. The HLO dispatch-conformance
+auditor (HLO006) flags it on the ``moe_step`` artifact; the waiver in
+``lint_waivers.json`` carries the tracking note and must be deleted
+when dispatch/combine are routed through ``comm/comm.py`` under an
+explicit ep shard_map.
 """
 
 import math

@@ -35,11 +35,33 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ... import comm
 from ...parallel.topology import PIPE_AXIS
 from ...utils.logging import log_dist
 from ..engine import DeepSpeedEngine, _cast_tree
 from . import schedule as sched
 from .module import PipelineModule
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:                      # pre-0.5 spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _pipe_shard_map(body, mesh, in_specs, out_specs):
+    """shard_map manual over ONLY the 'pipe' axis, replication check off
+    (outputs are made consistent by the explicit ppermute/psum legs).
+    Spelled for both shard_map generations: ``axis_names``/``check_vma``
+    (jax >= 0.5) vs ``auto``/``check_rep`` (the experimental module this
+    jax pin ships) — the same dual-spelling compressed_step.py uses."""
+    try:
+        return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, axis_names={PIPE_AXIS},
+                          check_vma=False)
+    except TypeError:
+        auto = frozenset(mesh.axis_names) - {PIPE_AXIS}
+        return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False, auto=auto)
 
 
 class PipelineEngine(DeepSpeedEngine):
@@ -155,8 +177,11 @@ class PipelineEngine(DeepSpeedEngine):
                 x, aux = run_stage(x, micro_idx)
                 valid = (micro_idx >= 0) & (micro_idx < M)
                 aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
-                nxt = lax.ppermute(x, PIPE_AXIS,
-                                   [(i, i + 1) for i in range(S - 1)])
+                # comm.ppermute, not raw lax: byte-identical HLO, but the
+                # stage hop lands in the wire accounting (ds_tpu_lint
+                # AST001 polices raw collectives outside comm/ and ops/)
+                nxt = comm.ppermute(x, [(i, i + 1) for i in range(S - 1)],
+                                    PIPE_AXIS)
                 return (nxt, aux_sum), x
 
             init = (jnp.zeros(x_embeds.shape[1:], cdtype), jnp.float32(0.0))
@@ -166,15 +191,13 @@ class PipelineEngine(DeepSpeedEngine):
             # sliced outside via the stacked out_spec (a static slice; no
             # collective, and its transpose is a zero-pad, not a scatter)
             outs = ys[S - 1:]
-            aux = lax.psum(aux_sum, PIPE_AXIS)
+            aux = comm.all_reduce(aux_sum, axis_name=PIPE_AXIS)
             return outs, aux
 
-        outs, aux = jax.shard_map(
-            body, mesh=mesh,
+        outs, aux = _pipe_shard_map(
+            body, mesh,
             in_specs=(P(PIPE_AXIS), P(), P()),
             out_specs=(P(PIPE_AXIS), P()),
-            axis_names={PIPE_AXIS},
-            check_vma=False,
         )(blocks, x_embeds, rng)
         # stacked over stages: [S*M, B, T, D]; the last stage's block holds
         # the pipeline outputs. head + loss run out here under plain GSPMD

@@ -29,6 +29,14 @@ Contents:
   robust to the printed schedule order — this is the number the bucketed
   ZeRO exchange (runtime/zero/overlap_schedule.py) exists to raise and
   the schedule autotuner (autotuning/schedule.py) scores.
+- ``collect_replica_groups(hlo_text)`` — parsed ``replica_groups`` per
+  collective instruction (explicit ``{{0,1},{2,3}}`` lists, the iota
+  ``[G,S]<=[dims]T(perm)`` form, and the empty all-devices form), one
+  record per op with the expanded group membership. The collective-
+  safety auditors (analysis/hlo_audit_rules.py) consume this instead of
+  re-regexing HLO text.
+- ``module_num_partitions(hlo_text)`` — the module's declared partition
+  count (``num_partitions=N`` header field), 0 when absent.
 - ``cost_summary(raw)`` — normalize a ``cost_analysis()`` result
   (dict, or the list/tuple wrapping older jax returns) to a flat dict
   of floats with python-identifier keys.
@@ -47,6 +55,7 @@ from typing import Any, Dict, Optional
 
 __all__ = ["DTYPE_BYTES", "COLLECTIVES", "collect_collectives",
            "collect_async", "collect_schedule_overlap",
+           "collect_replica_groups", "module_num_partitions",
            "hlo_overlap_summary", "cost_summary", "memory_summary"]
 
 #: HLO shape-prefix dtype -> bytes per element (unknown dtypes assume 4)
@@ -105,6 +114,88 @@ def collect_async(hlo_text: str) -> Dict[str, int]:
         n += len(re.findall(rf"\basync-start[^\n]*\b{op}\b", hlo_text))
         if n:
             out[op] = n
+    return out
+
+
+_NUM_PARTITIONS_RE = re.compile(r"\bnum_partitions=(\d+)")
+#: replica_groups in either printed form: explicit nested brace lists
+#: ('{{0,1},{2,3}}', '{}' = all devices) or the iota shorthand
+#: ('[G,S]<=[d0,d1,...]' with an optional 'T(perm)' transpose)
+_RG_RE = re.compile(
+    r"replica_groups=(\{(?:\{[\d,\s]*\}(?:,\s*)?)*\}|"
+    r"\[[\d,]+\]<=\[[\d,]+\](?:T\([\d,]+\))?)")
+_RG_LINE_RE = re.compile(
+    r"(%?[\w.\-]+)\s*=\s*(?:\([^=]*?\)|\S+)\s+([\w\-]+)\(")
+_IOTA_RE = re.compile(
+    r"\[([\d,]+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+
+
+def _expand_iota_groups(shape, dims, perm):
+    """Expand HLO's iota replica-group shorthand ``[G,S]<=[dims]T(perm)``:
+    device ids are ``transpose(arange(prod(dims)).reshape(dims), perm)``
+    flattened, then chunked into G groups of S."""
+    total = math.prod(dims)
+    # row-major strides of the ORIGINAL dims layout
+    strides = [1] * len(dims)
+    for i in range(len(dims) - 2, -1, -1):
+        strides[i] = strides[i + 1] * dims[i + 1]
+    tdims = [dims[p] for p in perm]
+    tstrides = [strides[p] for p in perm]
+    flat = []
+    for i in range(total):
+        rem, off = i, 0
+        for d, s in zip(reversed(tdims), reversed(tstrides)):
+            off += (rem % d) * s
+            rem //= d
+        flat.append(off)
+    group_size = shape[-1] if shape else total
+    n_groups = max(1, total // max(1, group_size))
+    return [flat[g * group_size:(g + 1) * group_size]
+            for g in range(n_groups)]
+
+
+def module_num_partitions(hlo_text: str) -> int:
+    """The compiled module's declared partition count (0 when the header
+    does not carry one)."""
+    m = _NUM_PARTITIONS_RE.search(hlo_text)
+    return int(m.group(1)) if m else 0
+
+
+def collect_replica_groups(hlo_text: str):
+    """One record per instruction carrying a ``replica_groups=`` field:
+    ``{"name", "op", "groups", "form", "line"}``. ``groups`` is the
+    expanded ``[[device ids], ...]`` membership — ``None`` for the empty
+    form (``replica_groups={}``: every device in one group). ``form`` is
+    ``"explicit"``, ``"iota"`` or ``"all"``. Shared by the HLO
+    collective-safety auditors and the overlap analyzer so nobody
+    re-regexes the module text."""
+    out = []
+    for lineno, line in enumerate(hlo_text.split("\n"), start=1):
+        if "replica_groups=" not in line:
+            continue
+        rg = _RG_RE.search(line)
+        if not rg:
+            continue
+        m = _RG_LINE_RE.search(line)
+        name = m.group(1).lstrip("%") if m else f"line{lineno}"
+        op = m.group(2) if m else ""
+        body = rg.group(1)
+        if body.startswith("["):
+            im = _IOTA_RE.match(body)
+            shape = [int(x) for x in im.group(1).split(",")]
+            dims = [int(x) for x in im.group(2).split(",")]
+            perm = ([int(x) for x in im.group(3).split(",")]
+                    if im.group(3) else list(range(len(dims))))
+            groups = _expand_iota_groups(shape, dims, perm)
+            form = "iota"
+        elif body == "{}":
+            groups, form = None, "all"
+        else:
+            groups = [[int(x) for x in g.split(",") if x.strip()]
+                      for g in re.findall(r"\{([\d,\s]*)\}", body[1:-1])]
+            form = "explicit"
+        out.append({"name": name, "op": op, "groups": groups,
+                    "form": form, "line": lineno})
     return out
 
 

@@ -53,6 +53,23 @@ from .trace import get_tracer
 
 __all__ = ["StatuszServer"]
 
+_LINT_FP: Optional[str] = None
+
+
+def _lint_fingerprint() -> str:
+    """Cached one-liner from analysis/findings.py (rules version + rule
+    count + checked-in waiver count). Computed once per process — it
+    only changes with the tree — and never allowed to fail a status
+    render."""
+    global _LINT_FP
+    if _LINT_FP is None:
+        try:
+            from ..analysis.findings import lint_fingerprint
+            _LINT_FP = lint_fingerprint()
+        except Exception:
+            _LINT_FP = "unavailable"
+    return _LINT_FP
+
 
 class StatuszServer:
     """One engine's introspection endpoint. Providers and health checks
@@ -167,6 +184,10 @@ class StatuszServer:
                 "uptime_s": round(time.time() - self._t_start, 1),
                 "healthy": healthy,
                 "health_detail": detail,
+                # which lint vintage this build was checked against —
+                # flight-recorder bundles embed the status sections, so
+                # every postmortem records it
+                "lint": _lint_fingerprint(),
             },
             "counters": counters,
             "sections": {},
@@ -216,7 +237,8 @@ class StatuszServer:
         cls = "good" if proc["healthy"] else "bad"
         parts.append(
             f"<p>pid {proc['pid']} · uptime {proc['uptime_s']}s · health "
-            f"<span class='{cls}'>{esc(proc['health_detail'])}</span></p>")
+            f"<span class='{cls}'>{esc(proc['health_detail'])}</span> · "
+            f"{esc(proc.get('lint', ''))}</p>")
 
         def table(rows):
             body = "".join(f"<tr><td>{esc(str(k))}</td>"
