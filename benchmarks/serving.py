@@ -30,6 +30,17 @@ benchmarks/serving_fleet.json with three asserted experiments:
    / prefill / handoff serialize+transfer+insert / decode / stream)
    lands in serving_fleet.json and each request's stage sum matches its
    independently measured e2e within 5% at the p50.
+
+``--speculative`` runs the speculative-decoding benchmark (ISSUE 12),
+writing benchmarks/serving_spec.json: greedy decode tokens/sec with
+speculation OFF vs ON over interleaved measurement blocks (off/on/off/on
+— kills sequential-loop drift), the measured acceptance-rate EMA, and a
+bitwise token-parity check of the spec-off path against ``generate()``.
+The bench model (8L/512d, small init, 1-layer self-speculative draft,
+k=8) is deliberately in the regime speculation targets: decode is
+weight-streaming-bound, so verifying 9 positions costs about one decode
+pass, and the shallow draft agrees with the full stack almost always —
+acceptance is MEASURED and reported, not assumed.
 """
 
 import argparse
@@ -413,6 +424,131 @@ def main_fleet(args):
     print(json.dumps(report, indent=2))
 
 
+def _spec_bench_engine(args):
+    """The speculative bench model: wide enough that single-token decode
+    is weight-streaming-bound (so a k+1-token verify costs ~one decode
+    pass) and init small enough that the 1-layer early-exit draft agrees
+    with the full stack — the high-acceptance regime the ISSUE's >=2x
+    gate targets. Acceptance is measured and reported, never assumed."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    model = GPT2Model(GPT2Config(
+        vocab_size=256, n_positions=max(256, args.prompt_len + args.max_new),
+        n_embd=512, n_layer=8, n_head=8, pad_vocab_to_multiple=1,
+        dtype="float32", initializer_range=0.01))
+    return deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+
+
+def _spec_block(engine, prompts, max_new, slots, spec_cfg):
+    """One measurement block: serve every prompt to completion, greedy,
+    all submitted up front (decode-bound — the steady state speculation
+    accelerates). Returns (tokens/sec, metrics summary, tokens)."""
+    from deepspeed_tpu.serving import SamplingParams, ServingEngine
+    cfg = {"num_slots": slots,
+           "max_model_len": prompts[0].size + max_new,
+           "max_queue": len(prompts), "max_prefills_per_tick": 4}
+    if spec_cfg is not None:
+        cfg["speculative"] = spec_cfg
+    srv = ServingEngine(engine, cfg)
+    warm = srv.submit(prompts[0], SamplingParams(max_new_tokens=4))
+    srv.run_until_idle()
+    assert srv.result(warm).done
+    t0 = time.perf_counter()
+    rids = [srv.submit(p, SamplingParams(max_new_tokens=max_new))
+            for p in prompts]
+    srv.run_until_idle()
+    wall = time.perf_counter() - t0
+    toks = [list(srv.result(r).tokens) for r in rids]
+    n_tokens = sum(len(t) for t in toks)
+    summary = srv.metrics.summary(wall_seconds=wall)
+    srv.shutdown()
+    return n_tokens / wall, summary, toks
+
+
+def main_spec(args):
+    # the speculative gate measures DECODE steady state: at the shared
+    # default of 16 new tokens the prefill fraction would dominate, so
+    # the unoverridden default deepens to 48 (explicit --max-new wins)
+    if args.max_new == 16 and "SRV_NEW" not in os.environ:
+        args.max_new = 48
+    if args.requests == 16 and "SRV_REQUESTS" not in os.environ:
+        args.requests = 8
+    engine = _spec_bench_engine(args)
+    rng = np.random.default_rng(args.seed)
+    prompts = [rng.integers(0, 256, (args.prompt_len,), dtype=np.int32)
+               for _ in range(args.requests)]
+    spec_cfg = {"enabled": True, "k": args.spec_k,
+                "draft": {"mode": "self", "layers": args.draft_layers}}
+
+    # interleaved off/on blocks: sequential-loop drift (cache warmth,
+    # clock scaling) hits both sides equally
+    off_tps, on_tps = [], []
+    off_toks = on_toks = None
+    spec_summary = None
+    for block in ("off", "on", "off", "on"):
+        if block == "off":
+            tps, _s, off_toks = _spec_block(
+                engine, prompts, args.max_new, args.slots, None)
+            off_tps.append(tps)
+        else:
+            tps, spec_summary, on_toks = _spec_block(
+                engine, prompts, args.max_new, args.slots, spec_cfg)
+            on_tps.append(tps)
+
+    # parity gates: spec-off serving is bitwise generate(), and the
+    # speculative stream is bitwise the non-speculative stream
+    for i in (0, len(prompts) // 2, len(prompts) - 1):
+        ref = np.asarray(engine.generate(
+            prompts[i][None], max_new_tokens=args.max_new))[0]
+        assert off_toks[i] == list(ref[args.prompt_len:]), \
+            f"spec-off serving diverged from generate() on request {i}"
+    assert off_toks == on_toks, \
+        "speculation changed the emitted tokens (exact-match verify broken)"
+
+    off = sorted(off_tps)[len(off_tps) // 2]
+    on = sorted(on_tps)[len(on_tps) // 2]
+    spec = spec_summary["speculative"]
+    report = {
+        "benchmark": "speculative_decode",
+        "model": "gpt2-bench(8L/512d, init 0.01)",
+        "draft": f"self-speculative (layers={args.draft_layers} of 8)",
+        "k": args.spec_k,
+        "requests": args.requests, "prompt_len": args.prompt_len,
+        "max_new_tokens": args.max_new, "num_slots": args.slots,
+        "interleaved_blocks": {"off_tokens_per_s": [round(x, 1)
+                                                    for x in off_tps],
+                               "on_tokens_per_s": [round(x, 1)
+                                                   for x in on_tps]},
+        "decode_tokens_per_s_off": round(off, 1),
+        "decode_tokens_per_s_on": round(on, 1),
+        "speedup_tokens_per_s": round(on / off, 2),
+        "acceptance_ema": spec["acceptance_ema"],
+        "acceptance_rate": spec["acceptance_rate"],
+        "tokens_per_tick_ema": spec["tokens_per_tick_ema"],
+        "draft_ms_last": spec["draft_ms_last"],
+        "verify_ms_last": spec["verify_ms_last"],
+        "greedy_parity_spec_off": "bitwise vs generate()",
+        "parity_spec_on_vs_off": "bitwise",
+        "note": ("interleaved off/on/off/on blocks, medians reported; the "
+                 "bench model is wide (decode weight-streaming-bound, so "
+                 "one k+1-token verify ~ one decode pass) with small init "
+                 "(the 1-layer early-exit draft tracks the full stack); "
+                 "acceptance is measured, not assumed — the emitted "
+                 "stream is bitwise identical with speculation on or off "
+                 "by exact-match verification"),
+    }
+    path = os.path.join(REPO, "benchmarks", "serving_spec.json")
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+    assert report["speedup_tokens_per_s"] >= args.spec_speedup_bound, \
+        f"speculative speedup {report['speedup_tokens_per_s']} under " \
+        f"{args.spec_speedup_bound}x"
+    assert report["acceptance_ema"] >= args.spec_acceptance_bound, \
+        f"acceptance {report['acceptance_ema']} under " \
+        f"{args.spec_acceptance_bound}"
+
+
 def main():
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
@@ -480,6 +616,17 @@ def _parse_args():
     p.add_argument("--fleet", action="store_true",
                    help="run the multi-replica fleet benchmark "
                         "-> serving_fleet.json")
+    p.add_argument("--speculative", action="store_true",
+                   help="run the speculative-decoding benchmark "
+                        "-> serving_spec.json")
+    p.add_argument("--spec-k", type=int, default=8,
+                   help="draft tokens per slot per tick (pow2)")
+    p.add_argument("--draft-layers", type=int, default=1,
+                   help="self-speculative early-exit depth")
+    p.add_argument("--spec-speedup-bound", type=float, default=2.0,
+                   help="minimum decode tokens/sec speedup (spec on/off)")
+    p.add_argument("--spec-acceptance-bound", type=float, default=0.7,
+                   help="minimum measured acceptance-rate EMA")
     p.add_argument("--requests", type=int,
                    default=int(os.environ.get("SRV_REQUESTS", 16)))
     p.add_argument("--rate", type=float,
@@ -509,5 +656,7 @@ if __name__ == "__main__":
     _args = _parse_args()
     if _args.fleet:
         main_fleet(_args)
+    elif _args.speculative:
+        main_spec(_args)
     else:
         main()

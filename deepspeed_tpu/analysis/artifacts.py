@@ -24,10 +24,11 @@ from typing import Dict, List, Optional, Sequence
 from .hlo_audit_rules import HloArtifact
 
 __all__ = ["lower_train_step", "lower_decode_step", "lower_pipe_step",
-           "lower_moe_step", "default_artifacts", "ARTIFACT_NAMES"]
+           "lower_moe_step", "lower_spec_verify_step", "lower_spec_draft_step",
+           "default_artifacts", "ARTIFACT_NAMES"]
 
 ARTIFACT_NAMES = ("train_step_zero3", "decode_with_slots", "pipe_step",
-                  "moe_step")
+                  "moe_step", "spec_verify", "spec_draft")
 
 #: model dims per size knob: (n_layer, n_embd, n_head, seq)
 _SIZES = {"tiny": (4, 64, 4, 32), "bench": (8, 512, 8, 128)}
@@ -214,20 +215,24 @@ def lower_decode_step(num_slots: int = 4, max_len: int = 32,
     toks = np.zeros((num_slots,), np.int32)
     positions = np.ones((num_slots,), np.int32)
     temps = np.zeros((num_slots,), np.float32)
+    top_ks = np.zeros((num_slots,), np.int32)
+    top_ps = np.ones((num_slots,), np.float32)
+    seeds = np.zeros((num_slots,), np.int32)
     per_before = comm.comm_per_op_stats()
     # one call builds (and caches) the compiled step; then lower the same
     # function for the audit text
     pool, _ = engine.slot_decode_step(pool, toks, positions, temps)
     fn = engine._slot_fns[("slot_decode", num_slots, max_len)]
     args = (engine.params, pool, jnp.asarray(toks), jnp.asarray(positions),
-            jnp.asarray(temps), jax.random.PRNGKey(0))
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            jnp.asarray(seeds))
     with engine.mesh:
         lowered = fn.lower(*args)
         stablehlo = lowered.as_text()
         hlo = lowered.compile().as_text()
     per_after = comm.comm_per_op_stats()
     counts = _leaf_counts(*args)
-    roles = ["weights", "kv_slots", "io", "io", "io", "io"]
+    roles = ["weights", "kv_slots"] + ["io"] * (len(counts) - 2)
     return HloArtifact(
         name="decode_with_slots",
         hlo_texts=[hlo],
@@ -241,6 +246,116 @@ def lower_decode_step(num_slots: int = 4, max_len: int = 32,
     )
 
 
+def _spec_engine(num_slots: int, max_len: int):
+    import deepspeed_tpu
+    from ..models.gpt2 import GPT2Config, GPT2Model
+
+    _reset_mesh()
+    model = GPT2Model(GPT2Config(vocab_size=128, n_positions=max_len * 2,
+                                 n_embd=64, n_layer=2, n_head=4,
+                                 pad_vocab_to_multiple=1, dtype="float32"))
+    engine = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    from ..serving.config import DraftConfig
+    draft = engine.init_draft(DraftConfig(mode="self", layers=1))
+    return engine, draft
+
+
+def lower_spec_verify_step(num_slots: int = 4, max_len: int = 32,
+                           k: int = 2,
+                           donation_min_bytes: int = 1 << 10) -> HloArtifact:
+    """The speculative verify step (``GPT2Model.verify_with_slots`` +
+    in-step accept/rollback under the slot pool) — one batched forward
+    verifying k draft tokens per slot. The TARGET KV pool is the
+    donatable role: verify is state-in/state-out per tick exactly like
+    decode, so an undonated pool doubles kv_slots HBM."""
+    import jax.numpy as jnp
+    import numpy as np
+    from .. import comm
+
+    engine, draft = _spec_engine(num_slots, max_len)
+    pool = engine.init_slot_pool(num_slots, max_len)
+    toks = np.zeros((num_slots,), np.int32)
+    drafts = np.zeros((num_slots, k), np.int32)
+    positions = np.ones((num_slots,), np.int32)
+    temps = np.zeros((num_slots,), np.float32)
+    top_ks = np.zeros((num_slots,), np.int32)
+    top_ps = np.ones((num_slots,), np.float32)
+    seeds = np.zeros((num_slots,), np.int32)
+    per_before = comm.comm_per_op_stats()
+    pool, _tgt, _acc = engine.slot_verify_step(pool, toks, drafts, positions,
+                                               temps, top_ks, top_ps, seeds)
+    fn = engine._slot_fns[("slot_verify", num_slots, max_len, k)]
+    args = (engine.params, pool, jnp.asarray(toks), jnp.asarray(drafts),
+            jnp.asarray(positions), jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(top_ps), jnp.asarray(seeds))
+    with engine.mesh:
+        lowered = fn.lower(*args)
+        stablehlo = lowered.as_text()
+        hlo = lowered.compile().as_text()
+    per_after = comm.comm_per_op_stats()
+    counts = _leaf_counts(*args)
+    roles = ["weights", "kv_slots"] + ["io"] * (len(counts) - 2)
+    return HloArtifact(
+        name="spec_verify",
+        hlo_texts=[hlo],
+        stablehlo=stablehlo,
+        arg_roles=list(zip(roles, counts)),
+        donatable_roles={"kv_slots"},
+        traced_per_op={k2: per_after.get(k2, 0) - per_before.get(k2, 0)
+                       for k2 in per_after},
+        donation_min_bytes=donation_min_bytes,
+        meta={"num_slots": num_slots, "max_len": max_len, "k": k},
+    )
+
+
+def lower_spec_draft_step(num_slots: int = 4, max_len: int = 32,
+                          k: int = 2,
+                          donation_min_bytes: int = 1 << 10) -> HloArtifact:
+    """The speculative draft-propose step (k+1 draft decode steps in one
+    compiled ``lax.scan``). The DRAFT KV pool is the donatable role —
+    the draft pool rides the same state-in/state-out contract as the
+    target pool, and HLO005 holds both sides to it."""
+    import jax.numpy as jnp
+    import numpy as np
+    from .. import comm
+
+    engine, draft = _spec_engine(num_slots, max_len)
+    dpool = engine.init_draft_pool(draft, num_slots, max_len)
+    toks = np.zeros((num_slots,), np.int32)
+    positions = np.ones((num_slots,), np.int32)
+    temps = np.zeros((num_slots,), np.float32)
+    top_ks = np.zeros((num_slots,), np.int32)
+    top_ps = np.ones((num_slots,), np.float32)
+    seeds = np.zeros((num_slots,), np.int32)
+    per_before = comm.comm_per_op_stats()
+    dpool, _drafts = engine.slot_draft_propose(draft, dpool, toks, positions,
+                                               temps, top_ks, top_ps, seeds,
+                                               k)
+    fn = engine._slot_fns[("slot_draft", num_slots, max_len, k, draft.key)]
+    args = (draft.params, dpool, jnp.asarray(toks), jnp.asarray(positions),
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            jnp.asarray(seeds))
+    with engine.mesh:
+        lowered = fn.lower(*args)
+        stablehlo = lowered.as_text()
+        hlo = lowered.compile().as_text()
+    per_after = comm.comm_per_op_stats()
+    counts = _leaf_counts(*args)
+    roles = ["weights", "kv_slots"] + ["io"] * (len(counts) - 2)
+    return HloArtifact(
+        name="spec_draft",
+        hlo_texts=[hlo],
+        stablehlo=stablehlo,
+        arg_roles=list(zip(roles, counts)),
+        donatable_roles={"kv_slots"},
+        traced_per_op={k2: per_after.get(k2, 0) - per_before.get(k2, 0)
+                       for k2 in per_after},
+        donation_min_bytes=donation_min_bytes,
+        meta={"num_slots": num_slots, "max_len": max_len, "k": k,
+              "draft": "self(layers=1)"},
+    )
+
+
 def default_artifacts(size: str = "tiny",
                       include: Optional[Sequence[str]] = None
                       ) -> List[HloArtifact]:
@@ -251,6 +366,8 @@ def default_artifacts(size: str = "tiny",
         "decode_with_slots": lambda: lower_decode_step(),
         "pipe_step": lambda: lower_pipe_step(size),
         "moe_step": lambda: lower_moe_step(size),
+        "spec_verify": lambda: lower_spec_verify_step(),
+        "spec_draft": lambda: lower_spec_draft_step(),
     }
     names = include or ARTIFACT_NAMES
     return [builders[n]() for n in names]

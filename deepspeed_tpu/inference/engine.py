@@ -42,16 +42,15 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
 
 
-def _sample_tokens(logits, temps, key, vocab):
-    """Per-row greedy/temperature sampling shared by the slot prefill and
-    fused decode programs. logits [S, V_padded]; temps [S]. Greedy rows
-    (temps <= 0) reproduce generate()'s sample() exactly: fp32 argmax over
-    the real vocab — the serving-vs-generate token-parity contract."""
-    last = logits[:, :vocab].astype(jnp.float32)
-    greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
-    scaled = last / jnp.maximum(temps, 1e-6)[:, None]
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-    return jnp.where(temps <= 0.0, greedy, sampled)
+def _sample_one(logits_row, temp, top_k, top_p, seed, col, vocab):
+    """Single-row sampling at cache column ``col`` (the position the
+    sampled token will be FED at): the key derives only from
+    ``(seed, col)``, so serving replays — across ticks, slots, and
+    replicas — regenerate the identical token (speculative.row_keys)."""
+    from .speculative import row_keys, sample_rows
+    keys = row_keys(seed[None], col[None])
+    return sample_rows(logits_row[None], temp[None], top_k[None],
+                       top_p[None], keys, vocab)[0]
 
 
 def _lane_slice(leaf, slot_idx):
@@ -191,9 +190,10 @@ class InferenceEngine:
             return self.mesh_manager.batch_sharding(False)
         return NamedSharding(self.mesh, P())
 
-    def _cache_shardings(self, cache_shapes):
+    def _cache_shardings(self, cache_shapes, rules=None):
         planner = ZeroShardingPlanner(self.mesh_manager, stage=0,
-                                      rules=self._cache_rules)
+                                      rules=self._cache_rules
+                                      if rules is None else rules)
         return planner.param_shardings(cache_shapes)
 
     def _observe_compile(self, label, fn, args, names=None):
@@ -555,17 +555,25 @@ class InferenceEngine:
     # the serving hot path.
 
     def _pool_shardings(self, num_slots: int, max_len: int,
-                        quantize: bool = False):
+                        quantize: bool = False, model=None):
         """Cache-rule shardings for the slot pool, with any mesh axis that
         does not divide its dimension dropped to replication (num_slots is
         operator-chosen and rarely divides the dp axes; heads-over-'model'
         TP is the sharding that matters for serving). With ``quantize``,
         returns a QuantizedSlotPool of shardings: q leaves keep the fp
-        spec, per-column scale leaves keep it minus the trailing hd axis."""
+        spec, per-column scale leaves keep it minus the trailing hd axis.
+        ``model`` overrides the cached model (the speculative DRAFT pool
+        follows the draft model's cache rules)."""
+        rules = None
+        if model is None:
+            model = self.module
+        else:
+            rules = (model.cache_partition_rules()
+                     if hasattr(model, "cache_partition_rules") else [])
         shapes = jax.eval_shape(
-            lambda: self.module.init_kv_cache(num_slots, max_len,
-                                              dtype=self.dtype))
-        shardings = self._cache_shardings(shapes)
+            lambda: model.init_kv_cache(num_slots, max_len,
+                                        dtype=self.dtype))
+        shardings = self._cache_shardings(shapes, rules=rules)
 
         def axis_size(ax):
             names = ax if isinstance(ax, (tuple, list)) else (ax,)
@@ -667,11 +675,12 @@ class InferenceEngine:
             return fn()
 
     def slot_prefill(self, pool, slot: int, prompt, temperature: float = 0.0,
-                     key=None):
+                     top_k: int = 0, top_p: float = 1.0, seed: int = 0):
         """Prefill ``prompt`` (1-D int array) into ``pool`` slot ``slot`` and
         sample the first generated token. The prompt is right-padded to a
         pow2 bucket (one compile per bucket; pad K/V beyond the prompt is
-        masked until overwritten by decode writes). Returns
+        masked until overwritten by decode writes). Sampling is
+        deterministic per ``(seed, position)`` — replay-safe. Returns
         (new_pool, first_token:int)."""
         model = self.module
         vocab = model.config.vocab_size
@@ -690,31 +699,35 @@ class InferenceEngine:
             pool_shardings = self._pool_shardings(num_slots, max_len,
                                                   quantize=quantized)
 
-            def pf(params, ids, pool, slot_idx, last_idx, temp, key):
+            def pf(params, ids, pool, slot_idx, last_idx, temp, top_k,
+                   top_p, seed):
                 mini = model.init_kv_cache(1, max_len, dtype=self.dtype)
                 logits, mini = model.apply_with_cache(params, ids, mini,
                                                       jnp.int32(0))
                 pool = self._write_lane(pool, mini, slot_idx, quantized)
                 last = jnp.take(logits[0], last_idx, axis=0)
-                tok = _sample_tokens(last[None], temp[None], key, vocab)[0]
+                # the first token is FED at column last_idx + 1
+                tok = _sample_one(last, temp, top_k, top_p, seed,
+                                  last_idx + 1, vocab)
                 return pool, tok
 
             fn = self._slot_fns[fkey] = jax.jit(pf, in_shardings=(
                 self.param_shardings, None, pool_shardings, None, None, None,
-                None), out_shardings=(pool_shardings, None))
-        if key is None:
-            key = jax.random.PRNGKey(0)
+                None, None, None), out_shardings=(pool_shardings, None))
         pf_args = (self.params, jnp.asarray(ids), pool, jnp.int32(slot),
-                   jnp.int32(t - 1), jnp.float32(temperature), key)
+                   jnp.int32(t - 1), jnp.float32(temperature),
+                   jnp.int32(top_k), jnp.float32(top_p), jnp.int32(seed))
         self._observe_compile("slot_prefill", fn, pf_args,
                               names=("params", "ids", "pool", "slot",
-                                     "last_idx", "temperature", "rng"))
+                                     "last_idx", "temperature", "top_k",
+                                     "top_p", "seed"))
         with self.mesh:
             pool, tok = fn(*pf_args)
         return pool, int(tok)
 
     def slot_suffix_prefill(self, pool, slot: int, tokens, start_pos: int,
-                            temperature: float = 0.0, key=None):
+                            temperature: float = 0.0, top_k: int = 0,
+                            top_p: float = 1.0, seed: int = 0):
         """Prefill only the SUFFIX ``tokens`` of a prompt into slot
         ``slot`` whose lane already holds valid K/V for cache columns
         ``[0, start_pos)`` — the prefix-reuse fast path
@@ -749,27 +762,27 @@ class InferenceEngine:
                                                   quantize=quantized)
 
             def spf(params, ids, pool, slot_idx, start_pos, last_idx, temp,
-                    key):
+                    top_k, top_p, seed):
                 mini = self._read_lane(pool, slot_idx, quantized)
                 logits, mini = model.apply_with_cache(params, ids, mini,
                                                       start_pos)
                 pool = self._write_lane(pool, mini, slot_idx, quantized)
                 last = jnp.take(logits[0], last_idx, axis=0)
-                tok = _sample_tokens(last[None], temp[None], key, vocab)[0]
+                tok = _sample_one(last, temp, top_k, top_p, seed,
+                                  start_pos + last_idx + 1, vocab)
                 return pool, tok
 
             fn = self._slot_fns[fkey] = jax.jit(spf, in_shardings=(
                 self.param_shardings, None, pool_shardings, None, None, None,
-                None, None), out_shardings=(pool_shardings, None))
-        if key is None:
-            key = jax.random.PRNGKey(0)
+                None, None, None, None), out_shardings=(pool_shardings, None))
         spf_args = (self.params, jnp.asarray(ids), pool, jnp.int32(slot),
                     jnp.int32(start_pos), jnp.int32(t - 1),
-                    jnp.float32(temperature), key)
+                    jnp.float32(temperature), jnp.int32(top_k),
+                    jnp.float32(top_p), jnp.int32(seed))
         self._observe_compile("slot_suffix_prefill", fn, spf_args,
                               names=("params", "ids", "pool", "slot",
                                      "start_pos", "last_idx", "temperature",
-                                     "rng"))
+                                     "top_k", "top_p", "seed"))
         with self.mesh:
             pool, tok = fn(*spf_args)
         return pool, int(tok)
@@ -866,12 +879,14 @@ class InferenceEngine:
         with self.mesh:
             return fn(*ins_args)
 
-    def slot_decode_step(self, pool, toks, positions, temps, key=None):
+    def slot_decode_step(self, pool, toks, positions, temps, top_ks=None,
+                         top_ps=None, seeds=None):
         """One fused decode step over ALL slots: feed token ``toks[s]`` at
         cache column ``positions[s]`` and sample the next token per slot
-        (greedy where temps[s] <= 0). Inactive slots pass dummy inputs and
-        their outputs are ignored by the scheduler. Returns
-        (new_pool, next_tokens [S])."""
+        (greedy where temps[s] <= 0; per-row top-k/top-p with keys
+        derived from ``(seeds[s], position)`` otherwise — deterministic
+        replay). Inactive slots pass dummy inputs and their outputs are
+        ignored by the scheduler. Returns (new_pool, next_tokens [S])."""
         model = self.module
         vocab = model.config.vocab_size
         num_slots, max_len, quantized = self._pool_dims(pool)
@@ -881,8 +896,10 @@ class InferenceEngine:
         if fn is None:
             pool_shardings = self._pool_shardings(num_slots, max_len,
                                                   quantize=quantized)
+            from .speculative import row_keys, sample_rows
 
-            def dec(params, pool, toks, positions, temps, key):
+            def dec(params, pool, toks, positions, temps, top_ks, top_ps,
+                    seeds):
                 if quantized:
                     from .kv_quant import dequantize_pool, quantize_pool
                     fp = dequantize_pool(pool, self.dtype)
@@ -890,7 +907,10 @@ class InferenceEngine:
                     fp = pool
                 logits, fp = model.decode_with_slots(
                     params, toks[:, None], fp, positions)
-                nxt = _sample_tokens(logits[:, -1], temps, key, vocab)
+                # the sampled token will be FED at column positions + 1
+                keys = row_keys(seeds, positions + 1)
+                nxt = sample_rows(logits[:, -1], temps, top_ks, top_ps,
+                                  keys, vocab)
                 # re-quantize on the way out: per-column scales make the
                 # round-trip of every column this step did not write exact,
                 # so old tokens never re-accumulate quantization error
@@ -903,17 +923,26 @@ class InferenceEngine:
             # donation auditor (HLO005) flags. Every caller rebinds the
             # pool from the return (scheduler.py decode tick included).
             fn = self._slot_fns[fkey] = jax.jit(dec, in_shardings=(
-                self.param_shardings, pool_shardings, None, None, None, None),
+                self.param_shardings, pool_shardings, None, None, None, None,
+                None, None),
                 out_shardings=(pool_shardings, None),
                 donate_argnums=(1,))
-        if key is None:
-            key = jax.random.PRNGKey(0)
+        n = len(np.asarray(toks).reshape(-1))
+        if top_ks is None:
+            top_ks = np.zeros((n,), np.int32)
+        if top_ps is None:
+            top_ps = np.ones((n,), np.float32)
+        if seeds is None:
+            seeds = np.zeros((n,), np.int32)
         dec_args = (self.params, pool, jnp.asarray(toks, jnp.int32),
                     jnp.asarray(positions, jnp.int32),
-                    jnp.asarray(temps, jnp.float32), key)
+                    jnp.asarray(temps, jnp.float32),
+                    jnp.asarray(top_ks, jnp.int32),
+                    jnp.asarray(top_ps, jnp.float32),
+                    jnp.asarray(seeds, jnp.int32))
         self._observe_compile("slot_decode", fn, dec_args,
                               names=("params", "pool", "toks", "positions",
-                                     "temps", "rng"))
+                                     "temps", "top_ks", "top_ps", "seeds"))
         with self.mesh:
             pool, nxt = fn(*dec_args)
         return pool, np.asarray(nxt)
@@ -928,6 +957,257 @@ class InferenceEngine:
                        ("slot_decode", num_slots, max_len, "q8")),
                 False: (("slot_decode", num_slots, max_len),),
                 True: (("slot_decode", num_slots, max_len, "q8"),)}
+        total = 0
+        for fkey in keys[quantized]:
+            fn = self._slot_fns.get(fkey)
+            if fn is not None:
+                total += fn._cache_size()
+        return total
+
+    # -------------------------------------------- speculative decode protocol
+    # Draft-model speculation over the slot pool (inference/speculative.py):
+    # a cheap draft proposes K tokens per slot in ONE compiled lax.scan,
+    # the target verifies all K in ONE batched verify_with_slots forward,
+    # and per-slot accept/rollback of KV columns happens INSIDE the
+    # compiled verify step. Both pools are donated (state-in/state-out per
+    # tick — ds_tpu_lint HLO005 audits the lowered programs).
+
+    def init_draft(self, draft_cfg):
+        """Build (or fetch the cached) DraftRuntime for ``draft_cfg`` —
+        co-resident replicas sharing this engine share draft weights."""
+        from .speculative import build_draft, draft_key
+        if not hasattr(self, "_drafts"):
+            self._drafts: Dict[Any, Any] = {}
+        key = draft_key(draft_cfg)
+        draft = self._drafts.get(key)
+        if draft is None:
+            draft = self._drafts[key] = build_draft(self, draft_cfg)
+            log_dist(f"InferenceEngine: draft runtime ready "
+                     f"({draft.describe})", ranks=[0])
+        return draft
+
+    def init_draft_pool(self, draft, num_slots: int, max_len: int):
+        """Allocate the draft model's slot-pool KV cache (fp — the draft
+        is already the cheap side of the trade), once, at static shape."""
+        fkey = ("draft_pool", num_slots, max_len, draft.key)
+        fn = self._slot_fns.get(fkey)
+        if fn is None:
+            fn = self._slot_fns[fkey] = jax.jit(
+                lambda: draft.model.init_kv_cache(num_slots, max_len,
+                                                  dtype=self.dtype),
+                out_shardings=self._pool_shardings(num_slots, max_len,
+                                                   model=draft.model))
+        self._observe_compile("draft_pool", fn, ())
+        with self.mesh:
+            return fn()
+
+    def draft_prefill(self, draft, dpool, slot: int, prompt):
+        """Prefill ``prompt`` into the DRAFT pool's slot lane (pow2
+        buckets like slot_prefill; logits are discarded — only the K/V
+        matter, XLA dead-code-eliminates the head). The draft pool is
+        donated. Returns the new draft pool."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        t = prompt.shape[0]
+        num_slots = int(jax.tree.leaves(dpool)[0].shape[1])
+        max_len = int(jax.tree.leaves(dpool)[0].shape[-2])
+        if not 0 < t <= max_len:
+            raise ValueError(f"prompt length {t} not in [1, {max_len}]")
+        bucket = min(_next_pow2(t), max_len)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :t] = prompt
+        fkey = ("draft_prefill", bucket, num_slots, max_len, draft.key)
+        fn = self._slot_fns.get(fkey)
+        if fn is None:
+            pool_shardings = self._pool_shardings(num_slots, max_len,
+                                                  model=draft.model)
+
+            def dpf(dparams, ids, dpool, slot_idx):
+                mini = draft.model.init_kv_cache(1, max_len,
+                                                 dtype=self.dtype)
+                _logits, mini = draft.model.apply_with_cache(
+                    dparams, ids, mini, jnp.int32(0))
+                return self._write_lane(dpool, mini, slot_idx, False)
+
+            fn = self._slot_fns[fkey] = jax.jit(dpf, in_shardings=(
+                draft.param_shardings, None, pool_shardings, None),
+                out_shardings=pool_shardings, donate_argnums=(2,))
+        dpf_args = (draft.params, jnp.asarray(ids), dpool, jnp.int32(slot))
+        self._observe_compile("draft_prefill", fn, dpf_args,
+                              names=("draft_params", "ids", "draft_pool",
+                                     "slot"))
+        with self.mesh:
+            return fn(*dpf_args)
+
+    def slot_draft_propose(self, draft, dpool, toks, positions, temps,
+                           top_ks, top_ps, seeds, k: int):
+        """Propose ``k`` draft tokens per slot: a single compiled
+        ``lax.scan`` of k+1 draft decode steps (the extra step writes the
+        last proposal's K/V so a fully-accepted block leaves no gap in
+        the draft lane). The draft samples with the SAME
+        ``(seed, column)`` keys the target verify uses — the coupling
+        that maximizes exact-match acceptance. Draft pool donated.
+        Returns (new_dpool, draft_tokens [S, k])."""
+        vocab = draft.model.config.vocab_size
+        num_slots = int(jax.tree.leaves(dpool)[0].shape[1])
+        max_len = int(jax.tree.leaves(dpool)[0].shape[-2])
+        fkey = ("slot_draft", num_slots, max_len, int(k), draft.key)
+        fn = self._slot_fns.get(fkey)
+        if fn is None:
+            pool_shardings = self._pool_shardings(num_slots, max_len,
+                                                  model=draft.model)
+            from .speculative import row_keys, sample_rows
+
+            def prop(dparams, dpool, toks, positions, temps, top_ks,
+                     top_ps, seeds):
+                def body(carry, _):
+                    dpool, tok, pos = carry
+                    logits, dpool = draft.model.decode_with_slots(
+                        dparams, tok[:, None], dpool, pos)
+                    keys = row_keys(seeds, pos + 1)
+                    nxt = sample_rows(logits[:, -1], temps, top_ks, top_ps,
+                                      keys, vocab)
+                    return (dpool, nxt, pos + 1), nxt
+
+                (dpool, _, _), drafts = lax.scan(
+                    body, (dpool, toks, positions), None, length=k + 1)
+                return dpool, jnp.transpose(drafts[:k])      # [S, k]
+
+            fn = self._slot_fns[fkey] = jax.jit(prop, in_shardings=(
+                draft.param_shardings, pool_shardings, None, None, None,
+                None, None, None),
+                out_shardings=(pool_shardings, None), donate_argnums=(1,))
+        prop_args = (draft.params, dpool, jnp.asarray(toks, jnp.int32),
+                     jnp.asarray(positions, jnp.int32),
+                     jnp.asarray(temps, jnp.float32),
+                     jnp.asarray(top_ks, jnp.int32),
+                     jnp.asarray(top_ps, jnp.float32),
+                     jnp.asarray(seeds, jnp.int32))
+        self._observe_compile("slot_draft", fn, prop_args,
+                              names=("draft_params", "draft_pool", "toks",
+                                     "positions", "temps", "top_ks",
+                                     "top_ps", "seeds"))
+        with self.mesh:
+            dpool, drafts = fn(*prop_args)
+        return dpool, np.asarray(drafts)
+
+    def slot_verify_step(self, pool, toks, draft_toks, positions, temps,
+                         top_ks=None, top_ps=None, seeds=None):
+        """Verify ``k`` draft tokens per slot in ONE batched forward and
+        advance every slot by its accepted prefix plus one target token.
+        Acceptance is EXACT MATCH against the target's own deterministic
+        per-position sample (greedy argmax at temps<=0), so the emitted
+        stream is bitwise what the non-speculative path would emit.
+        Rejected KV columns are rolled back INSIDE the compiled step:
+        every column past ``positions[s] + accepts[s]`` is restored to
+        its pre-verify value (for int8 pools the restore is exact by the
+        per-column-scale round-trip guarantee). The target pool is
+        donated. Returns (new_pool, target_tokens [S, k+1],
+        accepts [S] in [0, k]) — the emitted tokens for slot s are
+        ``target_tokens[s, :accepts[s] + 1]``."""
+        model = self.module
+        vocab = model.config.vocab_size
+        num_slots, max_len, quantized = self._pool_dims(pool)
+        draft_toks = np.asarray(draft_toks, np.int32)
+        k = int(draft_toks.shape[1])
+        fkey = ("slot_verify", num_slots, max_len, k) + \
+            (("q8",) if quantized else ())
+        fn = self._slot_fns.get(fkey)
+        if fn is None:
+            pool_shardings = self._pool_shardings(num_slots, max_len,
+                                                  quantize=quantized)
+            from .speculative import row_keys, sample_rows
+
+            def ver(params, pool, toks, draft_toks, positions, temps,
+                    top_ks, top_ps, seeds):
+                if quantized:
+                    from .kv_quant import dequantize_pool, quantize_pool
+                    fp_old = dequantize_pool(pool, self.dtype)
+                else:
+                    fp_old = pool
+                block = jnp.concatenate([toks[:, None], draft_toks], axis=1)
+                logits, fp_new = model.verify_with_slots(
+                    params, block, fp_old, positions)      # [S, k+1, V]
+                # target's candidate at offset j would be FED at column
+                # positions + j + 1 — the same key the plain decode path
+                # (and the draft) derives for that position
+                cols = positions[:, None] + 1 + \
+                    jnp.arange(k + 1)[None, :]             # [S, k+1]
+                tgt = jax.vmap(
+                    lambda lg, cs: sample_rows(lg, temps, top_ks, top_ps,
+                                               row_keys(seeds, cs), vocab),
+                    in_axes=(1, 1), out_axes=1)(logits, cols)
+                match = (draft_toks == tgt[:, :k]).astype(jnp.int32)
+                accepts = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                # rollback INSIDE the step: only columns this verify
+                # WROTE and the accept prefix covers keep their new
+                # values — everything else (untouched columns AND
+                # rejected writes) restores to the pre-verify lane
+                cols_ax = jnp.arange(max_len)[None, :]
+                keep = (cols_ax >= positions[:, None]) & \
+                    (cols_ax <= (positions + accepts)[:, None])   # [S, C]
+                if quantized:
+                    # restore in QUANTIZED space: original q/scale BYTES
+                    # are copied verbatim for every non-kept column, so
+                    # rolled-back int8 lanes are bit-exact — the
+                    # untouched-column guarantee by construction, immune
+                    # even to ulp-level requantization drift
+                    newq = quantize_pool(fp_new)
+
+                    def rbq(new, old):
+                        return jnp.where(keep[None, :, None, :, None],
+                                         new, old)
+
+                    def rbs(new, old):
+                        return jnp.where(keep[None, :, None, :], new, old)
+
+                    from .kv_quant import QuantizedSlotPool
+                    out_pool = QuantizedSlotPool(
+                        q=jax.tree.map(rbq, newq.q, pool.q),
+                        scales=jax.tree.map(rbs, newq.scales, pool.scales))
+                else:
+                    def rb(new, old):
+                        return jnp.where(keep[None, :, None, :, None],
+                                         new, old)
+
+                    out_pool = jax.tree.map(rb, fp_new, fp_old)
+                return out_pool, tgt, accepts.astype(jnp.int32)
+
+            fn = self._slot_fns[fkey] = jax.jit(ver, in_shardings=(
+                self.param_shardings, pool_shardings, None, None, None,
+                None, None, None, None),
+                out_shardings=(pool_shardings, None, None),
+                donate_argnums=(1,))
+        n = len(np.asarray(toks).reshape(-1))
+        if top_ks is None:
+            top_ks = np.zeros((n,), np.int32)
+        if top_ps is None:
+            top_ps = np.ones((n,), np.float32)
+        if seeds is None:
+            seeds = np.zeros((n,), np.int32)
+        ver_args = (self.params, pool, jnp.asarray(toks, jnp.int32),
+                    jnp.asarray(draft_toks, jnp.int32),
+                    jnp.asarray(positions, jnp.int32),
+                    jnp.asarray(temps, jnp.float32),
+                    jnp.asarray(top_ks, jnp.int32),
+                    jnp.asarray(top_ps, jnp.float32),
+                    jnp.asarray(seeds, jnp.int32))
+        self._observe_compile("slot_verify", fn, ver_args,
+                              names=("params", "pool", "toks", "draft_toks",
+                                     "positions", "temps", "top_ks",
+                                     "top_ps", "seeds"))
+        with self.mesh:
+            pool, tgt, accepts = fn(*ver_args)
+        return pool, np.asarray(tgt), np.asarray(accepts)
+
+    def slot_verify_executables(self, num_slots: int, max_len: int, k: int,
+                                quantized: Optional[bool] = None) -> int:
+        """Compiled-executable count behind the speculative verify step
+        for one K flavor — the pow2-K compile-once evidence the tests
+        assert (mirrors slot_decode_executables)."""
+        keys = {None: (("slot_verify", num_slots, max_len, k),
+                       ("slot_verify", num_slots, max_len, k, "q8")),
+                False: (("slot_verify", num_slots, max_len, k),),
+                True: (("slot_verify", num_slots, max_len, k, "q8"),)}
         total = 0
         for fkey in keys[quantized]:
             fn = self._slot_fns.get(fkey)

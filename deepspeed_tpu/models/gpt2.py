@@ -747,6 +747,92 @@ class GPT2Model(ModelSpec):
             logits = logits + head_b
         return logits, {"k": new_k, "v": new_v}
 
+    def verify_with_slots(self, params, input_ids, cache, positions):
+        """Multi-token block forward with PER-ROW cache positions — the
+        speculative-decoding verify step (deepspeed_tpu/serving/): row
+        ``s`` feeds a block of T tokens (its pending token followed by
+        T-1 draft proposals), token j's K/V is written at cache column
+        ``positions[s] + j``, and it attends columns
+        ``<= positions[s] + j`` (block-causal over the slot lane). One
+        statically-shaped program verifies every draft position of every
+        slot in ONE forward — the trade XLA rewards: T target positions
+        for one weight pass instead of T sequential decode dispatches.
+
+        input_ids [S, T]; positions [S] (traced). Like
+        ``decode_with_slots`` the per-row block write is a masked select
+        over the column axis (a one-hot [S, T, max_len] contraction —
+        static shapes, no scatter), so each (S, max_len, T) flavor
+        compiles exactly once. Writes whose column would land at or past
+        ``max_len`` match no column and are dropped; their logits are
+        garbage by construction and the serving layer never consumes
+        them (a request's budget keeps every live position in range).
+        Returns (logits [S, T, V], new_cache). T=1 is semantically
+        ``decode_with_slots`` (which stays the steady-state program —
+        its compiled flavor is pinned by the serving tests)."""
+        b, t = input_ids.shape
+        max_len = cache["k"].shape[-2]
+        compute_dtype = self._compute_dtype(params)
+        pos2d = positions[:, None] + jnp.arange(t)[None, :]   # [S, T]
+        x = self._embed(params, input_ids, positions=pos2d)
+        k_pos = jnp.arange(max_len)[None, None, :]            # [1, 1, max_len]
+        q_pos = pos2d[:, :, None]                             # [S, T, 1]
+        extras = self._layer_extras()
+        base_mask = None
+        if extras is None:
+            base_mask = self._decode_attn_mask(q_pos, k_pos)[:, None]
+        bias = self._decode_attn_bias(q_pos, k_pos)
+        # one-hot block write: token j of row s owns column positions[s]+j
+        write = (jnp.arange(max_len)[None, None, :] ==
+                 pos2d[:, :, None])                           # [S, T, C]
+        wrote = write.any(axis=1)                             # [S, C]
+
+        from ..ops.flash_attention import reference_attention
+
+        def body(x, xs):
+            if extras is None:
+                (layer_params, k_cache, v_cache), extra = xs, None
+                mask = base_mask
+            else:
+                layer_params, k_cache, v_cache, extra = xs
+                mask = self._decode_attn_mask_ex(q_pos, k_pos,
+                                                 extra)[:, None]
+            new_kv = {}
+
+            def cached_attn(q, k, v):
+                # k/v [S, H, T, hd] -> scatter-free block write [S, H, C, hd]
+                kin = jnp.einsum("stc,shtd->shcd",
+                                 write.astype(jnp.float32),
+                                 k.astype(jnp.float32)).astype(k_cache.dtype)
+                vin = jnp.einsum("stc,shtd->shcd",
+                                 write.astype(jnp.float32),
+                                 v.astype(jnp.float32)).astype(v_cache.dtype)
+                sel = wrote[:, None, :, None]
+                kc = jnp.where(sel, kin, k_cache)
+                vc = jnp.where(sel, vin, v_cache)
+                new_kv["k"], new_kv["v"] = kc, vc
+                kq, vq = kc.astype(q.dtype), vc.astype(q.dtype)
+                if q.shape[1] != kq.shape[1]:        # GQA: repeat kv heads
+                    rep = q.shape[1] // kq.shape[1]
+                    kq = jnp.repeat(kq, rep, axis=1)
+                    vq = jnp.repeat(vq, rep, axis=1)
+                return reference_attention(q, kq, vq, causal=False, mask=mask,
+                                           bias=bias)
+
+            return self._decode_block(x, layer_params, cached_attn,
+                                      jnp.int32(0), positions=pos2d,
+                                      extra=extra), \
+                (new_kv["k"], new_kv["v"])
+
+        xs = (params["blocks"], cache["k"], cache["v"]) if extras is None \
+            else (params["blocks"], cache["k"], cache["v"], extras)
+        x, (new_k, new_v) = lax.scan(body, x, xs)
+        x = self._final_norm(params, x)
+        logits = x @ self._unembed_weight(params, compute_dtype).T
+        head_b = self._head_bias(params, logits.dtype)
+        if head_b is not None:
+            logits = logits + head_b
+        return logits, {"k": new_k, "v": new_v}
+
     def cache_partition_rules(self):
         """Sharding for the KV cache: heads over 'model' (TP), batch over the
         dp axes."""

@@ -9,8 +9,8 @@ disaggregation over KV handoffs, and radix prefix reuse of the slot
 pool.
 """
 
-from .config import (KVQuantConfig, PrefixCacheConfig, ServingConfig,
-                     SLOConfig)
+from .config import (DraftConfig, KVQuantConfig, PrefixCacheConfig,
+                     ServingConfig, SLOConfig, SpeculativeConfig)
 from .engine import ServingEngine
 from .fleet import (FleetConfig, FleetRequest, FleetRouter, KVHandoff,
                     RadixPrefixCache, ReplicaHandle, build_fleet)
@@ -21,6 +21,7 @@ from .scheduler import (ContinuousBatchingScheduler, QueueFull, Request,
 
 __all__ = [
     "ServingConfig", "SLOConfig", "PrefixCacheConfig", "KVQuantConfig",
+    "SpeculativeConfig", "DraftConfig",
     "ServingEngine", "SlotPool", "ServingMetrics", "FleetMetrics",
     "ContinuousBatchingScheduler", "QueueFull", "Request", "RequestState",
     "SamplingParams",

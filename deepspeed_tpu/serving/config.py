@@ -81,6 +81,83 @@ class KVQuantConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class DraftConfig(DeepSpeedConfigModel):
+    """The draft flavor inside the ``"speculative"`` block
+    (inference/speculative.py). ``mode="self"`` — the self-speculative
+    fallback — slices the target's own first ``layers`` blocks as the
+    draft (no second model has to fit HBM); ``mode="model"`` builds a
+    separate small config of the same family (``n_layer``/``n_embd``/
+    ``n_head`` override the target's dims; vocab and positions are
+    inherited so token ids line up)."""
+    mode: str = "self"      # self | model
+    #: self mode: early-exit depth (0 = target n_layer // 2)
+    layers: int = 0
+    #: model mode: draft dims (0 = inherit the target's)
+    n_layer: int = 2
+    n_embd: int = 0
+    n_head: int = 0
+    #: model mode: draft param init seed (until a trained draft loads)
+    seed: int = 0
+
+    def validate(self):
+        if self.mode not in ("self", "model"):
+            raise ConfigError(
+                f"speculative.draft.mode must be self|model, "
+                f"got {self.mode!r}")
+        if self.layers < 0:
+            raise ConfigError("speculative.draft.layers must be >= 0")
+        if self.mode == "model" and self.n_layer < 1:
+            raise ConfigError("speculative.draft.n_layer must be >= 1")
+
+
+@dataclasses.dataclass
+class SpeculativeConfig(DeepSpeedConfigModel):
+    """The ``"speculative"`` block: draft-model speculative decoding
+    over the slot pool. Each tick the draft proposes ``k`` tokens per
+    slot (one compiled scan), the target verifies all of them in ONE
+    batched forward (``verify_with_slots``) and every slot advances by
+    its accepted prefix plus one target token — between 1 and k+1
+    tokens per tick instead of exactly 1. The emitted stream is bitwise
+    identical to non-speculative serving (exact-match verification
+    against the target's deterministic per-position sample)."""
+    enabled: bool = False
+    #: draft tokens proposed per slot per tick. Must be a power of two:
+    #: each (num_slots, max_model_len, k) flavor of the verify program
+    #: compiles exactly once, and pow2 buckets keep the flavor count
+    #: logarithmic if an adaptive policy later varies k.
+    k: int = 4
+    #: draft flavor (dict -> DraftConfig)
+    draft: Any = None
+    #: acceptance-rate EMA floor: crossing BELOW it (edge-triggered,
+    #: after warmup_ticks) fires the flight recorder with kind
+    #: "acceptance_drop" — speculation that stopped paying for itself
+    #: is an incident worth a postmortem bundle. 0 disables.
+    acceptance_floor: float = 0.0
+    #: speculative ticks before the floor rule arms
+    warmup_ticks: int = 8
+    #: EMA smoothing for the acceptance gauge
+    ema_alpha: float = 0.2
+
+    def validate(self):
+        if self.k < 1 or (self.k & (self.k - 1)):
+            raise ConfigError(
+                f"speculative.k must be a power of two >= 1 (one compiled "
+                f"verify flavor per k bucket), got {self.k}")
+        if not (0.0 <= self.acceptance_floor <= 1.0):
+            raise ConfigError(
+                "speculative.acceptance_floor must be in [0, 1]")
+        if self.warmup_ticks < 1:
+            raise ConfigError("speculative.warmup_ticks must be >= 1")
+        if not (0.0 < self.ema_alpha <= 1.0):
+            raise ConfigError("speculative.ema_alpha must be in (0, 1]")
+        if isinstance(self.draft, dict):
+            self.draft = DraftConfig.from_dict(self.draft)
+        elif self.draft is None:
+            self.draft = DraftConfig()
+        self.draft.validate()
+
+
+@dataclasses.dataclass
 class ServingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving knobs (deepspeed_tpu/serving/)."""
 
@@ -148,6 +225,10 @@ class ServingConfig(DeepSpeedConfigModel):
 
     # kv_quant (dict -> KVQuantConfig): int8 slot pool, ~4x slots/HBM byte
     kv_quant: Any = None
+
+    # speculative (dict -> SpeculativeConfig): draft-model speculative
+    # decoding — 1..k+1 tokens per tick at bitwise-identical output
+    speculative: Any = None
 
     # fleet (dict -> fleet.config.FleetConfig): router + replica-set
     # block read by ds_tpu_serve --fleet / benchmarks; inert (and
@@ -221,6 +302,11 @@ class ServingConfig(DeepSpeedConfigModel):
             self.kv_quant = KVQuantConfig.from_dict(self.kv_quant)
         elif self.kv_quant is None:
             self.kv_quant = KVQuantConfig()
+        if isinstance(self.speculative, dict):
+            self.speculative = SpeculativeConfig.from_dict(self.speculative)
+        elif self.speculative is None:
+            self.speculative = SpeculativeConfig()
+        self.speculative.validate()
         from .fleet.config import FleetConfig
         if isinstance(self.fleet, dict):
             self.fleet = FleetConfig.from_dict(self.fleet)

@@ -82,6 +82,7 @@ class ServingEngine:
         # /debug/capture; off by default = nothing allocated
         self._recorder = None
         self._last_burn = 0.0
+        self._last_spec_ema = None
         if getattr(config.flight_recorder, "enabled", False):
             from ..telemetry.flight_recorder import FlightRecorder
             self._recorder = FlightRecorder(config.flight_recorder,
@@ -199,6 +200,9 @@ class ServingEngine:
         if request is None:
             sampling = SamplingParams(
                 temperature=handoff.temperature,
+                top_k=int(getattr(handoff, "top_k", 0)),
+                top_p=float(getattr(handoff, "top_p", 1.0)),
+                seed=int(getattr(handoff, "seed", 0)),
                 max_new_tokens=handoff.max_new_tokens,
                 eos_token_id=handoff.eos_token_id)
             trace = None
@@ -261,9 +265,13 @@ class ServingEngine:
         are the weights plus the slot-pool KV cache — the
         ``dstpu_mem_params_gib`` / ``dstpu_mem_kv_slots_gib`` gauges."""
         try:
+            kv_bytes = self._hbm.device_bytes(self.scheduler.pool.cache)
+            if self.scheduler.draft_cache is not None:
+                # the draft pool is KV state too — it rides the same role
+                kv_bytes += self._hbm.device_bytes(
+                    self.scheduler.draft_cache)
             roles = {"params": self._hbm.device_bytes(self.engine.params),
-                     "kv_slots": self._hbm.device_bytes(
-                         self.scheduler.pool.cache)}
+                     "kv_slots": kv_bytes}
             import jax
             stats = jax.local_devices()[0].memory_stats() or {}
             self._hbm.update(roles,
@@ -294,6 +302,24 @@ class ServingEngine:
                     f"crossed {thresh:g} (queue {self.queue_depth}, "
                     f"{self.active_requests} active)")
             self._last_burn = burn
+        spec = self.scheduler.spec
+        ema = self.metrics.spec_acceptance_ema
+        if spec is not None and ema is not None and \
+                spec.acceptance_floor > 0 and \
+                self.metrics.spec_ticks >= spec.warmup_ticks:
+            # edge-triggered on the EMA dropping BELOW the floor:
+            # speculation that stopped paying for itself (draft drift,
+            # workload change) is an incident, not a steady alarm
+            floor = spec.acceptance_floor
+            prev = self._last_spec_ema
+            if ema < floor and (prev is None or prev >= floor):
+                tpt = self.metrics.spec_tokens_per_tick_ema or 0.0
+                rec.trigger(
+                    "acceptance_drop",
+                    f"tick {self.metrics.ticks}: speculative acceptance "
+                    f"EMA {ema:.3f} fell below floor {floor:g} "
+                    f"(k={self.metrics.spec_k}, tokens/tick {tpt:.2f})")
+            self._last_spec_ema = ema
 
     def _check_preemption(self) -> bool:
         if self._preemption is None or self._draining:
@@ -451,6 +477,18 @@ class ServingEngine:
         if pc is not None:
             for k, v in pc.stats().items():
                 out[f"prefix_{k}"] = v
+        sched = self.scheduler
+        if sched.spec is not None:
+            out["speculative"] = (f"k={sched.spec.k} "
+                                  f"draft={sched.draft.describe}")
+            m = self.metrics
+            if m.spec_ticks:
+                out["spec_acceptance_ema"] = round(
+                    m.spec_acceptance_ema or 0.0, 4)
+                out["spec_tokens_per_tick"] = round(
+                    m.spec_tokens_per_tick_ema or 0.0, 3)
+                out["spec_draft/verify_ms"] = \
+                    f"{m.spec_draft_ms:.2f} / {m.spec_verify_ms:.2f}"
         for name, ps in self.metrics.percentiles().items():
             if ps["n"]:
                 out[f"{name}_p50/p95/p99"] = \

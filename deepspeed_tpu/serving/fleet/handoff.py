@@ -70,6 +70,12 @@ class KVHandoff:
     kv_len: int                     # valid cache columns (== len(prompt))
     lane: Any                       # host lane pytree (fp or quantized)
     temperature: float = 0.0
+    #: sampling law (with temperature + seed): the decode side must
+    #: reproduce the prefill side's stream bit-for-bit, so the full
+    #: replay law crosses the wire in the frame header
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
     max_new_tokens: int = 64
     eos_token_id: Optional[int] = None
     request_id: Optional[int] = None
@@ -89,6 +95,9 @@ class KVHandoff:
             "first_token": int(self.first_token),
             "kv_len": int(self.kv_len),
             "temperature": float(self.temperature),
+            "top_k": int(self.top_k),
+            "top_p": float(self.top_p),
+            "seed": int(self.seed),
             "max_new_tokens": int(self.max_new_tokens),
             "eos_token_id": self.eos_token_id,
             "request_id": self.request_id,
@@ -125,6 +134,9 @@ class KVHandoff:
             kv_len=header["kv_len"],
             lane=_unflatten_lane(pairs, header["quantized"]),
             temperature=header["temperature"],
+            top_k=header.get("top_k", 0),
+            top_p=header.get("top_p", 1.0),
+            seed=header.get("seed", 0),
             max_new_tokens=header["max_new_tokens"],
             eos_token_id=header["eos_token_id"],
             request_id=header["request_id"],

@@ -192,6 +192,9 @@ class FleetRouter:
         sampling = sampling or SamplingParams()
         from ...telemetry.disttrace import TraceContext
         ctx = TraceContext.mint(origin="router")
+        # seed + sampling params ride the trace from the first hop: every
+        # replica assignment (and failover replay) reproduces the same law
+        ctx.sampling = sampling.to_dict()
         ctx.mark("submit")
         freq = FleetRequest(self._next_fid, prompt, sampling, on_token,
                             trace=ctx)

@@ -34,6 +34,12 @@ class SlotPool:
         self.lengths = np.zeros((num_slots,), np.int32)   # tokens in cache
         self.pending = np.zeros((num_slots,), np.int32)   # next token to feed
         self.temps = np.zeros((num_slots,), np.float32)
+        # per-request sampling registers: top-k / top-p truncation and the
+        # request seed — sampling keys derive ONLY from (seed, position),
+        # so a failover replay regenerates the identical stream
+        self.top_ks = np.zeros((num_slots,), np.int32)
+        self.top_ps = np.ones((num_slots,), np.float32)
+        self.seeds = np.zeros((num_slots,), np.int32)
         self.requests: List[Optional[object]] = [None] * num_slots
         self._free = list(range(num_slots - 1, -1, -1))   # pop() -> slot 0 first
         #: slots parked in the prefix cache: not free, not active — their
@@ -61,6 +67,9 @@ class SlotPool:
         self.lengths[slot] = 0
         self.pending[slot] = 0
         self.temps[slot] = 0.0
+        self.top_ks[slot] = 0
+        self.top_ps[slot] = 1.0
+        self.seeds[slot] = 0
         self.cached.discard(slot)
         self._free.append(slot)
 
@@ -75,15 +84,24 @@ class SlotPool:
         self.requests[slot] = None
         self.pending[slot] = 0
         self.temps[slot] = 0.0
+        self.top_ks[slot] = 0
+        self.top_ps[slot] = 1.0
+        self.seeds[slot] = 0
         self.cached.add(slot)
 
     def bind(self, slot: int, request, length: int, first_token: int,
-             temperature: float):
-        """Attach an admitted request to its slot after prefill."""
+             sampling=None):
+        """Attach an admitted request to its slot after prefill.
+        ``sampling`` is the request's SamplingParams (or None for the
+        greedy defaults) — its temperature/top-k/top-p/seed become this
+        slot's per-tick registers."""
         self.requests[slot] = request
         self.lengths[slot] = length
         self.pending[slot] = first_token
-        self.temps[slot] = temperature
+        self.temps[slot] = getattr(sampling, "temperature", 0.0)
+        self.top_ks[slot] = getattr(sampling, "top_k", 0)
+        self.top_ps[slot] = getattr(sampling, "top_p", 1.0)
+        self.seeds[slot] = getattr(sampling, "seed", 0)
 
     # ------------------------------------------------------------ queries
     @property
@@ -100,8 +118,10 @@ class SlotPool:
         return 1.0 - len(self._free) / self.num_slots
 
     def decode_arrays(self):
-        """(toks, positions, temps) device-feed arrays for one fused decode
-        step. Free slots carry dummy values (token 0 at column 0 with
-        temp 0); their lane writes land in a lane the next prefill fully
-        overwrites, and their sampled tokens are dropped by the scheduler."""
-        return self.pending.copy(), self.lengths.copy(), self.temps.copy()
+        """(toks, positions, temps, top_ks, top_ps, seeds) device-feed
+        arrays for one fused decode/verify step. Free slots carry dummy
+        values (token 0 at column 0, greedy); their lane writes land in a
+        lane the next prefill fully overwrites, and their sampled tokens
+        are dropped by the scheduler."""
+        return (self.pending.copy(), self.lengths.copy(), self.temps.copy(),
+                self.top_ks.copy(), self.top_ps.copy(), self.seeds.copy())

@@ -59,6 +59,18 @@ class ServingMetrics:
         self.ticks = 0
         self.handoffs_in = 0      # KV lanes received into this pool
         self.handoffs_out = 0     # KV lanes extracted and handed off
+        # speculative decode (serving/scheduler.py _decode_speculative):
+        # acceptance EMA + tokens/tick EMA + draft/verify wall split —
+        # the dstpu_spec_* gauge family
+        self.spec_ticks = 0
+        self.spec_accepted = 0            # accepted draft tokens, lifetime
+        self.spec_proposed = 0            # k x active, lifetime
+        self.spec_emitted = 0             # tokens emitted by spec ticks
+        self.spec_acceptance_ema: Optional[float] = None
+        self.spec_tokens_per_tick_ema: Optional[float] = None
+        self.spec_draft_ms = 0.0          # last tick's draft wall
+        self.spec_verify_ms = 0.0         # last tick's verify wall
+        self.spec_k = 0
         #: last computed SLO burn rate (refreshed every monitor_interval
         #: ticks by _emit_slo_gauges); None until targets produce one.
         #: The per-tick flight-recorder path reads this instead of
@@ -100,6 +112,43 @@ class ServingMetrics:
             e2e = (finish - submit) * 1e3
             self.e2e_ms.append(e2e)
             self._emit("serving/e2e_ms", e2e)
+
+    def record_spec_tick(self, step_s: float, n_active: int, k: int,
+                         accepted: int, emitted: int, draft_s: float,
+                         verify_s: float, ema_alpha: float = 0.2):
+        """One speculative tick advanced ``n_active`` requests by
+        ``emitted`` tokens total (``accepted`` of them draft-proposed).
+        The per-token latency each request observed is the tick wall
+        over its own emitted count — approximated by the mean."""
+        self.spec_ticks += 1
+        self.spec_k = k
+        self.spec_accepted += accepted
+        self.spec_proposed += k * n_active
+        self.spec_emitted += emitted
+        self.tokens_out += emitted
+        per_req = max(1.0, emitted / max(1, n_active))
+        self.token_ms.append(step_s * 1e3 / per_req)
+        self.spec_draft_ms = draft_s * 1e3
+        self.spec_verify_ms = verify_s * 1e3
+        rate = accepted / max(1, k * n_active)
+        tpt = emitted / max(1, n_active)
+        if self.spec_acceptance_ema is None:
+            self.spec_acceptance_ema = rate
+            self.spec_tokens_per_tick_ema = tpt
+        else:
+            a = ema_alpha
+            self.spec_acceptance_ema += a * (rate - self.spec_acceptance_ema)
+            self.spec_tokens_per_tick_ema += \
+                a * (tpt - self.spec_tokens_per_tick_ema)
+        if self.spec_ticks % self.monitor_interval == 0 or \
+                self.spec_ticks == 1:
+            self._emit("spec/acceptance_ema", self.spec_acceptance_ema)
+            self._emit("spec/tokens_per_tick", self.spec_tokens_per_tick_ema)
+            self._gauge("spec/k", k)
+            self._gauge("spec/draft_ms", self.spec_draft_ms)
+            self._gauge("spec/verify_ms", self.spec_verify_ms)
+            self._gauge("spec/accepted_total", self.spec_accepted)
+            self._gauge("spec/emitted_total", self.spec_emitted)
 
     def record_handoff_in(self):
         self.handoffs_in += 1
@@ -229,6 +278,18 @@ class ServingMetrics:
         }
         if any(v is not None for v in self._slo_targets().values()):
             out["slo"] = self.slo_status()
+        if self.spec_ticks:
+            out["speculative"] = {
+                "ticks": self.spec_ticks,
+                "k": self.spec_k,
+                "acceptance_rate": round(
+                    self.spec_accepted / max(1, self.spec_proposed), 4),
+                "acceptance_ema": round(self.spec_acceptance_ema or 0.0, 4),
+                "tokens_per_tick_ema": round(
+                    self.spec_tokens_per_tick_ema or 0.0, 3),
+                "draft_ms_last": round(self.spec_draft_ms, 3),
+                "verify_ms_last": round(self.spec_verify_ms, 3),
+            }
         if wall_seconds:
             out["tokens_per_s"] = round(self.tokens_out / wall_seconds, 2)
         return out

@@ -16,7 +16,6 @@ import time
 from collections import deque
 from typing import Callable, List, Optional
 
-import jax
 import numpy as np
 
 from ..telemetry.trace import get_tracer
@@ -39,9 +38,17 @@ class RequestState(enum.Enum):
 
 @dataclasses.dataclass
 class SamplingParams:
-    """Per-request sampling controls (serving supports greedy and
-    temperature sampling; beam/top-k stay on the offline generate() path)."""
+    """Per-request sampling controls: greedy (temperature 0, the
+    default), or temperature / top-k / top-p sampling with a
+    deterministic per-request ``seed`` — every sampled token's PRNG key
+    derives only from ``(seed, cache position)``, so the stream is
+    reproducible across ticks, slots, replicas, and failover replays
+    (the router's delivered-position dedup depends on it). Beam search
+    stays on the offline generate() path."""
     temperature: float = 0.0
+    top_k: int = 0                         # 0 = off
+    top_p: float = 1.0                     # 1.0 = off
+    seed: int = 0
     max_new_tokens: Optional[int] = None   # None -> config default
     eos_token_id: Optional[int] = None
     timeout_s: Optional[float] = None      # None -> config default
@@ -51,8 +58,23 @@ class SamplingParams:
             raise ValueError("max_new_tokens must be >= 1")
         if self.temperature < 0.0:
             raise ValueError("temperature must be >= 0")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.temperature <= 0.0 and (self.top_k or self.top_p < 1.0):
+            raise ValueError(
+                "top_k/top_p require temperature > 0 (temperature<=0 means "
+                "greedy decoding, which would silently ignore them)")
         if self.timeout_s is not None and self.timeout_s <= 0:
             raise ValueError("timeout_s must be > 0")
+
+    def to_dict(self) -> dict:
+        """The replay-relevant fields — carried in the TraceContext
+        header so a postmortem (or a cross-process survivor) can name
+        the exact sampling law of the stream it is deduplicating."""
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "seed": self.seed}
 
 
 @dataclasses.dataclass
@@ -99,7 +121,9 @@ class ContinuousBatchingScheduler:
     """
 
     def __init__(self, engine, config, metrics: ServingMetrics = None,
-                 clock: Callable[[], float] = time.monotonic, seed: int = 0,
+                 clock: Callable[[], float] = time.monotonic,
+                 seed: int = 0,  # retained for API compat; sampling keys
+                                 # now derive from per-REQUEST seeds only
                  handoff_sink: Optional[Callable] = None,
                  replica_name: Optional[str] = None):
         self.engine = engine
@@ -124,7 +148,18 @@ class ContinuousBatchingScheduler:
         if getattr(pc_cfg, "enabled", False):
             from .fleet.prefix_cache import RadixPrefixCache
             self.prefix_cache = RadixPrefixCache(pc_cfg)
-        self._base_key = jax.random.PRNGKey(seed)
+        # speculative decoding (inference/speculative.py): a draft model
+        # plus a draft slot pool in lockstep with the target pool. Prefill
+        # replicas never decode, so they skip the draft entirely.
+        self.spec = None
+        self.draft = None
+        self.draft_cache = None
+        spec_cfg = getattr(config, "speculative", None)
+        if getattr(spec_cfg, "enabled", False) and self.role != "prefill":
+            self.spec = spec_cfg
+            self.draft = engine.init_draft(spec_cfg.draft)
+            self.draft_cache = engine.init_draft_pool(
+                self.draft, config.num_slots, config.max_model_len)
         self._tick_no = 0
         # per-request async spans (queue → prefill → decode → complete)
         # land in the same trace as train/comm spans
@@ -150,6 +185,11 @@ class ContinuousBatchingScheduler:
             from ..telemetry.disttrace import TraceContext
             request.trace = TraceContext.mint(origin=self.replica_name)
         ctx = request.trace
+        if getattr(ctx, "sampling", None) is None:
+            # the replay law rides the trace: a survivor (or a human in a
+            # postmortem) can see the exact seed/temperature the dedup'd
+            # stream was generated under
+            ctx.sampling = request.sampling.to_dict()
         ctx.bind_span(request.request_id)
         ctx.hop(self.replica_name)
         ctx.mark("queued")
@@ -281,8 +321,12 @@ class ContinuousBatchingScheduler:
                 self._release_slot(slot, req)
             else:
                 self.pool.bind(slot, req, int(handoff.kv_len),
-                               int(handoff.first_token),
-                               req.sampling.temperature)
+                               int(handoff.first_token), req.sampling)
+                if self.spec is not None:
+                    # the draft lane has no handoff: rebuild it from the
+                    # prompt (the draft is the cheap side of the trade)
+                    self.draft_cache = self.engine.draft_prefill(
+                        self.draft, self.draft_cache, slot, req.prompt)
 
     def _admit(self, now: float):
         """Move queued requests into free slots, prefilling each prompt
@@ -308,9 +352,7 @@ class ContinuousBatchingScheduler:
                                  "replica": self.replica_name,
                                  **(ctx.span_args() if ctx is not None
                                     else {})})
-            key = jax.random.fold_in(
-                jax.random.fold_in(self._base_key, self._tick_no), slot + 1)
-            first = self._prefill_into(slot, req, key)
+            first = self._prefill_into(slot, req)
             if ctx is not None:
                 ctx.mark("first_token")
             t_first = self.clock()
@@ -325,13 +367,17 @@ class ContinuousBatchingScheduler:
                 self._hand_off(slot, req, first)
             else:
                 self.pool.bind(slot, req, len(req.prompt), first,
-                               req.sampling.temperature)
+                               req.sampling)
+                if self.spec is not None:
+                    self.draft_cache = self.engine.draft_prefill(
+                        self.draft, self.draft_cache, slot, req.prompt)
             admitted += 1
 
-    def _prefill_into(self, slot: int, req: Request, key) -> int:
+    def _prefill_into(self, slot: int, req: Request) -> int:
         """Full prefill, or the prefix-reuse fast path when the radix
         cache holds a shared prefix. Returns the first sampled token."""
         tr = self.tracer
+        sp = req.sampling
         hit = None
         if self.prefix_cache is not None:
             hit = self.prefix_cache.lookup(req.prompt)
@@ -358,8 +404,8 @@ class ContinuousBatchingScheduler:
                             self.engine.slot_suffix_prefill(
                                 self.pool.cache, slot, req.prompt[offset:],
                                 offset,
-                                temperature=req.sampling.temperature,
-                                key=key)
+                                temperature=sp.temperature, top_k=sp.top_k,
+                                top_p=sp.top_p, seed=sp.seed)
                     return first
                 finally:
                     self.prefix_cache.release(hit, used_tokens=offset)
@@ -374,7 +420,8 @@ class ContinuousBatchingScheduler:
             # already device-synced, so the span duration is honest
             self.pool.cache, first = self.engine.slot_prefill(
                 self.pool.cache, slot, req.prompt,
-                temperature=req.sampling.temperature, key=key)
+                temperature=sp.temperature, top_k=sp.top_k,
+                top_p=sp.top_p, seed=sp.seed)
         return first
 
     def _hand_off(self, slot: int, req: Request, first: int):
@@ -396,6 +443,8 @@ class ContinuousBatchingScheduler:
             prompt=req.prompt, first_token=int(first),
             kv_len=int(req.prompt.size), lane=lane,
             temperature=req.sampling.temperature,
+            top_k=req.sampling.top_k, top_p=req.sampling.top_p,
+            seed=req.sampling.seed,
             max_new_tokens=req.max_new_tokens,
             eos_token_id=req.sampling.eos_token_id,
             request_id=req.request_id,
@@ -420,9 +469,10 @@ class ContinuousBatchingScheduler:
         active = self.pool.active_slots
         if not active:
             return
-        toks, positions, temps = self.pool.decode_arrays()
-        key = jax.random.fold_in(
-            jax.random.fold_in(self._base_key, self._tick_no), 0)
+        if self.spec is not None:
+            return self._decode_speculative(active)
+        toks, positions, temps, top_ks, top_ps, seeds = \
+            self.pool.decode_arrays()
         t0 = self.clock()
         with self.tracer.span("decode_step", cat="serving",
                               args={"n_active": len(active),
@@ -430,7 +480,8 @@ class ContinuousBatchingScheduler:
                                     "replica": self.replica_name}):
             # slot_decode_step returns host ndarrays (already synced)
             self.pool.cache, nxt = self.engine.slot_decode_step(
-                self.pool.cache, toks, positions, temps, key=key)
+                self.pool.cache, toks, positions, temps,
+                top_ks=top_ks, top_ps=top_ps, seeds=seeds)
         dt = self.clock() - t0
         self.metrics.record_decode_step(dt, len(active))
         now = self.clock()
@@ -448,6 +499,79 @@ class ContinuousBatchingScheduler:
             if finishing:
                 self._finish(req, RequestState.FINISHED, now)
                 self._release_slot(slot, req)
+
+    def _decode_speculative(self, active):
+        """One speculative tick: the draft proposes k tokens per slot
+        (one compiled scan), the target verifies all of them in one
+        batched forward with in-step accept/rollback, and every active
+        slot advances by its accepted prefix + 1 — between 1 and k+1
+        tokens — with the emitted stream bitwise identical to the
+        non-speculative path."""
+        toks, positions, temps, top_ks, top_ps, seeds = \
+            self.pool.decode_arrays()
+        k = self.spec.k
+        tr = self.tracer
+        t0 = self.clock()
+        with tr.span("draft_propose", cat="serving",
+                     args={"n_active": len(active), "k": k,
+                           "tick": self._tick_no,
+                           "replica": self.replica_name}):
+            self.draft_cache, draft_toks = self.engine.slot_draft_propose(
+                self.draft, self.draft_cache, toks, positions, temps,
+                top_ks, top_ps, seeds, k)
+        t_draft = self.clock()
+        # marks are consecutive: prev mark -> spec_verify_start buckets as
+        # "decode" (draft + scheduling), spec_verify_start -> spec_verify
+        # is the verify forward itself — stage sums still equal e2e exactly
+        for slot in active:
+            req = self.pool.requests[slot]
+            if req.trace is not None:
+                req.trace.mark("spec_verify_start")
+        with tr.span("spec_verify", cat="serving",
+                     args={"n_active": len(active), "k": k,
+                           "tick": self._tick_no,
+                           "replica": self.replica_name}):
+            self.pool.cache, out_toks, accepts = self.engine.slot_verify_step(
+                self.pool.cache, toks, draft_toks, positions, temps,
+                top_ks, top_ps, seeds)
+        t_verify = self.clock()
+        for slot in active:
+            req = self.pool.requests[slot]
+            if req.trace is not None:
+                req.trace.mark("spec_verify")
+        now = self.clock()
+        accepted_total = emitted_total = 0
+        for slot in active:
+            req = self.pool.requests[slot]
+            a = int(accepts[slot])
+            p = int(self.pool.lengths[slot])
+            delivered = 0
+            finishing = False
+            for j in range(a + 1):
+                tok = int(out_toks[slot, j])
+                finishing = self._should_finish(req, tok, pending=1)
+                if finishing and req.trace is not None:
+                    req.trace.mark("decode_done")
+                self._deliver(req, tok)
+                delivered += 1
+                if finishing:
+                    break
+            # columns p..p+a hold the fed token + accepted drafts; the
+            # final emitted token (the bonus / first mismatch) is the new
+            # pending — its K/V is not in the cache yet
+            self.pool.lengths[slot] = p + 1 + min(delivered, a)
+            accepted_total += a
+            emitted_total += delivered
+            if finishing:
+                self._finish(req, RequestState.FINISHED, now)
+                self._release_slot(slot, req)
+            else:
+                self.pool.pending[slot] = int(out_toks[slot, a])
+        self.metrics.record_spec_tick(
+            step_s=now - t0, n_active=len(active), k=k,
+            accepted=accepted_total, emitted=emitted_total,
+            draft_s=t_draft - t0, verify_s=t_verify - t_draft,
+            ema_alpha=self.spec.ema_alpha)
 
     # -------------------------------------------------------------- helpers
     def _deliver(self, req: Request, tok: int):

@@ -54,7 +54,7 @@ __all__ = ["TraceContext", "FleetAggregator", "merge_chrome_traces",
 #: the failover re-enqueue gap when a replay happened)
 CRITICAL_PATH_STAGES = ("route", "queue", "prefill", "handoff_serialize",
                         "handoff_transfer", "handoff_insert", "decode",
-                        "stream", "failover")
+                        "spec_verify", "stream", "failover")
 
 _MINT_LOCK = threading.Lock()
 _MINT_SEQ = itertools.count()
@@ -83,6 +83,15 @@ def _stage_of(prev: Optional[str], end: str) -> Optional[str]:
         return "handoff_insert"
     if end == "decode_done":
         return "decode"
+    # speculative decode brackets the verify forward with a mark pair
+    # every tick: prev -> spec_verify_start is draft + scheduling time
+    # (the decode bucket), spec_verify_start -> spec_verify is the
+    # verify forward itself — repeated pairs accumulate, so stage sums
+    # still equal e2e exactly
+    if end == "spec_verify_start":
+        return "decode"
+    if end == "spec_verify":
+        return "spec_verify"
     if end == "requeued":
         return "failover"
     if end == "finished":
@@ -98,12 +107,13 @@ class TraceContext:
     """One request's identity and timeline across the fleet."""
 
     __slots__ = ("trace_id", "origin", "span_ids", "replays",
-                 "replay_parent", "hops", "marks")
+                 "replay_parent", "hops", "marks", "sampling")
 
     def __init__(self, trace_id: str, origin: str,
                  span_ids: Optional[List[int]] = None, replays: int = 0,
                  replay_parent: Optional[int] = None,
-                 hops: Optional[List[str]] = None):
+                 hops: Optional[List[str]] = None,
+                 sampling: Optional[Dict[str, Any]] = None):
         self.trace_id = trace_id
         self.origin = origin
         self.span_ids = list(span_ids or [])
@@ -111,6 +121,11 @@ class TraceContext:
         self.replay_parent = replay_parent
         self.hops = list(hops or [])
         self.marks: List[tuple] = []        # (label, t_us), process-local
+        #: the stream's replay law ({temperature, top_k, top_p, seed}):
+        #: a failover survivor replays the IDENTICAL sampled stream from
+        #: these, so the delivered-position dedup stays exact — and a
+        #: postmortem can name the seed a disputed stream ran under
+        self.sampling = sampling
 
     # ------------------------------------------------------------- minting
     @classmethod
@@ -171,7 +186,8 @@ class TraceContext:
         return {"trace_id": self.trace_id, "origin": self.origin,
                 "span_ids": list(self.span_ids), "replays": self.replays,
                 "replay_parent": self.replay_parent,
-                "hops": list(self.hops)}
+                "hops": list(self.hops),
+                "sampling": self.sampling}
 
     @classmethod
     def from_header(cls, header: Dict[str, Any]) -> "TraceContext":
@@ -180,7 +196,8 @@ class TraceContext:
                    span_ids=header.get("span_ids"),
                    replays=header.get("replays", 0),
                    replay_parent=header.get("replay_parent"),
-                   hops=header.get("hops"))
+                   hops=header.get("hops"),
+                   sampling=header.get("sampling"))
 
     # -------------------------------------------------------- critical path
     def total_ms(self) -> float:

@@ -247,6 +247,15 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
             host_lines.append(f"# TYPE {prefix}_fleet_{name} gauge")
             host_lines.append(f"{prefix}_fleet_{name} {fval}")
             continue
+        if tag.startswith("spec/"):
+            # speculative-decode gauges (serving/metrics.py): dedicated
+            # dstpu_spec_acceptance_ema / _tokens_per_tick / _draft_ms /
+            # _verify_ms series — the acceptance floor is an alerting
+            # target, not a label-matched lookup
+            name = _prom(tag[len("spec/"):])
+            host_lines.append(f"# TYPE {prefix}_spec_{name} gauge")
+            host_lines.append(f"{prefix}_spec_{name} {fval}")
+            continue
         lines.append(f'{prefix}_metric{{tag="{_prom(tag)}"}} {fval}')
     lines.extend(host_lines)
     aggs = span_aggregates(tracer)

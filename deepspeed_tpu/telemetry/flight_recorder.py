@@ -25,6 +25,10 @@ still in memory:
 - ``overlap_drop`` — a recompile produced a step program whose HLO
   static overlap fraction fell below ``compile_plane.overlap_floor``
   (telemetry/overlap.py: a schedule that silently de-overlapped).
+- ``acceptance_drop`` — a serving replica's speculative-decode
+  acceptance EMA fell below ``speculative.acceptance_floor``
+  (edge-triggered by serving/engine.py after warmup: speculation that
+  stopped paying for itself — draft drift, workload shift).
 - ``manual``      — an explicit ``/debug/capture`` request.
 
 A bundle is ONE JSON file (atomic tmp+rename write) containing the
@@ -56,7 +60,7 @@ __all__ = ["FlightRecorder", "TRIGGER_KINDS"]
 #: the trigger-rule vocabulary (bundle filenames carry the kind)
 TRIGGER_KINDS = ("slow_step", "recompile", "sentinel", "slo_burn",
                  "preemption", "straggler", "failover", "overlap_drop",
-                 "manual")
+                 "acceptance_drop", "manual")
 
 
 class FlightRecorder:

@@ -362,16 +362,21 @@ def test_cli_list_rules():
 @pytest.fixture(scope="module")
 def real_artifacts():
     from deepspeed_tpu.analysis.artifacts import (lower_decode_step,
+                                                  lower_spec_draft_step,
+                                                  lower_spec_verify_step,
                                                   lower_train_step)
-    return [lower_train_step("tiny"), lower_decode_step()]
+    return [lower_train_step("tiny"), lower_decode_step(),
+            lower_spec_verify_step(), lower_spec_draft_step()]
 
 
 def test_hlo_audit_real_artifacts_clean(real_artifacts):
-    """ISSUE 11 acceptance: the REAL bucketed+compressed ZeRO-3 train
-    step and the fused decode step audit clean — async pairs matched,
+    """ISSUE 11/12 acceptance: the REAL bucketed+compressed ZeRO-3
+    train step, the fused decode step, and the speculative verify +
+    draft-propose steps audit clean — async pairs matched,
     replica_groups partition the 8-way mesh, params/optimizer state
-    donated, KV pool donated, every HLO collective kind reconciled
-    with the comm dispatch trace — with zero waivers needed."""
+    donated, target AND draft KV pools donated, every HLO collective
+    kind reconciled with the comm dispatch trace — with zero waivers
+    needed."""
     findings = run_hlo_audit(real_artifacts)
     assert findings == [], "\n".join(
         f"{f.waiver_key}: {f.message}" for f in findings)
@@ -400,3 +405,16 @@ def test_decode_artifact_pool_donated(real_artifacts):
     off = decode.arg_roles[0][1]
     kv = args[off:off + decode.arg_roles[1][1]]
     assert kv and all(a["donated"] for a in kv)
+
+
+def test_spec_artifacts_pools_donated(real_artifacts):
+    """ISSUE 12 acceptance: the speculative verify step donates the
+    TARGET pool and the draft-propose step donates the DRAFT pool —
+    speculation must not re-introduce the pool-sized HBM double the
+    decode-step donation fix removed."""
+    from deepspeed_tpu.analysis import collect_donation
+    for art in real_artifacts[2:]:
+        args = collect_donation(art.stablehlo)
+        off = art.arg_roles[0][1]
+        kv = args[off:off + art.arg_roles[1][1]]
+        assert kv and all(a["donated"] for a in kv), art.name
