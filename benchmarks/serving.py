@@ -549,6 +549,249 @@ def main_spec(args):
         f"{args.spec_acceptance_bound}"
 
 
+_MIX_VOCAB = 8192
+
+
+def _mix_engine(args):
+    """Bench model for the multi-tenant mix: context long enough for the
+    4k whale prompt, with a REALISTIC vocab — the decode tick pays the
+    [slots, vocab] unembed + per-row sampling every step, while the
+    chunk program's head is DCE'd (chunk_prefill_with_cache), which is
+    exactly the asymmetry that lets a bounded chunk ride a decode tick
+    without doubling it. A toy 256-token vocab would understate the
+    decode side and overstate the chunk's relative cost."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    n_pos = max(args.tpot_prompt + 2 * args.max_new,
+                args.whale_prompt + 2 * args.max_new)
+    model = GPT2Model(GPT2Config(
+        vocab_size=_MIX_VOCAB, n_positions=_npow2(n_pos), n_embd=128,
+        n_layer=2, n_head=4, pad_vocab_to_multiple=1, dtype="float32"))
+    return deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+
+
+def _npow2(n):
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+def _mix_block(engine, args, isolated: bool):
+    """One measurement block of the adversarial mix: a whale tenant's
+    long prompts flood the queue while small tenants trickle short
+    prompts in. ``isolated`` turns on chunked prefill + DRR tenant
+    queues; off is the plain FIFO/monolithic baseline. Returns
+    (per-tenant ttft lists, aggregate tokens/s, summary)."""
+    from deepspeed_tpu.serving import SamplingParams, ServingEngine
+    rng = np.random.default_rng(args.seed + (1 if isolated else 0))
+    cfg = {"num_slots": args.slots,
+           "max_model_len": args.whale_prompt + 2 * args.max_new,
+           "max_queue": 256, "max_prefills_per_tick": 2}
+    if isolated:
+        cfg["chunked_prefill"] = {"enabled": True,
+                                  "chunk_tokens": args.chunk_tokens}
+        cfg["tenants"] = {"enabled": True, "quantum_tokens": 64}
+    srv = ServingEngine(engine, cfg)
+    # warm every compiled flavor both modes touch (whale + small admit,
+    # decode) so the measured block compares steady states
+    warm_whale = srv.submit(
+        rng.integers(0, _MIX_VOCAB, (args.whale_prompt,), dtype=np.int32),
+        SamplingParams(max_new_tokens=2, tenant="whale"))
+    warm_small = srv.submit(
+        rng.integers(0, _MIX_VOCAB, (args.small_prompt,), dtype=np.int32),
+        SamplingParams(max_new_tokens=2, tenant="s0"))
+    srv.run_until_idle()
+    assert srv.result(warm_whale).done and srv.result(warm_small).done
+
+    # the adversarial schedule: the whale's whole burst is already queued
+    # when the small tenants' requests arrive behind it — the FIFO
+    # worst case the tenant dimension exists to fix
+    whale_prompts = [rng.integers(0, _MIX_VOCAB, (args.whale_prompt,),
+                                  dtype=np.int32)
+                     for _ in range(args.whale_requests)]
+    small_tenants = [f"s{i}" for i in range(args.small_tenants)]
+    small_reqs = [(small_tenants[i % len(small_tenants)],
+                   rng.integers(0, _MIX_VOCAB, (args.small_prompt,),
+                                dtype=np.int32))
+                  for i in range(args.small_requests)]
+    t0 = time.perf_counter()
+    submit_t = {}
+    ttfts = {}
+
+    def on_first(rid, tenant):
+        def cb(req, tok):
+            if rid not in ttfts:
+                ttfts[rid] = (tenant,
+                              (time.perf_counter() - submit_t[rid]) * 1e3)
+        return cb
+
+    rids = []
+    for p in whale_prompts:
+        rid = srv.submit(p, SamplingParams(max_new_tokens=args.max_new,
+                                           tenant="whale"))
+        submit_t[rid] = time.perf_counter()
+        srv.result(rid).on_token = on_first(rid, "whale")
+        rids.append(rid)
+    for tenant, p in small_reqs:
+        rid = srv.submit(p, SamplingParams(max_new_tokens=args.max_new,
+                                           tenant=tenant))
+        submit_t[rid] = time.perf_counter()
+        srv.result(rid).on_token = on_first(rid, tenant)
+        rids.append(rid)
+    srv.run_until_idle()
+    wall = time.perf_counter() - t0
+    tokens = sum(len(srv.result(r).tokens) for r in rids)
+    assert all(srv.result(r).state == "finished" or srv.result(r).done
+               for r in rids)
+    per_tenant = {}
+    for rid in rids:
+        tenant, ms = ttfts[rid]
+        per_tenant.setdefault(tenant, []).append(ms)
+    summary = srv.metrics.summary(wall_seconds=wall)
+    srv.shutdown()
+    return per_tenant, tokens / wall, summary
+
+
+def _mix_tpot(engine, args):
+    """In-flight TPOT under an injected long-prompt prefill: several
+    small requests decode in steady state, then a ``tpot_prompt``-token
+    prompt arrives. Chunked, every tick during its prefill does ``decode
+    + one chunk``; unchunked, one tick does the whole prefill — the
+    stall every in-flight request observes as a TPOT spike."""
+    from deepspeed_tpu.serving import RequestState, SamplingParams, \
+        ServingEngine
+    rng = np.random.default_rng(args.seed + 7)
+    out = {}
+    # a loaded pool: the decode tick must represent real steady-state
+    # work (its cost scales with active slots; the chunk's does not) —
+    # an idle 2-slot pool would make ANY added chunk look like a spike
+    slots = max(args.slots, 8)
+    for label, chunked in (("unchunked", False), ("chunked", True)):
+        cfg = {"num_slots": slots,
+               "max_model_len": args.tpot_prompt + 2 * args.max_new,
+               "max_queue": 64, "max_prefills_per_tick": 1}
+        if chunked:
+            cfg["chunked_prefill"] = {"enabled": True,
+                                      "chunk_tokens":
+                                          args.tpot_chunk_tokens}
+        srv = ServingEngine(engine, cfg)
+        warm = srv.submit(
+            rng.integers(0, _MIX_VOCAB, (args.tpot_prompt,), dtype=np.int32),
+            SamplingParams(max_new_tokens=2))
+        srv.run_until_idle()
+        assert srv.result(warm).done
+        # steady state: small requests decoding, no admissions pending;
+        # deep enough to outlive settle + steady + the whole prefill
+        # window, so the decode population stays constant throughout
+        deep = 120 + args.tpot_prompt // args.tpot_chunk_tokens
+        small = [srv.submit(
+            rng.integers(0, _MIX_VOCAB, (args.small_prompt,), dtype=np.int32),
+            SamplingParams(max_new_tokens=deep))
+            for _ in range(slots - 1)]
+        for _ in range(8):
+            srv.step()                        # settle admissions
+        steady = []
+        for _ in range(24):
+            t0 = time.perf_counter()
+            srv.step()
+            steady.append((time.perf_counter() - t0) * 1e3)
+        whale = srv.submit(
+            rng.integers(0, _MIX_VOCAB, (args.tpot_prompt,), dtype=np.int32),
+            SamplingParams(max_new_tokens=2))
+        during = []
+        while srv.result(whale).state in (RequestState.QUEUED,
+                                          RequestState.PREFILLING):
+            t0 = time.perf_counter()
+            srv.step()
+            during.append((time.perf_counter() - t0) * 1e3)
+        srv.run_until_idle()
+        srv.shutdown()
+        out[label] = {
+            "steady_tick_ms_p50": round(_pctl(steady, 0.50), 3),
+            "steady_tick_ms_p99": round(_pctl(steady, 0.99), 3),
+            "prefill_ticks": len(during),
+            "during_prefill_tick_ms_p99": round(_pctl(during, 0.99), 3),
+            "during_prefill_tick_ms_max": round(max(during), 3),
+            "tpot_p99_ratio_vs_steady": round(
+                _pctl(during, 0.99) / max(_pctl(steady, 0.99), 1e-9), 2),
+        }
+    return out
+
+
+def main_mix(args):
+    """--adversarial-mix: whale-vs-small-tenants isolation + in-flight
+    TPOT bound -> benchmarks/serving_tenant.json."""
+    engine = _mix_engine(args)
+    # interleaved baseline/isolated blocks: drift hits both sides equally
+    base_ttft, iso_ttft = {}, {}
+    base_tps, iso_tps = [], []
+    iso_summary = None
+    for mode in ("base", "iso", "base", "iso"):
+        per_tenant, tps, summary = _mix_block(engine, args,
+                                              isolated=(mode == "iso"))
+        sink = base_ttft if mode == "base" else iso_ttft
+        for tenant, vals in per_tenant.items():
+            sink.setdefault(tenant, []).extend(vals)
+        (base_tps if mode == "base" else iso_tps).append(tps)
+        if mode == "iso":
+            iso_summary = summary
+
+    def small_p99(t):
+        vals = [v for k, vs in t.items() if k != "whale" for v in vs]
+        return _pctl(vals, 0.99)
+
+    base = sorted(base_tps)[len(base_tps) // 2]
+    iso = sorted(iso_tps)[len(iso_tps) // 2]
+    tpot = _mix_tpot(engine, args)
+    report = {
+        "benchmark": "multi_tenant_adversarial_mix",
+        "model": "gpt2-mix(2L/128d, vocab 8192)",
+        "whale": {"requests": args.whale_requests,
+                  "prompt_len": args.whale_prompt},
+        "small": {"tenants": args.small_tenants,
+                  "requests": args.small_requests,
+                  "prompt_len": args.small_prompt},
+        "max_new_tokens": args.max_new, "num_slots": args.slots,
+        "chunk_tokens": args.chunk_tokens,
+        "small_tenant_ttft_ms_p99_baseline": round(small_p99(base_ttft), 1),
+        "small_tenant_ttft_ms_p99_isolated": round(small_p99(iso_ttft), 1),
+        "small_ttft_p99_improvement": round(
+            small_p99(base_ttft) / max(small_p99(iso_ttft), 1e-9), 2),
+        "whale_ttft_ms_p99_baseline": round(
+            _pctl(base_ttft.get("whale", [0]), 0.99), 1),
+        "whale_ttft_ms_p99_isolated": round(
+            _pctl(iso_ttft.get("whale", [0]), 0.99), 1),
+        "aggregate_tokens_per_s_baseline": round(base, 1),
+        "aggregate_tokens_per_s_isolated": round(iso, 1),
+        "throughput_ratio": round(iso / base, 3),
+        "tenant_summary_isolated": iso_summary.get("tenants"),
+        "tpot_under_long_prefill": tpot,
+        "note": ("baseline = FIFO admission + monolithic prefill; "
+                 "isolated = DRR tenant queues + chunked prefill, "
+                 "interleaved base/iso/base/iso blocks in ONE process; "
+                 "tpot_under_long_prefill injects a "
+                 f"{args.tpot_prompt}-token prompt into a steady decode "
+                 "pool and measures every tick's wall time during its "
+                 "prefill"),
+    }
+    path = os.path.join(REPO, "benchmarks", "serving_tenant.json")
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+    assert report["small_ttft_p99_improvement"] >= args.mix_isolation_bound, \
+        f"small-tenant p99 TTFT improved only " \
+        f"{report['small_ttft_p99_improvement']}x (bound " \
+        f"{args.mix_isolation_bound}x)"
+    lo, hi = 1.0 - args.mix_throughput_slack, 1.0 / (
+        1.0 - args.mix_throughput_slack)
+    assert lo <= report["throughput_ratio"] <= hi, \
+        f"aggregate throughput moved {report['throughput_ratio']}x " \
+        f"(allowed [{lo:.2f}, {hi:.2f}])"
+    assert tpot["chunked"]["tpot_p99_ratio_vs_steady"] <= \
+        args.mix_tpot_bound, \
+        f"chunked in-flight TPOT p99 " \
+        f"{tpot['chunked']['tpot_p99_ratio_vs_steady']}x steady " \
+        f"(bound {args.mix_tpot_bound}x)"
+
+
 def main():
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
@@ -619,6 +862,33 @@ def _parse_args():
     p.add_argument("--speculative", action="store_true",
                    help="run the speculative-decoding benchmark "
                         "-> serving_spec.json")
+    p.add_argument("--adversarial-mix", action="store_true",
+                   help="run the multi-tenant whale-vs-smalls benchmark "
+                        "-> serving_tenant.json")
+    p.add_argument("--whale-prompt", type=int, default=1024,
+                   help="whale tenant prompt length (adversarial mix)")
+    p.add_argument("--whale-requests", type=int, default=8,
+                   help="whale requests queued up front")
+    p.add_argument("--small-tenants", type=int, default=3,
+                   help="number of small tenants")
+    p.add_argument("--small-requests", type=int, default=12,
+                   help="total small-tenant requests")
+    p.add_argument("--small-prompt", type=int, default=16,
+                   help="small tenant prompt length")
+    p.add_argument("--chunk-tokens", type=int, default=256,
+                   help="chunked_prefill.chunk_tokens for the mix (pow2)")
+    p.add_argument("--tpot-chunk-tokens", type=int, default=128,
+                   help="chunk size for the in-flight TPOT experiment")
+    p.add_argument("--tpot-prompt", type=int, default=4096,
+                   help="injected long prompt for the in-flight TPOT "
+                        "experiment")
+    p.add_argument("--mix-isolation-bound", type=float, default=3.0,
+                   help="minimum small-tenant p99 TTFT improvement "
+                        "(baseline / isolated)")
+    p.add_argument("--mix-throughput-slack", type=float, default=0.10,
+                   help="allowed aggregate tokens/s drift between modes")
+    p.add_argument("--mix-tpot-bound", type=float, default=2.0,
+                   help="max chunked in-flight TPOT p99 over steady state")
     p.add_argument("--spec-k", type=int, default=8,
                    help="draft tokens per slot per tick (pow2)")
     p.add_argument("--draft-layers", type=int, default=1,
@@ -658,5 +928,7 @@ if __name__ == "__main__":
         main_fleet(_args)
     elif _args.speculative:
         main_spec(_args)
+    elif _args.adversarial_mix:
+        main_mix(_args)
     else:
         main()

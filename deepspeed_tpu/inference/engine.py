@@ -787,6 +787,78 @@ class InferenceEngine:
             pool, tok = fn(*spf_args)
         return pool, int(tok)
 
+    def slot_chunk_prefill(self, pool, slot: int, tokens, start_pos: int):
+        """Write ONE CHUNK of a prompt's K/V into slot ``slot`` at cache
+        columns ``[start_pos, start_pos+len(tokens))`` without sampling —
+        the building block of chunked prefill (serving/scheduler.py): a
+        long prompt is admitted as a sequence of fixed-size chunks
+        interleaved with decode ticks, so no decode tick ever waits on
+        more than ``chunk_tokens`` of prefill work. The chunk is
+        right-padded to a pow2 bucket (one compiled program per
+        (bucket, pool) flavor — the scheduler always sends full
+        ``chunk_tokens`` chunks, so steady state is exactly ONE flavor);
+        the logits head is dead code and XLA eliminates it
+        (``chunk_prefill_with_cache``). Pad columns past the chunk hold
+        garbage K/V until the next chunk (or a decode write) overwrites
+        them, exactly like a fresh prefill's pad tail. The FINAL chunk of
+        a prompt never comes through here — it runs
+        ``slot_suffix_prefill`` so the first token is sampled at the same
+        ``(seed, position)`` key a monolithic prefill would use (bitwise
+        token parity). Returns the new pool."""
+        model = self.module
+        tokens = np.asarray(tokens, dtype=np.int32).reshape(-1)
+        t = tokens.shape[0]
+        num_slots, max_len, quantized = self._pool_dims(pool)
+        if t < 1:
+            raise ValueError("chunk must carry at least one token")
+        bucket = min(_next_pow2(t), max_len)
+        if start_pos < 0 or start_pos + bucket > max_len:
+            raise ValueError(
+                f"chunk bucket [{start_pos}, {start_pos + bucket}) exceeds "
+                f"max_len={max_len}; size chunks so every bucket fits")
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :t] = tokens
+        fkey = ("slot_chunk", num_slots, bucket, max_len) + \
+            (("q8",) if quantized else ())
+        fn = self._slot_fns.get(fkey)
+        if fn is None:
+            pool_shardings = self._pool_shardings(num_slots, max_len,
+                                                  quantize=quantized)
+
+            def cpf(params, ids, pool, slot_idx, start_pos):
+                mini = self._read_lane(pool, slot_idx, quantized)
+                mini = model.chunk_prefill_with_cache(params, ids, mini,
+                                                      start_pos)
+                return self._write_lane(pool, mini, slot_idx, quantized)
+
+            fn = self._slot_fns[fkey] = jax.jit(cpf, in_shardings=(
+                self.param_shardings, None, pool_shardings, None, None),
+                out_shardings=pool_shardings, donate_argnums=(2,))
+        cpf_args = (self.params, jnp.asarray(ids), pool, jnp.int32(slot),
+                    jnp.int32(start_pos))
+        self._observe_compile("slot_chunk_prefill", fn, cpf_args,
+                              names=("params", "ids", "pool", "slot",
+                                     "start_pos"))
+        with self.mesh:
+            return fn(*cpf_args)
+
+    def slot_chunk_executables(self, num_slots: int, max_len: int,
+                               bucket: int,
+                               quantized: Optional[bool] = None) -> int:
+        """Compiled-executable count behind the chunk-prefill program for
+        one pow2 bucket flavor — the compile-once evidence the chunked-
+        prefill tests assert (mirrors slot_decode_executables)."""
+        keys = {None: (("slot_chunk", num_slots, bucket, max_len),
+                       ("slot_chunk", num_slots, bucket, max_len, "q8")),
+                False: (("slot_chunk", num_slots, bucket, max_len),),
+                True: (("slot_chunk", num_slots, bucket, max_len, "q8"),)}
+        total = 0
+        for fkey in keys[quantized]:
+            fn = self._slot_fns.get(fkey)
+            if fn is not None:
+                total += fn._cache_size()
+        return total
+
     def slot_copy_lane(self, pool, src: int, dst: int):
         """Copy slot ``src``'s whole cache lane over slot ``dst``'s —
         device-side, no host round-trip, quantized lanes copy their q and

@@ -679,6 +679,22 @@ class GPT2Model(ModelSpec):
             logits = logits + head_b
         return logits, {"k": new_k, "v": new_v}
 
+    def chunk_prefill_with_cache(self, params, input_ids, cache, start_pos):
+        """K/V-write-only forward for chunked prefill: one chunk of a
+        long prompt through the stack, cache columns
+        ``[start_pos, start_pos+T)`` written, NO logits. The intermediate
+        chunks of a chunked admission never sample a token, so the final
+        norm + unembedding (the largest matmul of a small-batch prefill)
+        are dead code here — returning only the cache lets XLA eliminate
+        them, which is what makes a chunk strictly cheaper than the same
+        tokens through ``apply_with_cache``. The last chunk of a prompt
+        does NOT come through here: it runs the regular suffix-prefill
+        path so the first token is sampled from real logits at the same
+        ``(seed, position)`` key a monolithic prefill would use."""
+        _logits, cache = self.apply_with_cache(params, input_ids, cache,
+                                               start_pos)
+        return cache
+
     def decode_with_slots(self, params, input_ids, cache, positions):
         """One decode token per batch row with PER-ROW cache positions — the
         continuous-batching serving step (deepspeed_tpu/serving/): each row

@@ -9,22 +9,25 @@ disaggregation over KV handoffs, and radix prefix reuse of the slot
 pool.
 """
 
-from .config import (DraftConfig, KVQuantConfig, PrefixCacheConfig,
-                     ServingConfig, SLOConfig, SpeculativeConfig)
+from .config import (ChunkedPrefillConfig, DraftConfig, KVQuantConfig,
+                     PrefixCacheConfig, ServingConfig, SLOConfig,
+                     SpeculativeConfig, TenantConfig)
 from .engine import ServingEngine
 from .fleet import (FleetConfig, FleetRequest, FleetRouter, KVHandoff,
                     RadixPrefixCache, ReplicaHandle, build_fleet)
 from .kv_slots import SlotPool
 from .metrics import FleetMetrics, ServingMetrics
-from .scheduler import (ContinuousBatchingScheduler, QueueFull, Request,
-                        RequestState, SamplingParams)
+from .scheduler import (ContinuousBatchingScheduler, QueueFull,
+                        RateLimited, Request, RequestState, SamplingParams,
+                        TenantQueues)
 
 __all__ = [
     "ServingConfig", "SLOConfig", "PrefixCacheConfig", "KVQuantConfig",
-    "SpeculativeConfig", "DraftConfig",
+    "SpeculativeConfig", "DraftConfig", "ChunkedPrefillConfig",
+    "TenantConfig",
     "ServingEngine", "SlotPool", "ServingMetrics", "FleetMetrics",
-    "ContinuousBatchingScheduler", "QueueFull", "Request", "RequestState",
-    "SamplingParams",
+    "ContinuousBatchingScheduler", "QueueFull", "RateLimited", "Request",
+    "RequestState", "SamplingParams", "TenantQueues",
     "FleetConfig", "FleetRouter", "FleetRequest", "KVHandoff",
     "RadixPrefixCache", "ReplicaHandle", "build_fleet",
 ]

@@ -81,6 +81,99 @@ class KVQuantConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class ChunkedPrefillConfig(DeepSpeedConfigModel):
+    """The ``"chunked_prefill"`` block (serving/scheduler.py): Sarathi-
+    style stall-free batching on static shapes. A prompt whose unshared
+    suffix exceeds ``chunk_tokens`` is admitted as a PREFILLING request
+    that holds its slot across ticks and writes one ``chunk_tokens``-sized
+    K/V chunk per tick (``InferenceEngine.slot_chunk_prefill`` — logits
+    head DCE'd, one compiled program per pow2 chunk flavor), with the
+    final sub-chunk going through the existing pow2 suffix-prefill
+    machinery so the first token still derives from ``(seed, position)``
+    only. Each tick's work is bounded by ``decode + at most chunk_tokens
+    of prefill``, so in-flight TPOT stays bounded regardless of prompt
+    length."""
+    enabled: bool = False
+    #: prefill tokens per tick. Must be a power of two: the chunk program
+    #: compiles exactly once per (chunk_tokens, pool) flavor, like the
+    #: suffix-prefill buckets it is built from.
+    chunk_tokens: int = 256
+
+    def validate(self):
+        if self.chunk_tokens < 16 or \
+                (self.chunk_tokens & (self.chunk_tokens - 1)):
+            raise ConfigError(
+                f"chunked_prefill.chunk_tokens must be a power of two "
+                f">= 16 (one compiled chunk flavor), got {self.chunk_tokens}")
+
+
+@dataclasses.dataclass
+class TenantConfig(DeepSpeedConfigModel):
+    """The ``"tenants"`` block: the tenant dimension of the serving
+    plane. With ``enabled``, the scheduler's single FIFO becomes
+    per-tenant queues served by deficit round-robin — admission work
+    (prefill tokens) is granted proportionally to ``weights`` among
+    backlogged tenants, so one whale tenant cannot head-of-line-block
+    everyone else's TTFT. The FleetRouter additionally enforces
+    per-tenant token-bucket rate limits (``rate_tokens_per_s`` /
+    ``burst_tokens``, cost = prompt + requested new tokens), rejecting
+    over-limit submits with a 429-style ``RateLimited`` QueueFull.
+    Per-tenant SLO windows (serving/metrics.py) export
+    ``dstpu_tenant_*`` gauges either way."""
+    enabled: bool = False
+    #: DRR weight for tenants not named in ``weights``
+    default_weight: float = 1.0
+    #: {tenant: weight} — a weight-2 tenant gets twice the admission
+    #: tokens of a weight-1 tenant while both are backlogged
+    weights: Any = None
+    #: DRR quantum per weight unit, in prompt tokens per round
+    quantum_tokens: int = 256
+    #: router token-bucket refill for tenants not named in ``rates``
+    #: (tokens/second; 0 = unlimited)
+    rate_tokens_per_s: float = 0.0
+    #: {tenant: tokens_per_s} per-tenant refill overrides
+    rates: Any = None
+    #: token-bucket capacity (burst allowance), tokens
+    burst_tokens: int = 8192
+    #: cap on distinct tenants with live metric windows; excess tenants
+    #: fold into ``__other__`` (gauge cardinality stays bounded even if
+    #: a client sprays random tenant strings)
+    max_tracked: int = 64
+
+    def validate(self):
+        if self.default_weight <= 0:
+            raise ConfigError("tenants.default_weight must be > 0")
+        if self.weights is None:
+            self.weights = {}
+        if not isinstance(self.weights, dict) or not all(
+                isinstance(k, str) and isinstance(v, (int, float)) and v > 0
+                for k, v in self.weights.items()):
+            raise ConfigError(
+                "tenants.weights must be a {tenant: positive weight} dict")
+        if self.quantum_tokens < 1:
+            raise ConfigError("tenants.quantum_tokens must be >= 1")
+        if self.rate_tokens_per_s < 0:
+            raise ConfigError("tenants.rate_tokens_per_s must be >= 0")
+        if self.rates is None:
+            self.rates = {}
+        if not isinstance(self.rates, dict) or not all(
+                isinstance(k, str) and isinstance(v, (int, float)) and v >= 0
+                for k, v in self.rates.items()):
+            raise ConfigError(
+                "tenants.rates must be a {tenant: tokens_per_s} dict")
+        if self.burst_tokens < 1:
+            raise ConfigError("tenants.burst_tokens must be >= 1")
+        if self.max_tracked < 1:
+            raise ConfigError("tenants.max_tracked must be >= 1")
+
+    def weight_of(self, tenant: str) -> float:
+        return float(self.weights.get(tenant, self.default_weight))
+
+    def rate_of(self, tenant: str) -> float:
+        return float(self.rates.get(tenant, self.rate_tokens_per_s))
+
+
+@dataclasses.dataclass
 class DraftConfig(DeepSpeedConfigModel):
     """The draft flavor inside the ``"speculative"`` block
     (inference/speculative.py). ``mode="self"`` — the self-speculative
@@ -230,6 +323,15 @@ class ServingConfig(DeepSpeedConfigModel):
     # decoding — 1..k+1 tokens per tick at bitwise-identical output
     speculative: Any = None
 
+    # chunked_prefill (dict -> ChunkedPrefillConfig): interleave long
+    # prompts' prefill with decode ticks in chunk_tokens-sized chunks —
+    # bounded in-flight TPOT regardless of prompt length
+    chunked_prefill: Any = None
+
+    # tenants (dict -> TenantConfig): per-tenant weighted-fair admission
+    # (DRR), router rate limits, and dstpu_tenant_* SLO gauges
+    tenants: Any = None
+
     # fleet (dict -> fleet.config.FleetConfig): router + replica-set
     # block read by ds_tpu_serve --fleet / benchmarks; inert (and
     # allocating nothing) on a single replica
@@ -307,6 +409,23 @@ class ServingConfig(DeepSpeedConfigModel):
         elif self.speculative is None:
             self.speculative = SpeculativeConfig()
         self.speculative.validate()
+        if isinstance(self.chunked_prefill, dict):
+            self.chunked_prefill = ChunkedPrefillConfig.from_dict(
+                self.chunked_prefill)
+        elif self.chunked_prefill is None:
+            self.chunked_prefill = ChunkedPrefillConfig()
+        self.chunked_prefill.validate()
+        if self.chunked_prefill.enabled and \
+                self.chunked_prefill.chunk_tokens > self.max_model_len:
+            raise ConfigError(
+                f"chunked_prefill.chunk_tokens="
+                f"{self.chunked_prefill.chunk_tokens} exceeds "
+                f"max_model_len={self.max_model_len}")
+        if isinstance(self.tenants, dict):
+            self.tenants = TenantConfig.from_dict(self.tenants)
+        elif self.tenants is None:
+            self.tenants = TenantConfig()
+        self.tenants.validate()
         from .fleet.config import FleetConfig
         if isinstance(self.fleet, dict):
             self.fleet = FleetConfig.from_dict(self.fleet)

@@ -76,7 +76,9 @@ class ServingEngine:
         self._ledger = get_ledger()
         self.metrics = ServingMetrics(monitor=self.monitor,
                                       monitor_interval=config.monitor_interval,
-                                      tracer=self.tracer, slo=config.slo)
+                                      tracer=self.tracer, slo=config.slo,
+                                      tenants=getattr(config, "tenants",
+                                                      None))
         # flight recorder: per-tick records (queue depth, SLO burn) +
         # postmortem bundles on burn-rate spikes / preemption / explicit
         # /debug/capture; off by default = nothing allocated
@@ -204,7 +206,8 @@ class ServingEngine:
                 top_p=float(getattr(handoff, "top_p", 1.0)),
                 seed=int(getattr(handoff, "seed", 0)),
                 max_new_tokens=handoff.max_new_tokens,
-                eos_token_id=handoff.eos_token_id)
+                eos_token_id=handoff.eos_token_id,
+                tenant=getattr(handoff, "tenant", None) or "default")
             trace = None
             if handoff.trace is not None:
                 # a deserialized frame carries the producing side's trace
@@ -440,6 +443,7 @@ class ServingEngine:
         reqs = list(sched.queue)
         reqs += [req for _h, req in list(sched.handoff_queue)]
         reqs += [sched.pool.requests[s] for s in sched.pool.active_slots]
+        reqs += list(sched.prefilling.values())
         return sorted({req.trace.trace_id for req in reqs
                        if req is not None and req.trace is not None})
 
@@ -473,11 +477,27 @@ class ServingEngine:
         if self.metrics.handoffs_in or self.metrics.handoffs_out:
             out["kv_handoffs_in"] = self.metrics.handoffs_in
             out["kv_handoffs_out"] = self.metrics.handoffs_out
+        sched = self.scheduler
+        if sched.chunked is not None:
+            out["chunked_prefill"] = (
+                f"chunk_tokens={sched.chunked.chunk_tokens} "
+                f"prefilling={len(sched.prefilling)}")
+        if sched.queue.enabled:
+            depths = sched.queue.depths()
+            if depths:
+                out["tenant_queues"] = " ".join(
+                    f"{t}={n}" for t, n in sorted(depths.items()))
+        tstatus = self.metrics.tenant_status()
+        if len(tstatus) > 1 or (tstatus and "default" not in tstatus):
+            for tenant, row in sorted(tstatus.items()):
+                out[f"tenant_{tenant}"] = (
+                    f"share={row['token_share']} "
+                    f"ttft_p99={row['ttft_ms_p99']}ms "
+                    f"burn={row['burn_rate']} done={row['completed']}")
         pc = self.scheduler.prefix_cache
         if pc is not None:
             for k, v in pc.stats().items():
                 out[f"prefix_{k}"] = v
-        sched = self.scheduler
         if sched.spec is not None:
             out["speculative"] = (f"k={sched.spec.k} "
                                   f"draft={sched.draft.describe}")
@@ -511,7 +531,10 @@ class ServingEngine:
 
     @property
     def active_requests(self) -> int:
-        return len(self.scheduler.pool.active_slots)
+        """Requests holding a slot: decoding OR mid-chunked-prefill (a
+        PREFILLING request is active work, not queue depth)."""
+        return (len(self.scheduler.pool.active_slots) +
+                len(self.scheduler.prefilling))
 
     def decode_executables(self) -> int:
         """Compiled-executable count of the fused decode step (the

@@ -71,6 +71,13 @@ class FleetConfig(DeepSpeedConfigModel):
     #: ready while the fleet can still accept work
     statusz: Any = None
 
+    #: tenants (dict -> serving.config.TenantConfig): the router-level
+    #: view of the tenant dimension — per-tenant token-bucket rate
+    #: limits enforced at submit() and the /statusz "tenants" table.
+    #: None inherits the serving config's ``tenants`` block
+    #: (build_fleet copies it down), so one JSON defines the policy once
+    tenants: Any = None
+
     def validate(self):
         if self.replicas < 1:
             raise ConfigError("fleet.replicas must be >= 1")
@@ -102,6 +109,10 @@ class FleetConfig(DeepSpeedConfigModel):
             self.statusz = StatuszConfig.from_dict(self.statusz)
         elif self.statusz is None:
             self.statusz = StatuszConfig()
+        if isinstance(self.tenants, dict):
+            from ..config import TenantConfig
+            self.tenants = TenantConfig.from_dict(self.tenants)
+            self.tenants.validate()
 
     def roles(self) -> list:
         """Per-replica role list, prefill first (handoff producers warm

@@ -80,6 +80,10 @@ class KVHandoff:
     eos_token_id: Optional[int] = None
     request_id: Optional[int] = None
     source: Optional[str] = None    # producing replica name
+    #: the tenant this request bills to — survives disaggregation so the
+    #: decode side's per-tenant SLO windows and DRR admission see the
+    #: same tenant the prefill side admitted under
+    tenant: Optional[str] = None
     #: distributed trace context header (TraceContext.to_header()) — the
     #: request's fleet-wide identity rides the frame so the decode side
     #: continues the SAME trace, not a fresh one
@@ -102,6 +106,7 @@ class KVHandoff:
             "eos_token_id": self.eos_token_id,
             "request_id": self.request_id,
             "source": self.source,
+            "tenant": self.tenant,
             "trace": self.trace,
             "quantized": quantized,
             "buffers": [{"path": p, "dtype": a.dtype.str,
@@ -141,6 +146,7 @@ class KVHandoff:
             eos_token_id=header["eos_token_id"],
             request_id=header["request_id"],
             source=header["source"],
+            tenant=header.get("tenant"),
             trace=header.get("trace"))
 
     def nbytes(self) -> int:

@@ -35,11 +35,59 @@ import numpy as np
 from ...telemetry.trace import get_tracer
 from ...utils.logging import log_dist, logger
 from ..metrics import FleetMetrics
-from ..scheduler import QueueFull, RequestState, SamplingParams
+from ..scheduler import (QueueFull, RateLimited, RequestState,
+                         SamplingParams)
 from .config import FleetConfig
 from .replica import ReplicaHandle
 
-__all__ = ["FleetRouter", "FleetRequest", "build_fleet"]
+__all__ = ["FleetRouter", "FleetRequest", "TenantRateLimiter",
+           "build_fleet"]
+
+
+class TenantRateLimiter:
+    """Per-tenant token buckets at the fleet front door. Cost of one
+    submit = prompt tokens + requested new tokens (the work the fleet is
+    being asked to buy); refill ``rate_of(tenant)`` tokens/second up to
+    ``burst_tokens``. A tenant whose bucket cannot cover the cost is
+    rejected with a 429-style ``RateLimited`` BEFORE touching any
+    replica queue — rate abuse is shed at the cheapest possible point,
+    and the DRR queues behind it only ever see conforming traffic."""
+
+    def __init__(self, config, clock=time.monotonic):
+        self.config = config
+        self.clock = clock
+        #: tenant -> [tokens, last_refill_t]
+        self._buckets: Dict[str, list] = {}
+
+    def _bucket(self, tenant: str, now: float) -> list:
+        b = self._buckets.get(tenant)
+        if b is None:
+            # a fresh tenant starts with a full burst allowance
+            b = self._buckets[tenant] = [float(self.config.burst_tokens),
+                                         now]
+        return b
+
+    def try_admit(self, tenant: str, cost: float) -> Optional[float]:
+        """Take ``cost`` tokens from the tenant's bucket. Returns None
+        on success, else the seconds until the bucket could cover the
+        cost (the Retry-After hint; inf for a cost above burst at rate
+        0)."""
+        rate = self.config.rate_of(tenant)
+        if rate <= 0:
+            return None                       # unlimited tenant
+        now = self.clock()
+        b = self._bucket(tenant, now)
+        b[0] = min(float(self.config.burst_tokens),
+                   b[0] + (now - b[1]) * rate)
+        b[1] = now
+        if b[0] >= cost:
+            b[0] -= cost
+            return None
+        return (cost - b[0]) / rate
+
+    def snapshot(self) -> Dict[str, float]:
+        """tenant -> tokens currently in the bucket (statusz)."""
+        return {t: round(b[0], 1) for t, b in self._buckets.items()}
 
 _DONE_STATES = (RequestState.FINISHED, RequestState.TIMEOUT)
 
@@ -116,6 +164,13 @@ class FleetRouter:
         self.tracer = tracer or get_tracer()
         self.recorder = recorder
         self.metrics = FleetMetrics(tracer=self.tracer)
+        # per-tenant token-bucket rate limits (fleet.tenants block, or
+        # the serving tenants block build_fleet copied down); no tenants
+        # config (or no rates configured) allocates no limiter state
+        self.limiter = None
+        tcfg = getattr(self.config, "tenants", None)
+        if tcfg is not None and (tcfg.rate_tokens_per_s > 0 or tcfg.rates):
+            self.limiter = TenantRateLimiter(tcfg, clock=clock)
         self._fleet_requests: Dict[int, FleetRequest] = {}
         self._next_fid = 0
         self._pending: "deque[FleetRequest]" = deque()
@@ -138,6 +193,7 @@ class FleetRouter:
             from ...telemetry.statusz import StatuszServer
             self.statusz = StatuszServer(sz, tracer=self.tracer)
             self.statusz.register("fleet", self._statusz_section)
+            self.statusz.register("tenants", self._tenant_section)
             self.statusz.register_health("fleet", self._health_check)
             if self.aggregator is not None:
                 self.statusz.register("critical_path",
@@ -190,8 +246,22 @@ class FleetRouter:
         if self._shutdown:
             raise RuntimeError("FleetRouter is shut down; submit rejected")
         sampling = sampling or SamplingParams()
+        tenant = getattr(sampling, "tenant", None) or "default"
+        if self.limiter is not None:
+            # cost = the work this submit asks the fleet to buy
+            prompt_arr = np.asarray(prompt).reshape(-1)
+            cost = float(prompt_arr.size +
+                         (sampling.max_new_tokens or 0))
+            retry = self.limiter.try_admit(tenant, cost)
+            if retry is not None:
+                self.metrics.record_throttle(tenant)
+                raise RateLimited(
+                    f"tenant {tenant!r} rate-limited "
+                    f"({cost:g} tokens over budget); retry in "
+                    f"{retry:.2f}s", tenant=tenant,
+                    retry_after_s=round(retry, 3))
         from ...telemetry.disttrace import TraceContext
-        ctx = TraceContext.mint(origin="router")
+        ctx = TraceContext.mint(origin="router", tenant=tenant)
         # seed + sampling params ride the trace from the first hop: every
         # replica assignment (and failover replay) reproduces the same law
         ctx.sampling = sampling.to_dict()
@@ -464,6 +534,51 @@ class FleetRouter:
             pending=len(self._pending) + len(self._pending_handoffs),
             prefix_hits=hits, prefix_lookups=lookups)
 
+    def tenant_summary(self) -> dict:
+        """Fleet-wide per-tenant view: each live replica's tenant SLO
+        windows aggregated (counts summed, percentile/burn worst-of —
+        a tenant is out of SLO if ANY replica serves it out of SLO),
+        plus the router-side throttle counts and bucket levels. The
+        table ds_tpu_top renders to name the tenant eating the
+        budget."""
+        agg: Dict[str, dict] = {}
+
+        def row_of(tenant):
+            return agg.setdefault(tenant, {
+                "submitted": 0, "completed": 0, "timeouts": 0,
+                "tokens_out": 0, "ttft_ms_p99": 0.0, "burn_rate": 0.0,
+                "throttled": 0})
+
+        for r in self.replicas.values():
+            if r.engine is None or r.failed:
+                continue
+            for tenant, rep in r.engine.metrics.tenant_status().items():
+                a = row_of(tenant)
+                for key in ("submitted", "completed", "timeouts",
+                            "tokens_out"):
+                    a[key] += rep[key]
+                a["ttft_ms_p99"] = max(a["ttft_ms_p99"],
+                                       rep["ttft_ms_p99"])
+                a["burn_rate"] = max(a["burn_rate"], rep["burn_rate"])
+        for tenant, n in self.metrics.tenant_throttled.items():
+            row_of(tenant)["throttled"] = n
+        total = max(1, sum(a["tokens_out"] for a in agg.values()))
+        buckets = self.limiter.snapshot() if self.limiter is not None \
+            else {}
+        for tenant, a in agg.items():
+            a["token_share"] = round(a["tokens_out"] / total, 4)
+            if tenant in buckets:
+                a["bucket_tokens"] = buckets[tenant]
+        return agg
+
+    def _tenant_section(self) -> dict:
+        table = self.tenant_summary()
+        if not table:
+            return {}
+        return {"throttled_total": self.metrics.throttled,
+                "rate_limited": self.limiter is not None,
+                "table": table}
+
     def _health_check(self):
         if self._shutdown:
             return False, "shut down"
@@ -514,6 +629,10 @@ def build_fleet(engine, serving_config, clock=time.monotonic,
         serving_config.validate()
     import os
     fleet_cfg = serving_config.fleet
+    if fleet_cfg.tenants is None:
+        # one JSON defines the tenant policy once: the serving-level
+        # tenants block is also the router's rate-limit + table source
+        fleet_cfg.tenants = getattr(serving_config, "tenants", None)
     roles = fleet_cfg.roles()
     n = len(roles)
     replicas = []

@@ -35,18 +35,42 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+class _TenantStats:
+    """One tenant's bounded SLO windows + counters: the per-tenant
+    dimension of the serving metrics. Memory is O(window) per TRACKED
+    tenant, and the tracked set is capped (tenants.max_tracked) with
+    overflow folded into ``__other__`` — tenant strings are
+    client-controlled and must not become an unbounded gauge family."""
+
+    __slots__ = ("ttft_ms", "e2e_ms", "submitted", "completed",
+                 "tokens_out", "timeouts")
+
+    def __init__(self, window: int):
+        self.ttft_ms: "deque[float]" = deque(maxlen=window)
+        self.e2e_ms: "deque[float]" = deque(maxlen=window)
+        self.submitted = 0
+        self.completed = 0
+        self.tokens_out = 0
+        self.timeouts = 0
+
+
 class ServingMetrics:
     """Host-side counters mirrored into the telemetry gauges, with
     optional MonitorMaster fan-out on ``flush()``."""
 
     def __init__(self, monitor=None, monitor_interval: int = 16,
-                 tracer=None, slo=None):
+                 tracer=None, slo=None, tenants=None):
         self.monitor = monitor
         self.monitor_interval = monitor_interval
         self.tracer = tracer or get_tracer()
         self.slo = slo
+        self.tenants_cfg = tenants
         window = int(getattr(slo, "window", 1024) or 1024)
         self.window = window
+        #: per-tenant SLO windows (``dstpu_tenant_*`` gauge family,
+        #: owner = this instance so close() retracts them)
+        self.tenant_stats: Dict[str, _TenantStats] = {}
+        self._tenant_cap = int(getattr(tenants, "max_tracked", 64) or 64)
         # bounded percentile sources: O(window) forever
         self.ttft_ms: "deque[float]" = deque(maxlen=window)
         self.token_ms: "deque[float]" = deque(maxlen=window)
@@ -80,21 +104,38 @@ class ServingMetrics:
         self._closed = False
 
     # ------------------------------------------------------------- recording
-    def record_submit(self):
+    def _tenant(self, name) -> _TenantStats:
+        """The tenant's stats bucket, folding overflow past the tracked
+        cap into ``__other__``."""
+        name = name or "default"
+        stats = self.tenant_stats.get(name)
+        if stats is None:
+            if len(self.tenant_stats) >= self._tenant_cap and \
+                    name != "__other__":
+                return self._tenant("__other__")
+            stats = self.tenant_stats[name] = _TenantStats(self.window)
+        return stats
+
+    def record_submit(self, tenant=None):
         self.submitted += 1
+        self._tenant(tenant).submitted += 1
 
     def record_reject(self):
         self.rejected += 1
         self._emit("serving/rejected", self.rejected)
 
-    def record_timeout(self):
+    def record_timeout(self, tenant=None):
         self.timeouts += 1
         self._emit("serving/timeouts", self.timeouts)
+        self._tenant(tenant).timeouts += 1
 
-    def record_ttft(self, seconds: float):
+    def record_ttft(self, seconds: float, tenant=None):
         self.ttft_ms.append(seconds * 1e3)
         self.tokens_out += 1         # the first token is sampled at prefill
         self._emit("serving/ttft_ms", seconds * 1e3)
+        t = self._tenant(tenant)
+        t.ttft_ms.append(seconds * 1e3)
+        t.tokens_out += 1
 
     def record_decode_step(self, seconds: float, n_active: int):
         """One fused decode step advanced ``n_active`` requests by one
@@ -103,15 +144,23 @@ class ServingMetrics:
         self.token_ms.append(seconds * 1e3)
         self.tokens_out += n_active
 
+    def record_tenant_tokens(self, tenant, n: int = 1):
+        """Attribute ``n`` decode tokens to ``tenant`` (the aggregate
+        ``tokens_out`` is counted by the decode-step recorders)."""
+        self._tenant(tenant).tokens_out += n
+
     def record_completion(self, request):
         self.completed += 1
         self._emit("serving/completed", self.completed)
+        tstats = self._tenant(getattr(request, "tenant", None))
+        tstats.completed += 1
         finish = getattr(request, "finish_time", None)
         submit = getattr(request, "submit_time", None)
         if finish is not None and submit is not None and finish >= submit:
             e2e = (finish - submit) * 1e3
             self.e2e_ms.append(e2e)
             self._emit("serving/e2e_ms", e2e)
+            tstats.e2e_ms.append(e2e)
 
     def record_spec_tick(self, step_s: float, n_active: int, k: int,
                          accepted: int, emitted: int, draft_s: float,
@@ -217,6 +266,39 @@ class ServingMetrics:
         return {"target_quantile": target, "burn_rate": round(burn, 4),
                 "metrics": metrics}
 
+    def tenant_status(self) -> Dict[str, Dict[str, object]]:
+        """Per-tenant SLO view: latency percentiles over the tenant's
+        own windows, the burn rate against the SHARED slo targets
+        (tenant isolation means every tenant is held to the same SLO —
+        per-tenant targets would hide the whale's damage), and the
+        share of served tokens."""
+        target = float(getattr(self.slo, "target", 0.99) or 0.99)
+        allowed = max(1e-9, 1.0 - target)
+        targets = self._slo_targets()
+        total_tokens = max(1, sum(t.tokens_out
+                                  for t in self.tenant_stats.values()))
+        out: Dict[str, Dict[str, object]] = {}
+        for name, st in self.tenant_stats.items():
+            burn = 0.0
+            for metric, window in (("ttft_ms", st.ttft_ms),
+                                   ("e2e_ms", st.e2e_ms)):
+                limit = targets.get(metric)
+                if limit is not None and window:
+                    rate = sum(1 for v in window if v > limit) / len(window)
+                    burn = max(burn, rate / allowed)
+            ttft = sorted(st.ttft_ms)
+            out[name] = {
+                "submitted": st.submitted,
+                "completed": st.completed,
+                "timeouts": st.timeouts,
+                "tokens_out": st.tokens_out,
+                "token_share": round(st.tokens_out / total_tokens, 4),
+                "ttft_ms_p50": round(_percentile(ttft, 0.50), 3),
+                "ttft_ms_p99": round(_percentile(ttft, 0.99), 3),
+                "burn_rate": round(burn, 4),
+            }
+        return out
+
     def _emit_slo_gauges(self):
         pct = self.percentiles()
         for name, ps in pct.items():
@@ -226,6 +308,13 @@ class ServingMetrics:
         if any(v is not None for v in self._slo_targets().values()):
             self.last_burn_rate = self.slo_status()["burn_rate"]
             self._gauge("serving/slo_burn_rate", self.last_burn_rate)
+        # the dstpu_tenant_* family: one tenant= labeled series per
+        # metric (telemetry/export.py), owner= this instance so a
+        # closed replica's tenant gauges vanish with it
+        for tenant, row in self.tenant_status().items():
+            for metric in ("ttft_ms_p50", "ttft_ms_p99", "burn_rate",
+                           "completed", "tokens_out", "token_share"):
+                self._gauge(f"tenant/{tenant}/{metric}", row[metric])
 
     # ------------------------------------------------------------- fan-out
     def _gauge(self, tag: str, value: float):
@@ -278,6 +367,9 @@ class ServingMetrics:
         }
         if any(v is not None for v in self._slo_targets().values()):
             out["slo"] = self.slo_status()
+        if len(self.tenant_stats) > 1 or (
+                self.tenant_stats and "default" not in self.tenant_stats):
+            out["tenants"] = self.tenant_status()
         if self.spec_ticks:
             out["speculative"] = {
                 "ticks": self.spec_ticks,
@@ -312,7 +404,22 @@ class FleetMetrics:
         self.failovers = 0
         self.requeued = 0
         self.handoffs = 0
+        self.throttled = 0
+        #: per-tenant 429s (token-bucket rejections at the router) —
+        #: the "who is being shed" half of the tenant table
+        self.tenant_throttled: Dict[str, int] = {}
         self._closed = False
+
+    def record_throttle(self, tenant: str):
+        """One rate-limited submit: bump the fleet total and the
+        tenant's own ``dstpu_tenant_throttled`` series."""
+        self.throttled += 1
+        n = self.tenant_throttled.get(tenant, 0) + 1
+        self.tenant_throttled[tenant] = n
+        self.tracer.set_counter("fleet/throttled", float(self.throttled),
+                                owner=self)
+        self.tracer.set_counter(f"tenant/{tenant}/throttled", float(n),
+                                owner=self)
 
     def update(self, *, replicas: int, ready: int, pending: int,
                prefix_hits: int = 0, prefix_lookups: int = 0):

@@ -13,8 +13,8 @@ no compiled shape ever changes.
 import dataclasses
 import enum
 import time
-from collections import deque
-from typing import Callable, List, Optional
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -28,8 +28,26 @@ class QueueFull(RuntimeError):
     """Backpressure: the bounded admission queue is at capacity."""
 
 
+class RateLimited(QueueFull):
+    """429-style backpressure: the tenant's token bucket is empty. A
+    subclass of QueueFull so existing retry-with-backoff handling works
+    unchanged; ``tenant`` and ``retry_after_s`` let an API front-end
+    surface a proper 429 with a Retry-After header."""
+
+    def __init__(self, message: str, tenant: str = "default",
+                 retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+        self.status = 429
+
+
 class RequestState(enum.Enum):
     QUEUED = "queued"
+    #: chunked prefill in progress: the request holds its slot across
+    #: ticks while its prompt's K/V lands chunk by chunk, interleaved
+    #: with everyone else's decode ticks (chunked_prefill config block)
+    PREFILLING = "prefilling"
     RUNNING = "running"
     FINISHED = "finished"
     TIMEOUT = "timeout"
@@ -52,8 +70,19 @@ class SamplingParams:
     max_new_tokens: Optional[int] = None   # None -> config default
     eos_token_id: Optional[int] = None
     timeout_s: Optional[float] = None      # None -> config default
+    #: the tenant this request bills to: selects its DRR admission
+    #: queue and weight, its router rate-limit bucket, and the
+    #: dstpu_tenant_* SLO window its latencies land in. Carried on the
+    #: KVHandoff frame and the TraceContext header, so disaggregation
+    #: and failover never lose the billing identity.
+    tenant: str = "default"
 
     def validate(self):
+        if not self.tenant or not isinstance(self.tenant, str) or \
+                "/" in self.tenant:
+            raise ValueError(
+                f"tenant must be a non-empty string without '/' "
+                f"(it names a gauge tag segment), got {self.tenant!r}")
         if self.max_new_tokens is not None and self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if self.temperature < 0.0:
@@ -74,7 +103,8 @@ class SamplingParams:
         header so a postmortem (or a cross-process survivor) can name
         the exact sampling law of the stream it is deduplicating."""
         return {"temperature": self.temperature, "top_k": self.top_k,
-                "top_p": self.top_p, "seed": self.seed}
+                "top_p": self.top_p, "seed": self.seed,
+                "tenant": self.tenant}
 
 
 @dataclasses.dataclass
@@ -94,6 +124,21 @@ class Request:
     #: fleet router (or lazily at enqueue) and carried through every
     #: replica boundary this request crosses
     trace: Optional[object] = None
+    #: chunked prefill progress: prompt tokens whose K/V is already in
+    #: the slot lane (columns [0, prefill_pos) valid). Restarts from the
+    #: reuse offset on a failover replay — progress is replica-local.
+    prefill_pos: int = 0
+    #: True once the request left the queue (its request/decode span is
+    #: open) — a PREFILLING request that expires must close that span,
+    #: not the queued one
+    prefill_started: bool = False
+    #: tick number of this request's last chunk (a freshly admitted
+    #: chunked request must not take a second chunk in the same tick)
+    prefill_tick: int = -1
+
+    @property
+    def tenant(self) -> str:
+        return getattr(self.sampling, "tenant", None) or "default"
 
     @property
     def done(self) -> bool:
@@ -105,6 +150,137 @@ class Request:
         """prompt + generated tokens."""
         return np.concatenate(
             [self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+class TenantQueues:
+    """Admission queue with a tenant dimension: per-tenant FIFOs served
+    by deficit round-robin (DRR), replacing the single global FIFO.
+
+    With tenancy disabled (or only one tenant ever enqueues) this is
+    byte-for-byte the old deque: strict arrival order. With
+    ``tenants.enabled``, each tenant gets its own FIFO and ``popleft()``
+    runs DRR over the backlogged tenants — every round-robin visit adds
+    ``weight(tenant) * quantum_tokens`` to the tenant's deficit, and a
+    request pops only when the deficit covers its admission cost (its
+    prompt length, the prefill work the scheduler is about to buy it).
+    Over any backlogged interval, admitted prefill tokens converge to the
+    weight ratios — a whale tenant spraying 4k-token prompts drains its
+    deficit 256x faster than a 16-token tenant and cannot starve it.
+
+    The deque surface the rest of the stack uses is preserved:
+    ``append`` / ``popleft`` / ``remove`` / ``len`` / ``iter`` / truth.
+    """
+
+    def __init__(self, config=None):
+        self._cfg = config
+        self.enabled = bool(getattr(config, "enabled", False))
+        # tenant -> FIFO; insertion order gives a stable RR order
+        self._queues: "OrderedDict[str, deque]" = OrderedDict()
+        self._deficit: Dict[str, float] = {}
+        self._rr: List[str] = []           # backlogged tenants, RR order
+        self._rr_idx = 0
+        self._fifo: "deque[Request]" = deque()   # disabled-mode fast path
+        self._n = 0
+
+    @staticmethod
+    def _tenant_of(req) -> str:
+        return getattr(req, "tenant", None) or "default"
+
+    @staticmethod
+    def _cost(req) -> float:
+        """Admission cost in DRR currency: the prefill work this request
+        buys on pop (its prompt tokens)."""
+        return float(max(1, int(req.prompt.size)))
+
+    def _quantum(self, tenant: str) -> float:
+        cfg = self._cfg
+        return cfg.weight_of(tenant) * float(cfg.quantum_tokens)
+
+    # -------------------------------------------------------------- deque API
+    def __len__(self) -> int:
+        return self._n
+
+    def __bool__(self) -> bool:
+        return self._n > 0
+
+    def __iter__(self):
+        if not self.enabled:
+            return iter(self._fifo)
+        import itertools
+        return itertools.chain.from_iterable(self._queues.values())
+
+    def append(self, req):
+        self._n += 1
+        if not self.enabled:
+            self._fifo.append(req)
+            return
+        tenant = self._tenant_of(req)
+        dq = self._queues.get(tenant)
+        if dq is None:
+            dq = self._queues[tenant] = deque()
+        if not dq and tenant not in self._rr:
+            self._rr.append(tenant)
+        dq.append(req)
+
+    def remove(self, req):
+        """deque semantics: raises ValueError when absent."""
+        if not self.enabled:
+            self._fifo.remove(req)       # ValueError propagates
+            self._n -= 1
+            return
+        dq = self._queues.get(self._tenant_of(req))
+        if dq is None:
+            raise ValueError("request not in queue")
+        dq.remove(req)                   # ValueError propagates
+        self._n -= 1
+        if not dq:
+            self._retire(self._tenant_of(req))
+
+    def _retire(self, tenant: str):
+        """Tenant went idle: drop it from the rotation and zero its
+        deficit (classic DRR — an idle tenant must not bank credit)."""
+        self._deficit[tenant] = 0.0
+        if tenant in self._rr:
+            idx = self._rr.index(tenant)
+            self._rr.remove(tenant)
+            if idx < self._rr_idx:
+                self._rr_idx -= 1
+            if self._rr:
+                self._rr_idx %= len(self._rr)
+            else:
+                self._rr_idx = 0
+
+    def popleft(self):
+        """DRR pop: stays on the current tenant while its deficit covers
+        the head request, else tops the next tenant up by its quantum and
+        moves on. Terminates: every full rotation adds a positive quantum
+        to each backlogged tenant and costs are bounded by the prompt
+        length cap."""
+        if self._n == 0:
+            raise IndexError("pop from an empty TenantQueues")
+        self._n -= 1
+        if not self.enabled:
+            return self._fifo.popleft()
+        while True:
+            tenant = self._rr[self._rr_idx % len(self._rr)]
+            dq = self._queues[tenant]
+            cost = self._cost(dq[0])
+            if self._deficit.get(tenant, 0.0) >= cost:
+                req = dq.popleft()
+                self._deficit[tenant] -= cost
+                if not dq:
+                    self._retire(tenant)
+                return req
+            self._deficit[tenant] = \
+                self._deficit.get(tenant, 0.0) + self._quantum(tenant)
+            self._rr_idx = (self._rr_idx + 1) % len(self._rr)
+
+    # ------------------------------------------------------------ inspection
+    def depths(self) -> Dict[str, int]:
+        """Per-tenant queue depth (statusz / metrics)."""
+        if not self.enabled:
+            return {"default": len(self._fifo)} if self._fifo else {}
+        return {t: len(dq) for t, dq in self._queues.items() if dq}
 
 
 class ContinuousBatchingScheduler:
@@ -140,9 +316,26 @@ class ContinuousBatchingScheduler:
                                 "enabled", False))
         self.pool = SlotPool(engine, config.num_slots, config.max_model_len,
                              quantize=quantize)
-        self.queue: "deque[Request]" = deque()
+        #: admission queue: per-tenant FIFOs + deficit round-robin when
+        #: the tenants block is on, a plain FIFO otherwise (deque API)
+        self.queue = TenantQueues(getattr(config, "tenants", None))
         #: (KVHandoff, Request) pairs awaiting a slot (decode/unified role)
         self.handoff_queue: "deque" = deque()
+        #: chunked prefill in flight: slot -> PREFILLING Request, in
+        #: admission order — each holds its slot across ticks while its
+        #: prompt lands chunk by chunk (chunked_prefill config block)
+        self.prefilling: "OrderedDict[int, Request]" = OrderedDict()
+        self.chunked = getattr(config, "chunked_prefill", None)
+        if not getattr(self.chunked, "enabled", False):
+            self.chunked = None
+        #: ticks between unconditional queue deadline sweeps — queued
+        #: expiry is otherwise lazy (at pop time) plus EVENT-DRIVEN: the
+        #: scheduler tracks the minimum queued deadline (O(1) per tick)
+        #: and sweeps the moment the clock passes it, so deep per-tenant
+        #: queues don't make every tick linear in total queued requests
+        #: while timeouts still fire the tick they expire
+        self.expire_sweep_interval = 64
+        self._queue_min_deadline: Optional[float] = None
         self.prefix_cache = None
         pc_cfg = getattr(config, "prefix_cache", None)
         if getattr(pc_cfg, "enabled", False):
@@ -180,11 +373,17 @@ class ContinuousBatchingScheduler:
                    else self.config.request_timeout_s)
         if timeout is not None:
             request.deadline = now + timeout
+            if self._queue_min_deadline is None or \
+                    request.deadline < self._queue_min_deadline:
+                self._queue_min_deadline = request.deadline
         self.queue.append(request)
         if request.trace is None:
             from ..telemetry.disttrace import TraceContext
-            request.trace = TraceContext.mint(origin=self.replica_name)
+            request.trace = TraceContext.mint(origin=self.replica_name,
+                                              tenant=request.tenant)
         ctx = request.trace
+        if getattr(ctx, "tenant", None) is None:
+            ctx.tenant = request.tenant
         if getattr(ctx, "sampling", None) is None:
             # the replay law rides the trace: a survivor (or a human in a
             # postmortem) can see the exact seed/temperature the dedup'd
@@ -202,7 +401,7 @@ class ContinuousBatchingScheduler:
         tr.async_begin("request/queued", request.request_id, cat="serving",
                        args={"replica": self.replica_name,
                              "trace_id": ctx.trace_id})
-        self.metrics.record_submit()
+        self.metrics.record_submit(tenant=request.tenant)
 
     def enqueue_handoff(self, handoff, request: Request):
         """Admission control for the handoff path (decode role): the
@@ -227,18 +426,31 @@ class ContinuousBatchingScheduler:
     # ----------------------------------------------------------------- tick
     def tick(self) -> int:
         """One scheduling iteration. Returns the number of requests still
-        in flight (queued + running) after the tick."""
+        in flight (queued + prefilling + running) after the tick. With
+        chunked prefill, each tick's prefill work is budgeted in units
+        of ``chunk_tokens``: admissions (DRR-ordered, so a small
+        tenant's short prompt goes first) spend the budget, then the
+        OLDEST in-flight chunked prefill always advances one chunk —
+        steady state under a long prompt is exactly one chunk + decode
+        per tick, so a 4k-token prompt costs ~16 ticks of bounded work
+        instead of one unbounded one, and every active slot still
+        decodes every tick. Worst case (an admission landing the same
+        tick as a chunk) is a small constant multiple of chunk_tokens,
+        never the prompt length."""
         self._tick_no += 1
         now = self.clock()
         self._expire(now)
         self._admit_handoffs(now)
-        self._admit(now)
+        budget = (self.chunked.chunk_tokens if self.chunked is not None
+                  else None)
+        budget = self._admit(now, budget)
+        self._advance_prefills(now, budget)
         self._decode()
         self.metrics.record_tick(len(self.queue), self.pool.utilization)
         if self.prefix_cache is not None:
             self.metrics.record_prefix_cache(self.prefix_cache)
         return (len(self.queue) + len(self.handoff_queue) +
-                len(self.pool.active_slots))
+                len(self.pool.active_slots) + len(self.prefilling))
 
     def _alloc_slot(self) -> Optional[int]:
         """Claim a slot, evicting the LRU prefix-cache entry when the
@@ -273,19 +485,57 @@ class ContinuousBatchingScheduler:
         self.pool.free(slot)
 
     def _expire(self, now: float):
-        """Deadline enforcement for both queued and running requests."""
-        kept = deque()
-        for req in self.queue:
-            if req.deadline is not None and now > req.deadline:
-                self._finish(req, RequestState.TIMEOUT, now)
-            else:
-                kept.append(req)
-        self.queue = kept
+        """Deadline enforcement. Running and prefilling requests are
+        checked every tick (O(slots)). The QUEUE is no longer rescanned
+        every tick: expiry there is lazy at pop time (``_pop_live``)
+        plus a sweep that runs only when the tracked minimum queued
+        deadline has actually passed (event-driven — timeouts still
+        fire the tick they expire) or on the low-frequency
+        ``expire_sweep_interval`` backstop. A tick with nothing expired
+        costs O(1) in queue length; the sweep itself recomputes the
+        minimum, so a stale tracker only ever costs one extra scan."""
         for slot in self.pool.active_slots:
             req = self.pool.requests[slot]
             if req.deadline is not None and now > req.deadline:
                 self._finish(req, RequestState.TIMEOUT, now)
                 self.pool.free(slot)
+        for slot in list(self.prefilling):
+            req = self.prefilling[slot]
+            if req.deadline is not None and now > req.deadline:
+                del self.prefilling[slot]
+                self._finish(req, RequestState.TIMEOUT, now)
+                self.pool.free(slot)
+        due = (self._queue_min_deadline is not None and
+               now > self._queue_min_deadline)
+        if not due and self._tick_no % self.expire_sweep_interval:
+            return
+        expired = []
+        new_min = None
+        for req in self.queue:
+            if req.deadline is None:
+                continue
+            if now > req.deadline:
+                expired.append(req)
+            elif new_min is None or req.deadline < new_min:
+                new_min = req.deadline
+        self._queue_min_deadline = new_min
+        for req in expired:
+            try:
+                self.queue.remove(req)
+            except ValueError:
+                continue
+            self._finish(req, RequestState.TIMEOUT, now)
+
+    def _pop_live(self, now: float) -> Optional[Request]:
+        """Pop the next admissible request, finishing expired ones on
+        the way out (the lazy half of deadline enforcement)."""
+        while self.queue:
+            req = self.queue.popleft()
+            if req.deadline is not None and now > req.deadline:
+                self._finish(req, RequestState.TIMEOUT, now)
+                continue
+            return req
+        return None
 
     def _admit_handoffs(self, now: float):
         """Insert received KV lanes into free slots (decode/unified
@@ -328,21 +578,57 @@ class ContinuousBatchingScheduler:
                     self.draft_cache = self.engine.draft_prefill(
                         self.draft, self.draft_cache, slot, req.prompt)
 
-    def _admit(self, now: float):
-        """Move queued requests into free slots, prefilling each prompt
-        into its slot's cache lane (bounded per tick so admission bursts
-        cannot starve in-flight decode). With a prefix cache, a prompt
-        sharing a cached prefix admits via lane-copy + suffix prefill —
-        only the unshared tail runs through the stack. A ``prefill``-role
-        scheduler extracts the lane into a KVHandoff for ``handoff_sink``
-        instead of binding for decode."""
+    def _advance_prefills(self, now: float, budget):
+        """Advance in-flight chunked prefills, oldest first. The HEAD
+        request always moves one chunk — a flood of small admissions can
+        spend the whole budget, but it cannot starve a prefill already
+        holding a slot — and younger ones follow only while budget
+        remains (one chunk per tick in the steady state). A request
+        whose final chunk lands completes its admission (first token
+        sampled, slot bound for decode / handed off) in the same
+        tick."""
+        if not self.prefilling:
+            return
+        first = True
+        for slot in list(self.prefilling):
+            if not first and (budget is None or budget <= 0):
+                break
+            req = self.prefilling.get(slot)
+            if req is None or req.prefill_tick == self._tick_no:
+                continue                 # admitted (and chunked) this tick
+            spent = self._chunk_step(slot, req)
+            if budget is not None:
+                budget -= spent
+            first = False
+
+    def _admit(self, now: float, budget=None):
+        """Move queued requests into free slots (bounded per tick so
+        admission bursts cannot starve in-flight decode). A prompt whose
+        unshared suffix fits ``chunk_tokens`` (or everything, when
+        chunking is off) prefills inline exactly as before; a longer one
+        starts a CHUNKED admission — first chunk now, the rest
+        interleaved with decode ticks — so no single tick ever runs an
+        unbounded prefill. With a prefix cache, a prompt sharing a
+        cached prefix admits via lane-copy + suffix/chunk prefill: only
+        the unshared tail runs through the stack. A ``prefill``-role
+        scheduler extracts the completed lane into a KVHandoff for
+        ``handoff_sink`` instead of binding for decode. ``budget``
+        (chunked mode) is the tick's prefill-token budget; each
+        admission spends its actual prefill work against it, and the
+        remainder is returned for the in-flight chunk advance.
+        Admissions run BEFORE the chunk advance so a DRR-favored small
+        tenant's TTFT is one tick, not one whale prefill."""
         admitted = 0
         tr = self.tracer
-        while self.queue and admitted < self.config.max_prefills_per_tick:
+        while self.queue and admitted < self.config.max_prefills_per_tick \
+                and (budget is None or budget > 0):
             slot = self._alloc_slot()
             if slot is None:
-                return
-            req = self.queue.popleft()
+                return budget
+            req = self._pop_live(now)
+            if req is None:
+                self.pool.free(slot)
+                return budget
             ctx = req.trace
             if ctx is not None:
                 ctx.mark("admitted")
@@ -352,35 +638,143 @@ class ContinuousBatchingScheduler:
                                  "replica": self.replica_name,
                                  **(ctx.span_args() if ctx is not None
                                     else {})})
-            first = self._prefill_into(slot, req)
-            if ctx is not None:
-                ctx.mark("first_token")
-            t_first = self.clock()
-            req.state = RequestState.RUNNING
-            req.first_token_time = t_first
-            self.metrics.record_ttft(t_first - req.submit_time)
-            self._deliver(req, first)
-            if self._should_finish(req, first):
-                self._finish(req, RequestState.FINISHED, t_first)
-                self._release_slot(slot, req)
-            elif self.role == "prefill":
-                self._hand_off(slot, req, first)
+            req.prefill_started = True
+            hit = None
+            if self.prefix_cache is not None:
+                hit = self.prefix_cache.lookup(req.prompt)
+            # chunk only the UNSHARED suffix: a prefix hit may shrink a
+            # whale prompt below the chunking threshold entirely
+            suffix = int(req.prompt.size) - \
+                (hit.matched if hit is not None else 0)
+            if self.chunked is not None and \
+                    suffix > self.chunked.chunk_tokens:
+                spent = self._start_chunked(slot, req, hit)
             else:
-                self.pool.bind(slot, req, len(req.prompt), first,
-                               req.sampling)
-                if self.spec is not None:
-                    self.draft_cache = self.engine.draft_prefill(
-                        self.draft, self.draft_cache, slot, req.prompt)
+                first = self._prefill_into(slot, req, hit)
+                spent = suffix
+                self._complete_admission(slot, req, first)
+            if budget is not None:
+                budget -= spent
             admitted += 1
+        return budget
 
-    def _prefill_into(self, slot: int, req: Request) -> int:
+    def _start_chunked(self, slot: int, req: Request, hit) -> int:
+        """Begin a chunked admission: optional prefix-reuse lane copy,
+        then the first fixed-size chunk. The request holds its slot in
+        PREFILLING state; ``_advance_prefills`` moves it forward on
+        later ticks. Returns the prefill tokens spent now."""
+        tr = self.tracer
+        t = int(req.prompt.size)
+        start = 0
+        if hit is not None:
+            start = min(int(hit.matched), t - 1)
+            if start > 0:
+                try:
+                    with tr.span("prefix_reuse", cat="serving",
+                                 args={"request_id": req.request_id,
+                                       "slot": slot, "src_slot": hit.slot,
+                                       "matched": hit.matched,
+                                       "reused": start, "chunked": True,
+                                       "suffix": t - start,
+                                       "replica": self.replica_name,
+                                       **(req.trace.span_args()
+                                          if req.trace is not None
+                                          else {})}):
+                        self.pool.cache = self.engine.slot_copy_lane(
+                            self.pool.cache, hit.slot, slot)
+                finally:
+                    self.prefix_cache.release(hit, used_tokens=start)
+            else:
+                self.prefix_cache.release(hit, used_tokens=0)
+        req.state = RequestState.PREFILLING
+        req.prefill_pos = start
+        # dummy decode writes for an unbound slot land at column
+        # lengths[slot] — keep it one past the valid prefix so the next
+        # chunk (which starts exactly there) overwrites the garbage
+        self.pool.lengths[slot] = start
+        self.prefilling[slot] = req
+        return self._chunk_step(slot, req)
+
+    def _chunk_step(self, slot: int, req: Request) -> int:
+        """One chunk of prefill for a PREFILLING request. Intermediate
+        chunks write exactly ``chunk_tokens`` of K/V through the
+        sampling-free ``slot_chunk_prefill`` program (one compiled
+        flavor); the FINAL chunk runs the pow2 suffix-prefill machinery,
+        sampling the first token at the same ``(seed, position)`` key a
+        monolithic prefill would use — bitwise token parity — and
+        completes the admission. Returns prefill tokens spent."""
+        tr = self.tracer
+        t = int(req.prompt.size)
+        p = int(req.prefill_pos)
+        rem = t - p
+        ctx = req.trace
+        targs = ctx.span_args() if ctx is not None else {}
+        req.prefill_tick = self._tick_no
+        if rem > self.chunked.chunk_tokens:
+            chunk = self.chunked.chunk_tokens
+            with tr.span("prefill_chunk", cat="serving",
+                         args={"request_id": req.request_id, "slot": slot,
+                               "start": p, "chunk": chunk,
+                               "remaining": rem - chunk,
+                               "replica": self.replica_name, **targs}):
+                self.pool.cache = self.engine.slot_chunk_prefill(
+                    self.pool.cache, slot, req.prompt[p:p + chunk], p)
+            req.prefill_pos = p + chunk
+            self.pool.lengths[slot] = req.prefill_pos
+            if ctx is not None:
+                ctx.mark("prefill_chunk")
+            return chunk
+        # final chunk: suffix-prefill from an offset whose pow2 bucket
+        # fits max_len (reuse_plan may back the offset off below
+        # prefill_pos — those columns recompute to identical K/V)
+        from .fleet.prefix_cache import reuse_plan
+        offset, _sfx = reuse_plan(t, p, self.config.max_model_len)
+        sp = req.sampling
+        with tr.span("prefill", cat="serving",
+                     args={"request_id": req.request_id, "slot": slot,
+                           "prompt_len": t, "chunked": True,
+                           "suffix": t - offset,
+                           "replica": self.replica_name, **targs}):
+            self.pool.cache, first = self.engine.slot_suffix_prefill(
+                self.pool.cache, slot, req.prompt[offset:], offset,
+                temperature=sp.temperature, top_k=sp.top_k,
+                top_p=sp.top_p, seed=sp.seed)
+        self.prefilling.pop(slot, None)
+        self._complete_admission(slot, req, int(first))
+        return rem
+
+    def _complete_admission(self, slot: int, req: Request, first: int):
+        """Shared tail of every prefill path (inline or final chunk):
+        record TTFT, deliver the first token, then bind for decode /
+        hand off / finish."""
+        ctx = req.trace
+        if ctx is not None:
+            ctx.mark("first_token")
+        t_first = self.clock()
+        req.state = RequestState.RUNNING
+        req.first_token_time = t_first
+        self.metrics.record_ttft(t_first - req.submit_time,
+                                 tenant=req.tenant)
+        self._deliver(req, first)
+        if self._should_finish(req, first):
+            self._finish(req, RequestState.FINISHED, t_first)
+            self._release_slot(slot, req)
+        elif self.role == "prefill":
+            self._hand_off(slot, req, first)
+        else:
+            self.pool.bind(slot, req, len(req.prompt), first,
+                           req.sampling)
+            if self.spec is not None:
+                self.draft_cache = self.engine.draft_prefill(
+                    self.draft, self.draft_cache, slot, req.prompt)
+
+    def _prefill_into(self, slot: int, req: Request, hit) -> int:
         """Full prefill, or the prefix-reuse fast path when the radix
-        cache holds a shared prefix. Returns the first sampled token."""
+        cache holds a shared prefix (``hit`` — looked up by the caller
+        so the chunk-vs-inline decision sees the unshared suffix).
+        Returns the first sampled token."""
         tr = self.tracer
         sp = req.sampling
-        hit = None
-        if self.prefix_cache is not None:
-            hit = self.prefix_cache.lookup(req.prompt)
         if hit is not None:
             from .fleet.prefix_cache import reuse_plan
             offset, _suffix = reuse_plan(int(req.prompt.size), hit.matched,
@@ -448,6 +842,7 @@ class ContinuousBatchingScheduler:
             max_new_tokens=req.max_new_tokens,
             eos_token_id=req.sampling.eos_token_id,
             request_id=req.request_id,
+            tenant=req.tenant,
             trace=ctx.to_header() if ctx is not None else None)
         if ctx is not None:
             ctx.mark("handoff_out")
@@ -496,6 +891,7 @@ class ContinuousBatchingScheduler:
                 # bookkeeping) is the critical path's "stream" tail
                 req.trace.mark("decode_done")
             self._deliver(req, tok)
+            self.metrics.record_tenant_tokens(req.tenant)
             if finishing:
                 self._finish(req, RequestState.FINISHED, now)
                 self._release_slot(slot, req)
@@ -562,6 +958,7 @@ class ContinuousBatchingScheduler:
             self.pool.lengths[slot] = p + 1 + min(delivered, a)
             accepted_total += a
             emitted_total += delivered
+            self.metrics.record_tenant_tokens(req.tenant, delivered)
             if finishing:
                 self._finish(req, RequestState.FINISHED, now)
                 self._release_slot(slot, req)
@@ -599,10 +996,12 @@ class ContinuousBatchingScheduler:
         if req.trace is not None:
             req.trace.mark("finished")
         tr = self.tracer
-        if req.first_token_time is None:
+        if req.first_token_time is None and not req.prefill_started:
             # expired straight out of the queue: close the queued phase
             tr.async_end("request/queued", req.request_id, cat="serving")
         else:
+            # admitted (incl. a PREFILLING request that expired before
+            # its first token): the decode-phase span is the open one
             tr.async_end("request/decode", req.request_id, cat="serving")
         tr.async_end(
             "request", req.request_id, cat="serving",
@@ -613,6 +1012,6 @@ class ContinuousBatchingScheduler:
                   **(req.trace.span_args()
                      if req.trace is not None else {})})
         if state is RequestState.TIMEOUT:
-            self.metrics.record_timeout()
+            self.metrics.record_timeout(tenant=req.tenant)
         elif state is RequestState.FINISHED:
             self.metrics.record_completion(req)

@@ -52,9 +52,10 @@ __all__ = ["TraceContext", "FleetAggregator", "merge_chrome_traces",
 #: canonical stage order for critical-path reports (queue / route+probe /
 #: prefill / handoff serialize+transfer+insert / decode / stream, plus
 #: the failover re-enqueue gap when a replay happened)
-CRITICAL_PATH_STAGES = ("route", "queue", "prefill", "handoff_serialize",
-                        "handoff_transfer", "handoff_insert", "decode",
-                        "spec_verify", "stream", "failover")
+CRITICAL_PATH_STAGES = ("route", "queue", "prefill_chunk", "prefill",
+                        "handoff_serialize", "handoff_transfer",
+                        "handoff_insert", "decode", "spec_verify", "stream",
+                        "failover")
 
 _MINT_LOCK = threading.Lock()
 _MINT_SEQ = itertools.count()
@@ -75,6 +76,14 @@ def _stage_of(prev: Optional[str], end: str) -> Optional[str]:
         return "queue"
     if end == "first_token":
         return "prefill"
+    # chunked prefill marks once per chunk: admitted -> prefill_chunk and
+    # chunk -> chunk intervals accumulate into the prefill_chunk stage
+    # (the waiting BETWEEN chunks — interleaved decode ticks — included:
+    # that wait is exactly the latency chunking trades for bounded TPOT);
+    # the last chunk ends at first_token and buckets as plain prefill, so
+    # stage sums still equal e2e exactly
+    if end == "prefill_chunk":
+        return "prefill_chunk"
     if end == "handoff_out":
         return "handoff_serialize"
     if end == "handoff_queued":
@@ -107,13 +116,14 @@ class TraceContext:
     """One request's identity and timeline across the fleet."""
 
     __slots__ = ("trace_id", "origin", "span_ids", "replays",
-                 "replay_parent", "hops", "marks", "sampling")
+                 "replay_parent", "hops", "marks", "sampling", "tenant")
 
     def __init__(self, trace_id: str, origin: str,
                  span_ids: Optional[List[int]] = None, replays: int = 0,
                  replay_parent: Optional[int] = None,
                  hops: Optional[List[str]] = None,
-                 sampling: Optional[Dict[str, Any]] = None):
+                 sampling: Optional[Dict[str, Any]] = None,
+                 tenant: Optional[str] = None):
         self.trace_id = trace_id
         self.origin = origin
         self.span_ids = list(span_ids or [])
@@ -126,17 +136,23 @@ class TraceContext:
         #: these, so the delivered-position dedup stays exact — and a
         #: postmortem can name the seed a disputed stream ran under
         self.sampling = sampling
+        #: the tenant this request bills to — stamped on every span the
+        #: request touches and carried across handoffs and failovers, so
+        #: ds_tpu_top and postmortem bundles can NAME the tenant that ate
+        #: the TTFT budget instead of pointing at anonymous traffic
+        self.tenant = tenant
 
     # ------------------------------------------------------------- minting
     @classmethod
-    def mint(cls, origin: str) -> "TraceContext":
+    def mint(cls, origin: str,
+             tenant: Optional[str] = None) -> "TraceContext":
         """A fleet-unique context. The id mixes pid + a per-process random
         salt + a counter, so co-resident routers and separate hosts can
         mint concurrently without coordination."""
         with _MINT_LOCK:
             seq = next(_MINT_SEQ)
         return cls(trace_id=f"{os.getpid():x}-{_MINT_SALT}-{seq:x}",
-                   origin=origin)
+                   origin=origin, tenant=tenant)
 
     # ---------------------------------------------------------- propagation
     @property
@@ -171,6 +187,8 @@ class TraceContext:
         aggregator (and a human in Perfetto) joins on."""
         out: Dict[str, Any] = {"trace_id": self.trace_id,
                                "origin": self.origin}
+        if self.tenant:
+            out["tenant"] = self.tenant
         if self.span_ids:
             out["span_id"] = self.span_ids[-1]
         if self.replays:
@@ -187,7 +205,8 @@ class TraceContext:
                 "span_ids": list(self.span_ids), "replays": self.replays,
                 "replay_parent": self.replay_parent,
                 "hops": list(self.hops),
-                "sampling": self.sampling}
+                "sampling": self.sampling,
+                "tenant": self.tenant}
 
     @classmethod
     def from_header(cls, header: Dict[str, Any]) -> "TraceContext":
@@ -197,7 +216,8 @@ class TraceContext:
                    replays=header.get("replays", 0),
                    replay_parent=header.get("replay_parent"),
                    hops=header.get("hops"),
-                   sampling=header.get("sampling"))
+                   sampling=header.get("sampling"),
+                   tenant=header.get("tenant"))
 
     # -------------------------------------------------------- critical path
     def total_ms(self) -> float:

@@ -217,6 +217,7 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
     tracer = tracer or get_tracer()
     lines: List[str] = []
     host_lines: List[str] = []
+    tenant_series: Dict[str, List[str]] = {}
     lines.append(f"# TYPE {prefix}_metric gauge")
     for tag, (val, _step) in sorted(tracer.counters().items()):
         try:
@@ -247,6 +248,19 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
             host_lines.append(f"# TYPE {prefix}_fleet_{name} gauge")
             host_lines.append(f"{prefix}_fleet_{name} {fval}")
             continue
+        if tag.startswith("tenant/"):
+            # per-tenant SLO gauges (serving/metrics.py tenant windows,
+            # router throttle counts): tenant/<name>/<metric> becomes a
+            # tenant=-labeled dstpu_tenant_<metric> series — dashboards
+            # rank tenants by burn rate / share with one query instead of
+            # label-matching through the generic gauge
+            tname, _, metric = tag[len("tenant/"):].partition("/")
+            if metric:
+                name = _prom(metric)
+                tenant_series.setdefault(name, []).append(
+                    f'{prefix}_tenant_{name}{{tenant="{_prom(tname)}"}} '
+                    f"{fval}")
+                continue
         if tag.startswith("spec/"):
             # speculative-decode gauges (serving/metrics.py): dedicated
             # dstpu_spec_acceptance_ema / _tokens_per_tick / _draft_ms /
@@ -258,6 +272,11 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
             continue
         lines.append(f'{prefix}_metric{{tag="{_prom(tag)}"}} {fval}')
     lines.extend(host_lines)
+    for name in sorted(tenant_series):
+        # one TYPE header per family, samples contiguous per the
+        # exposition format (tenants vary only by label)
+        lines.append(f"# TYPE {prefix}_tenant_{name} gauge")
+        lines.extend(tenant_series[name])
     aggs = span_aggregates(tracer)
     if aggs:
         lines.append(f"# TYPE {prefix}_span_ms_total counter")

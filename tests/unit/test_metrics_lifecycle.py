@@ -102,14 +102,24 @@ def test_fleet_gauges_owned_and_released(tracer, tmp_path):
     router = build_fleet(inf, {
         "num_slots": 2, "max_model_len": 64,
         "slo": {"ttft_ms": 1.0, "window": 16},     # burn gauges populate
+        "monitor_interval": 1,                     # tenant gauges emit
         "flight_recorder": {"enabled": True,
                             "dir": str(tmp_path / "fleet_rec")},
+        "chunked_prefill": {"enabled": True, "chunk_tokens": 16},
+        "tenants": {"enabled": True, "rates": {"whale": 1.0},
+                    "burst_tokens": 24},
         "fleet": {"enabled": True, "replicas": 2,
                   "heartbeat_timeout_s": 60.0}})
     rng = np.random.default_rng(1)
     fids = [router.submit(rng.integers(0, VOCAB, (t,), dtype=np.int32),
-                          SamplingParams(max_new_tokens=4))
-            for t in (5, 8, 6)]
+                          SamplingParams(max_new_tokens=4,
+                                         tenant=tenant))
+            for t, tenant in ((5, "acme"), (40, "acme"), (6, "zen"))]
+    # a throttled tenant registers its dstpu_tenant_throttled series
+    from deepspeed_tpu.serving import RateLimited
+    with pytest.raises(RateLimited):
+        router.submit(rng.integers(0, VOCAB, (30,), dtype=np.int32),
+                      SamplingParams(max_new_tokens=8, tenant="whale"))
     router.step()
     victim = next(router.result(f).replica for f in fids
                   if router.result(f).replica is not None)
@@ -119,6 +129,11 @@ def test_fleet_gauges_owned_and_released(tracer, tmp_path):
     assert any(t.startswith("fleet/") for t in counters)
     assert any(t.startswith("fleet/path_") for t in counters)
     assert any(t.startswith("serving/") for t in counters)
+    # the tenant dimension: per-tenant SLO windows + router throttles
+    # must register owned (and vanish below) like every other family
+    assert any(t.startswith("tenant/acme/") for t in counters)
+    assert "tenant/whale/throttled" in counters
+    assert "fleet/throttled" in counters
     assert "recorder/bundles" in counters
     _assert_all_owned(tracer, "fleet live")
     router.shutdown()
