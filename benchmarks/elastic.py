@@ -1,0 +1,257 @@
+"""Elasticity benchmark: resize-resume drift + SLO-driven autoscale.
+
+Two experiments, one JSON (benchmarks/elastic.json):
+
+1. **Resize-resume ladder** — train a tiny GPT-2 on dp=8/tp=2 (16
+   virtual CPU devices), checkpoint, then resume the SAME state as
+   dp=4/tp=4 and again on dp=2 (an eighth of the chips), with the
+   optimizer frozen at lr=0 across the hops. Measured per hop:
+   time-to-resume (engine build + resharding load) and state drift —
+   params, optimizer moments, and the RNG stream are byte-compared, so
+   the asserted drift is exactly 0, not epsilon. Gradient-accumulation
+   recomputes automatically (gas 4 -> 8 -> 16) to preserve the global
+   batch of 32.
+
+2. **Autoscale under a load ramp** — a 1-replica fleet with a tight
+   TTFT SLO takes a burst that drives the burn rate over 1.0: the
+   router scales up to 2 replicas mid-ramp; a trailing trickle of light
+   load dilutes the SLO window, burn decays, and the router drains one
+   replica back down. Asserted: >=1 scale-up AND >=1 scale-down, every
+   request finished (0 dropped), every streamed position delivered
+   exactly once, and p99 TTFT bounded.
+
+Runs on CPU: JAX_PLATFORMS=cpu python benchmarks/elastic.py
+Knobs (env): EL_STEPS, EL_EMBD, EL_LAYERS, EL_BURST, EL_TTFT_BOUND_MS.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if os.environ.get("JAX_PLATFORMS", "").lower().startswith("cpu") or \
+        os.environ.get("DSTPU_ACCELERATOR", "").lower() == "cpu":
+    import importlib.util
+    _spec = importlib.util.spec_from_file_location(
+        "_dstpu_hermetic",
+        os.path.join(REPO, "deepspeed_tpu", "utils", "hermetic.py"))
+    _hermetic = importlib.util.module_from_spec(_spec)
+    _spec.loader.exec_module(_hermetic)
+    # 16 virtual devices: the dp=8/tp=2 -> dp=4/tp=4 -> dp=2 ladder
+    _hermetic.force_cpu(device_count=16)
+
+import jax  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.elasticity import elastic_resume  # noqa: E402
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model  # noqa: E402
+from deepspeed_tpu.serving import SamplingParams, build_fleet  # noqa: E402
+
+STEPS = int(os.environ.get("EL_STEPS", 3))
+EMBD = int(os.environ.get("EL_EMBD", 64))
+LAYERS = int(os.environ.get("EL_LAYERS", 2))
+BURST = int(os.environ.get("EL_BURST", 12))
+TTFT_BOUND_MS = float(os.environ.get("EL_TTFT_BOUND_MS", 5000.0))
+
+TINY = dict(vocab_size=128, n_positions=64, n_embd=EMBD, n_layer=LAYERS,
+            n_head=4, pad_vocab_to_multiple=1, dtype="float32")
+BATCH = 32
+
+
+def _cfg(lr, tp):
+    return {
+        "train_batch_size": BATCH,
+        "train_micro_batch_size_per_gpu": 1,
+        "tensor_parallel_size": tp,
+        "optimizer": {"type": "adamw", "params": {"lr": lr}},
+        "steps_per_print": 0,
+    }
+
+
+def _batch(engine, seed=0):
+    gas = engine._config.gradient_accumulation_steps
+    rows = BATCH // gas
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 127, size=(gas, rows, 32),
+                                      dtype=np.int32)}
+
+
+def _leaf_bytes(tree):
+    return [np.asarray(jax.device_get(x)).tobytes()
+            for x in jax.tree.leaves(tree)]
+
+
+def _drift(a, b):
+    """0.0 when byte-identical; else the count of differing leaves (the
+    honest unit — byte equality has no meaningful epsilon)."""
+    return float(sum(x != y for x, y in zip(a, b))) + \
+        abs(len(a) - len(b))
+
+
+def resize_ladder():
+    ckpt = tempfile.mkdtemp(prefix="dstpu_elastic_ckpt_")
+    t0 = time.perf_counter()
+    a, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2Model(GPT2Config(**TINY)), config=_cfg(1e-3, tp=2))
+    topo_a = (f"dp{a.mesh_manager.dp}/tp{a.mesh_manager.tp}"
+              f" gas={a._config.gradient_accumulation_steps}")
+    assert a.mesh_manager.dp == 8 and a.mesh_manager.tp == 2
+    for i in range(STEPS):
+        loss = a.train_batch(batch=_batch(a, seed=i))
+    jax.block_until_ready(loss)
+    build_a_s = time.perf_counter() - t0
+    a.save_checkpoint(ckpt)
+    ref = {"params": _leaf_bytes(a.params), "opt": _leaf_bytes(a.opt_state),
+           "rng": np.asarray(a._base_rng).tobytes()}
+    a.close()
+
+    hops = [("dp4_tp4", 4, None), ("dp2", 1, 2)]
+    rows = {"save_topology": topo_a, "train_steps": STEPS,
+            "build_and_train_s": round(build_a_s, 2), "hops": {}}
+    for name, tp, ndev in hops:
+        devices = None if ndev is None else list(jax.devices())[:ndev]
+        t0 = time.perf_counter()
+        engine, _c, plan = elastic_resume(
+            GPT2Model(GPT2Config(**TINY)), _cfg(0.0, tp=tp), ckpt,
+            devices=devices)
+        resume_s = time.perf_counter() - t0
+        drift = {
+            "params": _drift(_leaf_bytes(engine.params), ref["params"]),
+            "opt_state": _drift(_leaf_bytes(engine.opt_state), ref["opt"]),
+            "rng": float(np.asarray(engine._base_rng).tobytes()
+                         != ref["rng"]),
+        }
+        # one lr=0 step on the new mesh: params must not move a bit
+        jax.block_until_ready(engine.train_batch(batch=_batch(engine, 99)))
+        drift["params_after_lr0_step"] = _drift(
+            _leaf_bytes(engine.params), ref["params"])
+        assert all(v == 0.0 for v in drift.values()), (name, drift)
+        rows["hops"][name] = {
+            "plan": plan.describe(),
+            "gas": plan.gas,
+            "world_size": plan.world_size,
+            "time_to_resume_s": round(resume_s, 2),
+            "drift": drift,
+        }
+        # chain: the NEXT hop resumes through this topology's save
+        engine.save_checkpoint(ckpt)
+        ref["opt"] = _leaf_bytes(engine.opt_state)
+        engine.close()
+    gasses = [rows["hops"][n]["gas"] for n, _t, _d in hops]
+    assert gasses == [8, 16], gasses        # batch 32 preserved throughout
+    print(f"resize ladder: {topo_a} -> " + " -> ".join(
+        f"{n} (gas {rows['hops'][n]['gas']}, "
+        f"{rows['hops'][n]['time_to_resume_s']}s, drift 0)"
+        for n, _t, _d in hops))
+    return rows
+
+
+def autoscale_ramp():
+    model = GPT2Model(GPT2Config(**TINY))
+    infer = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    router = build_fleet(infer, {
+        "num_slots": 4, "max_model_len": 64, "max_queue": 64,
+        # a tight-but-honest TTFT target: the burst violates it, the
+        # trickle meets it — burn crosses both thresholds on its own.
+        # The window is small on purpose: at target 0.99 the burn
+        # multiplier is 100x, so burn only drops below the scale-down
+        # threshold once the burst's violations fully age out
+        "slo": {"ttft_ms": 30.0, "window": 12},
+        "monitor_interval": 1,
+        "fleet": {"enabled": True, "replicas": 1,
+                  "heartbeat_timeout_s": 600.0,
+                  "autoscale": {"enabled": True, "min_replicas": 1,
+                                "max_replicas": 2, "scale_up_burn": 1.0,
+                                "scale_down_burn": 0.25,
+                                "sustain_s": 0.05, "cooldown_s": 0.2}}})
+    rng = np.random.default_rng(5)
+    submit_t, first_tok = {}, {}
+    seen = {}
+
+    def on_token(req, tok):
+        pos = len(req.tokens)
+        seen.setdefault(req.request_id, []).append(pos)
+        if pos == 1:
+            first_tok[req.request_id] = time.perf_counter()
+
+    def submit(n, max_new):
+        fids = []
+        for _ in range(n):
+            p = rng.integers(0, 127, (rng.integers(4, 12),), np.int32)
+            fid = router.submit(p, SamplingParams(max_new_tokens=max_new),
+                                on_token=on_token)
+            submit_t[fid] = time.perf_counter()
+            fids.append(fid)
+        return fids
+
+    # phase 1: burst — queue waits blow the TTFT target, burn spikes
+    fids = submit(BURST, 16)
+    router.run_until_idle()
+    ups_after_burst = router.metrics.scale_ups
+    # phase 2: trickle — light load served fast ages the burst's
+    # violations out of every live replica's window (pairs, so BOTH
+    # replicas keep sampling: burn is worst-of and a window that never
+    # sees a new request never decays), with idle ticks between waves —
+    # a serve loop ticks on a cadence whether or not work arrived, and
+    # the controller's sustain clock only advances inside step()
+    for i in range(80):
+        fids += submit(2, 4)
+        router.run_until_idle()
+        for _ in range(4):
+            time.sleep(0.02)
+            router.step()
+        if router.metrics.scale_downs >= 1 and len(router.replicas) == 1:
+            break
+    # every request finished, every position exactly once
+    dropped = sum(router.result(f).state != "finished" for f in fids)
+    assert dropped == 0, f"{dropped} dropped request(s)"
+    for positions in seen.values():
+        assert positions == list(range(1, len(positions) + 1)), positions
+    assert ups_after_burst >= 1, "burst never forced a scale-up"
+    assert router.metrics.scale_downs >= 1, "trickle never scaled down"
+    assert len(router.replicas) == 1
+    ttft_ms = sorted((first_tok[f] - submit_t[f]) * 1e3
+                     for f in fids if f in first_tok)
+    p99 = ttft_ms[min(len(ttft_ms) - 1, int(0.99 * len(ttft_ms)))]
+    assert p99 < TTFT_BOUND_MS, f"p99 TTFT {p99:.0f}ms over bound"
+    out = {
+        "burst_requests": BURST, "total_requests": len(fids),
+        "dropped": dropped,
+        "scale_ups": router.metrics.scale_ups,
+        "scale_downs": router.metrics.scale_downs,
+        "final_replicas": len(router.replicas),
+        "ttft_ms_p50": round(ttft_ms[len(ttft_ms) // 2], 2),
+        "ttft_ms_p99": round(p99, 2),
+        "exactly_once": True,
+        "last_scale": {k: v for k, v in
+                       (router.last_scale or {}).items() if k != "time"},
+    }
+    router.shutdown()
+    print(f"autoscale ramp: {out['scale_ups']} up / {out['scale_downs']} "
+          f"down, {out['total_requests']} requests 0 dropped, "
+          f"p99 TTFT {out['ttft_ms_p99']}ms")
+    return out
+
+
+def main():
+    t0 = time.time()
+    results = {
+        "resize": resize_ladder(),
+        "autoscale": autoscale_ramp(),
+        "wall_s": None,
+    }
+    results["wall_s"] = round(time.time() - t0, 1)
+    out = os.path.join(REPO, "benchmarks", "elastic.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"wrote {out} ({results['wall_s']}s)")
+
+
+if __name__ == "__main__":
+    main()

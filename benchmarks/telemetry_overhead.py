@@ -62,7 +62,8 @@ THRESHOLD_PCT = float(os.environ.get("TEL_THRESHOLD_PCT", 2.0))
 
 
 def build_engine(telemetry_enabled: bool, full: bool = False,
-                 recorder_dir: str = "", compile_plane: bool = False):
+                 recorder_dir: str = "", compile_plane: bool = False,
+                 elastic: bool = False):
     model = GPT2Model(GPT2Config(
         vocab_size=256, n_positions=128,
         n_embd=int(os.environ.get("TEL_EMBD", 128)),
@@ -95,6 +96,12 @@ def build_engine(telemetry_enabled: bool, full: bool = False,
         # cadences. Compile events only happen during warmup; what this
         # measures is the steady-state fingerprint + ledger cost.
         "compile_plane": {"enabled": compile_plane},
+        # el mode: hostagg heartbeats EVERY step (worst-case cadence)
+        # feeding a dark ElasticCoordinator — one gather + one dict
+        # inspection per step when no host is missing
+        "hostagg": {"enabled": elastic, "interval": 1},
+        "elasticity": {"enabled": elastic,
+                       "ignore_non_elastic_batch_info": True},
     })
     return engine
 
@@ -211,21 +218,24 @@ def main():
     # one engine per mode; steps run in INTERLEAVED round-robin blocks so
     # machine drift (thermal, co-tenants) hits all modes equally —
     # sequential loops showed several % of drift, swamping the real cost
-    modes = {"off": (False, False, "", False),
-             "on": (True, False, "", False),
-             "full": (True, True, "", False),
-             "rec": (True, True, rec_dir, False),
-             "cp": (True, True, cp_dir, True)}
+    modes = {"off": (False, False, "", False, False),
+             "on": (True, False, "", False, False),
+             "full": (True, True, "", False, False),
+             "rec": (True, True, rec_dir, False, False),
+             "cp": (True, True, cp_dir, True, False),
+             "el": (True, True, "", False, True)}
     engines, times = {}, {name: [] for name in modes}
-    for name, (tel, full, rdir, cp) in modes.items():
+    for name, (tel, full, rdir, cp, el) in modes.items():
         engines[name] = build_engine(tel, full=full, recorder_dir=rdir,
-                                     compile_plane=cp)
+                                     compile_plane=cp, elastic=el)
     assert engines["full"].statusz is not None and \
         engines["full"].statusz.port > 0
     assert engines["rec"]._recorder is not None
     assert engines["cp"]._compile_plane is not None and \
         engines["cp"]._hbm is not None
-    for name, (tel, full, _rdir, _cp) in modes.items():  # compile + warmup
+    assert engines["el"]._elastic is not None and \
+        engines["el"]._hostagg is not None
+    for name, (tel, full, _rdir, _cp, _el) in modes.items():  # warmup
         _apply_mode(tel, full)
         run_block(engines[name], WARMUP)
 
@@ -233,7 +243,7 @@ def main():
     done = 0
     while done < STEPS:
         n = min(block, STEPS - done)
-        for name, (tel, full, _rdir, _cp) in modes.items():
+        for name, (tel, full, _rdir, _cp, _el) in modes.items():
             _apply_mode(tel, full)
             run_block(engines[name], n, collect=times[name])
         done += n
@@ -249,9 +259,12 @@ def main():
     # the compile plane saw exactly the warmup compile, then went quiet
     cp_ledger = engines["cp"]._compile_plane
     assert cp_ledger.compiles >= 1 and cp_ledger.recompiles == 0
+    # the dark coordinator aggregated every step and never latched
+    el = engines["el"]
+    assert el._hostagg.last is not None and not el._elastic.pending
     t_off, t_on = times["off"], times["on"]
     t_full, t_rec = times["full"], times["rec"]
-    t_cp = times["cp"]
+    t_cp, t_el = times["cp"], times["el"]
     for engine in engines.values():
         engine.close()
 
@@ -264,10 +277,12 @@ def main():
     full_ms = statistics.median(t_full) * 1e3
     rec_ms = statistics.median(t_rec) * 1e3
     cp_ms = statistics.median(t_cp) * 1e3
+    el_ms = statistics.median(t_el) * 1e3
     overhead_pct = 100.0 * (on_ms - off_ms) / off_ms
     overhead_full_pct = 100.0 * (full_ms - off_ms) / off_ms
     overhead_rec_pct = 100.0 * (rec_ms - off_ms) / off_ms
     overhead_cp_pct = 100.0 * (cp_ms - off_ms) / off_ms
+    overhead_el_pct = 100.0 * (el_ms - off_ms) / off_ms
     result = {
         "steps": STEPS,
         "step_ms_tracer_off_p50": round(off_ms, 4),
@@ -284,6 +299,8 @@ def main():
         "overhead_full_pct": round(overhead_full_pct, 3),
         "overhead_recorder_pct": round(overhead_rec_pct, 3),
         "overhead_compile_plane_pct": round(overhead_cp_pct, 3),
+        "step_ms_elastic_p50": round(el_ms, 4),
+        "overhead_elastic_pct": round(overhead_el_pct, 3),
         "serving_tick_ms_dark_p50": round(dt_off_ms, 4),
         "serving_tick_ms_disttrace_p50": round(dt_ms, 4),
         "overhead_disttrace_pct": round(overhead_dt_pct, 3),
@@ -311,6 +328,10 @@ def main():
         f"total observability overhead with the compile plane "
         f"(fingerprints + HBM ledger + overlap analyzer) "
         f"{overhead_cp_pct:.2f}% exceeds the {THRESHOLD_PCT}% budget")
+    assert overhead_el_pct < THRESHOLD_PCT, (
+        f"total observability overhead with per-step heartbeats + a "
+        f"dark ElasticCoordinator {overhead_el_pct:.2f}% exceeds the "
+        f"{THRESHOLD_PCT}% budget")
     assert overhead_dt_pct < THRESHOLD_PCT, (
         f"serving observability overhead with distributed tracing + "
         f"fleet aggregation armed {overhead_dt_pct:.2f}% exceeds the "
@@ -318,7 +339,8 @@ def main():
     print(f"OK: tracer-on overhead {overhead_pct:.2f}%, + goodput "
           f"ledger + statusz server {overhead_full_pct:.2f}%, + flight "
           f"recorder {overhead_rec_pct:.2f}%, + compile plane "
-          f"{overhead_cp_pct:.2f}%, serving fleet w/ distributed "
+          f"{overhead_cp_pct:.2f}%, + dark elastic coordinator "
+          f"{overhead_el_pct:.2f}%, serving fleet w/ distributed "
           f"tracing {overhead_dt_pct:.2f}% — all < {THRESHOLD_PCT}%")
 
 

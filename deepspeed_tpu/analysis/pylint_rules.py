@@ -257,7 +257,12 @@ _CONFIG_SOURCES = ("deepspeed_tpu/runtime/constants.py",
                    "deepspeed_tpu/runtime/config.py",
                    "deepspeed_tpu/serving/config.py",
                    "deepspeed_tpu/serving/fleet/config.py",
-                   "deepspeed_tpu/inference/config.py")
+                   "deepspeed_tpu/inference/config.py",
+                   # the elasticity block parses itself (ElasticityConfig
+                   # reads param_dict.get(...)); its keys and the fleet
+                   # AutoscaleConfig dataclass fields are the PR-14
+                   # config surface
+                   "deepspeed_tpu/elasticity/elasticity.py")
 
 #: keys read through non-static paths (getattr loops, env, kwargs)
 _EXTRA_KNOWN = {"seed"}

@@ -160,6 +160,51 @@ def _read_shard(path) -> Tuple[Dict[str, np.ndarray], Any, Any]:
     return flat, ckpt.get("args"), ckpt.get("checkpoint_version", 0)
 
 
+#: the per-layer tensors a GPT shard must carry, by flavor (moe swaps
+#: the dense MLP pair for the gate; experts live in separate shards)
+_LAYER_KEYS = ("input_layernorm.weight", "input_layernorm.bias",
+               "attention.query_key_value.weight",
+               "attention.query_key_value.bias",
+               "attention.dense.weight", "attention.dense.bias",
+               "post_attention_layernorm.weight",
+               "post_attention_layernorm.bias")
+_DENSE_MLP_KEYS = ("mlp.dense_h_to_4h.weight", "mlp.dense_h_to_4h.bias",
+                   "mlp.dense_4h_to_h.weight", "mlp.dense_4h_to_h.bias")
+_MOE_MLP_KEYS = ("mlp.deepspeed_moe.gate.wg.weight",)
+_GLOBAL_KEYS = ("wte", "wpe", "final_layernorm.weight",
+                "final_layernorm.bias")
+
+
+def _require_complete(merged: Dict[str, np.ndarray], layer_ids, is_moe,
+                      ckpt_dir: str):
+    """Structure gate for the merged shard set: every leaf the model
+    builder will consume must exist BEFORE assembly starts. A truncated
+    or mixed-family checkpoint (the old assumption: saved leaf count ==
+    live leaf count) fails here with the exact missing/extra leaf names
+    — not with a bare ``KeyError: 'layers.7.mlp...'`` halfway through
+    stacking (resilience.CheckpointLoadError carries the per-leaf diff,
+    mirroring the elastic loader's ``require_leaf_match``)."""
+    from ..resilience.manifest import CheckpointLoadError
+    per_layer = _LAYER_KEYS + (_MOE_MLP_KEYS if is_moe else _DENSE_MLP_KEYS)
+    want = set(_GLOBAL_KEYS)
+    for i in layer_ids:
+        want.update(f"layers.{i}.{k}" for k in per_layer)
+    have = set(merged)
+    missing = sorted(want - have)
+    if not missing:
+        return
+    extra = sorted(have - want)
+    raise CheckpointLoadError(
+        f"megatron checkpoint at {ckpt_dir!r} does not assemble into a "
+        f"{len(layer_ids)}-layer {'MoE' if is_moe else 'dense'} GPT: "
+        f"{len(missing)} leaf(s) missing "
+        f"({missing[:8]}{'...' if len(missing) > 8 else ''});"
+        f" {len(extra)} unconsumed leaf(s) present "
+        f"({extra[:8]}{'...' if len(extra) > 8 else ''})",
+        leaf_diff={"missing": missing, "extra": extra,
+                   "shape_mismatch": []})
+
+
 def load_megatron_checkpoint(ckpt_dir: str, tag: Optional[str] = None,
                              n_head: Optional[int] = None
                              ) -> Tuple[Any, Any]:
@@ -237,6 +282,7 @@ def load_megatron_checkpoint(ckpt_dir: str, tag: Optional[str] = None,
     v, d = merged["wte"].shape
     hd = d // n_head
     is_moe = any(".mlp.deepspeed_moe.gate." in k for k in merged)
+    _require_complete(merged, layer_ids, is_moe, ckpt_dir)
     if is_moe:
         inner = 4 * d  # ExpertFFN is fixed 4x (checked against shards below)
     else:

@@ -1,9 +1,38 @@
-"""Elastic training config math (reference deepspeed/elasticity)."""
+"""Elasticity — topology-free checkpoints, elastic resume, autoscaling.
+
+Three connected planes over the reference ``deepspeed/elasticity`` config
+math (elasticity.py):
+
+- **logical checkpoints** (logical.py): every tag records per-leaf
+  global shape + named-axis PartitionSpec + dtype plus the saving run's
+  topology and batch triangle, so any layout loads onto any mesh and a
+  structure drift fails with a per-leaf diff;
+- **elastic resume** (resize.py + coordinator.py): ``plan_resize`` /
+  ``elastic_resume`` recompute gas for a new world size preserving the
+  global batch; ``ElasticCoordinator`` turns hostagg heartbeat gaps into
+  emergency-save + shrink (``ElasticResizeRequired``) instead of a hang;
+- **serving autoscale** (serving/fleet/): the FleetRouter grows
+  ``scale_up``/``scale_down`` driven by SLO burn rate, configured by the
+  fleet ``autoscale`` block.
+"""
 
 from .elasticity import (ElasticityConfig, ElasticityConfigError,
                          ElasticityError, ElasticityIncompatibleWorldSize,
                          compute_elastic_config, get_valid_gpus)
+from .coordinator import ElasticCoordinator, ElasticResizeRequired
+from .logical import (build_logical_manifest, leaf_diff,
+                      read_logical_manifest, require_leaf_match,
+                      spec_from_json, spec_to_json,
+                      write_logical_manifest)
+from .resize import (ResizePlan, elastic_config, elastic_resume,
+                     plan_resize, read_topology)
 
 __all__ = ["compute_elastic_config", "get_valid_gpus", "ElasticityConfig",
            "ElasticityError", "ElasticityConfigError",
-           "ElasticityIncompatibleWorldSize"]
+           "ElasticityIncompatibleWorldSize",
+           "ElasticCoordinator", "ElasticResizeRequired",
+           "build_logical_manifest", "read_logical_manifest",
+           "write_logical_manifest", "leaf_diff", "require_leaf_match",
+           "spec_to_json", "spec_from_json",
+           "ResizePlan", "plan_resize", "read_topology",
+           "elastic_config", "elastic_resume"]

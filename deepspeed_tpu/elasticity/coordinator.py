@@ -1,0 +1,201 @@
+"""ElasticCoordinator — heartbeat gaps become a resize, not a hang.
+
+The host aggregator (telemetry/hostagg.py) already *names* a host whose
+step loop stopped advancing its heartbeat seqno; before this module that
+was a diagnostic (a 503 on /healthz, a gauge). The coordinator turns it
+into an actuator: when a host has missed ``hostagg.heartbeat_misses``
+consecutive aggregations, the surviving hosts
+
+1. fire the flight recorder with the new ``resize`` trigger kind — the
+   bundle embeds the before/after topology via the coordinator's
+   status provider while the evidence is fresh;
+2. write an **emergency checkpoint** through the PR-3 manifested path
+   (``engine.save_checkpoint``), so the resumable state is durable
+   before anything else happens;
+3. compute the **shrink plan** (elasticity/resize.py) for the surviving
+   world — same global batch, gas recomputed — and raise
+   ``ElasticResizeRequired`` carrying it.
+
+The training loop catches the exception exactly like
+``TrainingPreempted`` and calls ``elastic_resume`` on the surviving
+mesh instead of hanging in the next collective. A coordinator on a
+healthy fleet costs one dict inspection per hostagg aggregation (every
+``hostagg.interval`` steps) — dark by construction.
+"""
+
+import time
+from typing import Any, Dict, Optional
+
+from ..utils.logging import log_dist, logger
+from .elasticity import ElasticityError
+from .resize import ResizePlan, plan_resize
+
+__all__ = ["ElasticCoordinator", "ElasticResizeRequired"]
+
+
+class ElasticResizeRequired(ElasticityError):
+    """The fleet changed size under a running job: state is saved, a
+    resume plan is attached — re-initialize on the surviving mesh
+    (``elasticity.elastic_resume``) instead of hanging in the next
+    collective."""
+
+    def __init__(self, message, plan: Optional[ResizePlan] = None,
+                 checkpoint_dir: Optional[str] = None):
+        super().__init__(message)
+        self.plan = plan
+        self.checkpoint_dir = checkpoint_dir
+
+
+class ElasticCoordinator:
+    """Consumes hostagg aggregates; latches shrink-and-resume on a
+    heartbeat gap."""
+
+    def __init__(self, engine, config, recorder=None, tracer=None):
+        self.engine = engine
+        self.cfg = config
+        self.recorder = recorder
+        self.tracer = tracer if tracer is not None else engine.tracer
+        self._latched = False
+        self._gap: Dict[str, Any] = {}
+        self._exc: Optional["ElasticResizeRequired"] = None
+        self.resizes = 0
+        self.last_resize: Optional[Dict[str, Any]] = None
+        if recorder is not None:
+            # every bundle (not only resize ones) carries the elastic
+            # state: target topology, latch, last resize reason
+            recorder.add_provider("elasticity", self.summary)
+
+    # ------------------------------------------------------------ observe
+    def observe(self, agg: Dict[str, Any]):
+        """One hostagg aggregation result. Exports the dstpu_elastic_*
+        gauges; the first aggregation reporting missing heartbeats
+        latches the gap. The ACTION (save + plan + raise) happens at the
+        next step boundary via ``check()`` — after ``_post_step`` has
+        counted the completed step, so the emergency checkpoint resumes
+        exactly where an uninterrupted run would be (the same discipline
+        ``_check_preemption`` follows)."""
+        self._export(agg)
+        missing = agg.get("missing") or []
+        if not missing or self._latched:
+            return
+        self._latched = True
+        self._gap = {"missing": list(missing),
+                     "n_hosts": max(1, int(agg.get("n_hosts", 1)))}
+
+    @property
+    def pending(self) -> bool:
+        """A heartbeat gap is latched and the resize has not fired yet."""
+        return self._latched
+
+    def check(self):
+        """Step-boundary actuator: with a gap latched, fire the resize
+        bundle, write the emergency checkpoint, compute the shrink plan
+        and raise ``ElasticResizeRequired``. Once fired, every further
+        call re-raises — this engine's next collective would hang on the
+        dead host, so it must not run another step."""
+        if not self._latched:
+            return
+        if self._exc is not None:
+            raise self._exc
+        self.resizes += 1
+        missing = self._gap["missing"]
+        n_hosts = self._gap["n_hosts"]
+        doc = self._topology_doc()
+        world = doc["topology"]["world_size"]
+        per_host = max(1, world // n_hosts)
+        survivors = max(1, n_hosts - len(missing))
+        target_world = survivors * per_host
+        detail = (f"host(s) {missing} missed "
+                  f"{self.engine._hostagg.heartbeat_misses} heartbeat(s): "
+                  f"shrinking world {world} -> {target_world} "
+                  f"({survivors}/{n_hosts} hosts)")
+        log_dist(f"elasticity: {detail}", ranks=[0])
+        plan_err = plan = None
+        try:
+            plan = plan_resize(doc, target_world,
+                               micro_batches=self.cfg.micro_batches)
+        except ElasticityError as e:
+            plan_err = e             # still save + bundle before raising
+        self.last_resize = {
+            "kind": "shrink", "reason": detail, "time": time.time(),
+            "before": doc["topology"], "before_batch": doc["batch"],
+            "after": None if plan is None else {
+                "axes": {"pp": plan.pp, "dp": plan.dp // plan.ep,
+                         "ep": plan.ep, "sp": plan.sp, "tp": plan.tp},
+                "world_size": plan.world_size,
+            },
+            "after_batch": None if plan is None else {
+                "train_batch_size": plan.train_batch_size,
+                "micro": plan.micro, "gas": plan.gas,
+            },
+        }
+        if self.recorder is not None:
+            # bypasses debounce: the dying host's evidence has no second
+            # chance, and the bundle embeds before/after via summary()
+            self.recorder.trigger("resize", detail, force=True)
+        ckpt_dir = self._emergency_save()
+        self.last_resize["checkpoint_dir"] = ckpt_dir
+        self.tracer.set_counter("elastic/resizes", float(self.resizes),
+                                owner=self.engine)
+        if plan_err is not None:
+            self._exc = ElasticResizeRequired(
+                f"{detail}; state saved at {ckpt_dir} but no resume plan "
+                f"fits the survivors: {plan_err}",
+                checkpoint_dir=ckpt_dir)
+        else:
+            self._exc = ElasticResizeRequired(
+                f"{detail}; resume with elasticity.elastic_resume "
+                f"({plan.describe()}) from {ckpt_dir}",
+                plan=plan, checkpoint_dir=ckpt_dir)
+        raise self._exc
+
+    # ------------------------------------------------------------ helpers
+    def _topology_doc(self) -> Dict[str, Any]:
+        from .logical import build_logical_manifest
+        doc = build_logical_manifest(self.engine)
+        return {"topology": doc["topology"], "batch": doc["batch"]}
+
+    def _save_dir(self) -> Optional[str]:
+        rcfg = getattr(self.engine, "_resilience", None)
+        return (self.cfg.resize_save_dir or
+                getattr(rcfg, "emergency_checkpoint_dir", None) or
+                getattr(rcfg, "autosave_dir", None) or
+                self.engine._last_save_dir)
+
+    def _emergency_save(self) -> Optional[str]:
+        save_dir = self._save_dir()
+        if save_dir is None:
+            logger.warning(
+                "elasticity: heartbeat gap but no elasticity."
+                "resize_save_dir / resilience autosave dir configured and "
+                "no prior save; resuming will replay from the last "
+                "explicit checkpoint (if any)")
+            return None
+        with self.tracer.span("elastic_emergency_save", cat="resilience"):
+            self.engine.save_checkpoint(save_dir)
+        # the LOAD ROOT (not the tag dir): what elastic_resume takes —
+        # its read_topology resolves `latest` to the tag just written
+        return save_dir
+
+    def _export(self, agg: Dict[str, Any]):
+        mm = self.engine.mesh_manager
+        tr = self.tracer
+        own = self.engine
+        tr.set_counter("elastic/world_size",
+                       float(mm.mesh.devices.size), owner=own)
+        tr.set_counter("elastic/hosts_missing",
+                       float(len(agg.get("missing") or [])), owner=own)
+        tr.set_counter("elastic/resizes", float(self.resizes), owner=own)
+
+    # ------------------------------------------------------------ summary
+    def summary(self) -> Dict[str, Any]:
+        """The ``elasticity`` statusz/bundle section: current topology,
+        latch state, and the last resize's before/after."""
+        out: Dict[str, Any] = dict(self._topology_doc())
+        out["latched"] = self._latched
+        out["resizes"] = self.resizes
+        if self.last_resize is not None:
+            last = dict(self.last_resize)
+            last["age_s"] = round(max(0.0, time.time() - last["time"]), 1)
+            out["last_resize"] = last
+        return out

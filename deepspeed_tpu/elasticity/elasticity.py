@@ -56,6 +56,16 @@ class ElasticityConfig:
                            param_dict.get("prefer_larger_batch", True)))
         self.allowed_world_sizes = [
             int(x) for x in param_dict.get("allowed_world_sizes", [])]
+        # ---- elastic-resume coordinator (elasticity/coordinator.py) ----
+        #: with hostagg enabled, a host missing heartbeat_misses
+        #: aggregations triggers emergency save + shrink-and-resume
+        #: (ElasticResizeRequired) instead of a hang
+        self.resize_on_heartbeat_gap = bool(
+            param_dict.get("resize_on_heartbeat_gap", True))
+        #: where the coordinator's emergency checkpoint lands (falls back
+        #: to resilience.emergency_checkpoint_dir / autosave_dir / the
+        #: last explicit save directory)
+        self.resize_save_dir = param_dict.get("resize_save_dir", None)
         if any(m <= 0 for m in self.micro_batches):
             raise ElasticityConfigError(
                 f"micro_batch_sizes must be positive: {self.micro_batches}")

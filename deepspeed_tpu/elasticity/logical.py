@@ -1,0 +1,215 @@
+"""Logical shardings — the topology-free half of a checkpoint.
+
+Checkpoints here already store GLOBAL arrays (runtime/checkpointing.py),
+so any tag can reshard onto any mesh at load time. What a global array
+alone cannot answer is *what layout the run intended* and *whether the
+live model matches what was saved*. This module records both:
+
+- ``shardings.json`` — written into every checkpoint tag next to
+  ``model_states.msgpack``: one record per leaf (global shape +
+  named-axis PartitionSpec + dtype) for params and optimizer state,
+  plus the saving run's mesh topology (pp/dp/ep/sp/tp axis sizes,
+  world size, process count) and batch triangle (global batch, micro,
+  gas). The file is covered by the PR-3 integrity manifest like every
+  other file of the tag, so a torn write is caught at load time.
+- **per-leaf structure diff** — the loader compares the live model's
+  leaf set against the checkpoint's BEFORE any ``device_put``:
+  a mismatch raises ``CheckpointLoadError`` naming every missing and
+  extra leaf (and shape mismatches), instead of the megatron-era
+  "saved leaf count != live leaf count" tree-map crash.
+
+``elasticity/resize.py`` consumes the topology/batch documents to plan
+a resume on a different world size; nothing in this module imports the
+engine, so offline tools can read the manifest without jax state.
+"""
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..resilience.manifest import CheckpointLoadError
+
+__all__ = ["SHARDINGS_NAME", "spec_to_json", "spec_from_json",
+           "logical_records", "build_logical_manifest",
+           "write_logical_manifest", "read_logical_manifest",
+           "leaf_paths", "leaf_diff", "require_leaf_match"]
+
+#: file name inside a checkpoint tag directory
+SHARDINGS_NAME = "shardings.json"
+
+
+def _path_str(path) -> str:
+    """KeyPath -> 'blocks/qkv_w' (DictKey), 'm/0' (sequences), '.count'
+    (attrs) — a stable, human-readable leaf name."""
+    parts = []
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if key is None:
+            key = getattr(entry, "idx", None)
+        if key is None:
+            key = getattr(entry, "name", None)
+        parts.append(str(key) if key is not None else str(entry))
+    return "/".join(parts) if parts else "<root>"
+
+
+def spec_to_json(spec) -> List[Any]:
+    """PartitionSpec -> JSON list: axis name, null (replicated dim), or a
+    list of axis names for a multi-axis dim."""
+    out: List[Any] = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(a) for a in entry])
+        else:
+            out.append(str(entry))
+    return out
+
+
+def spec_from_json(doc) -> "Any":
+    """JSON list -> PartitionSpec (inverse of spec_to_json)."""
+    from jax.sharding import PartitionSpec as P
+    entries = []
+    for entry in doc:
+        if entry is None:
+            entries.append(None)
+        elif isinstance(entry, list):
+            entries.append(tuple(entry))
+        else:
+            entries.append(str(entry))
+    return P(*entries)
+
+
+def logical_records(shapes_tree, shardings_tree) -> Dict[str, dict]:
+    """Per-leaf {path: {shape, dtype, spec}} from matching pytrees of
+    shape structs (or arrays) and NamedShardings."""
+    import jax
+    shape_leaves = jax.tree_util.tree_flatten_with_path(shapes_tree)[0]
+    shard_leaves = jax.tree_util.tree_leaves(shardings_tree)
+    out: Dict[str, dict] = {}
+    for (path, leaf), sh in zip(shape_leaves, shard_leaves):
+        spec = getattr(sh, "spec", None)
+        out[_path_str(path)] = {
+            "shape": [int(d) for d in leaf.shape],
+            "dtype": str(np.dtype(leaf.dtype)),
+            "spec": spec_to_json(spec) if spec is not None else [],
+        }
+    return out
+
+
+def build_logical_manifest(engine) -> Dict[str, Any]:
+    """The shardings.json document for one engine: topology + batch
+    triangle + per-leaf logical shardings for params and (when present)
+    optimizer state."""
+    import jax
+    mm = engine.mesh_manager
+    cfg = engine._config
+    doc: Dict[str, Any] = {
+        "version": 1,
+        "topology": {
+            "axes": {"pp": mm.pp, "dp": mm.dp, "ep": mm.ep,
+                     "sp": mm.sp, "tp": mm.tp},
+            "world_size": int(mm.mesh.devices.size),
+            "processes": int(jax.process_count()),
+            "zero_stage": int(engine.zero_stage),
+        },
+        "batch": {
+            "train_batch_size": int(cfg.train_batch_size),
+            "micro": int(cfg.train_micro_batch_size_per_gpu),
+            "gas": int(cfg.gradient_accumulation_steps),
+            "dp": int(engine.dp_world_size),
+        },
+        "seed": int(getattr(cfg, "seed", 0)),
+        "params": logical_records(engine.param_shapes,
+                                  engine.param_shardings),
+    }
+    if engine.opt_state is not None and \
+            engine.opt_state_shardings is not None:
+        doc["opt_state"] = logical_records(engine.opt_state,
+                                           engine.opt_state_shardings)
+    else:
+        doc["opt_state"] = None
+    return doc
+
+
+def write_logical_manifest(engine, ckpt_dir: str) -> str:
+    """Write ``<ckpt_dir>/shardings.json`` atomically (tmp + fsync +
+    replace, same discipline as the integrity manifest that will cover
+    it)."""
+    doc = build_logical_manifest(engine)
+    out = os.path.join(ckpt_dir, SHARDINGS_NAME)
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, out)
+    return out
+
+
+def read_logical_manifest(ckpt_dir: str) -> Optional[Dict[str, Any]]:
+    """The shardings.json document of a tag directory, or None for a
+    pre-elasticity checkpoint (global arrays still reshard fine — the
+    resize planner just has nothing to preserve the batch triangle
+    against)."""
+    path = os.path.join(ckpt_dir, SHARDINGS_NAME)
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+# ------------------------------------------------------------- leaf diff
+
+def leaf_paths(tree) -> Dict[str, Tuple[int, ...]]:
+    """{path: shape} for every leaf of a pytree (shape () for leaves
+    without one)."""
+    import jax
+    out: Dict[str, Tuple[int, ...]] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        out[_path_str(path)] = tuple(int(d) for d in shape)
+    return out
+
+
+def leaf_diff(expected_tree, got_tree) -> Dict[str, list]:
+    """Structure diff between the live model's tree and a loaded one:
+    ``missing`` (live leaves absent from the checkpoint), ``extra``
+    (checkpoint leaves the live model has no home for), and
+    ``shape_mismatch`` entries 'path: saved (a, b) vs live (c, d)'."""
+    want = leaf_paths(expected_tree)
+    got = leaf_paths(got_tree)
+    missing = sorted(set(want) - set(got))
+    extra = sorted(set(got) - set(want))
+    shape_mismatch = []
+    for path in sorted(set(want) & set(got)):
+        if want[path] and got[path] and want[path] != got[path]:
+            shape_mismatch.append(
+                f"{path}: saved {got[path]} vs live {want[path]}")
+    return {"missing": missing, "extra": extra,
+            "shape_mismatch": shape_mismatch}
+
+
+def require_leaf_match(expected_tree, got_tree, what: str, where: str):
+    """Raise ``CheckpointLoadError`` naming every missing/extra leaf when
+    the loaded tree cannot restore into the live model. The resharding
+    loader calls this BEFORE any device_put, so a leaf-count drift (the
+    megatron-era assumption that saved == live) fails with the exact
+    leaves instead of a tree-map arity error."""
+    diff = leaf_diff(expected_tree, got_tree)
+    if not (diff["missing"] or diff["extra"] or diff["shape_mismatch"]):
+        return
+    parts = []
+    if diff["missing"]:
+        parts.append(f"missing from checkpoint: {diff['missing']}")
+    if diff["extra"]:
+        parts.append(f"extra in checkpoint: {diff['extra']}")
+    if diff["shape_mismatch"]:
+        parts.append(f"shape mismatch: {diff['shape_mismatch']}")
+    raise CheckpointLoadError(
+        f"{what} at {where} does not match the live model "
+        f"({len(diff['missing'])} missing / {len(diff['extra'])} extra / "
+        f"{len(diff['shape_mismatch'])} reshaped leaf(s)): "
+        + "; ".join(parts), leaf_diff=diff)

@@ -1,0 +1,225 @@
+"""Elastic resume — resize a training run onto a different world size.
+
+Checkpoints store global arrays plus a logical-sharding manifest
+(elasticity/logical.py), so the *data* reshards onto any mesh for free.
+What must be recomputed is the batch triangle: the global batch size is a
+training hyperparameter and survives a resize; the data-parallel degree
+changes with the world, so gradient-accumulation steps absorb the
+difference::
+
+    gas_new = train_batch_size / (micro * dp_new)
+
+``plan_resize`` reads the saved topology document and solves that for a
+target world size (keeping the saved model-parallel axes unless
+overridden, shrinking the micro batch when the saved one no longer
+divides), and ``elastic_resume`` is the one-call path: read the saved
+topology, rewrite the config for the current device set, build the
+engine, load the checkpoint — a dp=8/tp=2 run resumes as dp=4/tp=4 or on
+half the hosts without touching the training script's hyperparameters.
+"""
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..resilience.manifest import CheckpointLoadError, list_tags
+from ..utils.logging import log_dist
+from .elasticity import ElasticityIncompatibleWorldSize
+from .logical import read_logical_manifest
+
+__all__ = ["ResizePlan", "plan_resize", "read_topology", "elastic_config",
+           "elastic_resume"]
+
+#: config keys a resize plan rewrites
+_AXIS_KEYS = {"tp": "tensor_parallel_size", "pp": "pipeline_parallel_size",
+              "sp": "sequence_parallel_size", "ep": "expert_parallel_size"}
+
+
+@dataclasses.dataclass
+class ResizePlan:
+    """One resolved resume topology: the mesh axes and batch triangle a
+    checkpoint saved under ``saved`` should run with at ``world_size``."""
+
+    world_size: int
+    dp: int
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    train_batch_size: int = 0
+    micro: int = 0
+    gas: int = 0
+    #: the saving run's topology/batch document (shardings.json)
+    saved: Optional[Dict[str, Any]] = None
+
+    def config_overrides(self) -> Dict[str, Any]:
+        """The keys to merge over a training config dict so the batch
+        triangle solves to this plan on the new world."""
+        return {
+            "train_batch_size": self.train_batch_size,
+            "train_micro_batch_size_per_gpu": self.micro,
+            "gradient_accumulation_steps": self.gas,
+            "tensor_parallel_size": self.tp,
+            "pipeline_parallel_size": self.pp,
+            "sequence_parallel_size": self.sp,
+            "expert_parallel_size": self.ep,
+        }
+
+    def describe(self) -> str:
+        before = ""
+        if self.saved:
+            ax = self.saved.get("topology", {}).get("axes", {})
+            b = self.saved.get("batch", {})
+            before = (f"dp{ax.get('dp', '?')}/tp{ax.get('tp', '?')}"
+                      f"/pp{ax.get('pp', '?')} gas={b.get('gas', '?')} -> ")
+        return (f"{before}dp{self.dp}/tp{self.tp}/pp{self.pp} "
+                f"world={self.world_size} batch={self.train_batch_size} "
+                f"micro={self.micro} gas={self.gas}")
+
+
+def read_topology(load_dir: str, tag: Optional[str] = None
+                  ) -> Dict[str, Any]:
+    """The logical manifest of a checkpoint directory (resolving
+    ``latest`` when no tag is given, newest→oldest over tags carrying a
+    shardings.json). Raises ``CheckpointLoadError`` naming the directory
+    and tags when no tag carries one."""
+    load_dir = str(load_dir)
+    if tag is not None:
+        candidates = [str(tag)]
+    else:
+        latest = os.path.join(load_dir, "latest")
+        candidates = []
+        if os.path.isfile(latest):
+            with open(latest) as f:
+                name = f.read().strip()
+            if name:
+                candidates.append(name)
+        candidates += [t for t in list_tags(load_dir)
+                       if t not in candidates]
+        if os.path.isfile(os.path.join(load_dir, "shardings.json")):
+            candidates.append("")      # load_dir IS the tag directory
+    for cand in candidates:
+        doc = read_logical_manifest(
+            os.path.join(load_dir, cand) if cand else load_dir)
+        if doc is not None:
+            return doc
+    raise CheckpointLoadError(
+        f"no shardings.json under {load_dir!r} (tried tags "
+        f"{candidates or 'none'}): checkpoint predates topology-free "
+        f"saves — pass the batch triangle explicitly instead of "
+        f"elastic_resume")
+
+
+def _solve_micro(batch: int, dp: int, preferred: int,
+                 micro_batches: Optional[Sequence[int]]) -> Optional[int]:
+    """Largest usable micro batch: the saved one when it still divides,
+    else the largest candidate (configured ``micro_batch_sizes`` or the
+    divisors of batch/dp) that keeps gas integral."""
+    if batch % dp == 0 and (batch // dp) % preferred == 0:
+        return preferred
+    if batch % dp != 0:
+        return None
+    per = batch // dp
+    cands: List[int] = sorted(
+        (int(m) for m in micro_batches), reverse=True) \
+        if micro_batches else list(range(min(preferred, per), 0, -1))
+    for m in cands:
+        if m >= 1 and per % m == 0:
+            return m
+    return None
+
+
+def plan_resize(saved: Dict[str, Any], world_size: int,
+                micro_batches: Optional[Sequence[int]] = None,
+                **axes) -> ResizePlan:
+    """Solve the batch triangle for ``world_size`` devices against a
+    saved topology document. Keyword axes (``tp=4``, ``pp=2``, ...)
+    override the saved model-parallel degrees; dp absorbs the rest.
+    Raises ``ElasticityIncompatibleWorldSize`` when no integral gas
+    preserves the global batch."""
+    topo = saved.get("topology", {})
+    batch_doc = saved.get("batch", {})
+    saved_axes = dict(topo.get("axes", {}))
+    plan_axes = {name: int(axes.get(name, saved_axes.get(name, 1)) or 1)
+                 for name in ("tp", "pp", "sp", "ep")}
+    # ep carves experts out of the data-parallel degree (engine invariant:
+    # ep divides dp), so the model-parallel product excludes it
+    mp = plan_axes["tp"] * plan_axes["pp"] * plan_axes["sp"]
+    if world_size % mp != 0:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} not divisible by "
+            f"tp*pp*sp={mp} ({plan_axes}); override the model-parallel "
+            f"axes to fit the new world")
+    dp = world_size // mp
+    if dp % plan_axes["ep"] != 0:
+        raise ElasticityIncompatibleWorldSize(
+            f"data-parallel degree {dp} not divisible by "
+            f"ep={plan_axes['ep']}")
+    batch = int(batch_doc.get("train_batch_size", 0))
+    if batch <= 0:
+        raise ElasticityIncompatibleWorldSize(
+            f"saved topology document carries no train_batch_size: "
+            f"{batch_doc}")
+    micro = _solve_micro(batch, dp, int(batch_doc.get("micro", 1)),
+                         micro_batches)
+    if micro is None:
+        raise ElasticityIncompatibleWorldSize(
+            f"global batch {batch} cannot be preserved at dp={dp} "
+            f"(world {world_size}, mp {mp}): no micro batch size divides "
+            f"batch/dp — pick a world size from the elastic plan or "
+            f"change micro_batch_sizes")
+    return ResizePlan(world_size=world_size, dp=dp, **plan_axes,
+                      train_batch_size=batch, micro=micro,
+                      gas=batch // (micro * dp), saved=saved)
+
+
+def elastic_config(config: Dict[str, Any], load_dir: str,
+                   world_size: int, tag: Optional[str] = None,
+                   **axes) -> Dict[str, Any]:
+    """A copy of ``config`` whose batch triangle and mesh axes are
+    rewritten for ``world_size`` devices, preserving the checkpoint's
+    global batch size. Axis overrides default to the CONFIG's explicit
+    values (so a config that asks for tp=4 resumes as tp=4), then the
+    saved ones."""
+    saved = read_topology(load_dir, tag=tag)
+    for name, key in _AXIS_KEYS.items():
+        if name not in axes and key in config:
+            axes[name] = int(config[key])
+    el = (config.get("elasticity") or {})
+    micro_batches = el.get("micro_batch_sizes")
+    plan = plan_resize(saved, world_size, micro_batches=micro_batches,
+                       **axes)
+    out = dict(config)
+    out.update(plan.config_overrides())
+    return out
+
+
+def elastic_resume(model, config: Dict[str, Any], load_dir: str,
+                   tag: Optional[str] = None, devices=None,
+                   load_optimizer_states: bool = True, **initialize_kwargs):
+    """Resume a checkpoint on whatever devices this process has now.
+
+    Reads the tag's logical manifest, recomputes the batch triangle for
+    the current world size (``elastic_config``), builds the engine on a
+    fresh mesh over ``devices`` (default: all visible), and loads the
+    checkpoint — params, optimizer moments and the RNG stream restore
+    bit-identically regardless of the saved topology. Returns
+    ``(engine, client_state, plan)``."""
+    import deepspeed_tpu
+    from ..parallel.topology import default_devices, initialize_mesh
+    devices = list(devices) if devices is not None else default_devices()
+    cfg2 = elastic_config(config, load_dir, len(devices), tag=tag)
+    plan = plan_resize(read_topology(load_dir, tag=tag), len(devices),
+                       tp=cfg2["tensor_parallel_size"],
+                       pp=cfg2["pipeline_parallel_size"],
+                       sp=cfg2["sequence_parallel_size"],
+                       ep=cfg2["expert_parallel_size"])
+    mm = initialize_mesh(pp=plan.pp, dp=plan.dp // plan.ep, ep=plan.ep,
+                         sp=plan.sp, tp=plan.tp, devices=devices)
+    engine = deepspeed_tpu.initialize(model=model, config=cfg2,
+                                      mesh_manager=mm,
+                                      **initialize_kwargs)[0]
+    _, client_state = engine.load_checkpoint(
+        load_dir, tag=tag, load_optimizer_states=load_optimizer_states)
+    log_dist(f"elastic_resume: {plan.describe()}", ranks=[0])
+    return engine, client_state, plan

@@ -34,7 +34,16 @@ _STEP_RE = re.compile(r"(\d+)\s*$")
 class CheckpointLoadError(RuntimeError):
     """No loadable checkpoint. The message names the directory scanned and
     every tag found, so the fix (wrong dir vs. all tags corrupt vs. nothing
-    ever saved) is actionable from the traceback alone."""
+    ever saved) is actionable from the traceback alone.
+
+    When the failure is a structure mismatch between the checkpoint and
+    the live model, ``leaf_diff`` carries the per-leaf breakdown
+    (``missing`` / ``extra`` / ``shape_mismatch`` — see
+    elasticity/logical.py) so callers can react programmatically."""
+
+    def __init__(self, message, leaf_diff=None):
+        super().__init__(message)
+        self.leaf_diff = leaf_diff
 
 
 def file_sha256(path: str, chunk: int = 1 << 20) -> str:

@@ -227,11 +227,24 @@ def _save_checkpoint_files(engine, ckpt_engine, _save, ckpt_dir, tag,
                              hasattr(engine.lr_scheduler, "state_dict") else None),
             "client_state": client_state or {},
             "dp_world_size": engine.dp_world_size,
+            # the per-step RNG stream root: restoring it (instead of
+            # re-deriving from config seed) keeps the fold_in(micro_steps)
+            # stream bit-identical across a resize-resume even when the
+            # resumed config drifts
+            "rng_key": np.asarray(engine._base_rng,
+                                  np.uint32).reshape(-1).tolist(),
         }
         with open(os.path.join(ckpt_dir, "engine_state.json"), "w") as f:
             json.dump(engine_state, f, indent=2, default=str)
         with open(os.path.join(ckpt_dir, "ds_config.json"), "w") as f:
             json.dump(engine._config._param_dict, f, indent=2, default=str)
+        # logical-sharding manifest (elasticity/logical.py): per-leaf
+        # global shape + PartitionSpec + dtype, and the saving run's
+        # topology + batch triangle — what elastic_resume replans against.
+        # Written before write_manifest runs, so the integrity manifest
+        # covers it like every other file of the tag.
+        from ..elasticity.logical import write_logical_manifest
+        write_logical_manifest(engine, ckpt_dir)
 
 
 def _engine_for_layout(config, model_states_path):
@@ -305,6 +318,13 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
                                    load_lr_scheduler_states,
                                    load_module_only)
             except Exception as e:  # torn state that slipped past verify
+                if isinstance(e, CheckpointLoadError) and \
+                        e.leaf_diff is not None:
+                    # structure drift, not corruption: every tag of this
+                    # directory has the same leaf set, so falling back
+                    # newest->oldest can only mask the real error — the
+                    # per-leaf diff propagates as-is
+                    raise
                 logger.warning(f"checkpoint {ckpt_dir} unreadable: {e}")
                 errors.append(f"{cand}: {type(e).__name__}: {e}")
                 continue
@@ -343,6 +363,13 @@ def _load_tag(engine, ckpt_dir, rcfg, tracer, load_optimizer_states,
     optim = _load(ckpt_engine.load,
                   os.path.join(ckpt_dir, "optim_states.msgpack"),
                   label="ckpt load optim_states") if need_optim else None
+    # structure gate BEFORE any state mutates: a checkpoint whose leaf
+    # set drifted from the live model (renamed/added/removed params)
+    # fails naming every missing/extra leaf — not with a tree-map arity
+    # error after half the tree moved to device
+    from ..elasticity.logical import require_leaf_match
+    require_leaf_match(engine.param_shapes, params,
+                       what="model_states", where=ckpt_dir)
     if offload is not None:
         # checkpoint holds fp32 masters; host offload owns them — the
         # device-param refresh happens ONCE at the end (after optimizer
@@ -363,6 +390,11 @@ def _load_tag(engine, ckpt_dir, rcfg, tracer, load_optimizer_states,
             engine.global_samples = engine_state.get("global_samples", 0)
             engine.micro_steps = engine_state.get("micro_steps", 0)
             engine.skipped_steps = engine_state.get("skipped_steps", 0)
+            if engine_state.get("rng_key") is not None:
+                # restore the per-step RNG stream root bit-exactly (a
+                # pre-elasticity checkpoint re-derives it from the seed)
+                engine._base_rng = jnp.asarray(engine_state["rng_key"],
+                                               jnp.uint32)
             if (load_lr_scheduler_states and engine.lr_scheduler is not None
                     and engine_state.get("lr_scheduler") is not None):
                 engine.lr_scheduler.load_state_dict(engine_state["lr_scheduler"])
@@ -465,13 +497,11 @@ def load_params_for_inference(load_dir, tag=None, like=None, shardings=None,
             f"{candidates}; errors: {errors}")
     params = get_fp32_state_dict_from_checkpoint(ckpt_dir)
     if like is not None:
-        want = jax.tree.structure(like)
-        got = jax.tree.structure(params)
-        if want != got:
-            raise ValueError(
-                f"checkpoint at {ckpt_dir} does not match the serving "
-                f"model's parameter structure:\n  model: {want}\n  "
-                f"checkpoint: {got}")
+        # per-leaf diff instead of dumping two treedefs: the error names
+        # the exact missing/extra leaves (CheckpointLoadError.leaf_diff)
+        from ..elasticity.logical import require_leaf_match
+        require_leaf_match(like, params, what="serving params",
+                           where=ckpt_dir)
     if cast is not None:
         params = jax.tree.map(lambda x: cast(jnp.asarray(x)), params)
     if shardings is not None:

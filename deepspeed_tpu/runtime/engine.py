@@ -434,6 +434,20 @@ class DeepSpeedEngine:
             from ..telemetry.hostagg import HostAggregator
             self._hostagg = HostAggregator(cfg.hostagg, tracer=self.tracer,
                                            owner=self)
+        # elastic coordinator (elasticity/coordinator.py): with the
+        # elasticity block enabled, a hostagg heartbeat gap becomes
+        # emergency-save + shrink-and-resume (ElasticResizeRequired)
+        # instead of a hang in the next collective. Costs one dict
+        # inspection per aggregation when nothing is wrong.
+        self._elastic = None
+        el_dict = (cfg._param_dict or {}).get("elasticity") or {}
+        if el_dict.get("enabled") and self._hostagg is not None:
+            from ..elasticity import ElasticCoordinator, ElasticityConfig
+            el_cfg = ElasticityConfig(el_dict)
+            if el_cfg.resize_on_heartbeat_gap:
+                self._elastic = ElasticCoordinator(
+                    self, el_cfg, recorder=self._recorder,
+                    tracer=self.tracer)
         # compile/memory plane (telemetry/compileplane.py + overlap.py):
         # compile ledger with recompile diffs + cost/memory analysis, HBM
         # role ledger, collective-overlap analyzer. Off by default = no
@@ -507,6 +521,8 @@ class DeepSpeedEngine:
                 # a host with a heartbeat gap is a pod problem: flip
                 # /healthz so the operator's probe sees it
                 self.statusz.register_health("hosts", self._hostagg.health)
+            if self._elastic is not None:
+                self.statusz.register("elasticity", self._elastic.summary)
             if self._compile_plane is not None:
                 self.statusz.register("compile_plane",
                                       self._compile_plane.summary)
@@ -1155,6 +1171,11 @@ class DeepSpeedEngine:
         assert self.optimizer is not None
         cfg = self._config
         self._check_preemption()
+        if self._elastic is not None:
+            # a latched heartbeat gap becomes emergency-save +
+            # ElasticResizeRequired here, BEFORE the next collective
+            # would hang on the dead host
+            self._elastic.check()
         # flight recorder: the step record's wall time starts here so an
         # injected (or real) input-pipeline stall is part of the step the
         # operator sees — the record's goodput deltas attribute it
@@ -1552,6 +1573,12 @@ class DeepSpeedEngine:
                     f"{res['max_ms']:.1f}ms vs median "
                     f"{res['median_ms']:.1f}ms ({res['spread']:.2f}x)",
                     step=self.global_steps)
+            if res and self._elastic is not None:
+                # latch only — the emergency save + ElasticResizeRequired
+                # fire at the NEXT step boundary (train_batch calls
+                # _elastic.check() beside _check_preemption), after
+                # _post_step counted this completed step
+                self._elastic.observe(res)
 
     def _next_gas_batch(self, data_iter):
         """Stack gas micro-batches from an iterator into [gas, ...] leaves.

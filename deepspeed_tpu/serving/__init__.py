@@ -13,7 +13,8 @@ from .config import (ChunkedPrefillConfig, DraftConfig, KVQuantConfig,
                      PrefixCacheConfig, ServingConfig, SLOConfig,
                      SpeculativeConfig, TenantConfig)
 from .engine import ServingEngine
-from .fleet import (FleetConfig, FleetRequest, FleetRouter, KVHandoff,
+from .fleet import (AutoscaleConfig, FleetConfig, FleetRequest,
+                    FleetRouter, KVHandoff,
                     RadixPrefixCache, ReplicaHandle, build_fleet)
 from .kv_slots import SlotPool
 from .metrics import FleetMetrics, ServingMetrics
@@ -28,6 +29,6 @@ __all__ = [
     "ServingEngine", "SlotPool", "ServingMetrics", "FleetMetrics",
     "ContinuousBatchingScheduler", "QueueFull", "RateLimited", "Request",
     "RequestState", "SamplingParams", "TenantQueues",
-    "FleetConfig", "FleetRouter", "FleetRequest", "KVHandoff",
+    "AutoscaleConfig", "FleetConfig", "FleetRouter", "FleetRequest", "KVHandoff",
     "RadixPrefixCache", "ReplicaHandle", "build_fleet",
 ]

@@ -8,14 +8,14 @@ of the slot KV pool (prefix_cache) — plus the fleet config block
 (config) and per-replica probe/backoff handles (replica).
 """
 
-from .config import FleetConfig
+from .config import AutoscaleConfig, FleetConfig
 from .handoff import InProcessTransport, KVHandoff
 from .prefix_cache import PrefixHit, RadixPrefixCache, reuse_plan
 from .replica import ReplicaHandle
 from .router import FleetRequest, FleetRouter, build_fleet
 
 __all__ = [
-    "FleetConfig", "KVHandoff", "InProcessTransport",
+    "AutoscaleConfig", "FleetConfig", "KVHandoff", "InProcessTransport",
     "RadixPrefixCache", "PrefixHit", "reuse_plan",
     "ReplicaHandle", "FleetRouter", "FleetRequest", "build_fleet",
 ]

@@ -13,7 +13,70 @@ from typing import Any, Optional
 
 from ...runtime.config_utils import ConfigError, DeepSpeedConfigModel
 
-__all__ = ["FleetConfig"]
+__all__ = ["AutoscaleConfig", "FleetConfig"]
+
+
+@dataclasses.dataclass
+class AutoscaleConfig(DeepSpeedConfigModel):
+    """SLO-driven replica autoscaling (the fleet ``autoscale`` block).
+
+    The actuator half of the PR-4 SLO plane: the router already computes
+    a per-replica error-budget burn rate; with this block enabled it
+    *acts* on the fleet-wide worst burn instead of only routing around
+    it. Scale-up spawns a replica (``build_fleet``'s factory) when burn
+    stays above ``scale_up_burn`` for ``sustain_s``; scale-down drains
+    the least-loaded replica — new traffic stops routing to it, running
+    requests finish in place, then it is removed — when burn stays at or
+    below ``scale_down_burn`` AND total queue depth stays at or below
+    ``scale_down_queue`` for the same window. ``cooldown_s`` separates
+    consecutive actions so one burst cannot saw the fleet up and down.
+    """
+
+    enabled: bool = False
+    #: replica-count bounds the controller never crosses
+    min_replicas: int = 1
+    max_replicas: int = 4
+    #: fleet-wide worst per-replica burn rate (violation_rate/(1-target))
+    #: that must be SUSTAINED to grow the fleet. 1.0 = exactly burning
+    #: the whole error budget
+    scale_up_burn: float = 1.0
+    #: burn at or below this (together with a quiet queue) marks spare
+    #: capacity worth giving back
+    scale_down_burn: float = 0.25
+    #: router pending + replica queue depth must be at or below this for
+    #: scale-down eligibility (work waiting anywhere vetoes a shrink)
+    scale_down_queue: int = 0
+    #: seconds a condition must hold before the controller acts — burn
+    #: gauges are windowed percentile sources; one bad sample is noise
+    sustain_s: float = 2.0
+    #: minimum seconds between consecutive scale actions
+    cooldown_s: float = 10.0
+    #: a draining replica that cannot finish its running requests within
+    #: this window is force-evicted (the PR-8 failover path re-enqueues
+    #: them onto survivors, exactly-once preserved) so a wedged request
+    #: cannot pin the fleet above target forever
+    drain_timeout_s: float = 30.0
+
+    def validate(self):
+        if self.min_replicas < 1:
+            raise ConfigError("autoscale.min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ConfigError(
+                f"autoscale.max_replicas ({self.max_replicas}) must be >= "
+                f"min_replicas ({self.min_replicas})")
+        if self.scale_up_burn <= 0:
+            raise ConfigError("autoscale.scale_up_burn must be > 0")
+        if not (0 <= self.scale_down_burn < self.scale_up_burn):
+            raise ConfigError(
+                f"autoscale.scale_down_burn ({self.scale_down_burn}) must "
+                f"be in [0, scale_up_burn={self.scale_up_burn})")
+        if self.scale_down_queue < 0:
+            raise ConfigError("autoscale.scale_down_queue must be >= 0")
+        for name in ("sustain_s", "cooldown_s"):
+            if getattr(self, name) < 0:
+                raise ConfigError(f"autoscale.{name} must be >= 0")
+        if self.drain_timeout_s <= 0:
+            raise ConfigError("autoscale.drain_timeout_s must be > 0")
 
 
 @dataclasses.dataclass
@@ -78,6 +141,11 @@ class FleetConfig(DeepSpeedConfigModel):
     #: (build_fleet copies it down), so one JSON defines the policy once
     tenants: Any = None
 
+    #: autoscale (dict -> AutoscaleConfig): SLO-burn-driven replica
+    #: count control. None/disabled = the replica count is the
+    #: launch-time constant it always was
+    autoscale: Any = None
+
     def validate(self):
         if self.replicas < 1:
             raise ConfigError("fleet.replicas must be >= 1")
@@ -113,6 +181,26 @@ class FleetConfig(DeepSpeedConfigModel):
             from ..config import TenantConfig
             self.tenants = TenantConfig.from_dict(self.tenants)
             self.tenants.validate()
+        if isinstance(self.autoscale, dict):
+            self.autoscale = AutoscaleConfig.from_dict(self.autoscale)
+        if self.autoscale is not None:
+            self.autoscale.validate()
+            if self.autoscale.enabled and self.prefill_replicas:
+                # role counts are a coupled pair (prefill output must land
+                # on a decode pool with capacity for it); a burn signal
+                # alone cannot tell WHICH tier to grow — autoscaling a
+                # disaggregated fleet needs per-tier policies this block
+                # does not model (docs/elasticity.md: when NOT to
+                # autoscale)
+                raise ConfigError(
+                    "autoscale requires a unified fleet "
+                    "(prefill_replicas/decode_replicas = 0)")
+            if self.autoscale.enabled and \
+                    self.replicas < self.autoscale.min_replicas:
+                raise ConfigError(
+                    f"fleet.replicas ({self.replicas}) below "
+                    f"autoscale.min_replicas "
+                    f"({self.autoscale.min_replicas})")
 
     def roles(self) -> list:
         """Per-replica role list, prefill first (handoff producers warm

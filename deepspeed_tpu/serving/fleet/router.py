@@ -24,6 +24,12 @@ route to *prefill* replicas; each completed prompt pass comes back
 through the handoff sink and is forwarded — KV lane and Request object
 together — to the least-loaded *decode* replica, which continues the
 token loop in its own slot pool.
+
+With the ``autoscale`` block (docs/elasticity.md), the replica count
+stops being a launch-time constant: sustained SLO burn spawns a replica
+through ``build_fleet``'s factory; sustained quiet drains the
+least-loaded one — new traffic stops immediately, running requests
+finish in place (streamed tokens stay exactly-once), then it is removed.
 """
 
 import time
@@ -154,8 +160,21 @@ class FleetRouter:
     def __init__(self, replicas: List[ReplicaHandle],
                  config: Optional[FleetConfig] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 tracer=None, recorder=None):
+                 tracer=None, recorder=None, replica_factory=None):
         self.config = config or FleetConfig(enabled=True)
+        #: () -> ReplicaHandle with a fleet-unique name; what scale_up
+        #: spawns. build_fleet provides one closing over the shared
+        #: weights + serving JSON; a router without a factory can still
+        #: scale DOWN (give capacity back) but never up
+        self.replica_factory = replica_factory
+        #: replica name -> drain start time: scale-down keeps a replica
+        #: ticking until its running requests finish, routing nothing
+        #: new to it
+        self._draining: Dict[str, float] = {}
+        self._as_high_since: Optional[float] = None
+        self._as_low_since: Optional[float] = None
+        self._as_last_action: float = float("-inf")
+        self.last_scale: Optional[dict] = None
         self.replicas: Dict[str, ReplicaHandle] = {
             r.name: r for r in replicas}
         if len(self.replicas) != len(replicas):
@@ -194,6 +213,7 @@ class FleetRouter:
             self.statusz = StatuszServer(sz, tracer=self.tracer)
             self.statusz.register("fleet", self._statusz_section)
             self.statusz.register("tenants", self._tenant_section)
+            self.statusz.register("autoscale", self.autoscale_summary)
             self.statusz.register_health("fleet", self._health_check)
             if self.aggregator is not None:
                 self.statusz.register("critical_path",
@@ -225,7 +245,8 @@ class FleetRouter:
         if pre:
             return pre
         return [r for r in self.replicas.values()
-                if r.role == "unified" and not r.failed]
+                if r.role == "unified" and not r.failed
+                and r.name not in self._draining]
 
     def _decode_replicas(self) -> List[ReplicaHandle]:
         return [r for r in self.replicas.values()
@@ -351,10 +372,12 @@ class FleetRouter:
         self._detect_failures(now)
         self._retry_pending()
         in_flight = 0
-        for r in self.replicas.values():
+        for r in list(self.replicas.values()):
             if r.failed or r.engine is None:
                 continue
             in_flight += r.engine.step()
+        self._finalize_drains(now)
+        self._autoscale_tick(now)
         self._harvest_completions()
         self._refresh_gauges()
         return in_flight + len(self._pending) + len(self._pending_handoffs)
@@ -411,6 +434,7 @@ class FleetRouter:
     def _evict(self, replica: ReplicaHandle, reason: str):
         replica.failed = True
         replica.ready = False
+        self._draining.pop(replica.name, None)
         victims = [f for f in self._fleet_requests.values()
                    if f.replica == replica.name and not f.done]
         trace_ids = []
@@ -447,6 +471,205 @@ class FleetRouter:
         log_dist(
             f"fleet: FAILOVER — replica {replica.name} evicted ({reason}); "
             f"re-enqueued {len(victims)} in-flight request(s)", ranks=[0])
+
+    # ------------------------------------------------------------ autoscale
+    def _live_unified(self) -> List[ReplicaHandle]:
+        """Replicas the controller counts and may shrink: live, unified,
+        not already draining."""
+        return [r for r in self.replicas.values()
+                if r.role == "unified" and not r.failed
+                and r.name not in self._draining]
+
+    def _load_signals(self) -> tuple:
+        """(fleet burn, total queue depth) in one sweep. Burn is the
+        WORST live replica's burn rate (a fleet is out of SLO if any
+        replica serves out of SLO — the same worst-of rule the tenant
+        table uses) — but only replicas with CURRENT work count: the
+        burn window is a rate with no clock, so an idle replica's window
+        is history, not pressure. Without this, the routing score's burn
+        penalty starves a burnt replica of traffic, its window never
+        refreshes, and the frozen burn pins the fleet at max forever."""
+        burn, queue = 0.0, len(self._pending)
+        for r in self.replicas.values():
+            if r.failed or r.name in self._draining:
+                continue
+            sig = r.load()
+            depth = int(sig.get("queue_depth") or 0)
+            active = int(sig.get("active_requests") or 0)
+            queue += depth
+            if depth + active > 0:
+                burn = max(burn, float(sig.get("slo_burn_rate") or 0.0))
+        return burn, queue
+
+    def _fleet_burn(self) -> float:
+        return self._load_signals()[0]
+
+    def _queue_total(self) -> int:
+        return self._load_signals()[1]
+
+    def _in_flight_on(self, name: str) -> List[FleetRequest]:
+        return [f for f in self._fleet_requests.values()
+                if f.replica == name and not f.done]
+
+    def _autoscale_tick(self, now: float):
+        """The controller: sustained burn above threshold grows the
+        fleet; sustained quiet (low burn AND empty queues) shrinks it.
+        Each condition must hold ``sustain_s`` continuously, and actions
+        are ``cooldown_s`` apart — a windowed burn gauge flaps, a fleet
+        must not."""
+        ac = getattr(self.config, "autoscale", None)
+        if ac is None or not ac.enabled or self._shutdown:
+            return
+        burn, queue = self._load_signals()
+        live = len(self._live_unified())
+        if burn >= ac.scale_up_burn:
+            self._as_low_since = None
+            if self._as_high_since is None:
+                self._as_high_since = now
+        elif burn <= ac.scale_down_burn and queue <= ac.scale_down_queue:
+            self._as_high_since = None
+            if self._as_low_since is None:
+                self._as_low_since = now
+        else:
+            self._as_high_since = self._as_low_since = None
+        if now - self._as_last_action < ac.cooldown_s:
+            return
+        if self._as_high_since is not None and \
+                now - self._as_high_since >= ac.sustain_s and \
+                live < ac.max_replicas and self.replica_factory is not None:
+            self._as_last_action = now
+            self._as_high_since = None
+            self.scale_up(f"slo burn {burn:.2f} >= {ac.scale_up_burn:g} "
+                          f"sustained {ac.sustain_s:g}s")
+        elif self._as_low_since is not None and \
+                now - self._as_low_since >= ac.sustain_s and \
+                live > ac.min_replicas:
+            self._as_last_action = now
+            self._as_low_since = None
+            self.scale_down(f"slo burn {burn:.2f} <= "
+                            f"{ac.scale_down_burn:g} and queue {queue} <= "
+                            f"{ac.scale_down_queue} sustained "
+                            f"{ac.sustain_s:g}s")
+
+    def scale_up(self, reason: str = "manual") -> Optional[str]:
+        """Spawn one replica through the factory and start routing to it
+        the moment its probe passes. Returns the new replica's name."""
+        if self.replica_factory is None:
+            logger.warning("fleet: scale_up requested but no "
+                           "replica_factory; ignoring")
+            return None
+        replica = self.replica_factory()
+        if replica.name in self.replicas:
+            raise ValueError(
+                f"replica_factory returned duplicate name {replica.name!r}")
+        self.replicas[replica.name] = replica
+        replica.probe(self.clock())
+        self._note_scale("up", replica.name, reason)
+        log_dist(f"fleet: SCALE-UP -> {replica.name} ({reason}); "
+                 f"{len(self._live_unified())} live replica(s)", ranks=[0])
+        return replica.name
+
+    def scale_down(self, reason: str = "manual",
+                   name: Optional[str] = None) -> Optional[str]:
+        """Start draining the least-loaded live replica (or ``name``).
+        New traffic stops routing to it immediately; its running
+        requests finish in place (the PR-8 drain contract — streamed
+        tokens keep their exactly-once delivery because nothing is
+        interrupted); once idle it is shut down and removed. Returns the
+        draining replica's name. Refuses to go below
+        ``autoscale.min_replicas`` (1 without the block) — ``kill()`` is
+        the operator's escape hatch, not this."""
+        ac = getattr(self.config, "autoscale", None)
+        floor = ac.min_replicas if (ac is not None and ac.enabled) else 1
+        if len(self._live_unified()) <= floor:
+            logger.warning(
+                f"fleet: scale_down refused — at the min_replicas floor "
+                f"({floor})")
+            return None
+        if name is None:
+            cands = sorted(self._live_unified(), key=lambda r: r.score())
+            if not cands:
+                return None
+            name = cands[0].name
+        elif name not in self.replicas or name in self._draining:
+            return None
+        self._draining[name] = self.clock()
+        self._note_scale("down", name, reason)
+        log_dist(f"fleet: SCALE-DOWN draining {name} ({reason}); "
+                 f"{len(self._live_unified())} live replica(s) remain",
+                 ranks=[0])
+        return name
+
+    def _finalize_drains(self, now: float):
+        """Remove draining replicas whose work finished; force-evict
+        ones that blew ``drain_timeout_s`` (the failover path re-enqueues
+        their requests onto survivors — delivery stays exactly-once via
+        the delivered-position dedup)."""
+        if not self._draining:
+            return
+        ac = getattr(self.config, "autoscale", None)
+        timeout = getattr(ac, "drain_timeout_s", 30.0) if ac else 30.0
+        for name, since in list(self._draining.items()):
+            r = self.replicas.get(name)
+            if r is None or r.failed:
+                self._draining.pop(name, None)
+                continue
+            busy = self._in_flight_on(name) or (
+                r.engine is not None and
+                (r.engine.active_requests or r.engine.queue_depth))
+            if not busy:
+                self._draining.pop(name, None)
+                del self.replicas[name]
+                if r.engine is not None:
+                    r.engine.shutdown()
+                log_dist(f"fleet: scale-down of {name} complete", ranks=[0])
+            elif now - since > timeout:
+                self._draining.pop(name, None)
+                self._evict(r, f"drain timeout after {timeout:g}s")
+                del self.replicas[name]
+                if r.engine is not None:
+                    self._dispose_failed(r.engine)
+
+    def _note_scale(self, kind: str, name: str, reason: str):
+        if kind == "up":
+            self.metrics.scale_ups += 1
+        else:
+            self.metrics.scale_downs += 1
+        self.last_scale = {"kind": kind, "replica": name,
+                           "reason": reason, "time": time.time(),
+                           "live": len(self._live_unified()),
+                           "draining": sorted(self._draining)}
+        with self.tracer.span(f"scale_{kind}", cat="fleet",
+                              args={"replica": name, "reason": reason}):
+            pass
+        if self.recorder is not None:
+            # scale events are rare and each one is evidence — bypass the
+            # per-kind debounce so an up immediately followed by a down
+            # (a flapping policy) still bundles both
+            self.recorder.trigger(
+                "resize", f"scale_{kind} {name}: {reason}", force=True)
+
+    def autoscale_summary(self) -> dict:
+        """The /statusz ``autoscale`` section (and ds_tpu_top panel):
+        target vs live count, bounds, last action."""
+        ac = getattr(self.config, "autoscale", None)
+        live = len(self._live_unified())
+        out = {
+            "enabled": bool(ac is not None and ac.enabled),
+            "live_replicas": live,
+            "draining": sorted(self._draining),
+            "scale_ups": self.metrics.scale_ups,
+            "scale_downs": self.metrics.scale_downs,
+        }
+        if ac is not None and ac.enabled:
+            out["min_replicas"] = ac.min_replicas
+            out["max_replicas"] = ac.max_replicas
+            out["can_grow"] = self.replica_factory is not None
+        if self.last_scale is not None:
+            last = dict(self.last_scale)
+            last["age_s"] = round(max(0.0, time.time() - last["time"]), 1)
+            out["last_scale"] = last
+        return out
 
     # -------------------------------------------------------------- results
     def result(self, fleet_id: int) -> FleetRequest:
@@ -533,6 +756,13 @@ class FleetRouter:
                       if r.ready and not r.failed),
             pending=len(self._pending) + len(self._pending_handoffs),
             prefix_hits=hits, prefix_lookups=lookups)
+        ac = getattr(self.config, "autoscale", None)
+        if ac is not None and ac.enabled:
+            self.metrics.update_autoscale(
+                live=len(self._live_unified()),
+                draining=len(self._draining),
+                min_replicas=ac.min_replicas,
+                max_replicas=ac.max_replicas)
 
     def tenant_summary(self) -> dict:
         """Fleet-wide per-tenant view: each live replica's tenant SLO
@@ -646,7 +876,15 @@ def build_fleet(engine, serving_config, clock=time.monotonic,
         router_rec = FlightRecorderConfig.from_dict(rec_cfg.to_dict())
         router_rec.dir = os.path.join(str(rec_cfg.dir), "router")
         recorder = FlightRecorder(router_rec)
-    for i, role in enumerate(roles):
+    autoscaling = getattr(fleet_cfg.autoscale, "enabled", False)
+    # id_stride spaces request-id streams so they stay fleet-unique. A
+    # fixed fleet strides by its size; an autoscaling fleet strides by a
+    # lifetime replica bound (replicas come and go — a new replica
+    # reusing a dead one's id lane would collide with requests the dead
+    # one minted)
+    stride = 1024 if autoscaling else n
+
+    def _make_replica(i: int, role: str) -> ReplicaHandle:
         cfg = ServingConfig.from_dict(serving_config.to_dict())
         cfg.role = role
         if getattr(cfg.statusz, "enabled", False):
@@ -655,10 +893,26 @@ def build_fleet(engine, serving_config, clock=time.monotonic,
             cfg.flight_recorder.dir = os.path.join(
                 str(rec_cfg.dir), f"r{i}")
         srv = ServingEngine(engine, cfg, clock=clock, seed=seed + i,
-                            id_start=i, id_stride=n,
+                            id_start=i, id_stride=stride,
                             replica_name=f"r{i}")
-        replicas.append(ReplicaHandle(
-            f"r{i}", engine=srv, role=role, config=fleet_cfg, clock=clock))
+        return ReplicaHandle(
+            f"r{i}", engine=srv, role=role, config=fleet_cfg, clock=clock)
+
+    for i, role in enumerate(roles):
+        replicas.append(_make_replica(i, role))
+    factory = None
+    if autoscaling:
+        serial = [n]
+
+        def factory():
+            i = serial[0]
+            serial[0] += 1
+            if i >= stride:
+                raise RuntimeError(
+                    f"fleet exhausted its lifetime replica-id space "
+                    f"({stride}); restart the router")
+            return _make_replica(i, "unified")
+
     router = FleetRouter(replicas, fleet_cfg, clock=clock,
-                         recorder=recorder)
+                         recorder=recorder, replica_factory=factory)
     return router

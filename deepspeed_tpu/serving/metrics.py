@@ -405,6 +405,11 @@ class FleetMetrics:
         self.requeued = 0
         self.handoffs = 0
         self.throttled = 0
+        #: autoscale actions (serving/fleet/router.py) — exported as the
+        #: dedicated ``dstpu_elastic_*`` family, the serving half of the
+        #: elasticity gauge space the training coordinator also writes
+        self.scale_ups = 0
+        self.scale_downs = 0
         #: per-tenant 429s (token-bucket rejections at the router) —
         #: the "who is being shed" half of the tenant table
         self.tenant_throttled: Dict[str, int] = {}
@@ -433,6 +438,19 @@ class FleetMetrics:
                          ("fleet/requeued", self.requeued),
                          ("fleet/kv_handoffs", self.handoffs),
                          ("fleet/prefix_cache_hit_rate", hit_rate)):
+            self.tracer.set_counter(tag, float(val), owner=self)
+
+    def update_autoscale(self, *, live: int, draining: int,
+                         min_replicas: int, max_replicas: int):
+        """The ``dstpu_elastic_*`` serving gauges: live vs bounds plus
+        action counters — what a dashboard plots against the SLO burn
+        series to see the controller track load."""
+        for tag, val in (("elastic/live_replicas", live),
+                         ("elastic/draining_replicas", draining),
+                         ("elastic/min_replicas", min_replicas),
+                         ("elastic/max_replicas", max_replicas),
+                         ("elastic/scale_ups", self.scale_ups),
+                         ("elastic/scale_downs", self.scale_downs)):
             self.tracer.set_counter(tag, float(val), owner=self)
 
     def close(self):

@@ -261,6 +261,16 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
                     f'{prefix}_tenant_{name}{{tenant="{_prom(tname)}"}} '
                     f"{fval}")
                 continue
+        if tag.startswith("elastic/"):
+            # elasticity gauges (elasticity/coordinator.py on the
+            # training side, FleetMetrics.update_autoscale on the serving
+            # side): dedicated dstpu_elastic_world_size / _hosts_missing /
+            # _resizes / _live_replicas / _scale_ups series — a fleet
+            # changing size is an alerting event, not a label lookup
+            name = _prom(tag[len("elastic/"):])
+            host_lines.append(f"# TYPE {prefix}_elastic_{name} gauge")
+            host_lines.append(f"{prefix}_elastic_{name} {fval}")
+            continue
         if tag.startswith("spec/"):
             # speculative-decode gauges (serving/metrics.py): dedicated
             # dstpu_spec_acceptance_ema / _tokens_per_tick / _draft_ms /

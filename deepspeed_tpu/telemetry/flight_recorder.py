@@ -60,7 +60,7 @@ __all__ = ["FlightRecorder", "TRIGGER_KINDS"]
 #: the trigger-rule vocabulary (bundle filenames carry the kind)
 TRIGGER_KINDS = ("slow_step", "recompile", "sentinel", "slo_burn",
                  "preemption", "straggler", "failover", "overlap_drop",
-                 "acceptance_drop", "manual")
+                 "acceptance_drop", "resize", "manual")
 
 
 class FlightRecorder:

@@ -126,6 +126,9 @@ class HostAggregator:
                 state[1] += 1
                 if state[1] >= self.heartbeat_misses:
                     missing.append(hid)
+            # heartbeat age in aggregation rounds (0 = advanced this
+            # round) — the per-host column ds_tpu_top renders
+            hosts[hid]["beats_behind"] = self._seen[hid][1]
 
         by_time = sorted((h["step_time_ms"], hid)
                          for hid, h in hosts.items())

@@ -1,0 +1,587 @@
+"""Elasticity tests (deepspeed_tpu/elasticity/ + fleet autoscale).
+
+Contracts under test: every checkpoint tag carries a logical-sharding
+manifest (per-leaf global shape + PartitionSpec + dtype, topology +
+batch triangle) that round-trips; ``plan_resize`` recomputes gradient
+accumulation to preserve the global batch on any world size (and
+refuses impossible ones by name); a resize-resume chain across three
+topologies restores params, optimizer moments and the RNG stream
+byte-identically, with lr=0 steps leaving params bitwise unchanged on
+every mesh; a simulated heartbeat gap latches, emergency-saves through
+the manifested path, fires a ``resize`` flight-recorder bundle with the
+before/after topology, and raises ``ElasticResizeRequired`` with the
+shrink plan instead of hanging; structure drift between a checkpoint
+and the live model fails naming the exact leaves (engine loader and
+megatron assembler both); the fleet router scales up under sustained
+SLO burn and drains the least-loaded replica on sustained quiet with
+streamed tokens delivered exactly once and bitwise equal to a direct
+generate(); autoscale respects bounds; config validation rejects the
+bad shapes; the dstpu_elastic_* gauges export; ds_tpu_top renders the
+autoscale panel and per-host heartbeat age, degrading on pre-elastic
+snapshots.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity import (ElasticCoordinator,  # noqa: F401
+                                      ElasticResizeRequired,
+                                      ElasticityIncompatibleWorldSize,
+                                      elastic_resume, leaf_diff,
+                                      plan_resize, read_logical_manifest,
+                                      read_topology, require_leaf_match,
+                                      spec_from_json, spec_to_json)
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.resilience.manifest import CheckpointLoadError
+from deepspeed_tpu.runtime.config_utils import ConfigError
+from deepspeed_tpu.serving import SamplingParams, ServingConfig, build_fleet
+from deepspeed_tpu.telemetry import get_tracer, prometheus_dump
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+TINY = dict(vocab_size=64, n_positions=32, n_embd=32, n_layer=1, n_head=2,
+            pad_vocab_to_multiple=1, dtype="float32")
+
+
+def _train_cfg(lr=1e-3, tp=1, **over):
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": lr}},
+        "steps_per_print": 0,
+        "tensor_parallel_size": tp,
+        "elasticity": {"enabled": True, "max_train_batch_size": 8,
+                       "micro_batch_sizes": [1, 2], "min_gpus": 2,
+                       "max_gpus": 16},
+    }
+    for key, val in over.items():
+        if isinstance(val, dict) and isinstance(cfg.get(key), dict):
+            cfg[key] = {**cfg[key], **val}
+        else:
+            cfg[key] = val
+    return cfg
+
+
+def _build(config, devices=None):
+    import jax
+    from deepspeed_tpu.parallel.topology import initialize_mesh
+    mm = None
+    if devices is not None:
+        tp = config.get("tensor_parallel_size", 1)
+        mm = initialize_mesh(dp=len(devices) // tp, tp=tp, devices=devices)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2Model(GPT2Config(**TINY)), config=config,
+        mesh_manager=mm)
+    return engine
+
+
+def _batch(engine, seed=0):
+    cfg = engine._config
+    gas = cfg.gradient_accumulation_steps
+    rows = cfg.train_batch_size // gas
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 63, size=(gas, rows, 16),
+                                      dtype=np.int32)}
+
+
+def _leaf_bytes(tree):
+    import jax
+    return [np.asarray(jax.device_get(x)).tobytes()
+            for x in jax.tree.leaves(tree)]
+
+
+# --------------------------------------------------------- logical manifest
+
+@pytest.fixture(scope="module")
+def saved(tmp_path_factory):
+    """One dp=8 engine trained 2 real steps, checkpointed: the manifest
+    and resume tests all read this tag."""
+    ckpt = tmp_path_factory.mktemp("elastic_ckpt")
+    engine = _build(_train_cfg())
+    for i in range(2):
+        engine.train_batch(batch=_batch(engine, seed=i))
+    engine.save_checkpoint(str(ckpt))
+    state = {"params": _leaf_bytes(engine.params),
+             "opt": _leaf_bytes(engine.opt_state),
+             "rng": np.asarray(engine._base_rng).tobytes(),
+             "steps": engine.global_steps,
+             "micro_steps": engine.micro_steps}
+    yield str(ckpt), state, engine
+    engine.close()
+
+
+def test_logical_manifest_round_trip(saved):
+    """Every tag carries shardings.json: topology + batch triangle +
+    per-leaf shape/spec/dtype matching the live engine, specs JSON
+    round-trip, and read_topology resolves it through `latest`."""
+    import jax
+    ckpt, _state, engine = saved
+    doc = read_topology(ckpt)          # resolves the latest tag
+    topo, batch = doc["topology"], doc["batch"]
+    assert topo["axes"]["dp"] * topo["axes"]["tp"] == 8
+    assert topo["world_size"] == 8
+    assert batch == {"train_batch_size": 8, "micro": 1, "gas": 2,
+                     "dp": 4} or batch["train_batch_size"] == 8
+    # per-leaf records match the engine's own shapes and shardings
+    shapes = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            engine.param_shapes)[0]:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+        shapes[name] = tuple(int(d) for d in leaf.shape)
+    assert set(doc["params"]) == set(shapes)
+    for name, rec in doc["params"].items():
+        assert tuple(rec["shape"]) == shapes[name], name
+        assert np.dtype(rec["dtype"]) is not None
+        # spec JSON round-trips to the same PartitionSpec
+        assert spec_to_json(spec_from_json(rec["spec"])) == rec["spec"]
+    assert doc["opt_state"], "optimizer moments must carry records too"
+    # the tag's manifest is itself covered: a direct tag read agrees
+    tag_dirs = [d for d in os.listdir(ckpt)
+                if os.path.isdir(os.path.join(ckpt, d))]
+    assert any(read_logical_manifest(os.path.join(ckpt, d)) == doc
+               for d in tag_dirs)
+
+
+def test_read_topology_pre_elastic_raises(tmp_path):
+    """A checkpoint predating topology-free saves fails by name, not
+    with a KeyError downstream."""
+    with pytest.raises(CheckpointLoadError) as e:
+        read_topology(str(tmp_path))
+    assert str(tmp_path) in str(e.value)
+
+
+# --------------------------------------------------------------- plan math
+
+def test_plan_resize_recomputes_gas():
+    doc = {"topology": {"axes": {"dp": 8, "tp": 2, "pp": 1, "sp": 1,
+                                 "ep": 1}, "world_size": 16},
+           "batch": {"train_batch_size": 64, "micro": 2, "gas": 4}}
+    # half the world, same model parallelism: gas doubles
+    plan = plan_resize(doc, 8)
+    assert (plan.dp, plan.tp, plan.micro, plan.gas) == (4, 2, 2, 8)
+    assert plan.train_batch_size == 64
+    # reshape tp instead: dp=4/tp=4 on the same 16 chips
+    plan = plan_resize(doc, 16, tp=4)
+    assert (plan.dp, plan.tp, plan.gas) == (4, 4, 8)
+    # saved micro no longer divides -> largest configured one that does
+    doc2 = {"topology": {"axes": {"dp": 4}, "world_size": 4},
+            "batch": {"train_batch_size": 12, "micro": 3, "gas": 1}}
+    plan = plan_resize(doc2, 6, micro_batches=[1, 2, 3])
+    assert (plan.dp, plan.micro, plan.gas) == (6, 2, 1)
+    assert plan.micro * plan.dp * plan.gas == 12
+    # impossible: batch not preservable
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        plan_resize({"topology": {"axes": {}},
+                     "batch": {"train_batch_size": 6, "micro": 1}}, 4)
+    # world not divisible by the model-parallel product
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        plan_resize(doc, 6)
+
+
+# -------------------------------------------------- resize-resume bit parity
+
+def test_resize_resume_bit_parity_across_topologies(tmp_path):
+    """dp=4/tp=2 -> dp=2/tp=4 -> dp=2 (half the chips) at lr=0: params,
+    optimizer moments and the RNG stream restore byte-identically at
+    every hop, gas recomputes to preserve the global batch, and an lr=0
+    step on each mesh leaves params bitwise unchanged."""
+    import jax
+    ckpt = str(tmp_path / "chain")
+    # topology A: dp=4/tp=2 on 8 devices, two REAL steps so moments are
+    # nontrivial, then freeze with lr=0 and checkpoint
+    a = _build(_train_cfg(lr=1e-3, tp=2))
+    for i in range(2):
+        a.train_batch(batch=_batch(a, seed=i))
+    a.save_checkpoint(ckpt)
+    ref = {"params": _leaf_bytes(a.params), "opt": _leaf_bytes(a.opt_state),
+           "rng": np.asarray(a._base_rng).tobytes(),
+           "micro_steps": a.micro_steps}
+    a_gas = a._config.gradient_accumulation_steps
+    assert a_gas == 2                      # batch 8 = 1 micro x 4 dp x 2
+    a.close()
+
+    hops = [
+        ({"tensor_parallel_size": 4}, None, 4),        # dp=2/tp=4, gas 4
+        ({"tensor_parallel_size": 1}, 2, 4),           # dp=2 on 2 chips
+    ]
+    for over, ndev, want_gas in hops:
+        cfg = _train_cfg(lr=0.0)
+        cfg.update(over)
+        devices = None if ndev is None else list(jax.devices())[:ndev]
+        engine, _client, plan = elastic_resume(
+            GPT2Model(GPT2Config(**TINY)), cfg, ckpt, devices=devices)
+        try:
+            assert plan.gas == want_gas and plan.train_batch_size == 8
+            assert engine._config.gradient_accumulation_steps == want_gas
+            # restored state is byte-identical to what A saved
+            assert _leaf_bytes(engine.params) == ref["params"]
+            assert _leaf_bytes(engine.opt_state) == ref["opt"]
+            assert np.asarray(engine._base_rng).tobytes() == ref["rng"]
+            assert engine.micro_steps == ref["micro_steps"]
+            # the derived per-step RNG stream continues bit-exactly
+            key = jax.random.fold_in(engine._base_rng, engine.micro_steps)
+            assert np.asarray(key).tobytes() == np.asarray(
+                jax.random.fold_in(
+                    jax.numpy.asarray(
+                        np.frombuffer(ref["rng"], np.uint32)),
+                    ref["micro_steps"])).tobytes()
+            # one lr=0 step on this mesh: params must not move a bit
+            engine.train_batch(batch=_batch(engine, seed=9))
+            assert _leaf_bytes(engine.params) == ref["params"]
+            # re-save so the NEXT hop resumes through this topology
+            engine.save_checkpoint(ckpt)
+            ref["opt"] = _leaf_bytes(engine.opt_state)
+            ref["micro_steps"] = engine.micro_steps
+        finally:
+            engine.close()
+
+
+# ------------------------------------------------- heartbeat gap -> shrink
+
+def test_heartbeat_gap_emergency_save_and_shrink(tmp_path):
+    """A host missing K heartbeats latches; the next step boundary
+    emergency-saves through the manifested path, fires exactly one
+    `resize` bundle embedding the before/after topology, and raises
+    ElasticResizeRequired with the shrink plan — then elastic_resume on
+    the survivors restores the exact params."""
+    import jax
+    bdir = tmp_path / "bundles"
+    sdir = tmp_path / "emergency"
+    engine = _build(_train_cfg(
+        lr=1e-3,
+        elasticity={"resize_save_dir": str(sdir)},
+        hostagg={"enabled": True, "interval": 1, "heartbeat_misses": 2},
+        flight_recorder={"enabled": True, "dir": str(bdir),
+                         "slow_step_factor": 1000.0, "warmup_steps": 1},
+        telemetry={"enabled": True, "mfu": False}))
+    assert engine._elastic is not None
+    calls = {"n": 0}
+
+    def gather(vec):
+        calls["n"] += 1
+        # host 7's heartbeat seqno never advances
+        return [list(vec), [7.0, 10.0, 0.0, 5.0]]
+
+    engine._hostagg._gather = gather
+    for i in range(3):                 # round 3 = second miss -> latch
+        engine.train_batch(batch=_batch(engine, seed=i))
+    assert engine._elastic.pending
+    pre = _leaf_bytes(engine.params)
+    with pytest.raises(ElasticResizeRequired) as e:
+        engine.train_batch(batch=_batch(engine, seed=9))
+    plan = e.value.plan
+    assert plan is not None and plan.world_size == 4    # 1 of 2 hosts
+    assert plan.train_batch_size == 8 and plan.gas == 2
+    assert e.value.checkpoint_dir == str(sdir)
+    # once latched, the engine refuses to run another step (the next
+    # collective would hang on the dead host)
+    with pytest.raises(ElasticResizeRequired):
+        engine.train_batch(batch=_batch(engine, seed=10))
+    # exactly one resize bundle, carrying before/after topology
+    files = [f for f in os.listdir(bdir) if f.endswith(".json")]
+    kinds = [f.split("-", 2)[2][:-len(".json")] for f in sorted(files)]
+    assert kinds.count("resize") == 1
+    [rf] = [f for f in files if "resize" in f]
+    with open(bdir / rf) as fh:
+        doc = json.load(fh)
+    el = doc["status"]["elasticity"]
+    assert el["last_resize"]["before"]["world_size"] == 8
+    assert el["last_resize"]["after"]["world_size"] == 4
+    assert el["last_resize"]["after_batch"]["gas"] == 2
+    # the survivors resume the exact state on half the world
+    resumed, _c, rplan = elastic_resume(
+        GPT2Model(GPT2Config(**TINY)), _train_cfg(lr=1e-3), str(sdir),
+        devices=list(jax.devices())[:4])
+    try:
+        assert rplan.world_size == 4
+        assert _leaf_bytes(resumed.params) == pre
+    finally:
+        resumed.close()
+        engine.close()
+
+
+# ------------------------------------------------------- structure gating
+
+def test_leaf_diff_names_missing_extra_and_shapes():
+    want = {"a": np.zeros((2, 3)), "b": {"c": np.zeros(4)},
+            "d": np.zeros(5)}
+    got = {"a": np.zeros((2, 3)), "b": {"x": np.zeros(4)},
+           "d": np.zeros(6)}
+    diff = leaf_diff(want, got)
+    assert diff["missing"] == ["b/c"]
+    assert diff["extra"] == ["b/x"]
+    assert diff["shape_mismatch"] == ["d: saved (6,) vs live (5,)"]
+    with pytest.raises(CheckpointLoadError) as e:
+        require_leaf_match(want, got, what="model_states", where="/ckpt/x")
+    assert "b/c" in str(e.value) and "b/x" in str(e.value)
+    assert e.value.leaf_diff == diff
+
+
+def test_checkpoint_structure_drift_names_leaves(saved):
+    """Loading a tag into a model whose leaves drifted fails BEFORE any
+    state moves, naming the reshaped leaves — not a tree-map arity
+    error."""
+    ckpt, _state, _engine = saved
+    cfg = dict(TINY)
+    cfg["n_embd"] = 16                      # live model shrank
+    other, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2Model(GPT2Config(**cfg)), config=_train_cfg())
+    try:
+        with pytest.raises(CheckpointLoadError) as e:
+            other.load_checkpoint(ckpt)
+        assert e.value.leaf_diff["shape_mismatch"]
+        assert "wte" in str(e.value)
+    finally:
+        other.close()
+
+
+def test_megatron_incomplete_checkpoint_names_missing_leaves():
+    from deepspeed_tpu.checkpoint.megatron import _require_complete
+    merged = {"wte": np.zeros((8, 4)), "wpe": np.zeros((4, 4)),
+              "final_layernorm.weight": np.zeros(4),
+              "final_layernorm.bias": np.zeros(4),
+              "layers.0.input_layernorm.weight": np.zeros(4),
+              "layers.0.attention.rotary_emb.inv_freq": np.zeros(2)}
+    with pytest.raises(CheckpointLoadError) as e:
+        _require_complete(merged, [0], False, "/meg/ckpt")
+    diff = e.value.leaf_diff
+    assert "layers.0.mlp.dense_h_to_4h.weight" in diff["missing"]
+    assert "layers.0.attention.rotary_emb.inv_freq" in diff["extra"]
+    # a complete layer set (extras present) passes
+    complete = dict(merged)
+    for k in diff["missing"]:
+        complete[k] = np.zeros(4)
+    _require_complete(complete, [0], False, "/meg/ckpt")
+
+
+# ------------------------------------------------------- serving autoscale
+
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def infer():
+    model = GPT2Model(GPT2Config(vocab_size=VOCAB, n_positions=64,
+                                 n_embd=32, n_layer=1, n_head=2,
+                                 pad_vocab_to_multiple=1, dtype="float32"))
+    return deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, VOCAB, (t,), dtype=np.int32) for t in lengths]
+
+
+def _autoscale_fleet(replicas=1, engine_cfg=None, **autoscale):
+    cfg = {"num_slots": 2, "max_model_len": 64, "max_queue": 32}
+    cfg.update(engine_cfg or {})
+    cfg["fleet"] = {
+        "enabled": True, "replicas": replicas,
+        "heartbeat_timeout_s": 600.0,
+        "autoscale": {"enabled": True, "min_replicas": 1,
+                      "max_replicas": 2, "sustain_s": 0.0,
+                      "cooldown_s": 0.0, **autoscale}}
+    return cfg
+
+
+def test_scale_up_under_injected_slo_burn(infer):
+    """An unmeetable TTFT target drives burn >= threshold while serving:
+    the router spawns a replica, routes to it, and the dstpu_elastic_*
+    gauges move."""
+    tr = get_tracer()
+    tr.configure(enabled=True)
+    # cooldown pinned high: exactly ONE action ever happens in this
+    # test — without it the quiet tail of run_until_idle could start a
+    # scale-down the moment the burst drains (sustain_s is 0 here)
+    router = build_fleet(infer, _autoscale_fleet(
+        replicas=1,
+        engine_cfg={"slo": {"ttft_ms": 0.0001, "window": 64},
+                    "monitor_interval": 1},
+        scale_up_burn=1.0, cooldown_s=600.0))
+    try:
+        fids = [router.submit(p, SamplingParams(max_new_tokens=6))
+                for p in _prompts((5, 7, 4, 6, 8, 5), seed=3)]
+        router.run_until_idle()
+        assert router.metrics.scale_ups >= 1
+        assert "r1" in router.replicas
+        assert all(router.result(f).state == "finished" for f in fids)
+        # the spawned replica really served traffic on later waves
+        fids2 = [router.submit(p, SamplingParams(max_new_tokens=4))
+                 for p in _prompts((5, 6, 7, 4), seed=4)]
+        router.run_until_idle()
+        assert {router.result(f).replica for f in fids2} >= {"r1"}
+        text = prometheus_dump(tr)
+        assert "dstpu_elastic_scale_ups" in text
+        assert "dstpu_elastic_live_replicas 2.0" in text
+        assert router.autoscale_summary()["last_scale"]["kind"] == "up"
+    finally:
+        router.shutdown()
+    assert "dstpu_elastic_live_replicas" not in prometheus_dump(tr)
+
+
+def test_scale_down_drains_mid_stream_exactly_once(infer):
+    """With burn and queues quiet the router drains the least-loaded
+    replica while its request is MID-STREAM: the request finishes in
+    place, every streamed position arrives exactly once, the tokens are
+    bitwise what a direct generate() yields, and the replica is then
+    removed."""
+    router = build_fleet(infer, _autoscale_fleet(
+        replicas=2, scale_down_burn=0.5))
+    seen = {}
+
+    def on_token(req, tok):
+        seen.setdefault(req.request_id, []).append(len(req.tokens))
+
+    try:
+        prompts = _prompts((6, 9), seed=11)
+        fids = [router.submit(p, SamplingParams(max_new_tokens=10),
+                              on_token=on_token) for p in prompts]
+        # two ticks: both requests admitted and decoding, queues empty —
+        # the quiet condition holds and the controller starts a drain
+        router.step()
+        router.step()
+        assert router.metrics.scale_downs >= 1 and router._draining
+        draining = set(router._draining)
+        victims = [f for f in fids
+                   if router.result(f).replica in draining]
+        assert victims, "the drained replica must hold a live stream"
+        router.run_until_idle()
+        assert len(router.replicas) == 1          # removed after drain
+        assert not router._draining
+        for fid, p in zip(fids, prompts):
+            fr = router.result(fid)
+            assert fr.state == "finished"
+            ref = np.asarray(infer.generate(
+                p[None], max_new_tokens=10))[0]
+            np.testing.assert_array_equal(fr.output_ids, ref)
+        # exactly-once: positions per request strictly contiguous
+        for positions in seen.values():
+            assert positions == list(range(1, len(positions) + 1))
+        # min_replicas=1 floor: the survivor is never drained
+        for _ in range(5):
+            router.step()
+        assert len(router.replicas) == 1 and not router._draining
+    finally:
+        router.shutdown()
+
+
+def test_autoscale_bounds_and_no_factory(infer):
+    """A router without a factory logs and skips scale-up; scale_down
+    below min_replicas is refused."""
+    from deepspeed_tpu.serving.fleet.config import FleetConfig
+    from deepspeed_tpu.serving.fleet.replica import ReplicaHandle
+    from deepspeed_tpu.serving.fleet.router import FleetRouter
+    from deepspeed_tpu.serving.engine import ServingEngine
+    srv = ServingEngine(infer, {"num_slots": 2, "max_model_len": 64})
+    fc = FleetConfig.from_dict(
+        {"enabled": True, "heartbeat_timeout_s": 600.0,
+         "autoscale": {"enabled": True, "min_replicas": 1,
+                       "max_replicas": 4, "sustain_s": 0.0,
+                       "cooldown_s": 0.0}})
+    fc.validate()
+    router = FleetRouter([ReplicaHandle("r0", engine=srv, config=fc)], fc)
+    try:
+        assert router.scale_up("test") is None          # no factory
+        assert router.scale_down("test") is None        # at the floor
+        assert router.metrics.scale_ups == 0
+        assert router.metrics.scale_downs == 0
+    finally:
+        router.shutdown()
+
+
+def test_autoscale_config_validation():
+    from deepspeed_tpu.serving.fleet.config import FleetConfig
+
+    def fleet(**autoscale):
+        cfg = FleetConfig.from_dict(
+            {"enabled": True, "replicas": 2, "autoscale": autoscale})
+        cfg.validate()
+        return cfg
+
+    cfg = fleet(enabled=True, min_replicas=1, max_replicas=4)
+    assert cfg.autoscale.scale_up_burn == 1.0
+    with pytest.raises(ConfigError):
+        fleet(enabled=True, min_replicas=3, max_replicas=2)
+    with pytest.raises(ConfigError):
+        fleet(enabled=True, scale_up_burn=0.5, scale_down_burn=0.5)
+    with pytest.raises(ConfigError):
+        fleet(enabled=True, min_replicas=0)
+    with pytest.raises(ConfigError):
+        fleet(enabled=True, bogus_knob=1)
+    with pytest.raises(ConfigError):          # replicas below the floor
+        fleet(enabled=True, min_replicas=3, max_replicas=4)
+    with pytest.raises(ConfigError):          # disagg + autoscale
+        cfg = FleetConfig.from_dict(
+            {"enabled": True, "replicas": 3, "prefill_replicas": 1,
+             "decode_replicas": 2, "autoscale": {"enabled": True}})
+        cfg.validate()
+
+
+def test_example_configs_parse():
+    """The shipped elastic/autoscale example configs validate through
+    the real parsers, and the training one's batch belongs to its own
+    elastic plan (the engine guard would reject it otherwise)."""
+    from deepspeed_tpu.elasticity import compute_elastic_config
+    cdir = os.path.join(REPO, "examples", "configs")
+    with open(os.path.join(cdir, "elastic_training.json")) as f:
+        train = json.load(f)
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    cfg = DeepSpeedConfig(dict(train), world_size=8)
+    batch, valid, _micro = compute_elastic_config(train, world_size=8)
+    assert batch == cfg.train_batch_size and 8 in valid
+    assert train["elasticity"]["resize_on_heartbeat_gap"] is True
+    with open(os.path.join(cdir, "serving_autoscale.json")) as f:
+        srv = ServingConfig.from_dict(json.load(f))
+    assert srv.fleet.autoscale.enabled
+    assert srv.fleet.autoscale.max_replicas >= srv.fleet.replicas
+
+
+def test_top_renders_autoscale_and_degrades(tmp_path):
+    """ds_tpu_top renders the autoscale panel + per-host heartbeat age
+    from a snapshot, and still exits 0 on a pre-elastic snapshot."""
+    snap = {
+        "counters": {"serving/queue_depth": 1.0},
+        "goodput": None,
+        "hosts": {"n_hosts": 2, "min_ms": 10.0, "median_ms": 11.0,
+                  "max_ms": 12.0, "spread": 1.2, "straggler": None,
+                  "missing": [7],
+                  "hosts": {"0": {"step_time_ms": 10.0, "seqno": 9,
+                                  "beats_behind": 0},
+                            "7": {"step_time_ms": 12.0, "seqno": 5,
+                                  "beats_behind": 3}}},
+        "sections": {
+            "autoscale": {"enabled": True, "live_replicas": 3,
+                          "min_replicas": 1, "max_replicas": 4,
+                          "scale_ups": 2, "scale_downs": 1,
+                          "draining": ["r1"],
+                          "last_scale": {"kind": "up", "replica": "r3",
+                                         "reason": "slo burn 1.52 >= 1",
+                                         "age_s": 12.0}}},
+    }
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(snap))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_tpu_top"),
+         "--once", "--snapshot", str(path)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "autoscale" in out.stdout and "live 3" in out.stdout
+    assert "scale_up r3" in out.stdout
+    assert "heartbeat age" in out.stdout and "***" in out.stdout
+    # pre-elastic snapshot: no autoscale/hosts sections, still renders
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps({"counters": {}, "goodput": None}))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_tpu_top"),
+         "--once", "--snapshot", str(old)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "autoscale" not in out.stdout
