@@ -262,7 +262,10 @@ _CONFIG_SOURCES = ("deepspeed_tpu/runtime/constants.py",
                    # reads param_dict.get(...)); its keys and the fleet
                    # AutoscaleConfig dataclass fields are the PR-14
                    # config surface
-                   "deepspeed_tpu/elasticity/elasticity.py")
+                   "deepspeed_tpu/elasticity/elasticity.py",
+                   # the measured-trials sweep: AutotuneConfig dataclass
+                   # fields are the `autotune` block's key surface
+                   "deepspeed_tpu/autotuning/measure.py")
 
 #: keys read through non-static paths (getattr loops, env, kwargs)
 _EXTRA_KNOWN = {"seed"}

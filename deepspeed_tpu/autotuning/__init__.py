@@ -1,11 +1,21 @@
 """Autotuning (reference deepspeed/autotuning) + the DeepCompile-style
-schedule autotuner (autotuning/schedule.py, ``bin/ds_tpu_tune``)."""
+schedule autotuner (autotuning/schedule.py) + the measured-trials plane
+(autotuning/measure.py + trials.py, ``bin/ds_tpu_tune --measure``)."""
 
 from .autotuner import Autotuner, Experiment
-from .cost_model import ScheduleCostModel
+from .cost_model import (ScheduleCostModel, calibrate_cost_model,
+                         rank_correlation)
+from .measure import (AutotuneConfig, MeasuredTuner, measure_fingerprint,
+                      measure_schedule, run_measured_trial)
 from .schedule import (SchedulePlan, ScheduleTuner, default_plans,
                        engine_fingerprint, plan_from_config, tune_schedule)
+from .trials import (TrialPoint, TrialScore, default_trial_space,
+                     point_from_config)
 
 __all__ = ["Autotuner", "Experiment", "ScheduleCostModel", "SchedulePlan",
            "ScheduleTuner", "default_plans", "engine_fingerprint",
-           "plan_from_config", "tune_schedule"]
+           "plan_from_config", "tune_schedule", "calibrate_cost_model",
+           "rank_correlation", "AutotuneConfig", "MeasuredTuner",
+           "measure_fingerprint", "measure_schedule", "run_measured_trial",
+           "TrialPoint", "TrialScore", "default_trial_space",
+           "point_from_config"]

@@ -129,6 +129,124 @@ class ScheduleCostModel:
     def to_dict(self) -> Dict[str, float]:
         return dataclasses.asdict(self)
 
+    @classmethod
+    def from_dict(cls, d: Dict[str, float]) -> "ScheduleCostModel":
+        """Rebuild a cost model from its persisted dict (the tuner cache
+        stores the constants beside the winner; a calibrated model loads
+        back with its measured alpha-beta terms)."""
+        return cls(**{f.name: float(d[f.name])
+                      for f in dataclasses.fields(cls) if f.name in d})
+
+
+def calibrate_cost_model(trials, base: Optional[ScheduleCostModel] = None,
+                         iters: int = 8) -> Optional[ScheduleCostModel]:
+    """Fit the alpha-beta terms from MEASURED trials (the close-the-loop
+    half of the DeepCompile story): each trial supplies the static cost
+    inputs (``flops``, ``wire_bytes``, ``hlo_collectives``,
+    ``static_overlap_fraction``) plus a ``measured_step_s`` wall time, and
+    the fit solves
+
+        measured ≈ a·flops + b·wire + c·n_collectives − hidden
+
+    for (a, b, c) = (1/peak_flops, 1/link_bandwidth, op_latency_s) by
+    alternating least squares: the ``hidden`` overlap term depends on the
+    coefficients through min(comm, compute), so we freeze it at the
+    current estimate, solve the linear problem, and iterate.
+    ``overlap_efficiency`` is held at the base model's value — it is
+    degenerate with the other constants at small trial counts. Returns
+    None with fewer than 2 usable trials (nothing to fit) — callers keep
+    the static model."""
+    base = base or ScheduleCostModel()
+    rows = []
+    for t in trials:
+        m = t.get("measured_step_s")
+        if not m or m <= 0 or t.get("flops", 0.0) <= 0:
+            continue
+        if t.get("wire_bytes", 0.0) <= 0:
+            # only explicit-exchange trials (the comm dispatch traced
+            # their wire bytes) have cost inputs on a consistent basis;
+            # GSPMD-path trials count program flops per-device and log
+            # no dispatch wire — mixing bases poisons the fit
+            continue
+        if t.get("disqualified") in ("nan", "recompile_steady", "oom",
+                                     "error"):
+            # a trial whose window contained recompiles/NaN handling
+            # measured the pathology, not the schedule; budget-DQ trials
+            # ("hbm_budget") timed fine and stay usable
+            continue
+        rows.append((float(t["flops"]), float(t.get("wire_bytes", 0.0)),
+                     float(t.get("hlo_collectives", 0.0)),
+                     min(max(float(t.get("static_overlap_fraction", 0.0)),
+                             0.0), 1.0),
+                     float(m)))
+    if len(rows) < 2:
+        return None
+    # coefficient vector [1/peak_flops, 1/link_bw, op_latency_s]
+    w = np.array([1.0 / base.peak_flops, 1.0 / base.link_bandwidth,
+                  base.op_latency_s])
+    x = np.array([[f, b, c] for f, b, c, _o, _m in rows])
+    y = np.array([m for *_rest, m in rows])
+    eff = base.overlap_efficiency
+    for _ in range(iters):
+        compute = x[:, 0] * w[0]
+        comm = x[:, 1] * w[1] + x[:, 2] * w[2]
+        hidden = eff * np.array([o for _f, _b, _c, o, _m in rows]) * \
+            np.minimum(comm, compute)
+        target = y + hidden
+        # scale columns so the normal equations stay conditioned across
+        # ~20 orders of magnitude between flops and op counts
+        scale = np.maximum(np.abs(x).max(axis=0), 1e-30)
+        xs = x / scale
+        a = xs.T @ xs + 1e-9 * np.eye(3)
+        sol = np.linalg.solve(a, xs.T @ target) / scale
+        # clamp to physical (non-negative) rates; a column the trials
+        # cannot identify keeps its prior instead of going negative
+        new_w = np.where(sol > 0, sol, w)
+        if np.allclose(new_w, w, rtol=1e-6):
+            w = new_w
+            break
+        w = new_w
+    return ScheduleCostModel(
+        peak_flops=1.0 / max(w[0], 1e-30),
+        link_bandwidth=1.0 / max(w[1], 1e-30),
+        op_latency_s=float(w[2]),
+        overlap_efficiency=eff)
+
+
+def rank_correlation(a, b) -> float:
+    """Spearman rank correlation between two equal-length sequences —
+    how well one ranking (e.g. calibrated cost-model scores) reproduces
+    another (measured step times). 1.0 = identical order."""
+    a = list(a)
+    b = list(b)
+    n = len(a)
+    if n < 2 or len(b) != n:
+        return 0.0
+
+    def ranks(vals):
+        order = sorted(range(n), key=lambda i: vals[i])
+        r = [0.0] * n
+        i = 0
+        while i < n:          # average ties so equal scores share a rank
+            j = i
+            while j + 1 < n and vals[order[j + 1]] == vals[order[i]]:
+                j += 1
+            avg = (i + j) / 2.0
+            for k in range(i, j + 1):
+                r[order[k]] = avg
+            i = j + 1
+        return r
+
+    ra, rb = ranks(a), ranks(b)
+    ma = sum(ra) / n
+    mb = sum(rb) / n
+    cov = sum((x - ma) * (y - mb) for x, y in zip(ra, rb))
+    va = math.sqrt(sum((x - ma) ** 2 for x in ra))
+    vb = math.sqrt(sum((y - mb) ** 2 for y in rb))
+    if va == 0 or vb == 0:
+        return 0.0
+    return cov / (va * vb)
+
 
 class ResidualSurrogate:
     """Least-squares correction on top of the analytic prior (the role of

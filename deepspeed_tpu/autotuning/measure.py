@@ -1,0 +1,729 @@
+"""Measured-trials driver: goodput-scored live trials close the loop.
+
+``ds_tpu_tune`` (PR 10) ranks comm-schedule plans from a *static* HLO
+cost model; this module is the measuring half the reference
+``autotuning/`` subsystem ships and DeepCompile (arxiv 2504.09983) says
+schedule search needs: sweep the joint space
+``(micro-batch, remat, offload, compression, overlap plan)`` —
+:mod:`autotuning.trials` — by running each candidate as a SHORT
+real-steps trial on a freshly built engine, and score it straight from
+the observability plane:
+
+- **productive fraction** from the goodput ledger's ``totals()`` window
+  (warmup/compile excluded by construction — ``GoodputLedger.window``),
+- **step TFLOPs / MFU** from the telemetry gauges' own numerator,
+- **steady-state recompiles** from the compile ledger
+  (``events_since``) + the recompile watchdog,
+- **peak HBM** from the HBM role ledger / allocator stats.
+
+Hard disqualification (score 0): OOM at build or step time, a NaN
+sentinel trip, any recompile inside the measured window, peak HBM over
+the configured budget. The winner persists per *measure fingerprint*
+(model, mesh, global batch, memory budget — micro/gas are SWEPT, so
+unlike the static tuner's fingerprint they are not identity) in the
+PR-10 cache format, so a re-run sweeps nothing. Each sweep emits exactly
+one ``trial_best`` and one ``trial_worst`` flight-recorder bundle
+embedding the trial's goodput table, compile events, and score
+breakdown — every tuning decision is auditable post-hoc. Measured trials
+also feed :func:`autotuning.cost_model.calibrate_cost_model`, persisting
+calibrated alpha-beta terms beside the winner so the static model's
+ranking provably improves after one measured sweep.
+"""
+
+import copy
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..runtime.config_utils import ConfigError, DeepSpeedConfigModel
+from ..utils.logging import log_dist, logger
+from .cost_model import (ScheduleCostModel, calibrate_cost_model,
+                         rank_correlation)
+from .schedule import DEFAULT_CACHE_DIR
+from .trials import TrialPoint, TrialScore, default_trial_space, \
+    point_from_config
+
+__all__ = ["AutotuneConfig", "MeasuredTuner", "measure_fingerprint",
+           "run_measured_trial", "measure_schedule", "probe_flops_basis"]
+
+#: config blocks forced onto every trial engine — the trial IS the
+#: observability plane reading itself, so the instruments are not
+#: optional (statusz/recorder stay off: the sweep owns its own recorder)
+_TRIAL_OBSERVABILITY = {
+    "telemetry": {"enabled": True, "mfu": True, "sync_spans": True},
+    "compile_plane": {"enabled": True, "memory_analysis": True,
+                      "hbm": True},
+    "resilience": {"sentinel_policy": "warn", "handle_signals": False},
+    "statusz": {"enabled": False},
+    "flight_recorder": {"enabled": False},
+    "steps_per_print": 0,
+}
+
+#: substrings that classify an engine-build/step failure as device OOM
+_OOM_MARKERS = ("resource_exhausted", "out of memory", "oom",
+                "allocation failure")
+
+
+@dataclasses.dataclass
+class AutotuneConfig(DeepSpeedConfigModel):
+    """The ``"autotune"`` config block (``ds_tpu_tune --measure`` reads
+    it from the base training config; the engine itself ignores it —
+    tuning is a driver concern). Defines the measured sweep: trial
+    length, the joint-space ladders, the HBM budget that disqualifies
+    configs which do not fit, and where winners/bundles persist."""
+    enabled: bool = False
+    #: measured steps per trial (after warmup; keep short — the point of
+    #: a trial is a goodput sample, not convergence)
+    steps: int = 4
+    #: compile/warmup steps excluded from the measured window
+    warmup_steps: int = 1
+    #: per-device HBM budget in GiB; a trial whose peak exceeds it is
+    #: disqualified (0 = no budget gate)
+    hbm_budget_gib: float = 0.0
+    #: micro-batch ladder (filtered to divisors of the global batch)
+    micro_batch_sizes: list = dataclasses.field(
+        default_factory=lambda: [1, 2, 4, 8])
+    #: remat policies to sweep: none | full
+    remat: list = dataclasses.field(default_factory=lambda: ["none"])
+    #: offload modes to sweep: none | cpu | cpu_pipelined
+    offload: list = dataclasses.field(default_factory=lambda: ["none"])
+    #: comm-compression policies to sweep
+    compressions: list = dataclasses.field(default_factory=lambda: ["off"])
+    #: overlap-schedule bucket ladder (monolithic is always included)
+    bucket_bytes: list = dataclasses.field(
+        default_factory=lambda: [4 << 20])
+    #: include bucketed-overlap plans at all (False = monolithic only)
+    overlap: bool = True
+    #: winner-cache directory (defaults to the schedule tuner's)
+    cache_dir: Optional[str] = None
+    #: trial_best/trial_worst bundle directory
+    bundle_dir: str = "autotune_bundles"
+    #: fit calibrated cost-model constants from the measured trials
+    calibrate: bool = True
+
+    def validate(self):
+        if self.steps < 1:
+            raise ConfigError("autotune.steps must be >= 1")
+        if self.warmup_steps < 1:
+            raise ConfigError("autotune.warmup_steps must be >= 1")
+        if self.hbm_budget_gib < 0:
+            raise ConfigError("autotune.hbm_budget_gib must be >= 0")
+        for name in ("micro_batch_sizes", "bucket_bytes"):
+            vals = getattr(self, name)
+            if not vals or any(int(v) < 1 for v in vals):
+                raise ConfigError(f"autotune.{name} must be a non-empty "
+                                  f"list of positive ints")
+        for name, allowed in (("remat", ("none", "full")),
+                              ("offload", ("none", "cpu",
+                                           "cpu_pipelined")),
+                              ("compressions", ("off", "int8",
+                                                "fp8_block"))):
+            bad = [v for v in getattr(self, name) if v not in allowed]
+            if bad:
+                raise ConfigError(
+                    f"autotune.{name}: unknown value(s) {bad}; "
+                    f"choose from {allowed}")
+
+
+# ------------------------------------------------------------- fingerprint
+
+def measure_fingerprint(engine, hbm_budget_gib: float = 0.0) -> str:
+    """Stable id of what a MEASURED winner applies to: model + mesh +
+    global batch + base ZeRO stage + dtype + memory budget. Micro batch
+    and gas are deliberately absent — they are axes of the sweep, not
+    identity (the static tuner's ``engine_fingerprint`` pins them)."""
+    cfg = getattr(engine.module, "config", None)
+    mm = engine.mesh_manager
+    ident = {
+        "kind": "measured",
+        "model": {
+            "model": type(engine.module).__name__,
+            "config": dataclasses.asdict(cfg)
+            if dataclasses.is_dataclass(cfg) else str(cfg),
+        },
+        "mesh": {"pp": mm.pp, "dp": mm.dp, "tp": mm.tp, "sp": mm.sp,
+                 "ep": mm.ep},
+        "global_batch": engine.train_batch_size,
+        "zero_stage": engine.zero_stage,
+        "dtype": str(engine._compute_dtype or "float32"),
+        "hbm_budget_gib": round(float(hbm_budget_gib), 6),
+    }
+    blob = json.dumps(ident, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+# ------------------------------------------------------------------ the trial
+
+def run_measured_trial(model_factory: Callable[[], Any],
+                       base_config: Dict[str, Any],
+                       batch_factory: Callable[[int], Any],
+                       point: TrialPoint,
+                       steps: int = 4,
+                       warmup_steps: int = 1,
+                       hbm_budget_gib: float = 0.0,
+                       flops_per_step: Optional[float] = None
+                       ) -> Dict[str, Any]:
+    """Run ``point`` as one short real-steps trial on a fresh engine and
+    return the scored entry: the TrialScore fields, the static cost
+    inputs for calibration, and the compile-event history for the audit
+    bundle. The engine is trial-scoped: built over a fresh mesh, closed
+    with ``release_ledger=True`` so its goodput mirror and gauges never
+    leak into the next trial. ``batch_factory(global_bs)`` is called
+    once per step (a rigged factory can change shapes mid-trial to
+    exercise the recompile disqualification)."""
+    import numpy as np
+
+    import deepspeed_tpu
+    from .. import comm
+    from ..parallel import topology
+    from ..telemetry.goodput import get_ledger
+
+    score = TrialScore(hbm_budget_gib=float(hbm_budget_gib))
+    entry: Dict[str, Any] = {"point": point.to_dict(), "key": point.key()}
+
+    cfg = copy.deepcopy(base_config)
+    for key in ("autotune", "autotuning"):
+        cfg.pop(key, None)
+    # the trial owns the schedule blocks: each point sets its own
+    cfg.pop("overlap_schedule", None)
+    cfg.pop("comm_compression", None)
+    global_batch = int(cfg.get("train_batch_size") or 0)
+    topology.reset_mesh()
+    import jax
+    dp = jax.device_count()       # trial scope is pure dp (schedule.py)
+    if not global_batch:
+        global_batch = dp * point.micro_bs
+        cfg["train_batch_size"] = global_batch
+    reason = point.feasible(dp, global_batch)
+    if reason:
+        # a point the enumerator should have filtered is a caller bug,
+        # not a measurement — fail loudly instead of scoring it 0
+        raise ConfigError(f"infeasible trial point {point.key()}: "
+                          f"{reason}")
+    try:
+        for key, block in point.config_overrides(global_batch, dp).items():
+            if isinstance(block, dict):
+                merged = dict(cfg.get(key) or {})
+                merged.update(block)
+                cfg[key] = merged
+            else:
+                cfg[key] = block
+        for key, block in _TRIAL_OBSERVABILITY.items():
+            if isinstance(block, dict):
+                merged = dict(cfg.get(key) or {})
+                merged.update(block)
+                cfg[key] = merged
+            else:
+                cfg[key] = block
+        comm_before = comm.comm_stats()
+        engine = None
+        t_build = time.perf_counter()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model_factory(), config=cfg)
+    except Exception as e:                    # noqa: BLE001 — classified
+        msg = str(e)
+        if isinstance(e, MemoryError) or \
+                any(m in msg.lower() for m in _OOM_MARKERS):
+            score.disqualify("oom", msg[:300])
+        else:
+            score.disqualify("error", msg[:300])
+        entry.update(score.to_dict())
+        entry["score_breakdown"] = score.breakdown()
+        return entry
+
+    try:
+        gbs = engine.train_micro_batch_size_per_gpu * engine.dp_world_size
+        ledger = get_ledger()
+        sentinel = engine._sentinel
+        losses: List[float] = []
+
+        def one_step():
+            loss = engine.train_batch(batch=batch_factory(gbs))
+            losses.append(float(loss))
+
+        for _ in range(warmup_steps):
+            one_step()
+        entry["build_and_warmup_s"] = round(
+            time.perf_counter() - t_build, 3)
+        # steady state starts here: snapshot every instrument
+        cp = engine._compile_plane
+        ev_id = cp.last_event_id
+        rc_before = engine._watchdog.recompiles
+        bad_before = sentinel.bad_steps if sentinel is not None else 0
+        totals_before = ledger.totals()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            one_step()
+        wall = time.perf_counter() - t0
+
+        score.steps = steps
+        score.wall_s = round(wall, 6)
+        score.goodput = ledger.window(totals_before, wall)
+        score.productive_fraction = score.goodput["goodput_fraction"]
+        score.step_time_ms = wall * 1e3 / steps
+        # the TFLOPs numerator: prefer a SWEEP-CONSTANT basis (the
+        # caller's flops_per_step, profiled once on a probe engine) so
+        # scores compare across plans — the explicit shard_map exchange
+        # and the GSPMD path count program flops on different bases
+        # (per-device vs global), which would skew any cross-plan
+        # goodput comparison by ~dp
+        flops = flops_per_step or \
+            engine._step_flops.get(engine._last_fn_id, 0)
+        if flops and wall > 0:
+            score.step_tflops = flops * steps / wall / 1e12
+            peak_t = engine._config.telemetry.peak_tflops_per_device
+            if peak_t > 0:
+                score.mfu = score.step_tflops / \
+                    (peak_t * max(1, engine.mesh.size))
+        else:
+            score.step_tflops = \
+                engine.tracer.counter_value("telemetry/step_tflops") or 0.0
+        entry["final_loss"] = losses[-1]
+
+        # ---- disqualification rules (order: cheapest evidence first)
+        steady_events = [ev for ev in cp.events_since(ev_id)
+                         if ev["kind"] == "recompile"]
+        recompiles = (engine._watchdog.recompiles - rc_before) + \
+            len(steady_events)
+        score.recompiles_steady = recompiles
+        if recompiles > 0:
+            diff = "; ".join(steady_events[0].get("diff", [])[:3]) \
+                if steady_events else "jit cache grew"
+            score.disqualify("recompile_steady",
+                             f"{recompiles} recompile(s) in the measured "
+                             f"window: {diff}")
+        bad_steps = (sentinel.bad_steps - bad_before) \
+            if sentinel is not None else 0
+        if bad_steps > 0 or any(x != x for x in losses):
+            score.disqualify("nan",
+                             f"sentinel flagged {max(bad_steps, 1)} "
+                             f"non-finite step(s)")
+        engine._update_hbm()
+        mem = engine._hbm.summary() if engine._hbm is not None else {}
+        score.peak_hbm_gib = float(mem.get("peak_gib") or
+                                   mem.get("total_gib") or 0.0)
+        if hbm_budget_gib > 0 and score.peak_hbm_gib > hbm_budget_gib:
+            score.disqualify("hbm_budget",
+                             f"peak {score.peak_hbm_gib:.3f} GiB over "
+                             f"budget {hbm_budget_gib:.3f} GiB")
+
+        # ---- static cost inputs for alpha-beta calibration
+        comm_after = comm.comm_stats()
+        entry["wire_bytes"] = comm_after["bytes"] - comm_before["bytes"]
+        entry["measured_step_s"] = round(wall / steps, 6)
+        ev = cp.last_event("train_batch")
+        if ev is not None:
+            entry["flops"] = float((ev.get("cost") or {}).get("flops", 0.0))
+            ov = ev.get("overlap") or {}
+            entry["hlo_collectives"] = ov.get("collectives", 0)
+            entry["static_overlap_fraction"] = ov.get(
+                "static_overlap_fraction", 0.0)
+        entry["compile_events"] = cp.events()
+    except Exception as e:                    # noqa: BLE001 — classified
+        msg = str(e)
+        if isinstance(e, MemoryError) or \
+                any(m in msg.lower() for m in _OOM_MARKERS):
+            score.disqualify("oom", msg[:300])
+        else:
+            score.disqualify("error", msg[:300])
+    finally:
+        engine.close(release_ledger=True)
+    entry.update(score.to_dict())
+    entry["score_breakdown"] = score.breakdown()
+    return entry
+
+
+def probe_flops_basis(model_factory: Callable[[], Any],
+                      base_config: Dict[str, Any],
+                      batch_factory: Callable[[int], Any]) -> float:
+    """Global-program FLOPs of one train step on a plain (GSPMD-path)
+    engine built from the base config — the sweep-constant TFLOPs
+    numerator every trial's goodput score shares. Profiled once per
+    sweep; 0.0 when the profile fails (trials fall back to their own
+    engine's accounting)."""
+    import deepspeed_tpu
+    from ..parallel import topology
+
+    cfg = copy.deepcopy(base_config)
+    for key in ("autotune", "autotuning", "overlap_schedule",
+                "comm_compression"):
+        cfg.pop(key, None)
+    for key, block in _TRIAL_OBSERVABILITY.items():
+        if isinstance(block, dict):
+            merged = dict(cfg.get(key) or {})
+            merged.update(block)
+            cfg[key] = merged
+        else:
+            cfg[key] = block
+    topology.reset_mesh()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model_factory(), config=cfg)
+    try:
+        gbs = engine.train_micro_batch_size_per_gpu * engine.dp_world_size
+        engine.train_batch(batch=batch_factory(gbs))
+        return float(engine._step_flops.get(engine._last_fn_id, 0) or 0.0)
+    except Exception as e:                    # noqa: BLE001 — best effort
+        logger.warning(f"measured tuner: flops-basis probe failed: {e}")
+        return 0.0
+    finally:
+        engine.close(release_ledger=True)
+
+
+class _RecorderShim:
+    """Minimal config object for the sweep-owned FlightRecorder: bundles
+    land in the tuner's dir, per-kind debounce off (one sweep fires each
+    kind exactly once, by explicit force)."""
+
+    def __init__(self, bundle_dir: str, keep: int = 16):
+        self.dir = bundle_dir
+        self.keep = keep
+        self.debounce_s = 0.0
+
+
+# ------------------------------------------------------------------ the tuner
+
+class MeasuredTuner:
+    """Sweep trial points, score from the observability plane, persist
+    the winner per measure fingerprint, bundle best/worst, calibrate the
+    static cost model. ``trial_fn(point) -> entry`` is injectable (tests
+    rig it); the stock one is :func:`run_measured_trial`."""
+
+    def __init__(self, trial_fn: Callable[[TrialPoint], Dict],
+                 fingerprint: str,
+                 points: Sequence[TrialPoint],
+                 cache_dir: Optional[str] = None,
+                 bundle_dir: Optional[str] = None,
+                 cost_model: Optional[ScheduleCostModel] = None,
+                 baseline_key: Optional[str] = None,
+                 calibrate: bool = True,
+                 tracer=None):
+        from ..telemetry.trace import get_tracer
+        self.trial_fn = trial_fn
+        self.fingerprint = fingerprint
+        self.points = list(points)
+        self.cache_dir = cache_dir or DEFAULT_CACHE_DIR
+        self.cost_model = cost_model or ScheduleCostModel()
+        self.baseline_key = baseline_key
+        self.calibrate = calibrate
+        self.tracer = tracer or get_tracer()
+        self.recorder = None
+        if bundle_dir:
+            from ..telemetry.flight_recorder import FlightRecorder
+            self.recorder = FlightRecorder(_RecorderShim(bundle_dir),
+                                           tracer=self.tracer)
+            self.recorder.add_provider("tuning", self.statusz_section)
+        # live sweep state (the statusz "tuning" section)
+        self.state = "idle"
+        self.trials_total = len(self.points)
+        self.trials_done = 0
+        self.trials_run = 0          # this process, post-cache
+        self.current_key: Optional[str] = None
+        self.table: List[Dict[str, Any]] = []
+        self.result: Optional[Dict[str, Any]] = None
+        self._closed = False
+
+    # ------------------------------------------------------------- persistence
+    @property
+    def cache_path(self) -> str:
+        return os.path.join(self.cache_dir, f"{self.fingerprint}.json")
+
+    def load_cached(self) -> Optional[Dict[str, Any]]:
+        if not os.path.exists(self.cache_path):
+            return None
+        try:
+            with open(self.cache_path) as f:
+                result = json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning(f"measured tuner: unreadable cache "
+                           f"{self.cache_path}: {e}; re-sweeping")
+            return None
+        if result.get("fingerprint") != self.fingerprint:
+            return None
+        return result
+
+    # ------------------------------------------------------------------ sweep
+    def tune(self, force: bool = False) -> Dict[str, Any]:
+        """Cached winner when the measure fingerprint matches (ZERO
+        trials run), else the full measured sweep with best/worst
+        bundles and cost-model calibration."""
+        self.trials_run = 0
+        if not force:
+            cached = self.load_cached()
+            if cached is not None:
+                cached["cached"] = True
+                cached["trials_run"] = 0
+                self.state = "cached"
+                self.table = list(cached.get("table", []))
+                self.trials_done = len(self.table)
+                self.result = cached
+                self._export_gauges()
+                log_dist(f"measured tuner: cache hit {self.cache_path} -> "
+                         f"{cached.get('winner_key')}", ranks=[0])
+                return cached
+        if not self.points:
+            raise RuntimeError("measured tuner: no trial points to sweep")
+        self.state = "sweeping"
+        self.table = []
+        self.trials_done = 0
+        for point in self.points:
+            self.current_key = point.key()
+            self._export_gauges()
+            entry = self.trial_fn(point)
+            self.table.append(entry)
+            self.trials_done += 1
+            self.trials_run += 1
+            dq = entry.get("disqualified")
+            log_dist(
+                f"measured tuner: {entry['key']:44s} "
+                f"score {entry.get('score', 0.0):9.4f}  "
+                f"frac {entry.get('productive_fraction', 0.0):5.3f}  "
+                f"tflops {entry.get('step_tflops', 0.0):7.3f}" +
+                (f"  DQ[{dq}]" if dq else ""), ranks=[0])
+        self.current_key = None
+        result = self._finish()
+        self.state = "done"
+        self._export_gauges()
+        return result
+
+    def _finish(self) -> Dict[str, Any]:
+        qualified = [e for e in self.table if not e.get("disqualified")]
+        if not qualified:
+            raise RuntimeError(
+                "measured tuner: every trial was disqualified "
+                f"({[e.get('disqualified') for e in self.table]}); "
+                "raise the budget or widen the space")
+        best = max(qualified, key=lambda e: e.get("score", 0.0))
+        worst = min(self.table, key=lambda e: e.get("score", 0.0))
+        if worst is best and len(self.table) > 1:
+            worst = min((e for e in self.table if e is not best),
+                        key=lambda e: e.get("score", 0.0))
+        result: Dict[str, Any] = {
+            "fingerprint": self.fingerprint,
+            "winner": best["point"],
+            "winner_key": best["key"],
+            "score": best.get("score", 0.0),
+            "cost_model": self.cost_model.to_dict(),
+            "table": self.table,
+            "cached": False,
+            "trials_run": self.trials_run,
+        }
+        if self.baseline_key is not None:
+            base = next((e for e in self.table
+                         if e["key"] == self.baseline_key), None)
+            if base is not None:
+                result["baseline_key"] = self.baseline_key
+                result["baseline_score"] = base.get("score", 0.0)
+                if base.get("score", 0.0) > 0:
+                    result["winner_gain"] = round(
+                        result["score"] / base["score"], 4)
+        if self.calibrate:
+            calibrated = calibrate_cost_model(self.table,
+                                              base=self.cost_model)
+            if calibrated is not None:
+                result["cost_model"] = calibrated.to_dict()
+                result["cost_model_calibrated"] = True
+                result["rank_correlation"] = round(
+                    self._rank_correlation(calibrated), 4)
+        self.result = result
+        os.makedirs(self.cache_dir, exist_ok=True)
+        tmp = self.cache_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=1, default=str)
+        os.replace(tmp, self.cache_path)
+        log_dist(f"measured tuner: winner {best['key']} "
+                 f"(goodput score {result['score']:.4f}) -> "
+                 f"{self.cache_path}", ranks=[0])
+        self._emit_bundle("trial_best", best)
+        if worst is not best:
+            self._emit_bundle("trial_worst", worst)
+        return result
+
+    def _rank_correlation(self, model: ScheduleCostModel) -> float:
+        """Spearman rho between the (calibrated) cost model's ranking of
+        the measured trials and their measured step times — the
+        ranking-improves acceptance metric."""
+        rows = [e for e in self.table
+                if e.get("measured_step_s") and e.get("flops")
+                and e.get("wire_bytes", 0) > 0]
+        if len(rows) < 2:
+            return 0.0
+        pred = [model.score(e["flops"], e.get("wire_bytes", 0.0),
+                            e.get("hlo_collectives", 0.0),
+                            e.get("static_overlap_fraction", 0.0))
+                for e in rows]
+        meas = [e["measured_step_s"] for e in rows]
+        return rank_correlation(pred, meas)
+
+    # ---------------------------------------------------------------- bundles
+    def _emit_bundle(self, kind: str, entry: Dict[str, Any]):
+        """One audit bundle for this trial: the score breakdown (its
+        goodput window sums to the trial wall-clock by construction),
+        the trial's compile events, and the sweep table ride in the
+        bundle's status sections."""
+        if self.recorder is None:
+            return
+        audit = {
+            "key": entry["key"],
+            "point": entry["point"],
+            "score": entry.get("score", 0.0),
+            "score_breakdown": entry.get("score_breakdown", {}),
+            "compile_events": entry.get("compile_events", []),
+            "measured_step_s": entry.get("measured_step_s"),
+            "disqualified": entry.get("disqualified"),
+        }
+        self.recorder.add_provider("trial", lambda a=audit: a)
+        try:
+            self.recorder.trigger(
+                kind,
+                f"{entry['key']}: goodput score "
+                f"{entry.get('score', 0.0):.4f}"
+                + (f" DQ[{entry['disqualified']}]"
+                   if entry.get("disqualified") else ""),
+                force=True)
+        finally:
+            self.recorder._providers.pop("trial", None)
+
+    # ----------------------------------------------------------------- status
+    def statusz_section(self) -> Dict[str, Any]:
+        """The ``tuning`` statusz section / ds_tpu_top panel: sweep
+        progress, per-trial scores, winner delta vs the hand-written
+        baseline plan."""
+        out: Dict[str, Any] = {
+            "fingerprint": self.fingerprint,
+            "state": self.state,
+            "trials_total": self.trials_total,
+            "trials_done": self.trials_done,
+        }
+        if self.current_key:
+            out["current"] = self.current_key
+        if self.table:
+            out["trials"] = [
+                {"key": e["key"], "score": round(e.get("score", 0.0), 4),
+                 "productive_fraction": round(
+                     e.get("productive_fraction", 0.0), 4),
+                 "step_tflops": round(e.get("step_tflops", 0.0), 4),
+                 **({"disqualified": e["disqualified"]}
+                    if e.get("disqualified") else {})}
+                for e in self.table]
+        if self.result is not None:
+            out["winner_key"] = self.result.get("winner_key")
+            out["winner_score"] = round(self.result.get("score", 0.0), 4)
+            out["cached"] = bool(self.result.get("cached"))
+            if "winner_gain" in self.result:
+                out["winner_gain"] = self.result["winner_gain"]
+                out["baseline_key"] = self.result.get("baseline_key")
+            if "rank_correlation" in self.result:
+                out["rank_correlation"] = self.result["rank_correlation"]
+            if self.result.get("cost_model_calibrated"):
+                out["cost_model_calibrated"] = True
+        return out
+
+    def attach_statusz(self, server):
+        """Register the ``tuning`` section on a live statusz server."""
+        server.register("tuning", self.statusz_section)
+        return self
+
+    def _export_gauges(self):
+        tr = self.tracer
+        tr.set_counter("autotune/trials_total", float(self.trials_total),
+                       owner=self)
+        tr.set_counter("autotune/trials_done", float(self.trials_done),
+                       owner=self)
+        if self.result is not None:
+            tr.set_counter("autotune/winner_score",
+                           float(self.result.get("score", 0.0)),
+                           owner=self)
+
+    def close(self):
+        """Retract the sweep's gauges and the bundle recorder's — a
+        finished tuner must not read as live in /metrics. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.recorder is not None:
+            self.recorder.close()
+        self.tracer.release_counters(self)
+
+
+# ------------------------------------------------------------- convenience
+
+def measure_schedule(model_factory: Callable[[], Any],
+                     base_config: Dict[str, Any],
+                     batch_factory: Callable[[int], Any],
+                     points: Optional[Sequence[TrialPoint]] = None,
+                     autotune: Optional[AutotuneConfig] = None,
+                     cache_dir: Optional[str] = None,
+                     bundle_dir: Optional[str] = None,
+                     force: bool = False,
+                     statusz=None,
+                     keep_tuner: bool = False) -> Dict[str, Any]:
+    """End-to-end measured sweep: probe engine for the fingerprint and
+    mesh geometry, enumerate (or accept) the trial space, run the
+    measured trials, persist/bundle/calibrate. Returns the result dict;
+    with ``keep_tuner`` the live tuner rides along under ``"_tuner"``
+    (the CLI uses it for the statusz section; it is NOT serialized)."""
+    import deepspeed_tpu
+    from ..parallel import topology
+
+    at = autotune or AutotuneConfig.from_dict(
+        dict(base_config.get("autotune") or {}))
+    at.validate()
+    probe_cfg = copy.deepcopy(base_config)
+    for key in ("autotune", "autotuning"):
+        probe_cfg.pop(key, None)
+    topology.reset_mesh()
+    probe, _, _, _ = deepspeed_tpu.initialize(
+        model=model_factory(), config=probe_cfg)
+    try:
+        fingerprint = measure_fingerprint(probe, at.hbm_budget_gib)
+        dp = probe.dp_world_size
+        global_batch = probe.train_batch_size
+    finally:
+        probe.close(release_ledger=True)
+
+    if points is None:
+        points = default_trial_space(
+            global_batch, dp,
+            micro_ladder=[int(m) for m in at.micro_batch_sizes],
+            remats=tuple(at.remat), offloads=tuple(at.offload),
+            compressions=tuple(at.compressions),
+            bucket_sizes=[int(b) for b in at.bucket_bytes],
+            include_overlap=at.overlap)
+    baseline = point_from_config(base_config, dp=dp,
+                                 global_batch=global_batch)
+    points = list(points)
+    if baseline.feasible(dp, global_batch) is None and \
+            baseline not in points:
+        points.insert(0, baseline)
+
+    basis = {"flops": None}   # lazy: a cache hit never pays the probe
+
+    def trial(point: TrialPoint) -> Dict[str, Any]:
+        if basis["flops"] is None:
+            basis["flops"] = probe_flops_basis(
+                model_factory, base_config, batch_factory)
+        return run_measured_trial(
+            model_factory, base_config, batch_factory, point,
+            steps=at.steps, warmup_steps=at.warmup_steps,
+            hbm_budget_gib=at.hbm_budget_gib,
+            flops_per_step=basis["flops"])
+
+    tuner = MeasuredTuner(
+        trial, fingerprint, points,
+        cache_dir=cache_dir or at.cache_dir,
+        bundle_dir=bundle_dir or at.bundle_dir,
+        baseline_key=baseline.key(), calibrate=at.calibrate)
+    if statusz is not None:
+        tuner.attach_statusz(statusz)
+    try:
+        result = tuner.tune(force=force)
+        result["tuning"] = tuner.statusz_section()
+        if keep_tuner:
+            result["_tuner"] = tuner
+        return result
+    finally:
+        if not keep_tuner:
+            tuner.close()

@@ -1,0 +1,263 @@
+"""Measured-trial space + scoring for the goodput-driven autotuning loop.
+
+The static schedule tuner (autotuning/schedule.py) ranks comm-schedule
+plans from a lowered-HLO cost model; this module defines what a MEASURED
+trial is. A :class:`TrialPoint` is one point of the joint space the
+reference ``autotuning/`` subsystem sweeps by running real configs —
+
+    (micro-batch, remat policy, offload mode, comm-compression policy,
+     overlap-schedule plan)
+
+— and a :class:`TrialScore` is what the observability plane says about a
+short real-steps run of that point: productive fraction from the goodput
+ledger's ``totals()`` window, step TFLOPs/MFU from the telemetry gauges,
+steady-state recompiles from the compile ledger, peak HBM from the HBM
+ledger. The headline number is **measured goodput** =
+``productive_fraction × step_tflops`` — how much useful model math per
+second of wall-clock the config actually delivered — subject to hard
+disqualification rules (OOM, NaN sentinel trip, steady-state recompiles,
+HBM over budget): a config that diverges, thrashes the jit cache, or
+doesn't fit the memory budget scores 0 no matter how fast its surviving
+steps were.
+
+``autotuning/measure.py`` owns the driver that runs the trials;
+``trials.py`` is pure data + space enumeration (no jax import at module
+level, so the AST lint plane and tests can load it standalone).
+"""
+
+import dataclasses
+import itertools
+from typing import Any, Dict, List, Optional, Sequence
+
+from .schedule import SchedulePlan, plan_from_config
+
+__all__ = ["TrialPoint", "TrialScore", "DISQUALIFY_REASONS",
+           "default_trial_space", "point_from_config"]
+
+#: hard-disqualification vocabulary (TrialScore.disqualified values)
+DISQUALIFY_REASONS = ("oom", "nan", "recompile_steady", "hbm_budget",
+                      "error")
+
+
+# ------------------------------------------------------------------ the point
+
+@dataclasses.dataclass(frozen=True)
+class TrialPoint:
+    """One point of the joint measured-trial space. The schedule-plan
+    axes (``overlap``/``bucket_bytes``/``compression``/``layer_chunking``)
+    mirror :class:`SchedulePlan` so a measured winner and a static winner
+    describe the same thing; ``zero_stage=None`` inherits the base
+    config's stage (hand-written configs carry their own — see
+    :func:`point_from_config`)."""
+    micro_bs: int = 2
+    remat: str = "none"            # none | full (activation checkpointing)
+    offload: str = "none"          # none | cpu | cpu_pipelined
+    compression: str = "off"       # off | int8 | fp8_block
+    overlap: bool = False
+    bucket_bytes: int = 4 << 20
+    layer_chunking: bool = True
+    zero_stage: Optional[int] = None
+
+    def schedule_plan(self) -> SchedulePlan:
+        return SchedulePlan(bucket_bytes=self.bucket_bytes,
+                            overlap=self.overlap,
+                            compression=self.compression,
+                            layer_chunking=self.layer_chunking)
+
+    def key(self) -> str:
+        parts = [f"micro={self.micro_bs}"]
+        if self.zero_stage is not None:
+            parts.append(f"z{self.zero_stage}")
+        if self.remat != "none":
+            parts.append(f"remat={self.remat}")
+        if self.offload != "none":
+            parts.append(f"offload={self.offload}")
+        parts.append(self.schedule_plan().key())
+        return "/".join(parts)
+
+    def feasible(self, dp: int, global_batch: int) -> Optional[str]:
+        """None when this point can run under ``(dp, global_batch)``,
+        else the reason it cannot (the space enumerator filters on it;
+        the driver treats an infeasible explicit point as a config
+        error, not a measurement)."""
+        if self.micro_bs < 1:
+            return "micro_bs must be >= 1"
+        if global_batch % (self.micro_bs * dp) != 0:
+            return (f"global batch {global_batch} not divisible by "
+                    f"micro {self.micro_bs} x dp {dp}")
+        if self.offload != "none" and (self.overlap or
+                                       self.compression != "off"):
+            # the explicit shard_map exchange (compressed_step.py /
+            # overlap_schedule.py) rejects host-offloaded masters
+            return "offload excludes the explicit overlap/compression path"
+        if dp <= 1 and self.compression != "off":
+            return "compression needs dp > 1"
+        if self.offload != "none" and (self.zero_stage or 0) >= 3:
+            return "offload_optimizer is a stage<=2 feature here"
+        return None
+
+    def config_overrides(self, global_batch: int, dp: int) -> Dict[str, Any]:
+        """The config blocks that make an engine run this point, given
+        the sweep's fixed global batch and dp width (gas is solved, the
+        global batch is the invariant the sweep holds)."""
+        gas = global_batch // (self.micro_bs * dp)
+        over: Dict[str, Any] = {
+            "train_batch_size": int(global_batch),
+            "train_micro_batch_size_per_gpu": int(self.micro_bs),
+            "gradient_accumulation_steps": int(gas),
+        }
+        plan = self.schedule_plan()
+        if plan.overlap or plan.compression != "off":
+            over.update(plan.config_overrides())
+        if self.remat == "full":
+            over["activation_checkpointing"] = {
+                "partition_activations": True}
+        if self.offload != "none":
+            dev = {"device": "cpu"}
+            if self.offload == "cpu_pipelined":
+                dev.update({"pipeline_read": True, "pipeline_write": True})
+            over["zero_optimization"] = {"offload_optimizer": dev}
+        if self.zero_stage is not None:
+            zo = dict(over.get("zero_optimization") or {})
+            zo["stage"] = int(self.zero_stage)
+            if self.zero_stage >= 3:
+                zo.setdefault("stage3_param_persistence_threshold", 0)
+            over["zero_optimization"] = zo
+        return over
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrialPoint":
+        return cls(**{f.name: d[f.name] for f in dataclasses.fields(cls)
+                      if f.name in d})
+
+
+def point_from_config(config: Dict[str, Any],
+                      dp: int = 1,
+                      global_batch: Optional[int] = None) -> TrialPoint:
+    """The TrialPoint a hand-written training config encodes — the
+    comparison row for "the measured winner beats every hand-written
+    config". Micro batch, remat, offload, ZeRO stage, and the schedule
+    plan are read from their blocks; a micro batch the sweep's global
+    batch cannot hold is clamped down to the largest divisor (the
+    hand-written intent, mapped onto the bench geometry)."""
+    plan = plan_from_config(config)
+    micro = int(config.get("train_micro_batch_size_per_gpu") or 1)
+    if global_batch is not None:
+        while micro > 1 and global_batch % (micro * dp) != 0:
+            micro -= 1
+    ac = dict(config.get("activation_checkpointing") or {})
+    remat = "full" if (ac.get("partition_activations") or
+                       ac.get("cpu_checkpointing")) else "none"
+    zo = dict(config.get("zero_optimization") or {})
+    oo = zo.get("offload_optimizer")
+    if isinstance(oo, dict) and oo.get("device", "cpu") != "none":
+        offload = "cpu_pipelined" if (oo.get("pipeline_read") or
+                                      oo.get("pipeline_write")) else "cpu"
+    else:
+        offload = "none"
+    stage = zo.get("stage")
+    return TrialPoint(
+        micro_bs=micro, remat=remat, offload=offload,
+        compression=plan.compression, overlap=plan.overlap,
+        bucket_bytes=plan.bucket_bytes,
+        layer_chunking=plan.layer_chunking,
+        zero_stage=int(stage) if stage is not None else None)
+
+
+def default_trial_space(global_batch: int, dp: int,
+                        micro_ladder: Sequence[int] = (1, 2, 4, 8),
+                        remats: Sequence[str] = ("none", "full"),
+                        offloads: Sequence[str] = ("none",),
+                        compressions: Sequence[str] = ("off",),
+                        bucket_sizes: Sequence[int] = (4 << 20,),
+                        include_overlap: bool = True) -> List[TrialPoint]:
+    """The standard joint sweep: cross product of the axes, filtered to
+    feasible points, monolithic plan first per combo (cheap-first order
+    so a ``--plans N`` cap still covers the micro ladder)."""
+    points: List[TrialPoint] = []
+    for micro, remat, offload, comp in itertools.product(
+            micro_ladder, remats, offloads, compressions):
+        plans = [TrialPoint(micro_bs=micro, remat=remat, offload=offload,
+                            compression=comp, overlap=False)]
+        if include_overlap:
+            plans += [TrialPoint(micro_bs=micro, remat=remat,
+                                 offload=offload, compression=comp,
+                                 overlap=True, bucket_bytes=int(b))
+                      for b in bucket_sizes]
+        points += [p for p in plans if p.feasible(dp, global_batch) is None]
+    # dedup while preserving order (axis collisions, e.g. comp=off twice)
+    seen = set()
+    out = []
+    for p in points:
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+    return out
+
+
+# ------------------------------------------------------------------ the score
+
+@dataclasses.dataclass
+class TrialScore:
+    """What the observability plane measured about one trial. ``score``
+    is measured goodput — productive fraction × achieved step TFLOPs —
+    and 0.0 whenever a hard disqualification rule fired."""
+    productive_fraction: float = 0.0
+    step_tflops: float = 0.0
+    mfu: float = 0.0
+    step_time_ms: float = 0.0
+    wall_s: float = 0.0
+    steps: int = 0
+    recompiles_steady: int = 0
+    peak_hbm_gib: float = 0.0
+    hbm_budget_gib: float = 0.0
+    goodput: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    disqualified: Optional[str] = None
+    detail: str = ""
+
+    @property
+    def score(self) -> float:
+        if self.disqualified:
+            return 0.0
+        return self.productive_fraction * self.step_tflops
+
+    def disqualify(self, reason: str, detail: str = ""):
+        assert reason in DISQUALIFY_REASONS, reason
+        self.disqualified = reason
+        if detail:
+            self.detail = detail
+
+    def breakdown(self) -> Dict[str, Any]:
+        """The auditable score arithmetic a trial bundle embeds: the
+        goodput window the fraction came from (buckets + idle sum to
+        ``wall_s`` by construction — the ±1% bundle consistency check),
+        the TFLOPs leg, and the product."""
+        out: Dict[str, Any] = {
+            "score": round(self.score, 6),
+            "formula": "productive_fraction * step_tflops",
+            "productive_fraction": round(self.productive_fraction, 6),
+            "step_tflops": round(self.step_tflops, 6),
+            "goodput_window": dict(self.goodput),
+            "steps": self.steps,
+            "step_time_ms": round(self.step_time_ms, 3),
+        }
+        if self.mfu:
+            out["mfu"] = round(self.mfu, 6)
+        if self.peak_hbm_gib:
+            out["peak_hbm_gib"] = round(self.peak_hbm_gib, 6)
+        if self.hbm_budget_gib:
+            out["hbm_budget_gib"] = round(self.hbm_budget_gib, 6)
+        if self.recompiles_steady:
+            out["recompiles_steady"] = self.recompiles_steady
+        if self.disqualified:
+            out["disqualified"] = self.disqualified
+            out["detail"] = self.detail
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["score"] = round(self.score, 6)
+        return d

@@ -2,6 +2,6 @@
 
 from .layer import MoE
 from .experts import ExpertFFN
-from .sharded_moe import MOELayer, TopKGate, topk_gating
+from .sharded_moe import MoeMetrics, MOELayer, TopKGate, topk_gating
 from .utils import (has_moe_layers, is_moe_param_path,
                     split_params_into_moe_and_dense)

@@ -30,7 +30,7 @@ explicit ep shard_map.
 """
 
 import math
-from typing import Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +38,86 @@ from jax import lax
 
 from ..parallel.constraints import maybe_constraint
 from ..parallel.topology import DATA_AXIS, EXPERT_AXIS
+
+
+class MoeMetrics:
+    """Owner-scoped ``dstpu_moe_*`` gauge family: per-expert load +
+    capacity-factor overflow telemetry (the ROADMAP item 3 seed —
+    expert-load imbalance is a goodput bucket waiting to exist, and the
+    first step is measuring it).
+
+    HOST-SIDE ONLY: ``record()`` takes the *concrete* ``exp_counts``
+    vector a step returned (``np.asarray`` it after the step — never
+    inside traced code, which the AST002 lint would flag) plus the
+    static per-expert capacity, and mirrors:
+
+    - ``moe/expert_load_max`` / ``moe/expert_load_mean`` — tokens routed
+      to the hottest expert vs the mean (pre-capacity-drop counts);
+    - ``moe/load_imbalance`` — max/mean ratio (1.0 = perfectly balanced;
+      E = everything on one expert);
+    - ``moe/dropped_token_fraction`` — routed tokens beyond capacity ÷
+      routed tokens this record (the capacity-factor overflow rate);
+    - ``moe/overflow_tokens`` / ``moe/overflow_steps`` — cumulative
+      overflow counters.
+
+    Gauges carry ``owner=`` this instance and are retracted by
+    ``close()`` — the PR-4 gauge-lifecycle contract
+    (test_metrics_lifecycle.py enforces both)."""
+
+    def __init__(self, tracer=None):
+        from ..telemetry.trace import get_tracer
+        self.tracer = tracer or get_tracer()
+        self.records = 0
+        self.overflow_tokens = 0
+        self.overflow_steps = 0
+        self._closed = False
+
+    def record(self, exp_counts, capacity: int,
+               step: Optional[int] = None) -> Dict[str, float]:
+        """Attribute one step's routing. ``exp_counts`` is [E] (or any
+        leading dims summed away, e.g. [layers, E]) of tokens routed per
+        expert BEFORE the capacity drop; ``capacity`` is the static slot
+        count per expert the dispatch tensor enforced."""
+        import numpy as np
+
+        counts = np.asarray(exp_counts, dtype=np.float64)
+        counts = counts.reshape(-1, counts.shape[-1]).sum(axis=0)
+        routed = float(counts.sum())
+        n_experts = max(1, counts.shape[0])
+        mean = routed / n_experts
+        dropped = float(np.maximum(counts - float(capacity), 0.0).sum()) \
+            if capacity else 0.0
+        self.records += 1
+        if dropped > 0:
+            self.overflow_tokens += int(dropped)
+            self.overflow_steps += 1
+        out = {
+            "expert_load_max": float(counts.max()) if routed else 0.0,
+            "expert_load_mean": mean,
+            "load_imbalance":
+                float(counts.max()) / mean if mean > 0 else 0.0,
+            "dropped_token_fraction": dropped / routed if routed else 0.0,
+            "overflow_tokens": float(self.overflow_tokens),
+            "overflow_steps": float(self.overflow_steps),
+        }
+        for name, val in out.items():
+            self.tracer.set_counter(f"moe/{name}", round(val, 6),
+                                    step, owner=self)
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        """Statusz/bundle view of the cumulative overflow counters."""
+        return {"records": self.records,
+                "overflow_tokens": self.overflow_tokens,
+                "overflow_steps": self.overflow_steps}
+
+    def close(self):
+        """Retract this family from the shared counter space — a closed
+        MoE run's imbalance must not read as live. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.tracer.release_counters(self)
 
 
 def _capacity(num_tokens: int, num_experts: int, k: int,

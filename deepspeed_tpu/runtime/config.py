@@ -442,6 +442,9 @@ class DeepSpeedConfig:
         self.seed = pd.get("seed", 42)
         self.elasticity = pd.get(C.ELASTICITY, {})
         self.autotuning = pd.get(C.AUTOTUNING, {})
+        # measured-trials sweep parameters (autotuning/measure.py): the
+        # engine carries the block; `ds_tpu_tune --measure` consumes it
+        self.autotune = pd.get(C.AUTOTUNE, {})
         self.compression = pd.get(C.COMPRESSION_TRAINING, {})
         self.data_efficiency = pd.get(C.DATA_EFFICIENCY, {})
         self.curriculum_learning_legacy = pd.get(C.CURRICULUM_LEARNING_LEGACY, {})

@@ -110,6 +110,9 @@ CURRICULUM_LEARNING_LEGACY = "curriculum_learning"
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 ELASTICITY = "elasticity"
 AUTOTUNING = "autotuning"
+# the measured-trials sweep (autotuning/measure.py AutotuneConfig):
+# consumed by `ds_tpu_tune --measure`, carried inert by the engine
+AUTOTUNE = "autotune"
 EIGENVALUE = "eigenvalue"
 QUANTIZE_TRAINING = "quantize_training"
 CHECKPOINT = "checkpoint"

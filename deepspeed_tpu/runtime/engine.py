@@ -1857,14 +1857,21 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # introspection / properties (reference engine property surface)
     # ------------------------------------------------------------------
-    def close(self):
+    def close(self, release_ledger: bool = False):
         """Release this engine's observability footprint: stop the statusz
         server (port + thread), close the monitor sinks, and retract this
         engine's gauges from the shared telemetry counter space — with two
         co-resident engines, prometheus_dump()//metrics must not keep
         reporting a closed engine's last step time as live. Idempotent;
         params/optimizer state are untouched (a closed engine can still
-        train, it just stops being observable)."""
+        train, it just stops being observable).
+
+        ``release_ledger=True`` additionally disables the process-global
+        goodput ledger and retracts its ``goodput/*`` gauge mirror — the
+        trial-scoped lifecycle (autotuning/measure.py): back-to-back trial
+        engines each re-enable the ledger from a fresh epoch, and a
+        finished trial's bucket totals must not read as live between
+        trials."""
         if self._closed:
             return
         self._closed = True
@@ -1875,6 +1882,9 @@ class DeepSpeedEngine:
         if self._recorder is not None:
             self._recorder.close()
         self.tracer.release_counters(self)
+        if release_ledger:
+            from ..telemetry.goodput import configure_ledger
+            configure_ledger(enabled=False)
 
     def _health_check(self):
         """Training liveness: unhealthy once a preemption signal latched

@@ -35,6 +35,14 @@ class SLOConfig(DeepSpeedConfigModel):
     e2e_ms: Optional[float] = None
     #: fraction of samples that must meet each target (0.99 = "p99 SLO")
     target: float = 0.99
+    #: age samples out of the sliding windows by WALL CLOCK after this
+    #: many seconds (None = count-bounded only). Without it an idle
+    #: replica's windows are frozen history — its last burn rate reads
+    #: as live forever, which starves it in the router's burn-penalty
+    #: score and can pin autoscaling; with it, ``last_burn_rate`` and
+    #: the dstpu_tenant_* burn gauges relax to 0 once the replica has
+    #: been idle for ``decay_s``.
+    decay_s: Optional[float] = None
 
     def validate(self):
         if self.window < 8:
@@ -45,6 +53,8 @@ class SLOConfig(DeepSpeedConfigModel):
             val = getattr(self, name)
             if val is not None and val <= 0:
                 raise ConfigError(f"slo.{name} must be > 0 when set")
+        if self.decay_s is not None and self.decay_s <= 0:
+            raise ConfigError("slo.decay_s must be > 0 when set")
 
 
 @dataclasses.dataclass

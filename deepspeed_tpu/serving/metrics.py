@@ -21,6 +21,7 @@ configured targets (``slo.ttft_ms`` / ``tpot_ms`` / ``e2e_ms`` at
 ÷ allowed violation rate (>1 = out of budget).
 """
 
+import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
@@ -40,14 +41,19 @@ class _TenantStats:
     dimension of the serving metrics. Memory is O(window) per TRACKED
     tenant, and the tracked set is capped (tenants.max_tracked) with
     overflow folded into ``__other__`` — tenant strings are
-    client-controlled and must not become an unbounded gauge family."""
+    client-controlled and must not become an unbounded gauge family.
+    The ``*_t`` deques are the sample timestamps, appended in lockstep
+    with the values (same maxlen, so count-eviction stays aligned) —
+    what ``slo.decay_s`` ages the window by."""
 
-    __slots__ = ("ttft_ms", "e2e_ms", "submitted", "completed",
-                 "tokens_out", "timeouts")
+    __slots__ = ("ttft_ms", "e2e_ms", "ttft_t", "e2e_t", "submitted",
+                 "completed", "tokens_out", "timeouts")
 
     def __init__(self, window: int):
         self.ttft_ms: "deque[float]" = deque(maxlen=window)
         self.e2e_ms: "deque[float]" = deque(maxlen=window)
+        self.ttft_t: "deque[float]" = deque(maxlen=window)
+        self.e2e_t: "deque[float]" = deque(maxlen=window)
         self.submitted = 0
         self.completed = 0
         self.tokens_out = 0
@@ -59,7 +65,7 @@ class ServingMetrics:
     optional MonitorMaster fan-out on ``flush()``."""
 
     def __init__(self, monitor=None, monitor_interval: int = 16,
-                 tracer=None, slo=None, tenants=None):
+                 tracer=None, slo=None, tenants=None, clock=None):
         self.monitor = monitor
         self.monitor_interval = monitor_interval
         self.tracer = tracer or get_tracer()
@@ -67,14 +73,25 @@ class ServingMetrics:
         self.tenants_cfg = tenants
         window = int(getattr(slo, "window", 1024) or 1024)
         self.window = window
+        #: wall-clock aging of the windows (slo.decay_s): None = count-
+        #: bounded only; set = samples older than decay_s leave the
+        #: window, so an IDLE replica's burn rate relaxes to 0 instead of
+        #: freezing at whatever its last traffic looked like. The clock
+        #: is injectable for tests.
+        self._decay_s = getattr(slo, "decay_s", None)
+        self._clock = clock or time.monotonic
         #: per-tenant SLO windows (``dstpu_tenant_*`` gauge family,
         #: owner = this instance so close() retracts them)
         self.tenant_stats: Dict[str, _TenantStats] = {}
         self._tenant_cap = int(getattr(tenants, "max_tracked", 64) or 64)
-        # bounded percentile sources: O(window) forever
+        # bounded percentile sources: O(window) forever; the _t deques
+        # are per-sample timestamps appended in lockstep (same maxlen)
         self.ttft_ms: "deque[float]" = deque(maxlen=window)
         self.token_ms: "deque[float]" = deque(maxlen=window)
         self.e2e_ms: "deque[float]" = deque(maxlen=window)
+        self._ttft_t: "deque[float]" = deque(maxlen=window)
+        self._token_t: "deque[float]" = deque(maxlen=window)
+        self._e2e_t: "deque[float]" = deque(maxlen=window)
         self.submitted = 0
         self.completed = 0
         self.rejected = 0
@@ -99,9 +116,50 @@ class ServingMetrics:
         #: ticks by _emit_slo_gauges); None until targets produce one.
         #: The per-tick flight-recorder path reads this instead of
         #: re-walking the O(window) percentile sources every tick.
-        self.last_burn_rate: Optional[float] = None
+        self.last_burn_rate = None
         self._events: List[Tuple[str, float, int]] = []
         self._closed = False
+
+    # ----------------------------------------------------------- decay
+    @property
+    def last_burn_rate(self) -> Optional[float]:
+        """The cached burn rate — but with ``slo.decay_s`` set, reading
+        it first ages the windows by wall clock and, when anything aged
+        out, refreshes the burn + tenant gauges from the pruned windows.
+        An idle replica's burn therefore relaxes to 0 on the next READ
+        (the router's scoring/autoscale path) with no tick required,
+        while an active replica's fresh samples never age out."""
+        if self._decay_s and self._prune():
+            self._emit_slo_gauges()
+        return self._last_burn
+
+    @last_burn_rate.setter
+    def last_burn_rate(self, value: Optional[float]):
+        self._last_burn = value
+
+    def _window_pairs(self):
+        yield self.ttft_ms, self._ttft_t
+        yield self.token_ms, self._token_t
+        yield self.e2e_ms, self._e2e_t
+        for st in self.tenant_stats.values():
+            yield st.ttft_ms, st.ttft_t
+            yield st.e2e_ms, st.e2e_t
+
+    def _prune(self) -> bool:
+        """Age out samples older than ``slo.decay_s`` (values and
+        timestamps leave in lockstep). Cheap when nothing expired: one
+        peek per window. Returns True when anything was removed."""
+        if not self._decay_s:
+            return False
+        cutoff = self._clock() - float(self._decay_s)
+        removed = False
+        for vals, stamps in self._window_pairs():
+            while stamps and stamps[0] < cutoff:
+                stamps.popleft()
+                if vals:
+                    vals.popleft()
+                removed = True
+        return removed
 
     # ------------------------------------------------------------- recording
     def _tenant(self, name) -> _TenantStats:
@@ -129,12 +187,19 @@ class ServingMetrics:
         self._emit("serving/timeouts", self.timeouts)
         self._tenant(tenant).timeouts += 1
 
+    def _now(self) -> float:
+        """Sample timestamp for the decay clock; 0.0 (never read) when
+        decay is off, so the hot recording paths stay clock-free."""
+        return self._clock() if self._decay_s else 0.0
+
     def record_ttft(self, seconds: float, tenant=None):
         self.ttft_ms.append(seconds * 1e3)
+        self._ttft_t.append(self._now())
         self.tokens_out += 1         # the first token is sampled at prefill
         self._emit("serving/ttft_ms", seconds * 1e3)
         t = self._tenant(tenant)
         t.ttft_ms.append(seconds * 1e3)
+        t.ttft_t.append(self._now())
         t.tokens_out += 1
 
     def record_decode_step(self, seconds: float, n_active: int):
@@ -142,6 +207,7 @@ class ServingMetrics:
         token: the per-token latency every active request observed is the
         step wall time."""
         self.token_ms.append(seconds * 1e3)
+        self._token_t.append(self._now())
         self.tokens_out += n_active
 
     def record_tenant_tokens(self, tenant, n: int = 1):
@@ -159,8 +225,10 @@ class ServingMetrics:
         if finish is not None and submit is not None and finish >= submit:
             e2e = (finish - submit) * 1e3
             self.e2e_ms.append(e2e)
+            self._e2e_t.append(self._now())
             self._emit("serving/e2e_ms", e2e)
             tstats.e2e_ms.append(e2e)
+            tstats.e2e_t.append(self._now())
 
     def record_spec_tick(self, step_s: float, n_active: int, k: int,
                          accepted: int, emitted: int, draft_s: float,
@@ -177,6 +245,7 @@ class ServingMetrics:
         self.tokens_out += emitted
         per_req = max(1.0, emitted / max(1, n_active))
         self.token_ms.append(step_s * 1e3 / per_req)
+        self._token_t.append(self._now())
         self.spec_draft_ms = draft_s * 1e3
         self.spec_verify_ms = verify_s * 1e3
         rate = accepted / max(1, k * n_active)
@@ -235,6 +304,7 @@ class ServingMetrics:
 
     def percentiles(self) -> Dict[str, Dict[str, float]]:
         """p50/p95/p99 over the sliding windows, per latency metric."""
+        self._prune()
         out = {}
         for name, window in self._windows().items():
             vals = sorted(window)
@@ -248,6 +318,7 @@ class ServingMetrics:
         """Per-metric in-window violation fraction + the overall burn
         rate (worst metric). Metrics without a configured target report
         percentiles only."""
+        self._prune()
         target = float(getattr(self.slo, "target", 0.99) or 0.99)
         allowed = max(1e-9, 1.0 - target)
         targets = self._slo_targets()
@@ -272,6 +343,7 @@ class ServingMetrics:
         (tenant isolation means every tenant is held to the same SLO —
         per-tenant targets would hide the whale's damage), and the
         share of served tokens."""
+        self._prune()
         target = float(getattr(self.slo, "target", 0.99) or 0.99)
         allowed = max(1e-9, 1.0 - target)
         targets = self._slo_targets()

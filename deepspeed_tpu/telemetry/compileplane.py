@@ -335,6 +335,19 @@ class CompileLedger:
     def events(self) -> List[Dict[str, Any]]:
         return list(self._events)
 
+    def events_since(self, event_id: int) -> List[Dict[str, Any]]:
+        """Events with id strictly greater than ``event_id`` — the
+        measured-trial read API (autotuning/measure.py): a trial driver
+        remembers the last id after warmup, and any event returned here
+        during the measured window is a steady-state recompile (a hard
+        disqualification). Pass 0 for the full history."""
+        return [ev for ev in self._events if ev["id"] > event_id]
+
+    @property
+    def last_event_id(self) -> int:
+        """Highest event id issued so far (0 before the first event)."""
+        return self._next_id - 1
+
     def last_event(self, label: Optional[str] = None) \
             -> Optional[Dict[str, Any]]:
         for ev in reversed(self._events):

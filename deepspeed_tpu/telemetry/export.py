@@ -271,6 +271,16 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
             host_lines.append(f"# TYPE {prefix}_elastic_{name} gauge")
             host_lines.append(f"{prefix}_elastic_{name} {fval}")
             continue
+        if tag.startswith("moe/"):
+            # expert-parallel telemetry (moe/sharded_moe.py MoeMetrics):
+            # dedicated dstpu_moe_load_imbalance / _dropped_token_fraction
+            # / _overflow_tokens series — capacity-factor overflow is an
+            # alerting target (dropped tokens are silent quality loss),
+            # not a label-matched lookup
+            name = _prom(tag[len("moe/"):])
+            host_lines.append(f"# TYPE {prefix}_moe_{name} gauge")
+            host_lines.append(f"{prefix}_moe_{name} {fval}")
+            continue
         if tag.startswith("spec/"):
             # speculative-decode gauges (serving/metrics.py): dedicated
             # dstpu_spec_acceptance_ema / _tokens_per_tick / _draft_ms /

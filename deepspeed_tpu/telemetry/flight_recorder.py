@@ -57,10 +57,15 @@ from .trace import get_tracer
 
 __all__ = ["FlightRecorder", "TRIGGER_KINDS"]
 
-#: the trigger-rule vocabulary (bundle filenames carry the kind)
+#: the trigger-rule vocabulary (bundle filenames carry the kind).
+#: ``trial_best`` / ``trial_worst`` are fired once per measured autotuning
+#: sweep (autotuning/measure.py) with the winning and losing trial's
+#: goodput table, compile events, and score breakdown embedded — every
+#: tuning decision stays auditable post-hoc.
 TRIGGER_KINDS = ("slow_step", "recompile", "sentinel", "slo_burn",
                  "preemption", "straggler", "failover", "overlap_drop",
-                 "acceptance_drop", "resize", "manual")
+                 "acceptance_drop", "resize", "trial_best", "trial_worst",
+                 "manual")
 
 
 class FlightRecorder:

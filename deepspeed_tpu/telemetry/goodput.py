@@ -196,6 +196,33 @@ class GoodputLedger:
         with self._lock:
             return dict(self._buckets)
 
+    def window(self, before: Dict[str, float],
+               wall_s: float) -> Dict[str, object]:
+        """Bucket deltas since ``before`` (a prior ``totals()`` snapshot)
+        as a self-contained windowed accounting over ``wall_s`` seconds of
+        wall-clock: per-bucket seconds, the ``idle`` residual, productive
+        seconds, and the window's goodput fraction. The measured-trial
+        read API (autotuning/measure.py): a trial driver snapshots
+        ``totals()`` after warmup and scores only the steady-state window,
+        so compile time never pollutes a trial's productive fraction.
+        Buckets (idle included) sum to ``wall_s`` by construction."""
+        wall = max(0.0, float(wall_s))
+        totals = self.totals()
+        buckets = {}
+        for name, secs in totals.items():
+            delta = secs - before.get(name, 0.0)
+            if delta > 1e-9:
+                buckets[name] = round(delta, 6)
+        attributed = sum(buckets.values())
+        buckets["idle"] = round(max(0.0, wall - attributed), 6)
+        productive = sum(buckets.get(b, 0.0) for b in PRODUCTIVE_BUCKETS)
+        return {
+            "wall_s": round(wall, 6),
+            "buckets": buckets,
+            "productive_s": round(productive, 6),
+            "goodput_fraction": round(productive / wall, 6) if wall else 0.0,
+        }
+
     def wall_seconds(self) -> float:
         if self._t0 is None:
             return 0.0

@@ -1,0 +1,542 @@
+"""Measured-trials autotuning plane (autotuning/measure.py + trials.py).
+
+Covers the PR-15 contract (ROADMAP item 5):
+- trial-space enumeration respects feasibility (batch divisibility,
+  offload vs explicit-exchange exclusivity) and points round-trip
+  through JSON / hand-written configs;
+- deterministic trial scoring on a REAL (tiny) engine: qualified trial's
+  goodput window sums to its wall-clock within 1%, and the score is
+  productive_fraction x step TFLOPs;
+- injected NaN (fault point) and an injected mid-window shape change
+  (recompile) each hard-disqualify the trial;
+- the winner cache: same measure fingerprint loads with ZERO trials
+  run, force re-sweeps, a different fingerprint re-sweeps;
+- exactly one trial_best + one trial_worst bundle per sweep, each
+  embedding the trial's goodput table, compile events, and score
+  breakdown;
+- measured trials calibrate the ScheduleCostModel: a rigged plan pair
+  the static constants misrank is re-ranked correctly, rank correlation
+  1.0 vs measured;
+- the statusz "tuning" section round-trips as JSON and serves over a
+  live statusz server; ds_tpu_top renders it and degrades on pre-PR-15
+  snapshots;
+- `ds_tpu_tune --measure --plans 3 --steps 2` CLI smoke (tier-1); the
+  full joint sweep is marked slow.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from deepspeed_tpu.autotuning.cost_model import (  # noqa: E402
+    ScheduleCostModel, calibrate_cost_model, rank_correlation)
+from deepspeed_tpu.autotuning.measure import (  # noqa: E402
+    AutotuneConfig, MeasuredTuner, measure_schedule, run_measured_trial)
+from deepspeed_tpu.autotuning.trials import (  # noqa: E402
+    TrialPoint, TrialScore, default_trial_space, point_from_config)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    from deepspeed_tpu.comm import reset_comm_stats
+    from deepspeed_tpu.telemetry import configure_ledger, get_tracer
+    reset_comm_stats()
+    yield
+    configure_ledger(enabled=False)
+    get_tracer().clear()
+    get_tracer().configure(enabled=False)
+    reset_comm_stats()
+
+
+# ------------------------------------------------------------- trial space
+
+def test_trial_space_feasibility_and_roundtrip():
+    pts = default_trial_space(64, 8, micro_ladder=(1, 2, 4, 8, 3),
+                              offloads=("none", "cpu"),
+                              compressions=("off", "int8"),
+                              bucket_sizes=(1 << 20,))
+    keys = {p.key() for p in pts}
+    # micro=3 does not divide 64/8: filtered
+    assert not any("micro=3" in k for k in keys)
+    # offload excludes the explicit overlap/compression path
+    assert not any("offload" in k and ("bucket" in k or "int8" in k)
+                   for k in keys)
+    assert "micro=8/monolithic/comp=off" in keys
+    assert "micro=2/offload=cpu/monolithic/comp=off" in keys
+    for p in pts:
+        assert p.feasible(8, 64) is None
+        assert TrialPoint.from_dict(json.loads(json.dumps(
+            p.to_dict()))) == p
+
+
+def test_point_from_config_maps_handwritten_knobs():
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "zero_optimization": {"stage": 2, "offload_optimizer": {
+            "device": "cpu", "pipeline_read": True}},
+        "activation_checkpointing": {"partition_activations": True},
+        "comm_compression": {"enabled": True, "all_gather": "int8"},
+    }
+    p = point_from_config(cfg, dp=8, global_batch=64)
+    assert p.micro_bs == 8 and p.zero_stage == 2
+    assert p.offload == "cpu_pipelined" and p.remat == "full"
+    assert p.compression == "int8"
+    # a micro the bench geometry cannot hold clamps to a divisor
+    p2 = point_from_config({"train_micro_batch_size_per_gpu": 8}, dp=8,
+                           global_batch=40)
+    assert p2.micro_bs == 5
+    # empty config = monolithic defaults
+    p3 = point_from_config({}, dp=8, global_batch=64)
+    assert not p3.overlap and p3.compression == "off"
+
+
+def test_trial_point_config_overrides_solve_gas():
+    p = TrialPoint(micro_bs=2, remat="full", offload="cpu", zero_stage=2)
+    over = p.config_overrides(64, 8)
+    assert over["train_batch_size"] == 64
+    assert over["gradient_accumulation_steps"] == 4
+    assert over["activation_checkpointing"]["partition_activations"]
+    assert over["zero_optimization"]["offload_optimizer"]["device"] == \
+        "cpu"
+    assert over["zero_optimization"]["stage"] == 2
+    # overlap plans carry the schedule blocks
+    p2 = TrialPoint(micro_bs=4, overlap=True, bucket_bytes=1 << 20,
+                    compression="int8")
+    over2 = p2.config_overrides(64, 8)
+    assert over2["overlap_schedule"]["bucket_bytes"] == 1 << 20
+    assert over2["comm_compression"]["all_gather"] == "int8"
+
+
+def test_autotune_config_validation():
+    from deepspeed_tpu.runtime.config_utils import ConfigError
+    AutotuneConfig.from_dict({"steps": 2, "remat": ["none"]}).validate()
+    with pytest.raises(ConfigError, match="steps"):
+        AutotuneConfig.from_dict({"steps": 0}).validate()
+    with pytest.raises(ConfigError, match="remat"):
+        AutotuneConfig.from_dict({"remat": ["everything"]}).validate()
+    with pytest.raises(ConfigError, match="hbm_budget"):
+        AutotuneConfig.from_dict({"hbm_budget_gib": -1}).validate()
+    # the `autotune` key is in the registered config surface (AST004)
+    from deepspeed_tpu.analysis.pylint_rules import harvest_config_keys
+    known = harvest_config_keys(REPO)
+    assert "autotune" in known
+    assert "hbm_budget_gib" in known and "decay_s" in known
+
+
+# --------------------------------------------------- real-engine trials
+
+def _tiny_setup(vocab=256, n_layer=1, seq=24):
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    cfg = GPT2Config(vocab_size=vocab, n_positions=seq + 1, n_embd=32,
+                     n_layer=n_layer, n_head=2, pad_vocab_to_multiple=8)
+    rng = np.random.default_rng(0)
+
+    def batch_factory(gbs, seq_len=seq):
+        toks = rng.integers(0, vocab - 2, (1, gbs, seq_len + 1))
+        return {"input_ids": toks.astype(np.int32)}
+
+    base = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 0,
+    }
+    return (lambda: GPT2Model(cfg)), base, batch_factory
+
+
+def test_measured_trial_scores_real_engine():
+    """A qualified trial on a real tiny engine: productive fraction in
+    (0, 1], TFLOPs > 0, score = fraction x TFLOPs, and the goodput
+    window's buckets (idle included) sum to the measured wall within 1%
+    — the bundle-consistency contract."""
+    model_factory, base, batch_factory = _tiny_setup()
+    entry = run_measured_trial(model_factory, base, batch_factory,
+                               TrialPoint(micro_bs=2), steps=2,
+                               warmup_steps=1)
+    assert entry["disqualified"] is None
+    assert 0 < entry["productive_fraction"] <= 1.0
+    assert entry["step_tflops"] > 0
+    assert entry["score"] == pytest.approx(
+        entry["productive_fraction"] * entry["step_tflops"], abs=1e-6)
+    win = entry["score_breakdown"]["goodput_window"]
+    assert sum(win["buckets"].values()) == \
+        pytest.approx(win["wall_s"], rel=0.01)
+    assert entry["score_breakdown"]["formula"] == \
+        "productive_fraction * step_tflops"
+    # static cost inputs for calibration captured from the compile plane
+    assert entry["flops"] > 0 and entry["measured_step_s"] > 0
+    assert entry["compile_events"]
+    # trial-scoped lifecycle: no gauge survives the trial engine
+    from deepspeed_tpu.telemetry import get_tracer
+    assert not [t for t in get_tracer().counters()
+                if t.startswith(("telemetry/", "goodput/"))]
+
+
+def test_nan_trial_disqualified():
+    """An injected NaN loss (the resilience fault point) inside the
+    measured window trips the sentinel and hard-disqualifies the trial:
+    score 0 regardless of its timing."""
+    from deepspeed_tpu.resilience.faults import get_injector
+    model_factory, base, batch_factory = _tiny_setup()
+    get_injector().arm("nan_loss", times=1, skip=1)   # 2nd step = measured
+    entry = run_measured_trial(model_factory, base, batch_factory,
+                               TrialPoint(micro_bs=2), steps=2,
+                               warmup_steps=1)
+    assert entry["disqualified"] == "nan"
+    assert entry["score"] == 0.0
+    assert "non-finite" in entry["detail"]
+
+
+def test_recompile_trial_disqualified():
+    """A batch whose shape changes inside the measured window recompiles
+    the step — steady-state recompiles are a hard disqualification, and
+    the detail names the changed argument (compile-ledger diff)."""
+    model_factory, base, batch_factory = _tiny_setup()
+    calls = {"n": 0}
+
+    def shifty_batch(gbs):
+        calls["n"] += 1
+        # call 3 = the last measured step: shrink the sequence
+        return batch_factory(gbs, seq_len=12 if calls["n"] >= 3 else 24)
+
+    entry = run_measured_trial(model_factory, base, shifty_batch,
+                               TrialPoint(micro_bs=2), steps=2,
+                               warmup_steps=1)
+    assert entry["disqualified"] == "recompile_steady"
+    assert entry["score"] == 0.0
+    assert "input_ids" in entry["detail"]
+
+
+def test_hbm_budget_disqualifies():
+    """A budget smaller than the trial's measured peak disqualifies it
+    (the reference autotuner's OOM pruning, driven by the HBM ledger
+    instead of a crashed launcher run)."""
+    model_factory, base, batch_factory = _tiny_setup()
+    entry = run_measured_trial(model_factory, base, batch_factory,
+                               TrialPoint(micro_bs=2), steps=1,
+                               warmup_steps=1, hbm_budget_gib=1e-9)
+    assert entry["disqualified"] == "hbm_budget"
+    assert entry["peak_hbm_gib"] > 1e-9
+    assert entry["score"] == 0.0
+
+
+# ------------------------------------------------------ tuner + cache
+
+def _rigged_entry(point, step_s, frac=0.9, tflops=1.0, flops=1e9,
+                  wire=1e6, ncoll=10, overlap=0.0, dq=None):
+    score = TrialScore(productive_fraction=frac, step_tflops=tflops,
+                       wall_s=step_s * 2, steps=2,
+                       goodput={"wall_s": step_s * 2,
+                                "buckets": {"productive_step":
+                                            frac * step_s * 2,
+                                            "idle": (1 - frac) * step_s
+                                            * 2},
+                                "productive_s": frac * step_s * 2,
+                                "goodput_fraction": frac})
+    if dq:
+        score.disqualify(dq, "rigged")
+    entry = {"point": point.to_dict(), "key": point.key(),
+             "measured_step_s": step_s, "flops": flops,
+             "wire_bytes": wire, "hlo_collectives": ncoll,
+             "static_overlap_fraction": overlap,
+             "compile_events": [{"id": 1, "kind": "compile",
+                                 "label": "train_batch"}]}
+    entry.update(score.to_dict())
+    entry["score_breakdown"] = score.breakdown()
+    return entry
+
+
+def _rigged_tuner(tmp_path, fingerprint="fp-m", bundle=False,
+                  scores=(("fast", 0.01, 2.0), ("slow", 0.05, 0.4))):
+    points = [TrialPoint(micro_bs=m) for m in (2, 1)]
+    calls = {"n": 0}
+    by_key = {points[i].key(): scores[i] for i in range(len(points))}
+
+    def trial(point):
+        calls["n"] += 1
+        _name, step_s, tflops = by_key[point.key()]
+        return _rigged_entry(point, step_s, tflops=tflops)
+
+    tuner = MeasuredTuner(
+        trial, fingerprint, points, cache_dir=str(tmp_path / "cache"),
+        bundle_dir=str(tmp_path / "bundles") if bundle else None)
+    return tuner, calls
+
+
+def test_winner_cache_hit_skips_sweep_and_force_resweeps(tmp_path):
+    t1, calls = _rigged_tuner(tmp_path)
+    r1 = t1.tune()
+    assert calls["n"] == 2 and r1["trials_run"] == 2
+    assert not r1["cached"]
+    assert r1["winner_key"] == TrialPoint(micro_bs=2).key()
+    t1.close()
+
+    t2, calls2 = _rigged_tuner(tmp_path)
+    r2 = t2.tune()
+    assert calls2["n"] == 0 and r2["trials_run"] == 0   # pure cache hit
+    assert r2["cached"] and r2["winner"] == r1["winner"]
+    assert t2.statusz_section()["state"] == "cached"
+    t2.close()
+
+    t3, calls3 = _rigged_tuner(tmp_path)
+    r3 = t3.tune(force=True)
+    assert calls3["n"] == 2 and not r3["cached"]
+    t3.close()
+
+    t4, calls4 = _rigged_tuner(tmp_path, fingerprint="fp-other")
+    t4.tune()
+    assert calls4["n"] == 2                              # new fingerprint
+    t4.close()
+
+
+def test_best_and_worst_bundles_emitted_exactly_once(tmp_path):
+    """One sweep => exactly one trial_best and one trial_worst bundle,
+    each embedding the trial's goodput table, compile events, and a
+    score breakdown whose buckets sum to the window wall within 1%."""
+    tuner, _ = _rigged_tuner(tmp_path, bundle=True)
+    tuner.tune()
+    bdir = tmp_path / "bundles"
+    names = sorted(os.listdir(bdir))
+    assert len([n for n in names if "trial_best" in n]) == 1
+    assert len([n for n in names if "trial_worst" in n]) == 1
+    for name in names:
+        with open(bdir / name) as f:
+            doc = json.load(f)
+        trial = doc["status"]["trial"]
+        assert trial["score_breakdown"]["goodput_window"]["buckets"]
+        win = trial["score_breakdown"]["goodput_window"]
+        assert sum(win["buckets"].values()) == \
+            pytest.approx(win["wall_s"], rel=0.01)
+        assert trial["compile_events"]
+        assert doc["status"]["tuning"]["trials_done"] == 2
+        if "trial_best" in name:
+            assert trial["key"] == TrialPoint(micro_bs=2).key()
+        else:
+            assert trial["key"] == TrialPoint(micro_bs=1).key()
+    # the cache-hit path emits nothing new
+    tuner.close()
+    t2, _ = _rigged_tuner(tmp_path, bundle=True)
+    t2.tune()
+    assert sorted(os.listdir(bdir)) == names
+    t2.close()
+
+
+def test_all_disqualified_sweep_raises(tmp_path):
+    points = [TrialPoint(micro_bs=2)]
+    tuner = MeasuredTuner(
+        lambda p: _rigged_entry(p, 0.01, dq="hbm_budget"), "fp-dq",
+        points, cache_dir=str(tmp_path))
+    with pytest.raises(RuntimeError, match="disqualified"):
+        tuner.tune()
+    tuner.close()
+
+
+# ------------------------------------------------------- calibration
+
+def test_calibrated_cost_model_reranks_rigged_pair(tmp_path):
+    """Rigged physics: per-op issue latency is 50us, 25x the static
+    default. The static model therefore prefers the many-collectives
+    plan (its wire win looks free); the measured trials say otherwise.
+    After one sweep the calibrated model ranks the pair like the
+    measurements — rank correlation 1.0."""
+    truth = ScheduleCostModel(peak_flops=100e12, link_bandwidth=40e9,
+                              op_latency_s=5e-5)
+    plans = [
+        ("few_coll", TrialPoint(micro_bs=2), 400e6, 10),
+        ("many_coll", TrialPoint(micro_bs=2, overlap=True,
+                                 bucket_bytes=1 << 18), 100e6, 2000),
+        ("mid", TrialPoint(micro_bs=2, overlap=True,
+                           bucket_bytes=4 << 20), 200e6, 100),
+        ("micro1", TrialPoint(micro_bs=1), 400e6, 20),
+    ]
+    flops = 1e12                       # 10ms compute at 100 TFLOP/s
+
+    def trial(point):
+        _name, p, wire, ncoll = next(x for x in plans if x[1] == point)
+        step_s = truth.score(flops, wire, ncoll, 0.0)
+        return _rigged_entry(p, step_s, tflops=flops / step_s / 1e12,
+                             flops=flops, wire=wire, ncoll=ncoll)
+
+    static = ScheduleCostModel()
+    s_few = static.score(flops, 400e6, 10, 0.0)
+    s_many = static.score(flops, 100e6, 2000, 0.0)
+    assert s_many < s_few              # the static misranking
+    m_few = truth.score(flops, 400e6, 10, 0.0)
+    m_many = truth.score(flops, 100e6, 2000, 0.0)
+    assert m_many > m_few              # ...that measurement contradicts
+
+    tuner = MeasuredTuner(trial, "fp-cal", [x[1] for x in plans],
+                          cache_dir=str(tmp_path))
+    result = tuner.tune()
+    assert result["cost_model_calibrated"]
+    cal = ScheduleCostModel.from_dict(result["cost_model"])
+    assert cal.score(flops, 100e6, 2000, 0.0) > \
+        cal.score(flops, 400e6, 10, 0.0)          # re-ranked correctly
+    assert result["rank_correlation"] == pytest.approx(1.0)
+    # and the calibrated ranking of ALL swept plans matches measured
+    pred = [cal.score(e["flops"], e["wire_bytes"], e["hlo_collectives"],
+                      e["static_overlap_fraction"])
+            for e in result["table"]]
+    meas = [e["measured_step_s"] for e in result["table"]]
+    assert rank_correlation(pred, meas) == pytest.approx(1.0)
+    tuner.close()
+
+
+def test_calibration_skips_poisoned_trials():
+    pts = [TrialPoint(micro_bs=m) for m in (1, 2)]
+    good = [_rigged_entry(p, 0.01 * (i + 1), flops=1e9 * (i + 1))
+            for i, p in enumerate(pts)]
+    bad = _rigged_entry(TrialPoint(micro_bs=4), 99.0, flops=5e9,
+                        dq="recompile_steady")
+    assert calibrate_cost_model(good + [bad]) is not None
+    # a single usable trial cannot calibrate
+    assert calibrate_cost_model([good[0], bad]) is None
+
+
+def test_rank_correlation_math():
+    assert rank_correlation([1, 2, 3], [10, 20, 30]) == pytest.approx(1.0)
+    assert rank_correlation([1, 2, 3], [30, 20, 10]) == \
+        pytest.approx(-1.0)
+    assert rank_correlation([1], [2]) == 0.0
+
+
+# -------------------------------------------------- statusz + ds_tpu_top
+
+def test_statusz_tuning_section_roundtrips_and_serves(tmp_path):
+    tuner, _ = _rigged_tuner(tmp_path)
+    tuner.tune()
+    sec = tuner.statusz_section()
+    assert sec == json.loads(json.dumps(sec))       # JSON round-trip
+    assert sec["state"] == "done" and sec["trials_done"] == 2
+    assert sec["winner_key"] == TrialPoint(micro_bs=2).key()
+    assert len(sec["trials"]) == 2
+    # and the section serves over a live statusz server
+    from deepspeed_tpu.telemetry.statusz import StatuszServer
+    srv = StatuszServer(port=0)
+    try:
+        tuner.attach_statusz(srv)
+        with urllib.request.urlopen(
+                srv.url + "/statusz?format=json", timeout=5) as r:
+            doc = json.load(r)
+        assert doc["sections"]["tuning"]["winner_key"] == \
+            sec["winner_key"]
+        assert doc["sections"]["tuning"]["trials_done"] == 2
+    finally:
+        srv.close()
+        tuner.close()
+
+
+def _run_top(snapshot_path):
+    top = os.path.join(REPO, "bin", "ds_tpu_top")
+    return subprocess.run(
+        [sys.executable, top, "--once", "--snapshot", str(snapshot_path)],
+        capture_output=True, text=True, timeout=30)
+
+
+def test_ds_tpu_top_renders_tuning_panel(tmp_path):
+    snap = {"counters": {}, "sections": {"tuning": {
+        "state": "done", "trials_total": 3, "trials_done": 3,
+        "cached": False,
+        "trials": [
+            {"key": "micro=2/monolithic/comp=off", "score": 0.02,
+             "productive_fraction": 0.95, "step_tflops": 0.021},
+            {"key": "micro=1/monolithic/comp=off", "score": 0.01,
+             "productive_fraction": 0.93, "step_tflops": 0.011},
+            {"key": "micro=8/monolithic/comp=off", "score": 0.0,
+             "productive_fraction": 0.9, "step_tflops": 0.0,
+             "disqualified": "hbm_budget"}],
+        "winner_key": "micro=2/monolithic/comp=off",
+        "winner_score": 0.02, "winner_gain": 2.0,
+        "baseline_key": "micro=1/monolithic/comp=off",
+        "rank_correlation": 0.95}}}
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(snap))
+    out = _run_top(path)
+    assert out.returncode == 0, out.stderr
+    assert "tuning" in out.stdout and "3/3 trials" in out.stdout
+    assert "winner: micro=2/monolithic/comp=off" in out.stdout
+    assert "2.00x" in out.stdout
+    assert "DQ[hbm_budget]" in out.stdout
+    assert "rank correlation" in out.stdout
+
+
+def test_ds_tpu_top_degrades_on_pre_pr15_snapshot(tmp_path):
+    """A pre-measured-tuning snapshot (no tuning section) renders with
+    no tuning panel and no crash."""
+    snap = {"counters": {"telemetry/step_time_ms": 12.0},
+            "goodput": {"goodput_fraction": 0.9, "wall_s": 10.0,
+                        "buckets": {"productive_step": 9.0}}}
+    path = tmp_path / "old.json"
+    path.write_text(json.dumps(snap))
+    out = _run_top(path)
+    assert out.returncode == 0, out.stderr
+    assert "tuning" not in out.stdout
+    assert "goodput" in out.stdout
+
+
+# ------------------------------------------------------------- CLI smoke
+
+def test_ds_tpu_tune_measure_cli_smoke(tmp_path):
+    """Tier-1 smoke: 3 measured trials on the tiny model, winner + both
+    bundles persisted; the re-run is a pure cache hit (0 trials)."""
+    cmd = [sys.executable, os.path.join(REPO, "bin", "ds_tpu_tune"),
+           "--cpu", "--measure", "--plans", "3", "--steps", "2",
+           "--cache-dir", str(tmp_path / "cache"),
+           "--bundle-dir", str(tmp_path / "bundles"),
+           "--out", str(tmp_path / "tune.json")]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=560,
+                       env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "winner:" in r.stdout
+    with open(tmp_path / "tune.json") as f:
+        result = json.load(f)
+    assert len(result["table"]) == 3
+    assert result["trials_run"] == 3
+    assert result["sections"]["tuning"]["trials_done"] == 3
+    bundles = os.listdir(tmp_path / "bundles")
+    assert any("trial_best" in n for n in bundles)
+    assert any("trial_worst" in n for n in bundles)
+    # the CLI's --out doubles as a ds_tpu_top snapshot
+    out = _run_top(tmp_path / "tune.json")
+    assert out.returncode == 0 and "winner:" in out.stdout
+
+    r2 = subprocess.run(cmd, capture_output=True, text=True, timeout=560,
+                        env=env)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "cache hit — 0 trials run" in r2.stdout
+
+
+@pytest.mark.slow
+def test_full_joint_sweep_real_engines(tmp_path):
+    """The full (small) joint space on real engines: micro ladder x
+    remat, winner qualified, calibration present, cache round-trips."""
+    model_factory, base, batch_factory = _tiny_setup(n_layer=2)
+    base = dict(base)
+    base["autotune"] = {"steps": 2, "warmup_steps": 1,
+                        "micro_batch_sizes": [1, 2],
+                        "remat": ["none", "full"],
+                        "bucket_bytes": [1 << 20]}
+    result = measure_schedule(model_factory, base, batch_factory,
+                              cache_dir=str(tmp_path / "c"),
+                              bundle_dir=str(tmp_path / "b"))
+    assert result["trials_run"] >= 4
+    assert not result["cached"]
+    assert result["score"] > 0
+    assert result.get("cost_model_calibrated")
+    qualified = [e for e in result["table"] if not e.get("disqualified")]
+    assert result["score"] == pytest.approx(
+        max(e["score"] for e in qualified))
+    r2 = measure_schedule(model_factory, base, batch_factory,
+                          cache_dir=str(tmp_path / "c"),
+                          bundle_dir=str(tmp_path / "b"))
+    assert r2["cached"] and r2["trials_run"] == 0

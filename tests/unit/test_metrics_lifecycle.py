@@ -144,6 +144,51 @@ def test_fleet_gauges_owned_and_released(tracer, tmp_path):
         f"{sorted(leftovers)}")
 
 
+def test_moe_gauges_owned_and_released(tracer):
+    """ROADMAP item 3 seed: the dstpu_moe_* family (per-expert load +
+    capacity-factor overflow, moe/sharded_moe.py MoeMetrics) follows the
+    same owner/retraction contract as every other family — live with its
+    producer, gone from /metrics after close(). The routing math is
+    pinned too: a [E] count vector's imbalance and overflow fractions
+    must match hand arithmetic."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.moe import MoeMetrics
+    from deepspeed_tpu.moe.sharded_moe import topk_gating
+    from deepspeed_tpu.telemetry import prometheus_dump
+
+    m = MoeMetrics(tracer=tracer)
+    # real routing evidence: 16 tokens through a rigged 4-expert gate
+    # where every token prefers expert 0 (logit margin), capacity 4
+    logits = jnp.zeros((16, 4)).at[:, 0].set(5.0)
+    _l_aux, _combine, _dispatch, exp_counts = topk_gating(
+        logits, k=1, capacity_factor=1.0, min_capacity=4, use_rts=False,
+        rng=jax.random.PRNGKey(0), train=False)
+    out = m.record(np.asarray(exp_counts), capacity=4, step=1)
+    # all 16 routed to expert 0: imbalance = 16/4 mean = 4x, 12 dropped
+    assert out["expert_load_max"] == 16.0
+    assert out["expert_load_mean"] == 4.0
+    assert out["load_imbalance"] == pytest.approx(4.0)
+    assert out["dropped_token_fraction"] == pytest.approx(12 / 16)
+    assert out["overflow_tokens"] == 12.0 and out["overflow_steps"] == 1.0
+    # balanced counts: imbalance 1.0, nothing dropped, counters hold
+    out = m.record(np.full((4,), 4.0), capacity=4, step=2)
+    assert out["load_imbalance"] == pytest.approx(1.0)
+    assert out["dropped_token_fraction"] == 0.0
+    assert out["overflow_tokens"] == 12.0
+    assert m.summary()["records"] == 2
+    dump = prometheus_dump(tracer)
+    assert "dstpu_moe_load_imbalance 1.0" in dump
+    assert "dstpu_moe_dropped_token_fraction 0.0" in dump
+    assert "dstpu_moe_overflow_tokens 12.0" in dump
+    _assert_all_owned(tracer, "moe metrics live")
+    m.close()
+    dump = prometheus_dump(tracer)
+    assert "dstpu_moe_" not in dump
+    assert not [t for t in tracer.counters() if t.startswith("moe/")]
+
+
 def test_prometheus_dump_reflects_retraction(tracer):
     """The exported text is the user-visible surface of the contract: a
     family present while live must be absent after its producer closes."""

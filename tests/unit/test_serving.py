@@ -273,3 +273,72 @@ def test_slo_burn_rate_tracking():
     assert counters["serving/ttft_ms_p50"][0] == pytest.approx(10.0)
     m.close()
     assert "serving/slo_burn_rate" not in get_tracer().counters()
+
+
+def test_slo_burn_decays_on_idle_replica():
+    """PR-14 follow-up regression: with slo.decay_s the sliding windows
+    age out by WALL CLOCK, so an idle replica's last_burn_rate and its
+    dstpu_tenant_* burn gauges relax to 0 — while an active replica (its
+    samples keep refreshing) keeps its live burn. Without decay the idle
+    replica's window is frozen history and its burn reads as live
+    forever."""
+    from deepspeed_tpu.serving.config import SLOConfig
+    from deepspeed_tpu.serving.metrics import ServingMetrics
+    from deepspeed_tpu.telemetry import get_tracer
+
+    clock = {"t": 1000.0}
+    slo = SLOConfig.from_dict({"window": 64, "ttft_ms": 50.0,
+                               "target": 0.99, "decay_s": 30.0})
+
+    def violate(m, tenant):
+        m.record_ttft(0.100, tenant=tenant)       # 100ms > 50ms target
+
+    idle = ServingMetrics(slo=slo, monitor_interval=1,
+                          clock=lambda: clock["t"])
+    active = ServingMetrics(slo=slo, monitor_interval=1,
+                            clock=lambda: clock["t"])
+    for _ in range(16):
+        violate(idle, "acme")
+        violate(active, "acme")
+    idle.record_tick(queue_depth=0, slot_utilization=0.0)
+    active.record_tick(queue_depth=0, slot_utilization=0.0)
+    assert idle.last_burn_rate == pytest.approx(100.0)
+    assert active.last_burn_rate == pytest.approx(100.0)
+    assert idle.tenant_status()["acme"]["burn_rate"] == \
+        pytest.approx(100.0)
+
+    # 31 idle seconds: the idle replica's samples age out; the active
+    # replica keeps violating, so its window stays populated
+    for _ in range(10):
+        clock["t"] += 3.1
+        violate(active, "acme")
+    assert idle.last_burn_rate == 0.0            # relaxed on READ, no tick
+    assert idle.tenant_status()["acme"]["burn_rate"] == 0.0
+    assert idle.percentiles()["ttft_ms"]["n"] == 0
+    assert get_tracer().counter_value("serving/slo_burn_rate") == 0.0
+    assert active.last_burn_rate == pytest.approx(100.0)
+    assert active.tenant_status()["acme"]["burn_rate"] == \
+        pytest.approx(100.0)
+    # the relaxed gauges belong to the idle producer and die with it
+    idle.close()
+    active.close()
+
+
+def test_slo_no_decay_keeps_frozen_window():
+    """The decay is opt-in: without decay_s an idle replica's burn stays
+    at its last value (the pre-PR-15 behavior, unchanged)."""
+    from deepspeed_tpu.serving.config import SLOConfig
+    from deepspeed_tpu.serving.metrics import ServingMetrics
+
+    clock = {"t": 0.0}
+    m = ServingMetrics(slo=SLOConfig.from_dict(
+        {"window": 16, "ttft_ms": 50.0}), monitor_interval=1,
+        clock=lambda: clock["t"])
+    for _ in range(8):
+        m.record_ttft(0.100)
+    m.record_tick(queue_depth=0, slot_utilization=0.0)
+    burn = m.last_burn_rate
+    assert burn and burn > 0
+    clock["t"] += 1e6
+    assert m.last_burn_rate == burn
+    m.close()
