@@ -1,0 +1,372 @@
+"""Fleet soak harness: trace-driven sustained load + chaos + scorecard.
+
+Drives a full in-process fleet — router, >= 3 unified replicas with
+speculative decode, chunked prefill and the radix prefix cache enabled,
+autoscaling on — with a seeded trace from serving/loadgen.py (diurnal
+rate, zipf tenants, heavy-tail lengths, shared-prefix cohorts, an abuse
+spike) for a configurable wall-clock duration, injecting the scheduled
+chaos (mid-run replica kill through the failover path; an
+autoscale-forcing arrival burst). At the end it folds every subsystem's
+ledger into ONE scorecard (telemetry/scorecard.py) with hard invariants
+checked at fold time, and writes ONE merged Perfetto timeline
+(FleetAggregator lanes + soak counter tracks + chaos instant markers).
+
+Fast mode (the default, also the tier-1 smoke) replays a ~2.5s trace
+(~15s of fleet wall-clock once drain and the cooldown tail are in);
+``--full`` stretches the same shape to minutes. Outputs:
+
+- benchmarks/soak.json           — the scorecard (asserted: all
+  invariants pass, >= 1 failover, >= 1 scale-up)
+- benchmarks/soak_timeline.json  — the merged Perfetto document
+
+``--update-baseline`` additionally rewrites benchmarks/
+soak_baseline.json from this run's scorecard — the checked-in baseline
+``bin/ds_tpu_soakdiff`` gates future runs against (same flow as
+hlo_audit's).
+
+Runs on CPU: JAX_PLATFORMS=cpu python benchmarks/soak.py
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if os.environ.get("JAX_PLATFORMS", "").lower().startswith("cpu") or \
+        os.environ.get("DSTPU_ACCELERATOR", "").lower() == "cpu":
+    import importlib.util
+    _spec = importlib.util.spec_from_file_location(
+        "_dstpu_hermetic",
+        os.path.join(REPO, "deepspeed_tpu", "utils", "hermetic.py"))
+    _hermetic = importlib.util.module_from_spec(_spec)
+    _spec.loader.exec_module(_hermetic)
+    _hermetic.force_cpu()
+
+OUT_PATH = os.path.join(REPO, "benchmarks", "soak.json")
+TIMELINE_PATH = os.path.join(REPO, "benchmarks", "soak_timeline.json")
+BASELINE_PATH = os.path.join(REPO, "benchmarks", "soak_baseline.json")
+
+
+def _pctl(vals, q):
+    vals = sorted(vals)
+    return vals[min(len(vals) - 1, int(q * len(vals)))] if vals else 0.0
+
+
+def _tiny_engine(dtype="float32"):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    model = GPT2Model(GPT2Config(vocab_size=256, n_positions=256,
+                                 n_embd=128, n_layer=4, n_head=4,
+                                 pad_vocab_to_multiple=1, dtype=dtype))
+    return deepspeed_tpu.init_inference(model, config={"dtype": dtype})
+
+
+def _serving_config(args, bundle_dir):
+    """The full-stack fleet config: every PR-8..15 subsystem on."""
+    return {
+        "num_slots": 4,
+        "max_model_len": 256,
+        "max_queue": 512,
+        "max_prefills_per_tick": 2,
+        "default_max_new_tokens": 16,
+        "telemetry": {"enabled": True},
+        "compile_plane": {"enabled": True},
+        "slo": {"window": 256, "ttft_ms": args.slo_ttft_ms,
+                "e2e_ms": 8000.0, "target": 0.9, "decay_s": 2.0},
+        "flight_recorder": {"enabled": True, "dir": bundle_dir,
+                            "keep": 4, "debounce_s": 1.0, "ring": 128,
+                            "slo_burn_threshold": 2.0},
+        "prefix_cache": {"enabled": True},
+        "speculative": {"enabled": True, "k": 4},
+        "chunked_prefill": {"enabled": True, "chunk_tokens": 32},
+        "tenants": {"enabled": True,
+                    "rates": {"abuser": 40.0}, "burst_tokens": 64},
+        "loadgen": {"seed": args.seed, "duration_s": args.duration,
+                    "base_rate": args.rate,
+                    "prompt_len_max": 64, "output_len_max": 16},
+        "soak": {"recovery_window_s": args.recovery_window_s,
+                 "tail_s": args.tail_s},
+        "fleet": {"enabled": True, "replicas": args.replicas,
+                  "heartbeat_timeout_s": 60.0,
+                  "autoscale": {"enabled": True,
+                                "min_replicas": args.replicas,
+                                "max_replicas": args.replicas + 2,
+                                "scale_up_burn": 1.2,
+                                "scale_down_burn": 0.25,
+                                "sustain_s": 0.5, "cooldown_s": 2.0,
+                                "drain_timeout_s": 10.0}},
+    }
+
+
+def _drive(router, trace, soak, tracer, ledger):
+    """Replay the trace against the live fleet on the wall clock,
+    executing chaos on schedule and sampling burn / live replicas /
+    goodput counter tracks throughout. Returns everything only the
+    harness can know, for the scorecard fold."""
+    from deepspeed_tpu.serving import QueueFull, SamplingParams
+    events = list(trace.events)
+    chaos = list(trace.chaos)
+    streamed = {}
+    meta = {}
+    burn_series = []
+    chaos_log = []
+    rejected = {}
+    live_replica_seconds = 0.0
+    last_t = 0.0
+    last_live = len(router._live_unified())
+    last_sample = -1e9
+    goodput_before = ledger.totals()
+    t0 = time.perf_counter()
+
+    def make_cb(fid):
+        entries = streamed[fid]
+        rec = meta[fid]
+
+        def cb(req, tok):
+            now = time.perf_counter() - t0
+            entries.append((len(req.tokens), int(tok)))
+            if rec["first"] is None:
+                rec["first"] = now
+            rec["last"] = now
+        return cb
+
+    def sample(now, force=False):
+        nonlocal last_sample, last_t, last_live, live_replica_seconds
+        live_replica_seconds += (now - last_t) * last_live
+        last_t = now
+        last_live = len(router._live_unified())
+        if not force and now - last_sample < soak.sample_interval_s:
+            return
+        last_sample = now
+        burn, queue = router._load_signals()
+        burn_series.append((now, burn))
+        tracer.counter_track("soak/fleet",
+                             {"live_replicas": float(last_live),
+                              "queue_total": float(queue),
+                              "slo_burn": round(burn, 3)}, cat="soak")
+        totals = ledger.totals()
+        tracer.counter_track(
+            "soak/goodput",
+            {k: round(v, 3) for k, v in totals.items() if v > 0},
+            cat="soak")
+        hbm = {tag.split("/", 1)[1]: val for tag, (val, _s)
+               in tracer.counters().items() if tag.startswith("mem/")}
+        if hbm:
+            tracer.counter_track("soak/hbm", hbm, cat="soak")
+
+    def fire_chaos(now):
+        while chaos and chaos[0].t_s <= now:
+            ev = chaos.pop(0)
+            detail = dict(ev.detail)
+            if ev.kind == "kill_replica":
+                live = router._live_unified()
+                if len(live) > 1:
+                    victim = max(live, key=lambda r: len(
+                        router._in_flight_on(r.name)))
+                    detail["victim"] = victim.name
+                    detail["in_flight"] = len(
+                        router._in_flight_on(victim.name))
+                    tracer.instant(f"chaos:{ev.kind}", cat="soak",
+                                   args=detail)
+                    router.kill(victim.name, reason="soak chaos kill")
+                else:
+                    detail["skipped"] = "only one live replica"
+            else:
+                tracer.instant(f"chaos:{ev.kind}", cat="soak",
+                               args=detail)
+            chaos_log.append({"t_s": round(now, 3), "kind": ev.kind,
+                              "detail": detail})
+
+    while events or chaos or \
+            any(not router.result(f).done for f in meta):
+        now = time.perf_counter() - t0
+        fire_chaos(now)
+        while events and events[0].t_s <= now:
+            ev = events.pop(0)
+            try:
+                fid = router.submit(
+                    np.asarray(ev.prompt, dtype=np.int32),
+                    SamplingParams(max_new_tokens=ev.max_new_tokens,
+                                   tenant=ev.tenant))
+            except QueueFull:
+                rejected[ev.tenant] = rejected.get(ev.tenant, 0) + 1
+                continue
+            streamed[fid] = []
+            meta[fid] = {"arrival": now, "first": None, "last": None,
+                         "tenant": ev.tenant}
+            router.result(fid).on_token = make_cb(fid)
+        in_flight = router.step()
+        sample(time.perf_counter() - t0)
+        if not in_flight and events:
+            time.sleep(min(0.005, max(0.0, events[0].t_s - now)))
+
+    # cooldown tail: lets drains complete, burn windows decay, and the
+    # scale-down half of the autoscale cycle fire
+    tail_end = (time.perf_counter() - t0) + soak.tail_s
+    while time.perf_counter() - t0 < tail_end:
+        router.step()
+        sample(time.perf_counter() - t0)
+        time.sleep(0.01)
+    sample(time.perf_counter() - t0, force=True)
+    wall = time.perf_counter() - t0
+
+    # the delivered-position audit: every streamed (position, token)
+    # against the request's final token list — exactly-once or bust
+    audit = {"requests": len(meta) + sum(rejected.values()),
+             "audited": 0, "dropped": 0, "duplicated": 0,
+             "mismatched": 0, "failed_requests": 0,
+             "rejected": sum(rejected.values()),
+             "rejected_by_tenant": rejected,
+             "streamed_tokens": 0, "finished_tokens": 0}
+    for fid, entries in streamed.items():
+        fr = router.result(fid)
+        if fr.state != "finished":
+            audit["failed_requests"] += 1
+            continue
+        final = [int(t) for t in fr.tokens]
+        audit["audited"] += 1
+        audit["streamed_tokens"] += len(entries)
+        audit["finished_tokens"] += len(final)
+        seen = {}
+        for pos, tok in entries:
+            seen[pos] = seen.get(pos, 0) + 1
+            if pos < 1 or pos > len(final) or final[pos - 1] != tok:
+                audit["mismatched"] += 1
+        audit["duplicated"] += sum(c - 1 for c in seen.values() if c > 1)
+        audit["dropped"] += sum(1 for p in range(1, len(final) + 1)
+                                if p not in seen)
+
+    ttfts = [(m["first"] - m["arrival"]) * 1e3 for m in meta.values()
+             if m["first"] is not None]
+    e2es = [(m["last"] - m["arrival"]) * 1e3 for m in meta.values()
+            if m["last"] is not None]
+    latency = {"ttft_ms_p50": round(_pctl(ttfts, 0.50), 2),
+               "ttft_ms_p99": round(_pctl(ttfts, 0.99), 2),
+               "e2e_ms_p50": round(_pctl(e2es, 0.50), 2),
+               "e2e_ms_p95": round(_pctl(e2es, 0.95), 2)}
+    return {"wall_s": wall,
+            "goodput": ledger.window(goodput_before, wall),
+            "token_audit": audit, "burn_series": burn_series,
+            "chaos": chaos_log, "latency": latency,
+            "live_replica_seconds": live_replica_seconds}
+
+
+def run_soak(args):
+    from deepspeed_tpu.serving import SamplingParams, build_fleet
+    from deepspeed_tpu.serving.loadgen import generate_trace
+    from deepspeed_tpu.telemetry import get_ledger, get_tracer
+    from deepspeed_tpu.telemetry.scorecard import fold_scorecard
+
+    bundle_dir = tempfile.mkdtemp(prefix="soak_bundles_")
+    engine = _tiny_engine()
+    cfg = _serving_config(args, bundle_dir)
+    router = build_fleet(engine, cfg, seed=args.seed)
+    scfg = router.replicas[next(iter(router.replicas))].engine.config
+    trace = generate_trace(scfg.loadgen, scfg.soak)
+    tracer, ledger = get_tracer(), get_ledger()
+
+    try:
+        # warmup: compile the prefill/chunk/verify flavors outside the
+        # measured window so the goodput ledger scores steady state
+        rng = np.random.default_rng(args.seed + 1)
+        for plen in (8, 40):
+            fid = router.submit(
+                rng.integers(1, 256, (plen,), dtype=np.int32),
+                SamplingParams(max_new_tokens=4))
+            router.run_until_idle()
+            assert router.result(fid).done
+        data = _drive(router, trace, scfg.soak, tracer, ledger)
+        doc = fold_scorecard(
+            router, wall_s=data["wall_s"], goodput=data["goodput"],
+            token_audit=data["token_audit"],
+            burn_series=data["burn_series"], chaos=data["chaos"],
+            expected=trace.expected(),
+            live_replica_seconds=data["live_replica_seconds"],
+            latency=data["latency"], trace_summary=trace.summary(),
+            tolerances={
+                "goodput_wall_rel": scfg.soak.goodput_tolerance,
+                "recovery_window_s": scfg.soak.recovery_window_s,
+                "critical_path_rel": scfg.soak.critical_path_tolerance,
+                "critical_path_floor_ms":
+                    scfg.soak.critical_path_floor_ms,
+            })
+        timeline = router.aggregator.merged_trace()
+    finally:
+        router.shutdown()
+        shutil.rmtree(bundle_dir, ignore_errors=True)
+    return doc, timeline
+
+
+def _assert_scorecard(doc, timeline):
+    failed = [f"  {name}: {v['detail']}"
+              for name, v in doc["invariants"].items() if not v["ok"]]
+    assert not failed, "soak invariants failed:\n" + "\n".join(failed)
+    assert doc["fleet"]["failovers"] >= 1, \
+        "the scheduled replica kill never registered as a failover"
+    assert doc["fleet"]["scale_ups"] >= 1, \
+        "the scheduled burst never forced a scale-up"
+    lanes = timeline.get("otherData", {}).get("lanes", {})
+    assert len(lanes) >= 4, \
+        f"merged timeline has {len(lanes)} lane(s), expected router + 3+"
+    instants = [ev for ev in timeline.get("traceEvents", [])
+                if ev.get("ph") == "i"
+                and str(ev.get("name", "")).startswith("chaos:")]
+    assert instants, "no chaos instant markers in the merged timeline"
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration", type=float, default=None,
+                    help="trace horizon, seconds (default: 3.5 fast, "
+                         "45 with --full)")
+    ap.add_argument("--rate", type=float, default=5.0,
+                    help="midline request rate, req/s")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--slo-ttft-ms", type=float, default=300.0)
+    ap.add_argument("--recovery-window-s", type=float, default=20.0)
+    ap.add_argument("--tail-s", type=float, default=2.0)
+    ap.add_argument("--full", action="store_true",
+                    help="minutes-long soak (the slow-marked tier)")
+    ap.add_argument("--out", default=OUT_PATH)
+    ap.add_argument("--timeline-out", default=TIMELINE_PATH)
+    ap.add_argument("--update-baseline", action="store_true",
+                    help=f"rewrite {BASELINE_PATH} from this run")
+    ap.add_argument("--no-assert", action="store_true",
+                    help="emit the scorecard without hard-failing on "
+                         "invariants (debugging a broken fleet)")
+    args = ap.parse_args()
+    if args.duration is None:
+        args.duration = 45.0 if args.full else 2.5
+    if args.full:
+        args.recovery_window_s = max(args.recovery_window_s, 30.0)
+
+    from deepspeed_tpu.telemetry.scorecard import write_scorecard
+    doc, timeline = run_soak(args)
+    write_scorecard(doc, args.out)
+    with open(args.timeline_out, "w") as f:
+        json.dump(timeline, f)
+    print(f"soak scorecard -> {args.out}")
+    print(f"merged timeline -> {args.timeline_out} "
+          f"({len(timeline['traceEvents'])} events, "
+          f"{len(timeline['otherData']['lanes'])} lanes)")
+    for name, v in doc["invariants"].items():
+        print(f"  [{'ok' if v['ok'] else 'FAIL'}] {name}: {v['detail']}")
+    if not args.no_assert:
+        _assert_scorecard(doc, timeline)
+    if args.update_baseline:
+        base = dict(doc)
+        write_scorecard(base, BASELINE_PATH)
+        print(f"baseline updated -> {BASELINE_PATH}")
+
+
+if __name__ == "__main__":
+    main()

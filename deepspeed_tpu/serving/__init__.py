@@ -10,13 +10,15 @@ pool.
 """
 
 from .config import (ChunkedPrefillConfig, DraftConfig, KVQuantConfig,
-                     PrefixCacheConfig, ServingConfig, SLOConfig,
-                     SpeculativeConfig, TenantConfig)
+                     LoadgenConfig, PrefixCacheConfig, ServingConfig,
+                     SLOConfig, SoakConfig, SpeculativeConfig,
+                     TenantConfig)
 from .engine import ServingEngine
 from .fleet import (AutoscaleConfig, FleetConfig, FleetRequest,
                     FleetRouter, KVHandoff,
                     RadixPrefixCache, ReplicaHandle, build_fleet)
 from .kv_slots import SlotPool
+from .loadgen import ChaosEvent, LoadEvent, SoakTrace, generate_trace
 from .metrics import FleetMetrics, ServingMetrics
 from .scheduler import (ContinuousBatchingScheduler, QueueFull,
                         RateLimited, Request, RequestState, SamplingParams,
@@ -25,10 +27,11 @@ from .scheduler import (ContinuousBatchingScheduler, QueueFull,
 __all__ = [
     "ServingConfig", "SLOConfig", "PrefixCacheConfig", "KVQuantConfig",
     "SpeculativeConfig", "DraftConfig", "ChunkedPrefillConfig",
-    "TenantConfig",
+    "TenantConfig", "LoadgenConfig", "SoakConfig",
     "ServingEngine", "SlotPool", "ServingMetrics", "FleetMetrics",
     "ContinuousBatchingScheduler", "QueueFull", "RateLimited", "Request",
     "RequestState", "SamplingParams", "TenantQueues",
     "AutoscaleConfig", "FleetConfig", "FleetRouter", "FleetRequest", "KVHandoff",
     "RadixPrefixCache", "ReplicaHandle", "build_fleet",
+    "ChaosEvent", "LoadEvent", "SoakTrace", "generate_trace",
 ]

@@ -261,6 +261,131 @@ class SpeculativeConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class LoadgenConfig(DeepSpeedConfigModel):
+    """The ``"loadgen"`` block: the seeded trace-driven load generator
+    (serving/loadgen.py). Every knob feeds one ``numpy`` Generator, so a
+    given seed always produces the identical arrival/tenant/length
+    schedule — the property the soak-diff regression gate rests on.
+    Arrivals are an inhomogeneous Poisson process shaped by a diurnal
+    sinusoid; tenants are drawn zipf (a few whales, a long tail);
+    prompt/output lengths are lognormal (heavy tail); a fraction of
+    prompts share cohort prefixes (what the radix cache exists for);
+    abuse spikes slam many requests from one tenant into one instant
+    (what router rate limits exist for)."""
+    seed: int = 0
+    #: trace horizon, seconds of simulated wall-clock
+    duration_s: float = 10.0
+    #: mean request rate at the diurnal midline, requests/second
+    base_rate: float = 6.0
+    #: peak-to-midline rate swing, fraction of base_rate in [0, 1)
+    diurnal_amplitude: float = 0.5
+    #: sinusoid period; 0 = one full cycle over duration_s
+    diurnal_period_s: float = 0.0
+    #: distinct steady tenants (t0..tN-1); abuse spikes add "abuser"
+    tenants: int = 4
+    #: zipf skew over the steady tenants (larger = whalier)
+    zipf_alpha: float = 1.2
+    #: lognormal prompt-length median (tokens) / sigma / hard cap
+    prompt_len_median: int = 12
+    prompt_len_sigma: float = 0.6
+    prompt_len_max: int = 96
+    #: lognormal output-length median (tokens) / sigma / hard cap
+    output_len_median: int = 8
+    output_len_sigma: float = 0.5
+    output_len_max: int = 32
+    #: fraction of requests whose prompt starts with a cohort prefix
+    shared_prefix_fraction: float = 0.35
+    #: distinct shared-prefix cohorts and the prefix length (tokens)
+    prefix_cohorts: int = 3
+    prefix_len: int = 16
+    #: abuse spikes: count, requests per spike, tenant they bill to
+    abuse_spikes: int = 1
+    abuse_spike_requests: int = 12
+    abuse_tenant: str = "abuser"
+    #: token-id vocabulary for generated prompts
+    vocab: int = 256
+
+    def validate(self):
+        if self.duration_s <= 0:
+            raise ConfigError("loadgen.duration_s must be > 0")
+        if self.base_rate <= 0:
+            raise ConfigError("loadgen.base_rate must be > 0")
+        if not (0.0 <= self.diurnal_amplitude < 1.0):
+            raise ConfigError(
+                "loadgen.diurnal_amplitude must be in [0, 1)")
+        if self.tenants < 1:
+            raise ConfigError("loadgen.tenants must be >= 1")
+        if self.zipf_alpha <= 1.0:
+            raise ConfigError(
+                "loadgen.zipf_alpha must be > 1 (zipf divergence)")
+        for name in ("prompt_len_median", "prompt_len_max",
+                     "output_len_median", "output_len_max",
+                     "prefix_len", "vocab"):
+            if getattr(self, name) < 1:
+                raise ConfigError(f"loadgen.{name} must be >= 1")
+        if not (0.0 <= self.shared_prefix_fraction <= 1.0):
+            raise ConfigError(
+                "loadgen.shared_prefix_fraction must be in [0, 1]")
+        if self.prefix_cohorts < 1:
+            raise ConfigError("loadgen.prefix_cohorts must be >= 1")
+        if self.abuse_spikes < 0 or self.abuse_spike_requests < 1:
+            raise ConfigError(
+                "loadgen.abuse_spikes must be >= 0 and "
+                "abuse_spike_requests >= 1")
+        if "/" in self.abuse_tenant:
+            raise ConfigError("loadgen.abuse_tenant must not contain '/'")
+
+
+@dataclasses.dataclass
+class SoakConfig(DeepSpeedConfigModel):
+    """The ``"soak"`` block: chaos schedule + invariant tolerances for
+    the fleet soak harness (benchmarks/soak.py + telemetry/scorecard.py).
+    Chaos times are fractions of the loadgen trace horizon so the same
+    config scales from the tier-1 fast smoke to a minutes-long full
+    soak."""
+    #: when to kill a live replica, as a fraction of duration_s (<0 off)
+    kill_replica_at_frac: float = 0.3
+    #: when the autoscale-forcing burst starts, fraction of duration_s
+    #: (<0 off), how long it lasts (fraction), and the rate multiplier
+    #: stacked on top of the diurnal rate while it runs
+    burst_at_frac: float = 0.55
+    burst_duration_frac: float = 0.15
+    burst_rate_mult: float = 4.0
+    #: invariant (c): SLO burn must fall back to <= 1.0 within this many
+    #: seconds after each chaos event
+    recovery_window_s: float = 20.0
+    #: invariant (a): |sum(goodput buckets) - wall| tolerance, relative
+    goodput_tolerance: float = 0.02
+    #: invariant (e): critical-path decomposition slack (relative to e2e
+    #: mean, with an absolute floor in ms)
+    critical_path_tolerance: float = 0.05
+    critical_path_floor_ms: float = 0.5
+    #: burn/live-replica sampling cadence during the drive loop
+    sample_interval_s: float = 0.1
+    #: wall-clock grace after the trace drains: lets scale-down + drains
+    #: complete and burn samples decay before the scorecard folds
+    tail_s: float = 2.0
+
+    def validate(self):
+        if self.burst_rate_mult < 1.0:
+            raise ConfigError("soak.burst_rate_mult must be >= 1")
+        if self.burst_duration_frac < 0 or self.burst_duration_frac > 1:
+            raise ConfigError(
+                "soak.burst_duration_frac must be in [0, 1]")
+        if self.recovery_window_s <= 0:
+            raise ConfigError("soak.recovery_window_s must be > 0")
+        if not (0.0 < self.goodput_tolerance < 1.0):
+            raise ConfigError("soak.goodput_tolerance must be in (0, 1)")
+        if not (0.0 < self.critical_path_tolerance < 1.0):
+            raise ConfigError(
+                "soak.critical_path_tolerance must be in (0, 1)")
+        if self.sample_interval_s <= 0:
+            raise ConfigError("soak.sample_interval_s must be > 0")
+        if self.tail_s < 0:
+            raise ConfigError("soak.tail_s must be >= 0")
+
+
+@dataclasses.dataclass
 class ServingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving knobs (deepspeed_tpu/serving/)."""
 
@@ -346,6 +471,15 @@ class ServingConfig(DeepSpeedConfigModel):
     # block read by ds_tpu_serve --fleet / benchmarks; inert (and
     # allocating nothing) on a single replica
     fleet: Any = None
+
+    # loadgen (dict -> LoadgenConfig): seeded trace-driven load shape
+    # for the soak harness (serving/loadgen.py); inert at serve time
+    loadgen: Any = None
+
+    # soak (dict -> SoakConfig): chaos schedule + invariant tolerances
+    # for benchmarks/soak.py and telemetry/scorecard.py; inert at serve
+    # time
+    soak: Any = None
 
     ALIASES = {"max_seq_len": "max_model_len"}
 
@@ -441,3 +575,13 @@ class ServingConfig(DeepSpeedConfigModel):
             self.fleet = FleetConfig.from_dict(self.fleet)
         elif self.fleet is None:
             self.fleet = FleetConfig()
+        if isinstance(self.loadgen, dict):
+            self.loadgen = LoadgenConfig.from_dict(self.loadgen)
+        elif self.loadgen is None:
+            self.loadgen = LoadgenConfig()
+        self.loadgen.validate()
+        if isinstance(self.soak, dict):
+            self.soak = SoakConfig.from_dict(self.soak)
+        elif self.soak is None:
+            self.soak = SoakConfig()
+        self.soak.validate()

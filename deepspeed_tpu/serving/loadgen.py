@@ -1,0 +1,252 @@
+"""Seeded trace-driven load generation for the fleet soak plane.
+
+Every serving benchmark before this file drove the fleet with a
+seconds-long homogeneous Poisson burst. Real fleets do not see that
+traffic: request rate follows a diurnal curve, tenants are zipf (a few
+whales and a long tail), prompt and output lengths are heavy-tailed,
+a large fraction of prompts share system-prompt prefixes (the radix
+cache's whole reason to exist), and abuse happens (one tenant slamming
+the door — the router rate limiter's reason to exist). This module
+turns a ``LoadgenConfig`` into a **trace**: a fully materialised,
+seeded schedule of ``LoadEvent``s plus the ``SoakConfig``'s scheduled
+``ChaosEvent``s (mid-run replica kill through the failover path, an
+autoscale-forcing arrival burst).
+
+The trace is data, not behaviour: ``benchmarks/soak.py`` replays it
+against a live in-process fleet, and ``telemetry/scorecard.py`` checks
+the fleet's ledgers against the trace's ``expected()`` shape. All
+randomness flows from ONE ``numpy`` Generator seeded by
+``loadgen.seed`` — the same seed always yields the identical
+arrival/tenant/length/cohort schedule, which is what makes a soak-diff
+against a checked-in baseline meaningful.
+"""
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .config import LoadgenConfig, SoakConfig
+
+__all__ = ["LoadEvent", "ChaosEvent", "SoakTrace", "generate_trace",
+           "rate_at"]
+
+
+@dataclasses.dataclass
+class LoadEvent:
+    """One scheduled request arrival."""
+    t_s: float                      # offset from trace start
+    tenant: str
+    prompt: List[int]               # token ids (vocab-bounded)
+    max_new_tokens: int
+    cohort: Optional[int] = None    # shared-prefix cohort, if any
+    kind: str = "steady"            # steady | burst | abuse
+
+
+@dataclasses.dataclass
+class ChaosEvent:
+    """One scheduled chaos injection. ``kill_replica`` goes through the
+    PR-8 failover path (victims requeue, streams dedup on delivered
+    position); ``burst`` marks the window whose extra arrivals (already
+    in the event list, kind="burst") are meant to force the autoscaler
+    up."""
+    t_s: float
+    kind: str                       # kill_replica | burst
+    detail: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def rate_at(cfg: LoadgenConfig, t_s: float) -> float:
+    """Instantaneous diurnal arrival rate (requests/s) at trace offset
+    ``t_s``: a sinusoid starting at the trough (quiet "night" at t=0,
+    peak mid-trace) around ``base_rate``."""
+    period = cfg.diurnal_period_s or cfg.duration_s
+    phase = 2.0 * math.pi * (t_s / max(1e-9, period))
+    return cfg.base_rate * (1.0 + cfg.diurnal_amplitude
+                            * -math.cos(phase))
+
+
+class SoakTrace:
+    """A materialised soak schedule: load events (time-sorted), chaos
+    events, and the shape summary the scorecard checks against."""
+
+    def __init__(self, events: List[LoadEvent], chaos: List[ChaosEvent],
+                 loadgen: LoadgenConfig, soak: Optional[SoakConfig]):
+        self.events = events
+        self.chaos = chaos
+        self.loadgen = loadgen
+        self.soak = soak
+
+    @property
+    def duration_s(self) -> float:
+        return float(self.loadgen.duration_s)
+
+    def summary(self) -> Dict[str, Any]:
+        """The trace as numbers: totals per tenant/kind/cohort and the
+        per-second arrival histogram (the injected load shape the
+        autoscale invariant is judged against)."""
+        per_tenant: Dict[str, int] = {}
+        per_kind: Dict[str, int] = {}
+        cohorts: Dict[str, int] = {}
+        shape = [0] * max(1, int(math.ceil(self.duration_s)))
+        prompt_tokens = 0
+        output_tokens = 0
+        for ev in self.events:
+            per_tenant[ev.tenant] = per_tenant.get(ev.tenant, 0) + 1
+            per_kind[ev.kind] = per_kind.get(ev.kind, 0) + 1
+            if ev.cohort is not None:
+                key = f"c{ev.cohort}"
+                cohorts[key] = cohorts.get(key, 0) + 1
+            shape[min(len(shape) - 1, int(ev.t_s))] += 1
+            prompt_tokens += len(ev.prompt)
+            output_tokens += ev.max_new_tokens
+        return {
+            "seed": self.loadgen.seed,
+            "duration_s": round(self.duration_s, 3),
+            "requests": len(self.events),
+            "per_tenant": per_tenant,
+            "per_kind": per_kind,
+            "cohorts": cohorts,
+            "prompt_tokens": prompt_tokens,
+            "output_tokens_requested": output_tokens,
+            "arrivals_per_s": shape,
+            "chaos": [{"t_s": round(c.t_s, 3), "kind": c.kind,
+                       "detail": c.detail} for c in self.chaos],
+        }
+
+    def expected(self) -> Dict[str, Any]:
+        """What the injected schedule obliges the fleet to have done —
+        the scorecard's ``expected`` section. Kills must show up as
+        failovers; a burst window must force at least one scale-up when
+        autoscaling is on."""
+        kills = sum(1 for c in self.chaos if c.kind == "kill_replica")
+        bursts = sum(1 for c in self.chaos if c.kind == "burst")
+        return {"kills": kills, "bursts": bursts,
+                "failovers_min": kills,
+                "scale_ups_min": min(1, bursts),
+                "abuse_spikes": int(self.loadgen.abuse_spikes)}
+
+
+def _lengths(rng, n: int, median: int, sigma: float,
+             cap: int) -> np.ndarray:
+    """Heavy-tailed (lognormal) integer lengths, clamped to [1, cap]."""
+    raw = rng.lognormal(mean=math.log(max(1, median)), sigma=sigma,
+                        size=n)
+    return np.clip(np.rint(raw).astype(np.int64), 1, cap)
+
+
+def _zipf_weights(n: int, alpha: float) -> np.ndarray:
+    ranks = np.arange(1, n + 1, dtype=np.float64)
+    w = ranks ** (-alpha)
+    return w / w.sum()
+
+
+def generate_trace(loadgen: LoadgenConfig,
+                   soak: Optional[SoakConfig] = None,
+                   seed: Optional[int] = None) -> SoakTrace:
+    """Materialise the full soak schedule. Deterministic in
+    ``(loadgen, soak, seed)``: one ``np.random.default_rng`` drives
+    every draw in a fixed order. ``seed`` overrides ``loadgen.seed``."""
+    rng = np.random.default_rng(loadgen.seed if seed is None else seed)
+    horizon = float(loadgen.duration_s)
+    vocab = int(loadgen.vocab)
+
+    # cohort prefixes are part of the trace identity: same seed, same
+    # shared prefixes, same radix-cache hit pattern
+    prefixes = rng.integers(1, vocab, size=(loadgen.prefix_cohorts,
+                                            loadgen.prefix_len))
+    tenant_w = _zipf_weights(loadgen.tenants, loadgen.zipf_alpha)
+
+    # chaos schedule first (fixed draws regardless of arrival count)
+    chaos: List[ChaosEvent] = []
+    burst_window = None
+    if soak is not None:
+        if soak.kill_replica_at_frac >= 0:
+            chaos.append(ChaosEvent(
+                t_s=soak.kill_replica_at_frac * horizon,
+                kind="kill_replica",
+                detail={"via": "router.kill", "reason": "soak_chaos"}))
+        if soak.burst_at_frac >= 0 and soak.burst_rate_mult > 1.0 \
+                and soak.burst_duration_frac > 0:
+            t0 = soak.burst_at_frac * horizon
+            dur = soak.burst_duration_frac * horizon
+            burst_window = (t0, min(horizon, t0 + dur))
+            chaos.append(ChaosEvent(
+                t_s=t0, kind="burst",
+                detail={"duration_s": round(dur, 3),
+                        "rate_mult": soak.burst_rate_mult}))
+
+    # steady arrivals: inhomogeneous Poisson by thinning against the
+    # diurnal peak rate
+    peak = loadgen.base_rate * (1.0 + loadgen.diurnal_amplitude)
+    times: List[float] = []
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / peak))
+        if t >= horizon:
+            break
+        if float(rng.random()) < rate_at(loadgen, t) / peak:
+            times.append(t)
+    kinds = ["steady"] * len(times)
+
+    # burst arrivals: superposed homogeneous Poisson over the burst
+    # window at (mult - 1) x base_rate — together with the steady
+    # process this is the diurnal curve times the burst multiplier
+    if burst_window is not None:
+        b0, b1 = burst_window
+        extra = loadgen.base_rate * (soak.burst_rate_mult - 1.0)
+        t = b0
+        while True:
+            t += float(rng.exponential(1.0 / extra))
+            if t >= b1:
+                break
+            times.append(t)
+            kinds.append("burst")
+
+    n = len(times)
+    tenants = rng.choice(loadgen.tenants, size=n, p=tenant_w)
+    plens = _lengths(rng, n, loadgen.prompt_len_median,
+                     loadgen.prompt_len_sigma, loadgen.prompt_len_max)
+    olens = _lengths(rng, n, loadgen.output_len_median,
+                     loadgen.output_len_sigma, loadgen.output_len_max)
+    shared = rng.random(n) < loadgen.shared_prefix_fraction
+    cohort_ids = rng.integers(0, loadgen.prefix_cohorts, size=n)
+
+    events: List[LoadEvent] = []
+    for i in range(n):
+        plen = int(plens[i])
+        cohort: Optional[int] = None
+        if bool(shared[i]):
+            cohort = int(cohort_ids[i])
+            tail = rng.integers(1, vocab, size=max(1, plen
+                                                   - loadgen.prefix_len))
+            prompt = [int(x) for x in prefixes[cohort]] + \
+                [int(x) for x in tail]
+        else:
+            prompt = [int(x) for x in rng.integers(1, vocab, size=plen)]
+        events.append(LoadEvent(
+            t_s=float(times[i]), tenant=f"t{int(tenants[i])}",
+            prompt=prompt, max_new_tokens=int(olens[i]),
+            cohort=cohort, kind=kinds[i]))
+
+    # abuse spikes: one tenant, many requests, one instant
+    for _ in range(int(loadgen.abuse_spikes)):
+        spike_t = float(rng.uniform(0.1, 0.85)) * horizon
+        offsets = rng.uniform(0.0, 0.25, size=loadgen.abuse_spike_requests)
+        sp = _lengths(rng, loadgen.abuse_spike_requests,
+                      loadgen.prompt_len_median, loadgen.prompt_len_sigma,
+                      loadgen.prompt_len_max)
+        so = _lengths(rng, loadgen.abuse_spike_requests,
+                      loadgen.output_len_median, loadgen.output_len_sigma,
+                      loadgen.output_len_max)
+        for j in range(int(loadgen.abuse_spike_requests)):
+            prompt = [int(x) for x in rng.integers(1, vocab,
+                                                   size=int(sp[j]))]
+            events.append(LoadEvent(
+                t_s=min(horizon, spike_t + float(offsets[j])),
+                tenant=loadgen.abuse_tenant, prompt=prompt,
+                max_new_tokens=int(so[j]), kind="abuse"))
+
+    events.sort(key=lambda ev: ev.t_s)
+    chaos.sort(key=lambda c: c.t_s)
+    return SoakTrace(events, chaos, loadgen, soak)

@@ -31,6 +31,8 @@ from .compileplane import (CompileLedger, HBMLedger, fingerprint_args,
 from .overlap import OverlapAnalyzer, interval_overlap, overlap_from_events
 from .disttrace import (TraceContext, FleetAggregator, merge_chrome_traces,
                         split_events_by_replica, CRITICAL_PATH_STAGES)
+from .scorecard import (SCORECARD_KIND, INVARIANTS, check_invariants,
+                        fold_scorecard, diff_scorecards, write_scorecard)
 
 __all__ = ["Span", "Tracer", "RecompileWatchdog", "get_tracer",
            "configure_tracer", "chrome_trace", "write_chrome_trace",
@@ -42,4 +44,6 @@ __all__ = ["Span", "Tracer", "RecompileWatchdog", "get_tracer",
            "fingerprint_args", "diff_fingerprints", "OverlapAnalyzer",
            "interval_overlap", "overlap_from_events",
            "TraceContext", "FleetAggregator", "merge_chrome_traces",
-           "split_events_by_replica", "CRITICAL_PATH_STAGES"]
+           "split_events_by_replica", "CRITICAL_PATH_STAGES",
+           "SCORECARD_KIND", "INVARIANTS", "check_invariants",
+           "fold_scorecard", "diff_scorecards", "write_scorecard"]

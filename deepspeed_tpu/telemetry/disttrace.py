@@ -526,14 +526,26 @@ class FleetAggregator:
         if write and rec is not None:
             try:
                 os.makedirs(rec.dir, exist_ok=True)
-                seq = len([n for n in os.listdir(rec.dir)
-                           if n.startswith("crossrep-")]) + 1
+                # next sequence = max existing + 1, NOT count + 1: once
+                # retention prunes old crossrep docs, a count-derived
+                # name would collide with (and silently overwrite) a
+                # surviving newer one
+                seqs = [0]
+                for n in os.listdir(rec.dir):
+                    if n.startswith("crossrep-") and n.endswith(".json"):
+                        try:
+                            seqs.append(int(n[len("crossrep-"):-len(
+                                ".json")]))
+                        except ValueError:
+                            pass
+                seq = max(seqs) + 1
                 path = os.path.join(rec.dir, f"crossrep-{seq:04d}.json")
                 tmp = path + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(doc, f, default=str)
                 os.replace(tmp, path)
                 doc["path"] = path
+                rec._retain()          # crossrep docs share keep-last-N
             except OSError:
                 pass
         return doc

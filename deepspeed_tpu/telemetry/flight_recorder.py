@@ -295,6 +295,21 @@ class FlightRecorder:
                 os.remove(os.path.join(self.dir, name))
             except OSError:
                 pass
+        # cross-replica postmortems (crossrep-NNNN.json, written into
+        # this dir by FleetAggregator.cross_replica_postmortem) obey the
+        # same keep — a soak with a failover every few seconds must not
+        # grow the bundle dir without bound
+        try:
+            names = sorted(n for n in os.listdir(self.dir)
+                           if n.startswith("crossrep-")
+                           and n.endswith(".json"))
+        except OSError:
+            return
+        for name in names[:max(0, len(names) - self.keep)]:
+            try:
+                os.remove(os.path.join(self.dir, name))
+            except OSError:
+                pass
 
     def bundles(self) -> List[Dict[str, Any]]:
         """On-disk bundle index (newest last): id, kind, file, bytes."""

@@ -1,0 +1,444 @@
+"""Fleet soak scorecard — cross-subsystem invariants folded into ONE JSON.
+
+PRs 8–15 each left a ledger behind: the goodput ledger (wall-clock
+accounting), per-replica SLO windows, tenant counters, flight-recorder
+bundles, and disttrace critical-path windows. Each is checked in its own
+unit tests against its own subsystem; nothing checks them against *each
+other* under sustained mixed load. The scorecard is that check: one
+document folded at the end of a soak run (benchmarks/soak.py) with hard
+cross-subsystem invariants evaluated at fold time:
+
+- ``goodput_sums_to_wall``      — fleet goodput buckets (idle residual
+  included) sum to measured wall-clock within ``goodput_wall_rel``, and
+  serving work was actually attributed. An in-process fleet ticks its
+  replicas sequentially on one thread against the process-global ledger,
+  so attributed time sums to 1 x wall (``live_replica_seconds`` is
+  recorded alongside for the multi-process reading of the same law);
+  a hole means lost accounting, an overshoot means double-counting.
+- ``exactly_once_streaming``    — zero dropped / duplicated / mismatched
+  streamed tokens across failover and drain. The audit rides the PR-8
+  dedup bookkeeping: every ``on_token`` delivery is recorded with its
+  delivered position and compared against the request's final token
+  list.
+- ``slo_burn_recovers``         — after every chaos event the fleet burn
+  rate returns to <= 1.0 within ``recovery_window_s``, and ends <= 1.0.
+- ``autoscale_matches_load``    — the injected load shape's obligations
+  were met: >= 1 scale-up per burst window, >= 1 failover per kill, and
+  the live replica count respected the configured bounds.
+- ``critical_path_decomposes``  — the aggregator's aligned stage-mean
+  sum equals the mean e2e within ``critical_path_rel`` (per-request
+  decomposition is exact by construction; the folded check guards the
+  aggregation).
+- ``bundle_retention_bounded``  — after minutes of sustained triggers,
+  every member's bundle dir holds <= keep bundles and <= keep
+  cross-replica postmortems (the unbounded-growth failure this PR
+  fixed), with per-kind counts recorded.
+
+This module is stdlib-only on purpose: ``bin/ds_tpu_soakdiff`` loads it
+by file path on machines with no jax/numpy, and ``check_invariants`` /
+``diff_scorecards`` are pure functions over JSON-shaped dicts so the
+rigged-input tests need no fleet.
+"""
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["SCORECARD_KIND", "SCORECARD_VERSION", "INVARIANTS",
+           "DEFAULT_TOLERANCES", "DIFF_TOLERANCES", "check_invariants",
+           "fold_scorecard", "diff_scorecards", "format_diff",
+           "write_scorecard"]
+
+SCORECARD_KIND = "soak_scorecard"
+SCORECARD_VERSION = 1
+
+#: invariant names, in report order
+INVARIANTS = ("goodput_sums_to_wall", "exactly_once_streaming",
+              "slo_burn_recovers", "autoscale_matches_load",
+              "critical_path_decomposes", "bundle_retention_bounded")
+
+#: fold-time invariant tolerances (overridable per scorecard; the used
+#: values are embedded in the document so a reader sees what was checked)
+DEFAULT_TOLERANCES = {
+    "goodput_wall_rel": 0.02,        # +/-2% fleet-wide, per the contract
+    "recovery_window_s": 20.0,
+    "critical_path_rel": 0.05,
+    "critical_path_floor_ms": 0.5,
+}
+
+#: soak-diff noise tolerances: metric path -> (mode, bound). ``min_ratio``
+#: fails when candidate < bound x baseline; ``max_ratio`` when candidate >
+#: bound x baseline; ``abs_band`` when |candidate - baseline| > bound;
+#: ``max_abs`` when candidate > bound regardless of baseline. Latency on
+#: a shared CPU host is noisy — the ratio bands are deliberately wide;
+#: the *hard* signals (invariants, token audit) have no band at all.
+DIFF_TOLERANCES: Dict[str, Tuple[str, float]] = {
+    "goodput.goodput_fraction": ("min_ratio", 0.60),
+    "fleet.completed": ("min_ratio", 0.90),
+    "fleet.failovers": ("abs_band", 2),
+    "fleet.scale_ups": ("abs_band", 3),
+    "token_audit.dropped": ("max_abs", 0),
+    "token_audit.duplicated": ("max_abs", 0),
+    "token_audit.mismatched": ("max_abs", 0),
+    "token_audit.failed_requests": ("max_abs", 0),
+    "latency.ttft_ms_p99": ("max_ratio", 3.0),
+    "latency.e2e_ms_p95": ("max_ratio", 3.0),
+    "critical_path.e2e_ms_mean": ("max_ratio", 3.0),
+    "wall_s": ("max_ratio", 2.0),
+}
+
+
+def _get(doc: Dict[str, Any], path: str, default=None):
+    cur: Any = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return default
+        cur = cur[part]
+    return cur
+
+
+# ---------------------------------------------------------------------------
+# invariants
+# ---------------------------------------------------------------------------
+
+def _inv_goodput(doc, tol) -> Tuple[bool, str]:
+    g = doc.get("goodput")
+    if not g:
+        return False, "no goodput window in scorecard"
+    wall = float(g.get("wall_s") or 0.0)
+    if wall <= 0:
+        return False, "goodput wall_s is zero"
+    buckets = g.get("buckets") or {}
+    total = sum(float(v) for v in buckets.values())
+    serving = float(buckets.get("serving_step", 0.0)) \
+        + float(buckets.get("serving_drain", 0.0))
+    rel = tol["goodput_wall_rel"]
+    if serving <= 0:
+        return False, "no serving_step/serving_drain time attributed"
+    if abs(total - wall) > rel * wall:
+        kind = "hole (lost accounting)" if total < wall \
+            else "overshoot (double-counted interval)"
+        return False, (f"buckets sum {total:.3f}s vs wall {wall:.3f}s "
+                       f"({kind}, tol {rel:.0%})")
+    return True, (f"buckets sum {total:.3f}s == wall {wall:.3f}s "
+                  f"(+/-{rel:.0%}); serving {serving:.3f}s")
+
+
+def _inv_streaming(doc, tol) -> Tuple[bool, str]:
+    ta = doc.get("token_audit")
+    if not ta:
+        return False, "no token audit in scorecard"
+    if int(ta.get("audited") or 0) <= 0:
+        return False, "token audit saw zero requests"
+    bad = {k: int(ta.get(k) or 0)
+           for k in ("dropped", "duplicated", "mismatched",
+                     "failed_requests")}
+    if any(bad.values()):
+        return False, ("exactly-once violated: " +
+                       ", ".join(f"{k}={v}" for k, v in bad.items()
+                                 if v))
+    return True, (f"{ta.get('streamed_tokens', 0)} tokens over "
+                  f"{ta.get('audited', 0)} requests, 0 dropped / "
+                  f"0 duplicated (failovers={_get(doc, 'fleet.failovers', 0)})")
+
+
+def _inv_burn(doc, tol) -> Tuple[bool, str]:
+    series = _get(doc, "slo.burn_series") or []
+    chaos = doc.get("chaos") or []
+    if not series:
+        return False, "no burn samples recorded"
+    window = tol["recovery_window_s"]
+    final = float(series[-1][1])
+    if final > 1.0:
+        return False, f"final burn {final:.2f} > 1.0"
+    recoveries = []
+    for ev in chaos:
+        t0 = float(ev.get("t_s") or 0.0)
+        rec_at = next((float(t) for t, b in series
+                       if t >= t0 and float(b) <= 1.0), None)
+        if rec_at is None or rec_at - t0 > window:
+            return False, (f"burn after {ev.get('kind')}@{t0:.1f}s did "
+                           f"not recover within {window:g}s")
+        recoveries.append(f"{ev.get('kind')}@{t0:.1f}s: "
+                          f"{rec_at - t0:.1f}s")
+    return True, ("recovered <= 1.0 after every chaos event ("
+                  + "; ".join(recoveries) + ")" if recoveries
+                  else f"final burn {final:.2f} <= 1.0 (no chaos)")
+
+
+def _inv_autoscale(doc, tol) -> Tuple[bool, str]:
+    exp = doc.get("expected") or {}
+    ups = int(_get(doc, "fleet.scale_ups", 0) or 0)
+    fails = int(_get(doc, "fleet.failovers", 0) or 0)
+    need_ups = int(exp.get("scale_ups_min") or 0)
+    need_fails = int(exp.get("failovers_min") or 0)
+    if ups < need_ups:
+        return False, (f"{ups} scale-up(s) vs >= {need_ups} demanded by "
+                       f"the injected burst(s)")
+    if fails < need_fails:
+        return False, (f"{fails} failover(s) vs >= {need_fails} demanded "
+                       f"by the injected kill(s)")
+    live = _get(doc, "autoscale.live_replicas")
+    lo = _get(doc, "autoscale.min_replicas")
+    hi = _get(doc, "autoscale.max_replicas")
+    if live is not None and lo is not None and hi is not None and \
+            not (int(lo) <= int(live) <= int(hi)):
+        return False, (f"live replicas {live} outside autoscale bounds "
+                       f"[{lo}, {hi}]")
+    return True, (f"scale_ups={ups} (>= {need_ups}), failovers={fails} "
+                  f"(>= {need_fails}), live={live} in [{lo}, {hi}]")
+
+
+def _inv_critical_path(doc, tol) -> Tuple[bool, str]:
+    cp = doc.get("critical_path")
+    if not cp:
+        return False, "no critical-path summary in scorecard"
+    if int(cp.get("requests") or 0) <= 0:
+        return False, "critical path observed zero requests"
+    e2e = float(cp.get("e2e_ms_mean") or 0.0)
+    ssum = float(cp.get("stage_sum_ms_mean") or 0.0)
+    slack = max(tol["critical_path_floor_ms"],
+                tol["critical_path_rel"] * e2e)
+    if abs(ssum - e2e) > slack:
+        return False, (f"stage sum {ssum:.2f}ms != e2e mean {e2e:.2f}ms "
+                       f"(slack {slack:.2f}ms)")
+    return True, (f"stage sum {ssum:.2f}ms == e2e mean {e2e:.2f}ms over "
+                  f"{cp['requests']} request(s)")
+
+
+def _inv_bundles(doc, tol) -> Tuple[bool, str]:
+    members = _get(doc, "flight_recorder.members")
+    if not members:
+        return False, "no flight-recorder members in scorecard"
+    total = 0
+    for name, m in members.items():
+        keep = int(m.get("keep") or 0)
+        bundles = int(m.get("bundles") or 0)
+        crossrep = int(m.get("crossrep") or 0)
+        total += bundles
+        if keep and bundles > keep:
+            return False, (f"{name}: {bundles} bundles on disk > "
+                           f"keep={keep} (retention leak)")
+        if keep and crossrep > keep:
+            return False, (f"{name}: {crossrep} crossrep docs on disk > "
+                           f"keep={keep} (retention leak)")
+    return True, (f"{total} bundle(s) across {len(members)} member(s), "
+                  f"all within keep")
+
+
+_CHECKS = {
+    "goodput_sums_to_wall": _inv_goodput,
+    "exactly_once_streaming": _inv_streaming,
+    "slo_burn_recovers": _inv_burn,
+    "autoscale_matches_load": _inv_autoscale,
+    "critical_path_decomposes": _inv_critical_path,
+    "bundle_retention_bounded": _inv_bundles,
+}
+
+
+def check_invariants(doc: Dict[str, Any],
+                     tolerances: Optional[Dict[str, float]] = None
+                     ) -> Dict[str, Dict[str, Any]]:
+    """Evaluate every invariant against a scorecard-shaped dict. Pure:
+    no fleet required, so rigged inputs (an injected dropped token, a
+    goodput hole, an unrecovered burn) test each named invariant in
+    isolation."""
+    tol = dict(DEFAULT_TOLERANCES)
+    tol.update(doc.get("tolerances") or {})
+    tol.update(tolerances or {})
+    out: Dict[str, Dict[str, Any]] = {}
+    for name in INVARIANTS:
+        try:
+            ok, detail = _CHECKS[name](doc, tol)
+        except Exception as e:       # a malformed section is a failure,
+            ok, detail = False, f"check error: {e}"   # not a crash
+        out[name] = {"ok": bool(ok), "detail": detail}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# folding
+# ---------------------------------------------------------------------------
+
+def _crossrep_count(bundle_dir: str) -> int:
+    try:
+        return sum(1 for n in os.listdir(bundle_dir)
+                   if n.startswith("crossrep-") and n.endswith(".json"))
+    except OSError:
+        return 0
+
+
+def _recorder_member(rec) -> Dict[str, Any]:
+    by_kind: Dict[str, int] = {}
+    entries = rec.bundles()
+    for b in entries:
+        by_kind[b["kind"]] = by_kind.get(b["kind"], 0) + 1
+    return {"keep": int(rec.keep), "bundles": len(entries),
+            "by_kind": by_kind, "crossrep": _crossrep_count(rec.dir),
+            "triggers": dict(rec.trigger_counts),
+            "suppressed": int(rec.suppressed)}
+
+
+def fold_scorecard(router, *, wall_s: float,
+                   goodput: Optional[Dict[str, Any]] = None,
+                   token_audit: Optional[Dict[str, Any]] = None,
+                   burn_series: Optional[List[List[float]]] = None,
+                   chaos: Optional[List[Dict[str, Any]]] = None,
+                   expected: Optional[Dict[str, Any]] = None,
+                   live_replica_seconds: Optional[float] = None,
+                   latency: Optional[Dict[str, float]] = None,
+                   trace_summary: Optional[Dict[str, Any]] = None,
+                   tolerances: Optional[Dict[str, float]] = None,
+                   ) -> Dict[str, Any]:
+    """Fold one finished soak run into the scorecard document. The
+    harness supplies what only it can know (wall clock, the streamed-
+    token audit, the burn/chaos timelines, the injected-load
+    expectations); everything else is read off the router: fleet
+    counters, autoscale + tenant summaries, comm stats, the disttrace
+    critical-path summary, and every member's flight-recorder state.
+    Invariants are evaluated at fold time and embedded."""
+    tol = dict(DEFAULT_TOLERANCES)
+    tol.update(tolerances or {})
+    m = router.metrics
+    doc: Dict[str, Any] = {
+        "kind": SCORECARD_KIND,
+        "version": SCORECARD_VERSION,
+        "wall_s": round(float(wall_s), 3),
+        "tolerances": tol,
+        "fleet": {
+            "submitted": m.submitted, "completed": m.completed,
+            "failovers": m.failovers, "requeued": m.requeued,
+            "handoffs": m.handoffs, "throttled": m.throttled,
+            "scale_ups": m.scale_ups, "scale_downs": m.scale_downs,
+            "tenant_throttled": dict(m.tenant_throttled),
+            "replicas": len(router.replicas),
+        },
+        "autoscale": router.autoscale_summary(),
+        "tenants": router.tenant_summary(),
+    }
+    if live_replica_seconds is not None:
+        doc["fleet"]["live_replica_seconds"] = round(
+            float(live_replica_seconds), 3)
+    if goodput is not None:
+        doc["goodput"] = goodput
+    if token_audit is not None:
+        doc["token_audit"] = token_audit
+    doc["slo"] = {"burn_series": [[round(float(t), 3),
+                                   round(float(b), 4)]
+                                  for t, b in (burn_series or [])]}
+    doc["chaos"] = list(chaos or [])
+    if expected is not None:
+        doc["expected"] = expected
+    if latency is not None:
+        doc["latency"] = latency
+    if trace_summary is not None:
+        doc["load"] = trace_summary
+    agg = getattr(router, "aggregator", None)
+    if agg is not None:
+        doc["critical_path"] = agg.critical_path_summary()
+    try:
+        from ..comm.comm import comm_stats
+        doc["comm"] = comm_stats()
+    except Exception:
+        pass
+    members: Dict[str, Any] = {}
+    rec = getattr(router, "recorder", None)
+    if rec is not None:
+        members["router"] = _recorder_member(rec)
+    for name, handle in router.replicas.items():
+        eng_rec = getattr(handle.engine, "_recorder", None)
+        if eng_rec is not None:
+            members[name] = _recorder_member(eng_rec)
+    if members:
+        doc["flight_recorder"] = {"members": members}
+    doc["invariants"] = check_invariants(doc)
+    doc["ok"] = all(v["ok"] for v in doc["invariants"].values())
+    return doc
+
+
+def write_scorecard(doc: Dict[str, Any], path: str) -> str:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# diffing (the regression gate)
+# ---------------------------------------------------------------------------
+
+def diff_scorecards(base: Dict[str, Any], cand: Dict[str, Any],
+                    tolerances: Optional[Dict[str, Tuple[str, float]]]
+                    = None) -> Tuple[List[Dict[str, Any]], bool]:
+    """Compare a candidate scorecard against a baseline with per-metric
+    noise tolerances. Returns ``(rows, ok)``. Hard gates first: the
+    candidate must be a scorecard, and every embedded invariant must
+    hold — a run whose own invariants fail cannot pass the diff no
+    matter how its metrics compare."""
+    rows: List[Dict[str, Any]] = []
+
+    def row(metric, b, c, tol, ok, note=""):
+        rows.append({"metric": metric, "baseline": b, "candidate": c,
+                     "tolerance": tol, "ok": bool(ok), "note": note})
+
+    if cand.get("kind") != SCORECARD_KIND:
+        row("kind", base.get("kind"), cand.get("kind"),
+            SCORECARD_KIND, False, "candidate is not a soak scorecard")
+        return rows, False
+    for name in INVARIANTS:
+        inv = (cand.get("invariants") or {}).get(name) or {}
+        row(f"invariant:{name}",
+            (( base.get("invariants") or {}).get(name) or {}).get("ok"),
+            inv.get("ok"), "must hold", bool(inv.get("ok")),
+            "" if inv.get("ok") else str(inv.get("detail")))
+
+    for path, (mode, bound) in (tolerances or DIFF_TOLERANCES).items():
+        b, c = _get(base, path), _get(cand, path)
+        if c is None:
+            row(path, b, None, f"{mode} {bound:g}", False,
+                "missing in candidate")
+            continue
+        b_f, c_f = float(b if b is not None else 0.0), float(c)
+        if mode == "max_abs":
+            ok, tol_s = c_f <= bound, f"<= {bound:g}"
+        elif mode == "abs_band":
+            ok = b is None or abs(c_f - b_f) <= bound
+            tol_s = f"+/-{bound:g}"
+        elif mode == "min_ratio":
+            ok = b is None or b_f <= 0 or c_f >= bound * b_f
+            tol_s = f">= {bound:g}x base"
+        else:                                   # max_ratio
+            ok = b is None or b_f <= 0 or c_f <= bound * b_f
+            tol_s = f"<= {bound:g}x base"
+        row(path, b, c, tol_s, ok)
+    return rows, all(r["ok"] for r in rows)
+
+
+def format_diff(rows: List[Dict[str, Any]]) -> str:
+    """The pass/fail regression table ds_tpu_soakdiff prints."""
+    def fmt(v):
+        if isinstance(v, float):
+            return f"{v:.4g}"
+        return "-" if v is None else str(v)
+
+    header = ("metric", "baseline", "candidate", "tolerance", "verdict")
+    table = [header]
+    for r in rows:
+        verdict = "ok" if r["ok"] else "FAIL"
+        if r["note"]:
+            verdict += f"  ({r['note']})"
+        table.append((r["metric"], fmt(r["baseline"]),
+                      fmt(r["candidate"]), str(r["tolerance"]), verdict))
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(header) - 1)]
+    lines = []
+    for i, row in enumerate(table):
+        lines.append("  ".join(cell.ljust(widths[j]) if j < len(widths)
+                               else cell
+                               for j, cell in enumerate(row)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths) + "  " +
+                         "-" * 7)
+    return "\n".join(lines)

@@ -1,0 +1,488 @@
+"""Soak plane tests: loadgen determinism, scorecard invariants on
+rigged inputs, the soakdiff regression gate, flight-recorder retention
+under sustained triggers, ds_tpu_top's scorecard panel, and the tier-1
+fast soak smoke (a full CPU fleet through a replica kill and an
+autoscale cycle).
+
+Contracts under test: the same seed always yields the identical
+arrival/tenant/length/cohort schedule (what makes soak-diff against a
+checked-in baseline meaningful); each named invariant fails — by name,
+with the others unaffected — on its rigged input (an injected dropped
+token, a goodput hole, an unrecovered burn, a retention leak, a
+stage-sum mismatch, a missing scale-up); ``ds_tpu_soakdiff`` exits 0 on
+a faithful candidate and 1 on a degraded one, and refuses to baseline
+itself; a recorder under a trigger storm keeps last-N bundles AND
+last-N cross-replica postmortems (newest survive); the fast soak's own
+asserted scorecard passes the gate against the checked-in baseline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.runtime.config import FlightRecorderConfig
+from deepspeed_tpu.runtime.config_utils import ConfigError
+from deepspeed_tpu.serving import LoadgenConfig, SoakConfig
+from deepspeed_tpu.serving.loadgen import generate_trace, rate_at
+from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder
+from deepspeed_tpu.telemetry.scorecard import (
+    DEFAULT_TOLERANCES, INVARIANTS, SCORECARD_KIND, check_invariants,
+    diff_scorecards, format_diff, write_scorecard)
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+SOAKDIFF = os.path.join(REPO, "bin", "ds_tpu_soakdiff")
+TOP = os.path.join(REPO, "bin", "ds_tpu_top")
+
+
+def _cfg(**over):
+    base = dict(seed=7, duration_s=60.0, base_rate=8.0, tenants=4,
+                abuse_spikes=1, abuse_spike_requests=10)
+    base.update(over)
+    return LoadgenConfig(**base)
+
+
+def _key(ev):
+    return (round(ev.t_s, 9), ev.tenant, tuple(ev.prompt),
+            ev.max_new_tokens, ev.cohort, ev.kind)
+
+
+# ------------------------------------------------------------- loadgen
+
+def test_loadgen_deterministic_in_seed():
+    """Same seed ⇒ byte-identical schedule (events AND chaos);
+    different seed ⇒ a different one."""
+    cfg, soak = _cfg(), SoakConfig()
+    a = generate_trace(cfg, soak)
+    b = generate_trace(cfg, soak)
+    assert [_key(e) for e in a.events] == [_key(e) for e in b.events]
+    assert [(c.t_s, c.kind) for c in a.chaos] == \
+        [(c.t_s, c.kind) for c in b.chaos]
+    c = generate_trace(cfg, soak, seed=8)
+    assert [_key(e) for e in a.events] != [_key(e) for e in c.events]
+
+
+def test_loadgen_diurnal_shape():
+    """Trough at t=0, peak mid-trace — both in the closed-form rate and
+    in the realised arrival counts."""
+    cfg = _cfg(diurnal_amplitude=0.5)
+    assert rate_at(cfg, 0.0) == pytest.approx(
+        cfg.base_rate * 0.5, rel=1e-6)
+    assert rate_at(cfg, cfg.duration_s / 2) == pytest.approx(
+        cfg.base_rate * 1.5, rel=1e-6)
+    trace = generate_trace(cfg)
+    steady = [e.t_s for e in trace.events if e.kind == "steady"]
+    q = cfg.duration_s / 4
+    first, second = (sum(1 for t in steady if t < q),
+                     sum(1 for t in steady if q <= t < 2 * q))
+    assert second > first, (first, second)
+
+
+def test_loadgen_zipf_and_heavy_tail():
+    cfg = _cfg(zipf_alpha=1.5, prompt_len_median=12, prompt_len_max=96)
+    trace = generate_trace(cfg)
+    per_tenant = trace.summary()["per_tenant"]
+    assert per_tenant["t0"] > per_tenant.get(f"t{cfg.tenants - 1}", 0)
+    plens = [len(e.prompt) for e in trace.events]
+    assert min(plens) >= 1 and max(plens) <= cfg.prompt_len_max
+    assert max(plens) > 2 * cfg.prompt_len_median   # the heavy tail
+    olens = [e.max_new_tokens for e in trace.events]
+    assert max(olens) <= cfg.output_len_max and min(olens) >= 1
+
+
+def test_loadgen_shared_prefix_cohorts():
+    """Cohort members actually share the prefix (the radix cache's
+    workload), at roughly the configured fraction."""
+    cfg = _cfg(shared_prefix_fraction=0.35, prefix_cohorts=3,
+               prefix_len=16)
+    trace = generate_trace(cfg)
+    steady = [e for e in trace.events if e.kind != "abuse"]
+    cohorted = [e for e in steady if e.cohort is not None]
+    frac = len(cohorted) / len(steady)
+    assert 0.2 < frac < 0.5, frac
+    by_cohort = {}
+    for e in cohorted:
+        by_cohort.setdefault(e.cohort, []).append(e)
+    assert set(by_cohort) <= set(range(cfg.prefix_cohorts))
+    for members in by_cohort.values():
+        heads = {tuple(e.prompt[:cfg.prefix_len]) for e in members}
+        assert len(heads) == 1        # identical shared prefix
+
+
+def test_loadgen_abuse_spike_and_chaos_schedule():
+    cfg = _cfg(abuse_spikes=1, abuse_spike_requests=10)
+    soak = SoakConfig(kill_replica_at_frac=0.3, burst_at_frac=0.55,
+                      burst_duration_frac=0.15, burst_rate_mult=4.0)
+    trace = generate_trace(cfg, soak)
+    abuse = [e for e in trace.events if e.kind == "abuse"]
+    assert len(abuse) == 10
+    assert all(e.tenant == cfg.abuse_tenant for e in abuse)
+    assert max(e.t_s for e in abuse) - min(e.t_s for e in abuse) <= 0.25
+    kinds = {c.kind: c for c in trace.chaos}
+    assert set(kinds) == {"kill_replica", "burst"}
+    assert kinds["kill_replica"].t_s == pytest.approx(
+        0.3 * cfg.duration_s)
+    b0 = kinds["burst"].t_s
+    b1 = b0 + kinds["burst"].detail["duration_s"]
+    burst = [e.t_s for e in trace.events if e.kind == "burst"]
+    assert burst and all(b0 <= t <= b1 + 1e-6 for t in burst)
+    assert trace.expected() == {"kills": 1, "bursts": 1,
+                                "failovers_min": 1, "scale_ups_min": 1,
+                                "abuse_spikes": 1}
+    summ = trace.summary()
+    assert summ["requests"] == len(trace.events)
+    assert sum(summ["arrivals_per_s"]) == len(trace.events)
+
+
+def test_loadgen_config_validation():
+    with pytest.raises(ConfigError):
+        LoadgenConfig(zipf_alpha=1.0).validate()
+    with pytest.raises(ConfigError):
+        LoadgenConfig(base_rate=0.0).validate()
+    with pytest.raises(ConfigError):
+        SoakConfig(burst_rate_mult=0.5).validate()
+
+
+# ---------------------------------------------------- rigged invariants
+
+def _good_doc():
+    """A scorecard-shaped dict every invariant passes on — the rigged
+    tests perturb exactly one section each."""
+    doc = {
+        "kind": SCORECARD_KIND, "version": 1, "wall_s": 10.0,
+        "tolerances": dict(DEFAULT_TOLERANCES),
+        "fleet": {"submitted": 50, "completed": 48, "failovers": 1,
+                  "requeued": 2, "handoffs": 0, "throttled": 4,
+                  "scale_ups": 1, "scale_downs": 1, "replicas": 4},
+        "autoscale": {"live_replicas": 3, "min_replicas": 3,
+                      "max_replicas": 5},
+        "goodput": {"wall_s": 10.0,
+                    "buckets": {"serving_step": 8.5, "serving_drain": 0.5,
+                                "idle": 1.0},
+                    "productive_s": 9.0, "goodput_fraction": 0.9},
+        "token_audit": {"requests": 50, "audited": 48, "dropped": 0,
+                        "duplicated": 0, "mismatched": 0,
+                        "failed_requests": 0, "streamed_tokens": 310},
+        "slo": {"burn_series": [[0.0, 0.2], [3.0, 2.5], [5.0, 0.8],
+                                [10.0, 0.3]]},
+        "chaos": [{"t_s": 3.0, "kind": "kill_replica", "detail": {}}],
+        "expected": {"kills": 1, "bursts": 1, "failovers_min": 1,
+                     "scale_ups_min": 1, "abuse_spikes": 1},
+        "latency": {"ttft_ms_p50": 50.0, "ttft_ms_p99": 200.0,
+                    "e2e_ms_p50": 300.0, "e2e_ms_p95": 900.0},
+        "critical_path": {"requests": 48, "e2e_ms_mean": 350.0,
+                          "stage_sum_ms_mean": 349.5},
+        "flight_recorder": {"members": {
+            "router": {"keep": 4, "bundles": 3,
+                       "by_kind": {"failover": 1, "slo_burn": 2},
+                       "crossrep": 1, "triggers": {}, "suppressed": 0},
+            "r0": {"keep": 4, "bundles": 4, "by_kind": {},
+                   "crossrep": 0, "triggers": {}, "suppressed": 2}}},
+    }
+    doc["invariants"] = check_invariants(doc)
+    doc["ok"] = all(v["ok"] for v in doc["invariants"].values())
+    return doc
+
+
+def test_good_doc_passes_every_invariant():
+    doc = _good_doc()
+    assert doc["ok"], doc["invariants"]
+    assert set(doc["invariants"]) == set(INVARIANTS)
+
+
+def _assert_only_fails(doc, name, needle=""):
+    inv = check_invariants(doc)
+    assert not inv[name]["ok"], inv[name]
+    if needle:
+        assert needle in inv[name]["detail"], inv[name]["detail"]
+    others = {k: v for k, v in inv.items() if k != name}
+    assert all(v["ok"] for v in others.values()), others
+
+
+def test_injected_dropped_token_fails_by_name():
+    doc = _good_doc()
+    doc["token_audit"]["dropped"] = 3
+    _assert_only_fails(doc, "exactly_once_streaming", "dropped=3")
+
+
+def test_injected_duplicate_token_fails_by_name():
+    doc = _good_doc()
+    doc["token_audit"]["duplicated"] = 1
+    _assert_only_fails(doc, "exactly_once_streaming", "duplicated=1")
+
+
+def test_goodput_hole_and_overshoot_fail_by_name():
+    doc = _good_doc()
+    doc["goodput"]["buckets"] = {"serving_step": 7.0, "idle": 1.0}
+    _assert_only_fails(doc, "goodput_sums_to_wall", "hole")
+    doc = _good_doc()
+    doc["goodput"]["buckets"]["serving_step"] = 10.0   # double-counted
+    _assert_only_fails(doc, "goodput_sums_to_wall", "overshoot")
+
+
+def test_unrecovered_burn_fails_by_name():
+    doc = _good_doc()
+    # burn never returns <= 1.0 inside the 20s window after the kill
+    doc["slo"]["burn_series"] = [[0.0, 0.2], [3.0, 2.5], [10.0, 2.2],
+                                 [24.0, 0.5]]
+    _assert_only_fails(doc, "slo_burn_recovers", "did not recover")
+    doc = _good_doc()
+    doc["slo"]["burn_series"].append([10.5, 1.7])
+    _assert_only_fails(doc, "slo_burn_recovers", "final burn")
+
+
+def test_retention_leak_fails_by_name():
+    doc = _good_doc()
+    doc["flight_recorder"]["members"]["r0"]["bundles"] = 9
+    _assert_only_fails(doc, "bundle_retention_bounded", "retention leak")
+    doc = _good_doc()
+    doc["flight_recorder"]["members"]["router"]["crossrep"] = 7
+    _assert_only_fails(doc, "bundle_retention_bounded", "crossrep")
+
+
+def test_stage_sum_mismatch_fails_by_name():
+    doc = _good_doc()
+    doc["critical_path"]["stage_sum_ms_mean"] = 300.0
+    _assert_only_fails(doc, "critical_path_decomposes", "stage sum")
+
+
+def test_missing_scale_up_fails_by_name():
+    doc = _good_doc()
+    doc["fleet"]["scale_ups"] = 0
+    _assert_only_fails(doc, "autoscale_matches_load", "scale-up")
+
+
+# ------------------------------------------------------------- soakdiff
+
+def test_diff_scorecards_pass_and_perturbations():
+    base = _good_doc()
+    rows, ok = diff_scorecards(base, _good_doc())
+    assert ok and all(r["ok"] for r in rows)
+    assert {f"invariant:{n}" for n in INVARIANTS} <= \
+        {r["metric"] for r in rows}
+    table = format_diff(rows)
+    assert "verdict" in table and "FAIL" not in table
+
+    cand = _good_doc()                       # a dropped token is a hard
+    cand["token_audit"]["dropped"] = 1       # gate, band = 0
+    cand["invariants"] = check_invariants(cand)
+    rows, ok = diff_scorecards(base, cand)
+    assert not ok
+    bad = {r["metric"] for r in rows if not r["ok"]}
+    assert "token_audit.dropped" in bad
+    assert "invariant:exactly_once_streaming" in bad
+
+    cand = _good_doc()                       # throughput collapse
+    cand["fleet"]["completed"] = 30
+    rows, ok = diff_scorecards(base, cand)
+    assert not ok and "fleet.completed" in \
+        {r["metric"] for r in rows if not r["ok"]}
+
+    cand = _good_doc()                       # latency blow-up > 3x band
+    cand["latency"]["ttft_ms_p99"] = 700.0
+    rows, ok = diff_scorecards(base, cand)
+    assert not ok
+
+    cand = _good_doc()                       # noise within band passes
+    cand["fleet"]["completed"] = 46
+    cand["latency"]["ttft_ms_p99"] = 380.0
+    rows, ok = diff_scorecards(base, cand)
+    assert ok
+
+    rows, ok = diff_scorecards(base, {"kind": "snapshot"})
+    assert not ok and rows[0]["metric"] == "kind"
+
+
+def test_soakdiff_cli_exit_codes(tmp_path):
+    base_p = tmp_path / "base.json"
+    cand_p = tmp_path / "cand.json"
+    write_scorecard(_good_doc(), str(base_p))
+    write_scorecard(_good_doc(), str(cand_p))
+
+    def run(*argv):
+        return subprocess.run([sys.executable, SOAKDIFF, *argv],
+                              capture_output=True, text=True, timeout=60)
+
+    r = run(str(base_p), str(cand_p))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PASS" in r.stdout
+
+    degraded = _good_doc()
+    degraded["token_audit"]["duplicated"] = 2
+    degraded["invariants"] = check_invariants(degraded)
+    deg_p = tmp_path / "deg.json"
+    write_scorecard(degraded, str(deg_p))
+    r = run(str(base_p), str(deg_p))
+    assert r.returncode == 1
+    assert "FAIL" in r.stdout and "exactly_once_streaming" in r.stdout
+
+    # a gate run cannot baseline itself
+    r = run(str(tmp_path / "missing.json"), str(cand_p))
+    assert r.returncode == 1
+    assert "cannot baseline itself" in r.stderr
+
+    # --update-baseline pins the candidate (hlo_audit flow) ...
+    new_base = tmp_path / "pinned.json"
+    r = run(str(new_base), str(cand_p), "--update-baseline")
+    assert r.returncode == 0 and new_base.exists()
+    assert json.loads(new_base.read_text())["kind"] == SCORECARD_KIND
+    r = run(str(new_base), str(cand_p))
+    assert r.returncode == 0
+    # ... but refuses a non-scorecard candidate
+    not_sc = tmp_path / "not_sc.json"
+    not_sc.write_text(json.dumps({"kind": "snapshot"}))
+    r = run(str(new_base), str(not_sc), "--update-baseline")
+    assert r.returncode == 1
+
+
+# -------------------------------------------- flight-recorder retention
+
+def test_recorder_retention_under_sustained_triggers(tmp_path):
+    """A trigger storm (debounce-spaced) keeps last-N bundles AND
+    last-N crossrep docs — the bundle dir stays bounded for the whole
+    soak — while in-window repeats are suppressed (counted, not
+    captured)."""
+    clk = {"t": 0.0}
+    cfg = FlightRecorderConfig(enabled=True, dir=str(tmp_path), keep=3,
+                               debounce_s=5.0, ring=16)
+    rec = FlightRecorder(cfg, clock=lambda: clk["t"])
+    try:
+        for i in range(10):
+            clk["t"] += 6.0            # past debounce: all capture
+            assert rec.trigger("slo_burn", f"storm {i}") is not None
+        files = rec._bundle_files()
+        assert len(files) == 3, files
+        assert len(rec.bundles()) == 3
+        # newest survive: ids 8, 9, 10
+        assert [b["id"] for b in rec.bundles()] == [8, 9, 10]
+
+        suppressed = rec.suppressed
+        assert rec.trigger("slo_burn", "in-window repeat") is None
+        assert rec.suppressed == suppressed + 1
+        assert len(rec._bundle_files()) == 3
+        # a distinct kind still captures inside the other's window
+        assert rec.trigger("failover", "kill") is not None
+
+        # crossrep docs (written into this dir by the aggregator's
+        # cross_replica_postmortem) obey the same keep
+        for i in range(1, 9):
+            (tmp_path / f"crossrep-{i:04d}.json").write_text(
+                json.dumps({"kind": "cross_replica_postmortem"}))
+        clk["t"] += 6.0
+        rec.trigger("failover", "another kill")
+        cross = sorted(n for n in os.listdir(tmp_path)
+                       if n.startswith("crossrep-"))
+        assert cross == ["crossrep-0006.json", "crossrep-0007.json",
+                         "crossrep-0008.json"]
+    finally:
+        rec.close()
+
+
+# -------------------------------------------------- ds_tpu_top snapshot
+
+def _run_top(path):
+    return subprocess.run(
+        [sys.executable, TOP, "--once", "--snapshot", str(path)],
+        capture_output=True, text=True, timeout=60)
+
+
+def test_ds_tpu_top_renders_soak_scorecard(tmp_path):
+    path = tmp_path / "soak.json"
+    write_scorecard(_good_doc(), str(path))
+    out = _run_top(path)
+    assert out.returncode == 0, out.stderr
+    for name in INVARIANTS:
+        assert name in out.stdout
+    assert "kill_replica" in out.stdout      # the chaos table
+    assert "[!!]" not in out.stdout          # all invariants green
+
+
+def test_ds_tpu_top_flags_failed_invariant(tmp_path):
+    doc = _good_doc()
+    doc["token_audit"]["dropped"] = 2
+    doc["invariants"] = check_invariants(doc)
+    doc["ok"] = False
+    path = tmp_path / "bad.json"
+    write_scorecard(doc, str(path))
+    out = _run_top(path)
+    assert out.returncode == 0, out.stderr
+    assert "[!!]" in out.stdout and "dropped=2" in out.stdout
+
+
+def test_ds_tpu_top_degrades_on_pre_soak_snapshot(tmp_path):
+    """A pre-soak snapshot renders exactly as before: no soak panel, no
+    crash."""
+    snap = {"counters": {"serving/queue_depth": 1.0,
+                         "serving/ttft_ms_p50": 12.0},
+            "goodput": None}
+    path = tmp_path / "old.json"
+    path.write_text(json.dumps(snap))
+    out = _run_top(path)
+    assert out.returncode == 0, out.stderr
+    assert "soak" not in out.stdout
+    assert "queue depth" in out.stdout
+
+
+# ------------------------------------------------------------ the soak
+
+def _run_soak(tmp_path, *extra, timeout=840):
+    out = tmp_path / "soak.json"
+    tl = tmp_path / "timeline.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "soak.py"),
+         "--out", str(out), "--timeline-out", str(tl), *extra],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-4000:]
+    return json.loads(out.read_text()), json.loads(tl.read_text()), out
+
+
+def _assert_soak_outputs(doc, timeline):
+    assert doc["ok"], doc["invariants"]
+    assert all(v["ok"] for v in doc["invariants"].values())
+    assert doc["fleet"]["failovers"] >= 1     # the scheduled kill
+    assert doc["fleet"]["scale_ups"] >= 1     # the scheduled burst
+    assert doc["token_audit"]["audited"] > 0
+    assert doc["token_audit"]["dropped"] == 0
+    assert doc["token_audit"]["duplicated"] == 0
+    lanes = timeline["otherData"]["lanes"]
+    assert len(lanes) >= 4, lanes              # router + 3+ replicas
+    assert any(ev.get("ph") == "i"
+               and str(ev.get("name", "")).startswith("chaos:")
+               for ev in timeline["traceEvents"])
+
+
+def test_fast_soak_smoke(tmp_path):
+    """The tier-1 soak: a full CPU fleet (spec decode + chunked prefill
+    + radix cache + autoscale) through >= 1 replica kill and >= 1
+    autoscale cycle, every invariant passing, and the scorecard within
+    the checked-in baseline's tolerance bands."""
+    doc, timeline, out = _run_soak(tmp_path)
+    _assert_soak_outputs(doc, timeline)
+
+    baseline = os.path.join(REPO, "benchmarks", "soak_baseline.json")
+    assert os.path.exists(baseline), \
+        "benchmarks/soak_baseline.json must be checked in"
+    r = subprocess.run([sys.executable, SOAKDIFF, baseline, str(out)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    degraded = dict(doc)
+    degraded["token_audit"] = dict(doc["token_audit"], dropped=3)
+    degraded["invariants"] = check_invariants(degraded)
+    deg_p = tmp_path / "degraded.json"
+    deg_p.write_text(json.dumps(degraded))
+    r = subprocess.run([sys.executable, SOAKDIFF, baseline, str(deg_p)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1, r.stdout
+
+
+@pytest.mark.slow
+def test_full_soak(tmp_path):
+    """The minutes-long stretch of the same shape (--full)."""
+    doc, timeline, _ = _run_soak(tmp_path, "--full", timeout=1800)
+    _assert_soak_outputs(doc, timeline)
+    assert doc["load"]["duration_s"] >= 45.0
