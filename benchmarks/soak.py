@@ -6,7 +6,8 @@ autoscaling on — with a seeded trace from serving/loadgen.py (diurnal
 rate, zipf tenants, heavy-tail lengths, shared-prefix cohorts, an abuse
 spike) for a configurable wall-clock duration, injecting the scheduled
 chaos (mid-run replica kill through the failover path; an
-autoscale-forcing arrival burst). At the end it folds every subsystem's
+autoscale-forcing arrival burst; a same-version rolling weight update
+through the rollout plane). At the end it folds every subsystem's
 ledger into ONE scorecard (telemetry/scorecard.py) with hard invariants
 checked at fold time, and writes ONE merged Perfetto timeline
 (FleetAggregator lanes + soak counter tracks + chaos instant markers).
@@ -92,10 +93,16 @@ def _serving_config(args, bundle_dir):
         "loadgen": {"seed": args.seed, "duration_s": args.duration,
                     "base_rate": args.rate,
                     "prompt_len_max": 64, "output_len_max": 16},
+        # the rollout fires AFTER the burst window (0.55 + 0.15): the
+        # controller pauses autoscaling while it runs, and the burst
+        # must still force its scale-up
         "soak": {"recovery_window_s": args.recovery_window_s,
-                 "tail_s": args.tail_s},
+                 "tail_s": args.tail_s,
+                 "rollout_at_frac": 0.8},
         "fleet": {"enabled": True, "replicas": args.replicas,
                   "heartbeat_timeout_s": 60.0,
+                  "rollout": {"canary_n": 2, "step_fraction": 0.5,
+                              "sustain_s": 0.1, "drain_timeout_s": 10.0},
                   "autoscale": {"enabled": True,
                                 "min_replicas": args.replicas,
                                 "max_replicas": args.replicas + 2,
@@ -106,7 +113,7 @@ def _serving_config(args, bundle_dir):
     }
 
 
-def _drive(router, trace, soak, tracer, ledger):
+def _drive(router, trace, soak, tracer, ledger, engine=None):
     """Replay the trace against the live fleet on the wall clock,
     executing chaos on schedule and sampling burn / live replicas /
     goodput counter tracks throughout. Returns everything only the
@@ -117,6 +124,7 @@ def _drive(router, trace, soak, tracer, ledger):
     streamed = {}
     meta = {}
     burn_series = []
+    skew_series = []
     chaos_log = []
     rejected = {}
     live_replica_seconds = 0.0
@@ -148,10 +156,13 @@ def _drive(router, trace, soak, tracer, ledger):
         last_sample = now
         burn, queue = router._load_signals()
         burn_series.append((now, burn))
+        skew = router.version_skew()["skew"]
+        skew_series.append((now, skew))
         tracer.counter_track("soak/fleet",
                              {"live_replicas": float(last_live),
                               "queue_total": float(queue),
-                              "slo_burn": round(burn, 3)}, cat="soak")
+                              "slo_burn": round(burn, 3),
+                              "version_skew": float(skew)}, cat="soak")
         totals = ledger.totals()
         tracer.counter_track(
             "soak/goodput",
@@ -162,8 +173,21 @@ def _drive(router, trace, soak, tracer, ledger):
         if hbm:
             tracer.counter_track("soak/hbm", hbm, cat="soak")
 
+    last_disruption = [-1e9]
+
     def fire_chaos(now):
         while chaos and chaos[0].t_s <= now:
+            if chaos[0].kind == "rollout":
+                # no rollouts mid-incident: wall-clock stalls can
+                # compress the whole chaos schedule into one instant,
+                # so defer until the disruptive events are behind us
+                # AND the burn the shift is gated on is back under the
+                # ceiling (an operator would do exactly this)
+                burn, _ = router._load_signals()
+                if burn > 1.0 or now - last_disruption[0] < 2.0:
+                    break
+            else:
+                last_disruption[0] = now
             ev = chaos.pop(0)
             detail = dict(ev.detail)
             if ev.kind == "kill_replica":
@@ -179,6 +203,21 @@ def _drive(router, trace, soak, tracer, ledger):
                     router.kill(victim.name, reason="soak chaos kill")
                 else:
                     detail["skipped"] = "only one live replica"
+            elif ev.kind == "rollout":
+                # a same-version rolling update through the full plane:
+                # the bitwise canary verify has a ground truth
+                if engine is None:
+                    detail["skipped"] = "no base engine supplied"
+                else:
+                    try:
+                        view = engine.with_params(
+                            engine.params, engine.weights_version)
+                        ctl = router.start_rollout(view)
+                        detail["target_version"] = ctl.target_version
+                        tracer.instant(f"chaos:{ev.kind}", cat="soak",
+                                       args=detail)
+                    except Exception as e:
+                        detail["skipped"] = str(e)
             else:
                 tracer.instant(f"chaos:{ev.kind}", cat="soak",
                                args=detail)
@@ -186,6 +225,7 @@ def _drive(router, trace, soak, tracer, ledger):
                               "detail": detail})
 
     while events or chaos or \
+            (router.rollout is not None and router.rollout.active) or \
             any(not router.result(f).done for f in meta):
         now = time.perf_counter() - t0
         fire_chaos(now)
@@ -255,6 +295,7 @@ def _drive(router, trace, soak, tracer, ledger):
     return {"wall_s": wall,
             "goodput": ledger.window(goodput_before, wall),
             "token_audit": audit, "burn_series": burn_series,
+            "skew_series": skew_series,
             "chaos": chaos_log, "latency": latency,
             "live_replica_seconds": live_replica_seconds}
 
@@ -283,11 +324,13 @@ def run_soak(args):
                 SamplingParams(max_new_tokens=4))
             router.run_until_idle()
             assert router.result(fid).done
-        data = _drive(router, trace, scfg.soak, tracer, ledger)
+        data = _drive(router, trace, scfg.soak, tracer, ledger,
+                      engine=engine)
         doc = fold_scorecard(
             router, wall_s=data["wall_s"], goodput=data["goodput"],
             token_audit=data["token_audit"],
             burn_series=data["burn_series"], chaos=data["chaos"],
+            skew_series=data["skew_series"],
             expected=trace.expected(),
             live_replica_seconds=data["live_replica_seconds"],
             latency=data["latency"], trace_summary=trace.summary(),

@@ -118,6 +118,10 @@ class InferenceEngine:
             self.param_shardings = quantized_shardings(self._fp_shardings,
                                                        param_shapes)
         self._recast_fn = None
+        #: the checkpoint weights_version these params came from (0 =
+        #: unversioned: fresh init or a pre-rollout checkpoint); the
+        #: rollout plane compares it across replicas and KV handoffs
+        self.weights_version = 0
         with self.mesh:
             if params is not None:
                 self.params = self.recast(params)
@@ -234,6 +238,15 @@ class InferenceEngine:
         """Load a deepspeed_tpu training checkpoint (any source mp/dp layout
         — universal reshard-on-load) into the serving shardings. Checkpoints
         are fp; int8 serving quantizes after the reshard."""
+        from ..runtime.checkpointing import read_weights_version
+        self.params = self._load_params(load_dir, tag)
+        self.weights_version = read_weights_version(load_dir, tag=tag)
+        return load_dir
+
+    def _load_params(self, load_dir, tag=None):
+        """Checkpoint params resharded into this engine's serving layout
+        (structure-gated: a drifted leaf set raises with the per-leaf
+        diff BEFORE anything moves to device)."""
         from ..runtime.checkpointing import load_params_for_inference
         with self.mesh:
             params = load_params_for_inference(
@@ -242,8 +255,31 @@ class InferenceEngine:
             if self._quant is not None:
                 params = jax.jit(self._finalize_tree,
                                  out_shardings=self.param_shardings)(params)
-            self.params = params
-        return load_dir
+        return params
+
+    def with_params(self, params, weights_version=None):
+        """A shallow engine view sharing this engine's module, mesh,
+        planner, and compiled-program caches but serving ``params`` —
+        the rollout plane's vNext standup. Identical shapes mean the
+        shared executables serve both versions with ZERO new compiles;
+        only the params pointer (and the reported version) differ."""
+        import copy
+        view = copy.copy(self)
+        view.params = params
+        if weights_version is not None:
+            view.weights_version = int(weights_version)
+        return view
+
+    def load_version(self, load_dir, tag=None):
+        """Load a checkpoint WITHOUT mutating this engine: returns a
+        shallow view (``with_params``) serving the new weights at the
+        checkpoint's ``weights_version``. The structure gate and the
+        integrity manifest both run before the view exists, so a bad
+        checkpoint aborts here — never after traffic moved."""
+        from ..runtime.checkpointing import read_weights_version
+        params = self._load_params(load_dir, tag)
+        return self.with_params(
+            params, read_weights_version(load_dir, tag=tag))
 
     # ---------------------------------------------------------------- forward
     def forward(self, input_ids, **kwargs):

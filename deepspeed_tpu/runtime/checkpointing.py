@@ -105,6 +105,45 @@ def _read_latest(load_dir) -> Optional[str]:
     return tag or None
 
 
+def _next_weights_version(save_dir, exclude_tag=None) -> int:
+    """Monotonic ``weights_version`` for a new tag: 1 + the highest
+    version any existing sibling tag carries (1 when none do). The scan
+    excludes the tag being written so an overwritten tag does not bump
+    itself. Pre-rollout checkpoints without the field count as 0."""
+    best = 0
+    try:
+        tags = list_tags(save_dir)
+    except OSError:
+        tags = []
+    for t in tags:
+        if exclude_tag is not None and str(t) == str(exclude_tag):
+            continue
+        path = os.path.join(save_dir, str(t), "engine_state.json")
+        try:
+            with open(path) as f:
+                best = max(best,
+                           int(json.load(f).get("weights_version", 0)))
+        except (OSError, ValueError, TypeError):
+            continue
+    return best + 1
+
+
+def read_weights_version(load_dir, tag=None) -> int:
+    """The monotonic ``weights_version`` a checkpoint tag carries in its
+    ``engine_state.json`` (0 for pre-rollout checkpoints without one —
+    the rollout plane treats 0 as "unversioned"). ``tag=None`` resolves
+    ``latest``; a ``load_dir`` that IS the tag directory also works."""
+    load_dir = str(load_dir)
+    if tag is None:
+        tag = _read_latest(load_dir)
+    d = os.path.join(load_dir, str(tag)) if tag else load_dir
+    try:
+        with open(os.path.join(d, "engine_state.json")) as f:
+            return int(json.load(f).get("weights_version", 0))
+    except (OSError, ValueError, TypeError):
+        return 0
+
+
 def _gather_to_host(engine, tree):
     """Gather sharded global arrays to replicated and pull to host numpy,
     LEAF BY LEAF: replicating the whole ZeRO-sharded tree at once would
@@ -227,6 +266,11 @@ def _save_checkpoint_files(engine, ckpt_engine, _save, ckpt_dir, tag,
                              hasattr(engine.lr_scheduler, "state_dict") else None),
             "client_state": client_state or {},
             "dp_world_size": engine.dp_world_size,
+            # monotonic across the tags of this directory: what a fleet
+            # rollout deploys, verifies, and reports per replica — the
+            # integrity manifest written at commit time covers it
+            "weights_version": _next_weights_version(
+                os.path.dirname(ckpt_dir), exclude_tag=tag),
             # the per-step RNG stream root: restoring it (instead of
             # re-deriving from config seed) keeps the fold_in(micro_steps)
             # stream bit-identical across a resize-resume even when the

@@ -15,8 +15,9 @@ from .config import (ChunkedPrefillConfig, DraftConfig, KVQuantConfig,
                      TenantConfig)
 from .engine import ServingEngine
 from .fleet import (AutoscaleConfig, FleetConfig, FleetRequest,
-                    FleetRouter, KVHandoff,
-                    RadixPrefixCache, ReplicaHandle, build_fleet)
+                    FleetRouter, KVHandoff, RadixPrefixCache,
+                    ReplicaHandle, RolloutConfig, RolloutController,
+                    build_fleet)
 from .kv_slots import SlotPool
 from .loadgen import ChaosEvent, LoadEvent, SoakTrace, generate_trace
 from .metrics import FleetMetrics, ServingMetrics
@@ -33,5 +34,6 @@ __all__ = [
     "RequestState", "SamplingParams", "TenantQueues",
     "AutoscaleConfig", "FleetConfig", "FleetRouter", "FleetRequest", "KVHandoff",
     "RadixPrefixCache", "ReplicaHandle", "build_fleet",
+    "RolloutConfig", "RolloutController",
     "ChaosEvent", "LoadEvent", "SoakTrace", "generate_trace",
 ]

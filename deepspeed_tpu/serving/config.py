@@ -351,6 +351,11 @@ class SoakConfig(DeepSpeedConfigModel):
     burst_at_frac: float = 0.55
     burst_duration_frac: float = 0.15
     burst_rate_mult: float = 4.0
+    #: when to start a rolling weight update mid-soak, as a fraction of
+    #: duration_s (<0 off) — a same-version rollout through the full
+    #: plane (canary replay in shadow, SLO-gated shift, one-at-a-time
+    #: replace), so the bitwise verify has a ground truth
+    rollout_at_frac: float = -1.0
     #: invariant (c): SLO burn must fall back to <= 1.0 within this many
     #: seconds after each chaos event
     recovery_window_s: float = 20.0

@@ -198,6 +198,13 @@ class ServingEngine:
             raise ValueError(
                 f"handoff kv_len ({kv_len}) + max_new_tokens ({max_new}) "
                 f"exceeds serving.max_model_len={self.config.max_model_len}")
+        # version boundary check (rollout plane): a KV lane computed by a
+        # different weights_version must never seed this replica's decode
+        # — refuse it and re-prefill locally instead. None = pre-rollout
+        # producer, accepted for compatibility.
+        incoming = getattr(handoff, "weights_version", None)
+        refused = incoming is not None and \
+            int(incoming) != self.weights_version
         deliver_first = request is None
         if request is None:
             sampling = SamplingParams(
@@ -222,12 +229,17 @@ class ServingEngine:
                 on_token=on_token, trace=trace)
             self._next_id += self._id_stride
             request.submit_time = self.scheduler.clock()
-            self.tracer.async_begin(
-                "request", request.request_id, cat="serving",
-                args={"prompt_len": int(request.prompt.size),
-                      "max_new_tokens": request.max_new_tokens,
-                      "handoff": True, "replica": self.replica,
-                      **(trace.span_args() if trace is not None else {})})
+            if not refused:
+                self.tracer.async_begin(
+                    "request", request.request_id, cat="serving",
+                    args={"prompt_len": int(request.prompt.size),
+                          "max_new_tokens": request.max_new_tokens,
+                          "handoff": True, "replica": self.replica,
+                          **(trace.span_args() if trace is not None
+                             else {})})
+        if refused:
+            return self._refuse_handoff(handoff, request,
+                                        fresh=deliver_first)
         self.scheduler.enqueue_handoff(handoff, request)   # QueueFull here
         self._requests[request.request_id] = request
         if deliver_first:
@@ -239,6 +251,61 @@ class ServingEngine:
                     on_token(request, int(handoff.first_token))
                 except Exception:
                     pass
+        return request.request_id
+
+    def _refuse_handoff(self, handoff, request: Request,
+                        fresh: bool) -> int:
+        """Refuse a KV lane from a different ``weights_version`` and
+        re-prefill the request in THIS replica's pool instead. KV state
+        computed by one model and read by another is silent corruption,
+        and a mid-rollout fleet is exactly when producer and consumer
+        versions differ. The (seed, cache position) sampling contract
+        regenerates the SAME token stream from the local prefill, and
+        the router's delivered-position dedup keeps client delivery
+        exactly-once — the refusal costs one extra prompt pass, never
+        correctness. ``fresh`` marks the direct-API path (the Request
+        was just reconstructed here and has no open lifecycle span)."""
+        if len(self.scheduler.queue) >= self.config.max_queue:
+            # reject BEFORE mutating the request so the router can retry
+            # the untouched handoff on another decode replica
+            self.metrics.record_reject()
+            raise QueueFull(
+                f"serving queue at capacity ({self.config.max_queue}); "
+                f"handoff refusal cannot re-prefill")
+        producer = getattr(handoff, "weights_version", None)
+        ctx = getattr(request, "trace", None)
+        if ctx is not None:
+            ctx.mark("handoff_refused")
+        self.metrics.record_handoff_refused()
+        with self.tracer.span(
+                "handoff_refused", cat="serving",
+                args={"request_id": request.request_id,
+                      "producer_version": producer,
+                      "local_version": self.weights_version,
+                      "source": getattr(handoff, "source", None),
+                      "replica": self.replica,
+                      **(ctx.span_args() if ctx is not None else {})}):
+            pass
+        if not fresh:
+            # the request already lived a prefill on the producing side:
+            # close its open lifecycle span and reset to pre-admission
+            # state — enqueue() below re-opens the span for the local
+            # re-prefill, keeping the trace balanced
+            self.tracer.async_end(
+                "request", request.request_id, cat="serving",
+                args={"handoff_refused": True,
+                      "replica": self.replica})
+            request.state = RequestState.QUEUED
+            request.tokens.clear()
+            request.prefill_pos = 0
+            request.prefill_started = False
+            request.first_token_time = None
+        self.scheduler.enqueue(request)
+        self._requests[request.request_id] = request
+        log_dist(
+            f"serving: KV handoff for request {request.request_id} "
+            f"REFUSED (producer weights_version {producer} != local "
+            f"{self.weights_version}); re-prefilling locally", ranks=[0])
         return request.request_id
 
     # ------------------------------------------------------------------ step
@@ -471,12 +538,15 @@ class ServingEngine:
             "timeouts": self.metrics.timeouts,
             "tokens_out": self.metrics.tokens_out,
             "draining": self._draining,
+            "weights_version": self.weights_version,
         }
         if self.config.role != "unified":
             out["role"] = self.config.role
         if self.metrics.handoffs_in or self.metrics.handoffs_out:
             out["kv_handoffs_in"] = self.metrics.handoffs_in
             out["kv_handoffs_out"] = self.metrics.handoffs_out
+        if self.metrics.handoffs_refused:
+            out["kv_handoffs_refused"] = self.metrics.handoffs_refused
         sched = self.scheduler
         if sched.chunked is not None:
             out["chunked_prefill"] = (
@@ -520,6 +590,14 @@ class ServingEngine:
         return out
 
     # ------------------------------------------------------------- inspection
+    @property
+    def weights_version(self) -> int:
+        """The checkpoint ``weights_version`` this replica serves (0 =
+        unversioned: fresh init or a pre-rollout checkpoint). Reported
+        on /statusz and compared across replicas — and across KV handoff
+        frames — by the rollout plane."""
+        return int(getattr(self.engine, "weights_version", 0) or 0)
+
     @property
     def preempted(self) -> bool:
         """True once a preemption signal triggered the clean drain."""

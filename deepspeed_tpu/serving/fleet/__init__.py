@@ -8,14 +8,17 @@ of the slot KV pool (prefix_cache) — plus the fleet config block
 (config) and per-replica probe/backoff handles (replica).
 """
 
-from .config import AutoscaleConfig, FleetConfig
+from .config import AutoscaleConfig, FleetConfig, RolloutConfig
 from .handoff import InProcessTransport, KVHandoff
 from .prefix_cache import PrefixHit, RadixPrefixCache, reuse_plan
 from .replica import ReplicaHandle
+from .rollout import RolloutController
 from .router import FleetRequest, FleetRouter, build_fleet
 
 __all__ = [
-    "AutoscaleConfig", "FleetConfig", "KVHandoff", "InProcessTransport",
+    "AutoscaleConfig", "FleetConfig", "RolloutConfig", "KVHandoff",
+    "InProcessTransport",
     "RadixPrefixCache", "PrefixHit", "reuse_plan",
     "ReplicaHandle", "FleetRouter", "FleetRequest", "build_fleet",
+    "RolloutController",
 ]

@@ -13,7 +13,73 @@ from typing import Any, Optional
 
 from ...runtime.config_utils import ConfigError, DeepSpeedConfigModel
 
-__all__ = ["AutoscaleConfig", "FleetConfig"]
+__all__ = ["AutoscaleConfig", "FleetConfig", "RolloutConfig"]
+
+
+@dataclasses.dataclass
+class RolloutConfig(DeepSpeedConfigModel):
+    """Rolling weight updates (the fleet ``rollout`` block).
+
+    Knobs for the zero-downtime weight-swap plane
+    (serving/fleet/rollout.py, ``bin/ds_tpu_rollout``): a new
+    checkpoint version stands up as a shadow replica, must pass a
+    bitwise canary replay of recent completed requests plus health
+    gates (no recompile, no TTFT blowout), then takes traffic in
+    ``step_fraction`` increments — each step gated on the fleet SLO
+    burn rate staying at or below ``burn_ceiling`` for ``sustain_s`` —
+    before the old version drains out. Any gate breach rolls the shift
+    back automatically and fires a ``rollout_failed`` flight-recorder
+    bundle."""
+
+    #: False refuses ``start_rollout`` outright (a fleet whose operator
+    #: wants weight swaps to go through a different channel)
+    enabled: bool = True
+    #: recent completed requests replayed on the canary before it may
+    #: take traffic. Same weights_version => the replay must be bitwise
+    #: identical; a new version's outputs are recorded into the rollout
+    #: audit bundle instead
+    canary_n: int = 4
+    #: ticks the canary replay may take before the rollout aborts (a
+    #: wedged canary must not hold the fleet in shadow forever)
+    canary_timeout_ticks: int = 10_000
+    #: fraction of traffic shifted toward vNext per step (error-diffusion
+    #: admission: 0.25 => 1 of every 4 entry assignments prefers vNext
+    #: at the first step, 2 of 4 at the second, ...)
+    step_fraction: float = 0.25
+    #: seconds the fleet burn rate must hold at or below ``burn_ceiling``
+    #: before the next shift step (and before the final vPrev drain)
+    sustain_s: float = 2.0
+    #: SLO error-budget burn rate ceiling during the shift; any sample
+    #: above it triggers automatic rollback
+    burn_ceiling: float = 1.0
+    #: canary TTFT gate: the replay's worst TTFT must stay within this
+    #: multiple of the fleet's steady-state p50 (0 disables the gate —
+    #: clock-free test fleets have no meaningful TTFT)
+    ttft_band: float = 0.0
+    #: a draining vPrev replica that cannot finish its running requests
+    #: within this window is force-evicted (the failover path re-enqueues
+    #: them, exactly-once preserved). None inherits
+    #: ``autoscale.drain_timeout_s`` (or its 30s default)
+    drain_timeout_s: Any = None
+
+    def validate(self):
+        if self.canary_n < 0:
+            raise ConfigError("rollout.canary_n must be >= 0")
+        if self.canary_timeout_ticks < 1:
+            raise ConfigError("rollout.canary_timeout_ticks must be >= 1")
+        if not 0.0 < self.step_fraction <= 1.0:
+            raise ConfigError(
+                f"rollout.step_fraction must be in (0, 1], got "
+                f"{self.step_fraction}")
+        if self.sustain_s < 0:
+            raise ConfigError("rollout.sustain_s must be >= 0")
+        if self.burn_ceiling <= 0:
+            raise ConfigError("rollout.burn_ceiling must be > 0")
+        if self.ttft_band < 0:
+            raise ConfigError("rollout.ttft_band must be >= 0")
+        if self.drain_timeout_s is not None and \
+                float(self.drain_timeout_s) <= 0:
+            raise ConfigError("rollout.drain_timeout_s must be > 0")
 
 
 @dataclasses.dataclass
@@ -146,6 +212,12 @@ class FleetConfig(DeepSpeedConfigModel):
     #: launch-time constant it always was
     autoscale: Any = None
 
+    #: rollout (dict -> RolloutConfig): zero-downtime rolling weight
+    #: updates — canary verify, SLO-guarded traffic shift, automatic
+    #: rollback (docs/serving.md). None = defaults (rollouts allowed
+    #: with the stock gates)
+    rollout: Any = None
+
     def validate(self):
         if self.replicas < 1:
             raise ConfigError("fleet.replicas must be >= 1")
@@ -181,6 +253,11 @@ class FleetConfig(DeepSpeedConfigModel):
             from ..config import TenantConfig
             self.tenants = TenantConfig.from_dict(self.tenants)
             self.tenants.validate()
+        if isinstance(self.rollout, dict):
+            self.rollout = RolloutConfig.from_dict(self.rollout)
+        elif self.rollout is None:
+            self.rollout = RolloutConfig()
+        self.rollout.validate()
         if isinstance(self.autoscale, dict):
             self.autoscale = AutoscaleConfig.from_dict(self.autoscale)
         if self.autoscale is not None:

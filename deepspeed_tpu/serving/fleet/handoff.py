@@ -88,6 +88,12 @@ class KVHandoff:
     #: request's fleet-wide identity rides the frame so the decode side
     #: continues the SAME trace, not a fresh one
     trace: Optional[Dict[str, Any]] = None
+    #: the producing replica's weights_version: a decode replica whose
+    #: own version differs REFUSES the lane (re-prefills locally) — KV
+    #: from one model fed through another is silent corruption, and a
+    #: mid-rollout fleet is exactly when versions differ. ``None`` means
+    #: a pre-rollout producer (accepted for compatibility).
+    weights_version: Optional[int] = None
 
     # ------------------------------------------------------------- framing
     def to_bytes(self) -> bytes:
@@ -108,6 +114,7 @@ class KVHandoff:
             "source": self.source,
             "tenant": self.tenant,
             "trace": self.trace,
+            "weights_version": self.weights_version,
             "quantized": quantized,
             "buffers": [{"path": p, "dtype": a.dtype.str,
                          "shape": list(a.shape)} for p, a in pairs],
@@ -147,7 +154,8 @@ class KVHandoff:
             request_id=header["request_id"],
             source=header["source"],
             tenant=header.get("tenant"),
-            trace=header.get("trace"))
+            trace=header.get("trace"),
+            weights_version=header.get("weights_version"))
 
     def nbytes(self) -> int:
         """Payload bytes a transport would move (lane buffers only)."""

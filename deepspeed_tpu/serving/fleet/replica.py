@@ -137,7 +137,9 @@ class ReplicaHandle:
                     "slot_occupancy": round(
                         self.engine.active_requests /
                         self.engine.config.num_slots, 3),
-                    "slo_burn_rate": burn}
+                    "slo_burn_rate": burn,
+                    "weights_version": int(
+                        getattr(self.engine, "weights_version", 0) or 0)}
         try:
             import json
             with urllib.request.urlopen(
@@ -148,11 +150,14 @@ class ReplicaHandle:
             return {"queue_depth": srv.get("queue_depth", 0),
                     "active_requests": srv.get("active_requests", 0),
                     "slot_occupancy": srv.get("slot_occupancy", 0.0),
-                    "slo_burn_rate": srv.get("slo_burn_rate")}
+                    "slo_burn_rate": srv.get("slo_burn_rate"),
+                    "weights_version": int(
+                        srv.get("weights_version", 0) or 0)}
         except (urllib.error.URLError, OSError, ValueError) as e:
             logger.warning(f"fleet: statusz poll of {self.name} failed: {e}")
             return {"queue_depth": 0, "active_requests": 0,
-                    "slot_occupancy": 0.0, "slo_burn_rate": None}
+                    "slot_occupancy": 0.0, "slo_burn_rate": None,
+                    "weights_version": 0}
 
     def score(self) -> float:
         """Routing score — lower is better."""

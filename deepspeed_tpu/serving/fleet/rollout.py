@@ -1,0 +1,506 @@
+"""Rollout plane — zero-downtime rolling weight updates.
+
+A live fleet must be able to change WEIGHTS the way the elasticity plane
+changes SIZE: without dropping a request, without a client seeing a
+duplicated or missing token, and with an automatic path back when the
+new version is worse. The ``RolloutController`` runs that swap as a
+four-phase state machine driven from ``FleetRouter.step()``:
+
+1. **standup** — a vNext replica is spawned through ``build_fleet``'s
+   factory, wrapping a *view* of the shared InferenceEngine
+   (``engine.load_version(dir, tag)``: params loaded through the
+   structure gate with the integrity manifest verified, compiled
+   programs shared — zero new compiles by construction). The replica
+   joins the fleet in SHADOW: probed and ticked like any member, never
+   routed new traffic.
+2. **canary** — the last ``canary_n`` completed requests are replayed on
+   the shadow replica with their recorded seeds. The PR-12 determinism
+   contract (every sampled token's PRNG key derives only from
+   ``(seed, cache position)``) makes the comparison exact: a
+   same-version canary must reproduce every recorded stream
+   **bitwise**; a new version's outputs are recorded into the rollout
+   audit (embedded in flight-recorder bundles) instead. Health gates
+   ride along: the replay must finish within ``canary_timeout_ticks``,
+   the shared compile ledger must not grow (a recompile storm at swap
+   time is a rollout bug), and with ``ttft_band`` set the replay's
+   worst TTFT must stay within that multiple of the fleet's steady p50.
+3. **shift** — the canary leaves shadow and entry admission moves toward
+   vNext in ``step_fraction`` increments (error-diffusion ordering:
+   candidates are re-ORDERED, never filtered, so a full preferred group
+   falls through to the other and the shift itself can never drop a
+   request). Each step is gated on the fleet SLO burn rate holding at
+   or below ``burn_ceiling`` for ``sustain_s``. At fraction 1.0 the
+   controller enters **replace**: one vPrev replica at a time, a fresh
+   vNext member spawns first, then the vPrev drains through the SAME
+   drain path scale-down uses — running requests finish in place; past
+   the drain timeout the failover path re-enqueues them onto survivors
+   with delivery exactly-once via the delivered-position dedup.
+4. **done** — no vPrev remains; version skew across the fleet returns
+   to zero.
+
+Any gate breach (burn over ceiling, canary mismatch, canary replica
+lost, operator ``abort()``) triggers **automatic rollback**: the shift
+fraction returns to zero, every replica this rollout spawned drains out,
+and exactly ONE ``rollout_failed`` flight-recorder bundle fires with the
+canary diff and the burn timeline embedded.
+"""
+
+import time
+from typing import List, Optional
+
+from ...utils.logging import log_dist, logger
+
+__all__ = ["RolloutController", "PHASES"]
+
+#: phase -> gauge id (dstpu_rollout_phase)
+PHASES = {"idle": 0, "standup": 1, "canary": 2, "shift": 3,
+          "replace": 4, "done": 5, "rolled_back": 6}
+
+
+class _CanaryRecord:
+    """One recorded request and its replay on the canary."""
+
+    __slots__ = ("fleet_id", "prompt", "sampling", "expected", "rid",
+                 "got", "match", "ttft_ms")
+
+    def __init__(self, fleet_id, prompt, sampling, expected):
+        self.fleet_id = fleet_id
+        self.prompt = prompt
+        self.sampling = sampling
+        self.expected = list(expected)   # tokens the fleet already served
+        self.rid = None                  # request id on the canary engine
+        self.got: Optional[list] = None
+        self.match: Optional[bool] = None
+        self.ttft_ms: Optional[float] = None
+
+
+class RolloutController:
+    """One rolling weight update on a FleetRouter. Construct via
+    ``router.start_rollout(engine_view)``; advance via the router's own
+    ``step()`` loop; inspect via ``summary()``; stop via ``abort()``."""
+
+    def __init__(self, router, engine_view, config):
+        self.router = router
+        self.config = config
+        self.engine_view = engine_view
+        self.target_version = int(
+            getattr(engine_view, "weights_version", 0) or 0)
+        self.base_version = router.version_skew()["versions"]
+        self.phase = "idle"
+        self.active = False
+        self.fraction = 0.0
+        self.failure: Optional[str] = None
+        self.canary_verdict: Optional[str] = None
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        #: replica names THIS rollout spawned (canary + replacements) —
+        #: the set a rollback drains back out
+        self.spawned: List[str] = []
+        self._canary_name: Optional[str] = None
+        self._vnext: set = set()
+        self._records: List[_CanaryRecord] = []
+        self._acc = 0.0                  # error-diffusion accumulator
+        self._ticks = 0
+        self._canary_tick0 = 0
+        self._exec_before = 0
+        self._steady_ttft_p50 = 0.0
+        self._burn_ok_since: Optional[float] = None
+        self._pending_drain: Optional[str] = None
+        self._failed_fired = False
+        #: (tick, burn) samples during the shift — the rollback bundle's
+        #: burn timeline
+        self.burn_series: List[tuple] = []
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        """Stand the canary up in shadow and kick off the replay."""
+        router = self.router
+        if router.replica_factory is None:
+            raise RuntimeError(
+                "rollout needs a replica_factory (build_fleet provides "
+                "one); this router cannot stand up a vNext replica")
+        bad = [r.name for r in router.replicas.values()
+               if r.role != "unified"]
+        if bad:
+            raise RuntimeError(
+                f"rollout requires a unified fleet; {bad} have roles — "
+                f"roll a disaggregated fleet tier-by-tier instead")
+        self.active = True
+        self.phase = "standup"
+        self.started_at = time.time()
+        #: the replica count the rollout must hand back: the canary is
+        #: the FIRST vPrev member's replacement, not a net addition
+        self._target_size = max(1, len(router._live_unified()))
+        if router.recorder is not None:
+            # the audit section rides every bundle the router's recorder
+            # writes from here on — most importantly rollout_failed
+            router.recorder.add_provider("rollout", self.audit_section)
+        # snapshot the canary replay set BEFORE anything changes: the
+        # most recent completed requests, exactly as the fleet served
+        # them (prompt + full sampling law + delivered tokens)
+        from ..scheduler import RequestState
+        done = [f for f in router._fleet_requests.values()
+                if f.request is not None
+                and f.request.state is RequestState.FINISHED
+                and f.tokens]
+        done.sort(key=lambda f: f.fleet_id)
+        for f in done[-max(0, int(self.config.canary_n)):]:
+            self._records.append(_CanaryRecord(
+                f.fleet_id, f.prompt, f.sampling, f.tokens))
+        self._steady_ttft_p50 = self._fleet_ttft_p50()
+        canary = router.replica_factory(engine_override=self.engine_view)
+        router.replicas[canary.name] = canary
+        router._shadow.add(canary.name)
+        self.spawned.append(canary.name)
+        self._canary_name = canary.name
+        canary.probe(router.clock())
+        self._exec_before = self._decode_executables(canary)
+        with router.tracer.span(
+                "rollout_standup", cat="fleet",
+                args={"canary": canary.name,
+                      "target_version": self.target_version,
+                      "canary_n": len(self._records)}):
+            pass
+        log_dist(
+            f"fleet: ROLLOUT to weights_version {self.target_version} — "
+            f"canary {canary.name} in shadow, replaying "
+            f"{len(self._records)} recent request(s)", ranks=[0])
+        # submit the replays straight onto the canary engine (it is in
+        # shadow — the router will not route anything else to it)
+        eng = canary.engine
+        for rec in self._records:
+            rec.rid = eng.submit(rec.prompt, rec.sampling)
+        self.phase = "canary"
+        self._canary_tick0 = self._ticks
+
+    def abort(self, reason: str = "operator abort"):
+        """Roll back NOW (ds_tpu_rollout --abort, tests, ops)."""
+        if self.active:
+            self._fail(reason)
+
+    # ----------------------------------------------------------------- tick
+    def tick(self, now: float):
+        """One rollout step, driven from FleetRouter.step()."""
+        if not self.active:
+            return
+        self._ticks += 1
+        router = self.router
+        canary = router.replicas.get(self._canary_name) \
+            if self._canary_name else None
+        if self.phase == "canary":
+            if canary is None or canary.failed:
+                self._fail("canary replica lost during verify")
+                return
+            self._tick_canary(canary)
+            return
+        # shift/replace phases: every tick samples the burn gate
+        burn = router._fleet_burn()
+        self.burn_series.append((self._ticks, round(float(burn), 4)))
+        if len(self.burn_series) > 512:
+            del self.burn_series[:-512]
+        if burn > float(self.config.burn_ceiling):
+            self._fail(
+                f"slo burn rate {burn:.2f} breached ceiling "
+                f"{self.config.burn_ceiling:g} at shift fraction "
+                f"{self.fraction:g}")
+            return
+        if self._vnext and not any(
+                name in router.replicas
+                and not router.replicas[name].failed
+                for name in self._vnext):
+            self._fail("every vNext replica was lost mid-shift")
+            return
+        if self._burn_ok_since is None:
+            self._burn_ok_since = now
+            return
+        if now - self._burn_ok_since < float(self.config.sustain_s):
+            return
+        # one sustained-burn window buys one action
+        self._burn_ok_since = None
+        if self.phase == "shift":
+            if self.fraction < 1.0:
+                self.fraction = min(
+                    1.0, self.fraction + float(self.config.step_fraction))
+                with router.tracer.span(
+                        "rollout_shift", cat="fleet",
+                        args={"fraction": self.fraction}):
+                    pass
+                log_dist(f"fleet: rollout shift -> "
+                         f"{self.fraction:.0%} vNext", ranks=[0])
+            else:
+                self.phase = "replace"
+        if self.phase == "replace":
+            self._tick_replace(now)
+
+    # --------------------------------------------------------------- canary
+    def _tick_canary(self, canary):
+        eng = canary.engine
+        if self._ticks - self._canary_tick0 > \
+                int(self.config.canary_timeout_ticks):
+            self._fail(
+                f"canary replay did not finish within "
+                f"{self.config.canary_timeout_ticks} ticks")
+            return
+        execs = self._decode_executables(canary)
+        if execs > self._exec_before > 0:
+            self._fail(
+                f"recompile during canary verify ({self._exec_before} -> "
+                f"{execs} decode executables) — the vNext view must share "
+                f"the fleet's compiled programs")
+            return
+        from ..scheduler import RequestState
+        pending = 0
+        for rec in self._records:
+            req = eng.result(rec.rid)
+            if req.state in (RequestState.QUEUED, RequestState.PREFILLING,
+                             RequestState.RUNNING):
+                pending += 1
+        if pending:
+            return
+        # replay complete: verdict time
+        base_versions = set(self.base_version.values()) or {0}
+        same_version = base_versions == {self.target_version}
+        diffs = []
+        worst_ttft = 0.0
+        for rec in self._records:
+            req = eng.result(rec.rid)
+            if req.state is not RequestState.FINISHED:
+                diffs.append(f"fleet_id {rec.fleet_id}: replay ended "
+                             f"{req.state.value}, not finished")
+                rec.match = False
+                continue
+            rec.got = list(req.tokens)
+            if req.first_token_time is not None and req.submit_time:
+                rec.ttft_ms = (req.first_token_time - req.submit_time) \
+                    * 1e3
+                worst_ttft = max(worst_ttft, rec.ttft_ms)
+            if same_version:
+                rec.match = rec.got == rec.expected
+                if not rec.match:
+                    diffs.append(
+                        f"fleet_id {rec.fleet_id}: tokens diverge at "
+                        f"position {self._first_diff(rec.expected, rec.got)}"
+                        f" (expected {rec.expected[:8]}..., "
+                        f"got {rec.got[:8]}...)")
+            else:
+                rec.match = None          # recorded, not asserted
+        band = float(self.config.ttft_band)
+        if band > 0 and self._steady_ttft_p50 > 0 and \
+                worst_ttft > band * self._steady_ttft_p50:
+            diffs.append(
+                f"canary TTFT {worst_ttft:.1f}ms over {band:g}x steady "
+                f"p50 ({self._steady_ttft_p50:.1f}ms)")
+        if diffs:
+            self.canary_verdict = "failed"
+            self.router.metrics.canary_failures += 1
+            self._fail("canary verify failed: " + "; ".join(diffs[:4]))
+            return
+        self.canary_verdict = ("bitwise_identical" if same_version
+                               else "recorded")
+        if self._records:
+            log_dist(
+                f"fleet: canary verify PASSED "
+                f"({len(self._records)} replay(s), "
+                f"{self.canary_verdict})", ranks=[0])
+        # promotion: the canary leaves shadow and the shift begins
+        self.router._shadow.discard(self._canary_name)
+        self._vnext.add(self._canary_name)
+        self.phase = "shift"
+        self.fraction = 0.0
+        self._burn_ok_since = None
+
+    @staticmethod
+    def _first_diff(a, b) -> int:
+        for i, (x, y) in enumerate(zip(a, b)):
+            if x != y:
+                return i
+        return min(len(a), len(b))
+
+    # -------------------------------------------------------------- replace
+    def _tick_replace(self, now: float):
+        router = self.router
+        if self._pending_drain is not None:
+            if self._pending_drain in router._draining:
+                return                     # still draining: wait it out
+            self._pending_drain = None
+        prev = [r for r in router._live_unified()
+                if r.name not in self._vnext]
+        if not prev:
+            self._complete()
+            return
+        # spawn a replacement FIRST (capacity never dips below the
+        # fleet's size while a vPrev member drains out) — unless vNext
+        # already covers the original size: the canary was the first
+        # vPrev member's replacement, not a net addition
+        live_next = sum(
+            1 for n in self._vnext
+            if n in router.replicas and not router.replicas[n].failed
+            and n not in router._draining)
+        up = None
+        if live_next + (len(prev) - 1) < self._target_size:
+            try:
+                up = router.replica_factory(
+                    engine_override=self.engine_view)
+            except Exception as e:
+                self._fail(f"replacement replica spawn failed: {e}")
+                return
+            router.replicas[up.name] = up
+            up.probe(router.clock())
+            self.spawned.append(up.name)
+            self._vnext.add(up.name)
+        victim = sorted(prev, key=lambda r: r.score())[0].name
+        router.begin_drain(victim,
+                           timeout_s=self._drain_timeout())
+        self._pending_drain = victim
+        with router.tracer.span(
+                "rollout_replace", cat="fleet",
+                args={"up": up.name if up is not None else None,
+                      "draining": victim}):
+            pass
+        log_dist(f"fleet: rollout replace — "
+                 f"{(up.name + ' up, ') if up is not None else ''}"
+                 f"draining {victim} "
+                 f"(target v{self.target_version})", ranks=[0])
+
+    def _complete(self):
+        router = self.router
+        self.active = False
+        self.phase = "done"
+        self.fraction = 1.0
+        self.finished_at = time.time()
+        router.metrics.rollouts += 1
+        with router.tracer.span(
+                "rollout_done", cat="fleet",
+                args={"target_version": self.target_version,
+                      "replicas": len(self._vnext)}):
+            pass
+        log_dist(
+            f"fleet: ROLLOUT COMPLETE — {len(self._vnext)} replica(s) "
+            f"serving weights_version {self.target_version}, version "
+            f"skew {router.version_skew()['skew']}", ranks=[0])
+
+    # ------------------------------------------------------------- rollback
+    def _fail(self, reason: str):
+        """Automatic rollback: shift traffic back, drain everything this
+        rollout spawned, fire exactly one ``rollout_failed`` bundle."""
+        router = self.router
+        self.failure = reason
+        self.active = False
+        self.phase = "rolled_back"
+        self.fraction = 0.0
+        self.finished_at = time.time()
+        router.metrics.rollbacks += 1
+        for name in self.spawned:
+            r = router.replicas.get(name)
+            if r is None or r.failed:
+                continue
+            router.begin_drain(name, timeout_s=self._drain_timeout())
+        with router.tracer.span("rollout_rollback", cat="fleet",
+                                args={"reason": reason}):
+            pass
+        if router.recorder is not None and not self._failed_fired:
+            self._failed_fired = True
+            router.recorder.trigger(
+                "rollout_failed",
+                f"rollout to weights_version {self.target_version} "
+                f"rolled back: {reason}", force=True)
+        logger.warning(f"fleet: ROLLOUT ROLLED BACK — {reason}")
+
+    # -------------------------------------------------------------- routing
+    def order_candidates(self, cands):
+        """Re-ORDER entry candidates per the live shift fraction: error
+        diffusion accumulates ``fraction`` per assignment and prefers the
+        vNext group once it crosses 1. Never filters — a full preferred
+        group falls through to the other, so the shift cannot drop or
+        delay a request beyond normal backpressure."""
+        if not self.active or self.phase not in ("shift", "replace") \
+                or not self._vnext:
+            return cands
+        nxt = [r for r in cands if r.name in self._vnext]
+        prev = [r for r in cands if r.name not in self._vnext]
+        if not nxt or not prev:
+            return cands
+        self._acc += self.fraction
+        if self._acc >= 1.0:
+            self._acc -= 1.0
+            return nxt + prev
+        return prev + nxt
+
+    # ------------------------------------------------------------- plumbing
+    def _drain_timeout(self):
+        t = getattr(self.config, "drain_timeout_s", None)
+        return None if t is None else float(t)
+
+    @staticmethod
+    def _decode_executables(replica) -> int:
+        try:
+            return int(replica.engine.decode_executables())
+        except Exception:
+            return 0
+
+    def _fleet_ttft_p50(self) -> float:
+        """Steady-state fleet TTFT p50 (worst live replica's) at rollout
+        start — the canary TTFT gate's baseline."""
+        worst = 0.0
+        for r in self.router.replicas.values():
+            if r.failed or r.engine is None:
+                continue
+            try:
+                p = r.engine.metrics.percentiles()["ttft_ms"]
+                if p["n"]:
+                    worst = max(worst, float(p["p50"]))
+            except Exception:
+                continue
+        return worst
+
+    # ------------------------------------------------------------ reporting
+    def gauge_row(self) -> dict:
+        return {"active": int(self.active),
+                "phase": PHASES.get(self.phase, 0),
+                "fraction": round(float(self.fraction), 4),
+                "target_version": self.target_version}
+
+    def canary_table(self) -> list:
+        out = []
+        for rec in self._records:
+            out.append({
+                "fleet_id": rec.fleet_id,
+                "tokens": len(rec.expected),
+                "match": rec.match,
+                "ttft_ms": None if rec.ttft_ms is None
+                else round(rec.ttft_ms, 2)})
+        return out
+
+    def summary(self) -> dict:
+        """The /statusz ``rollout`` section (ds_tpu_top panel)."""
+        out = {
+            "phase": self.phase,
+            "active": self.active,
+            "target_version": self.target_version,
+            "shift_fraction": round(float(self.fraction), 4),
+            "canary": self._canary_name,
+            "canary_n": len(self._records),
+            "canary_verdict": self.canary_verdict,
+            "vnext_replicas": sorted(self._vnext),
+            "version_skew": self.router.version_skew()["skew"],
+            "rollouts": self.router.metrics.rollouts,
+            "rollbacks": self.router.metrics.rollbacks,
+        }
+        if self.failure:
+            out["failure"] = self.failure
+        return out
+
+    def audit_section(self) -> dict:
+        """Flight-recorder bundle section: the canary diff and the burn
+        timeline a postmortem needs to explain a rollback."""
+        return {
+            "phase": self.phase,
+            "target_version": self.target_version,
+            "base_versions": dict(self.base_version),
+            "shift_fraction": round(float(self.fraction), 4),
+            "canary_verdict": self.canary_verdict,
+            "canary": self.canary_table(),
+            "burn_timeline": list(self.burn_series[-64:]),
+            "spawned": list(self.spawned),
+            "failure": self.failure,
+        }

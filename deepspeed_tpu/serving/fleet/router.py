@@ -167,10 +167,21 @@ class FleetRouter:
         #: weights + serving JSON; a router without a factory can still
         #: scale DOWN (give capacity back) but never up
         self.replica_factory = replica_factory
-        #: replica name -> drain start time: scale-down keeps a replica
-        #: ticking until its running requests finish, routing nothing
-        #: new to it
+        #: replica name -> drain start time: scale-down and rollout share
+        #: this ONE drain path — a draining replica keeps ticking until
+        #: its running requests finish, routing nothing new to it
         self._draining: Dict[str, float] = {}
+        #: per-drain force-evict timeout (begin_drain resolves it at
+        #: drain start: rollout drains may carry their own window)
+        self._drain_timeout_of: Dict[str, float] = {}
+        #: replica names standing in SHADOW — a rollout canary under
+        #: verify: probed and ticked like any member, never routed new
+        #: traffic until the controller promotes it
+        self._shadow: set = set()
+        #: the live RolloutController (serving/fleet/rollout.py); stays
+        #: attached after a rollout resolves so gauges/statusz keep the
+        #: last verdict visible until the next rollout replaces it
+        self.rollout = None
         self._as_high_since: Optional[float] = None
         self._as_low_since: Optional[float] = None
         self._as_last_action: float = float("-inf")
@@ -214,6 +225,7 @@ class FleetRouter:
             self.statusz.register("fleet", self._statusz_section)
             self.statusz.register("tenants", self._tenant_section)
             self.statusz.register("autoscale", self.autoscale_summary)
+            self.statusz.register("rollout", self.rollout_summary)
             self.statusz.register_health("fleet", self._health_check)
             if self.aggregator is not None:
                 self.statusz.register("critical_path",
@@ -241,12 +253,14 @@ class FleetRouter:
         """Where NEW requests go: prefill replicas when disaggregated,
         else unified."""
         pre = [r for r in self.replicas.values()
-               if r.role == "prefill" and not r.failed]
+               if r.role == "prefill" and not r.failed
+               and r.name not in self._shadow]
         if pre:
             return pre
         return [r for r in self.replicas.values()
                 if r.role == "unified" and not r.failed
-                and r.name not in self._draining]
+                and r.name not in self._draining
+                and r.name not in self._shadow]
 
     def _decode_replicas(self) -> List[ReplicaHandle]:
         return [r for r in self.replicas.values()
@@ -302,7 +316,14 @@ class FleetRouter:
         return freq.fleet_id
 
     def _try_assign(self, freq: FleetRequest) -> bool:
-        for r in self._pick(self._entry_replicas()):
+        cands = self._pick(self._entry_replicas())
+        if self.rollout is not None:
+            # mid-shift the controller reorders candidates (error
+            # diffusion over step_fraction) — never filters them, so a
+            # full preferred group falls through to the other and no
+            # request is ever dropped by the shift itself
+            cands = self.rollout.order_candidates(cands)
+        for r in cands:
             try:
                 rid = r.engine.submit(freq.prompt, freq.sampling,
                                       on_token=freq._adapter,
@@ -370,6 +391,10 @@ class FleetRouter:
         for r in self.replicas.values():
             r.probe(now)
         self._detect_failures(now)
+        # gate blown drain timeouts the same tick they are detectable —
+        # BEFORE routing and replica ticks — so a wedged drain's requests
+        # fail over now, not one sweep later
+        self._finalize_drains(now)
         self._retry_pending()
         in_flight = 0
         for r in list(self.replicas.values()):
@@ -377,6 +402,8 @@ class FleetRouter:
                 continue
             in_flight += r.engine.step()
         self._finalize_drains(now)
+        if self.rollout is not None:
+            self.rollout.tick(now)
         self._autoscale_tick(now)
         self._harvest_completions()
         self._refresh_gauges()
@@ -435,6 +462,8 @@ class FleetRouter:
         replica.failed = True
         replica.ready = False
         self._draining.pop(replica.name, None)
+        self._drain_timeout_of.pop(replica.name, None)
+        self._shadow.discard(replica.name)
         victims = [f for f in self._fleet_requests.values()
                    if f.replica == replica.name and not f.done]
         trace_ids = []
@@ -478,7 +507,8 @@ class FleetRouter:
         not already draining."""
         return [r for r in self.replicas.values()
                 if r.role == "unified" and not r.failed
-                and r.name not in self._draining]
+                and r.name not in self._draining
+                and r.name not in self._shadow]
 
     def _load_signals(self) -> tuple:
         """(fleet burn, total queue depth) in one sweep. Burn is the
@@ -519,6 +549,12 @@ class FleetRouter:
         must not."""
         ac = getattr(self.config, "autoscale", None)
         if ac is None or not ac.enabled or self._shutdown:
+            return
+        if self.rollout is not None and self.rollout.active:
+            # a rollout owns the replica set while it runs: scaling
+            # mid-shift would fight the traffic shift (and a scale-down
+            # could drain the very replica the canary is verifying)
+            self._as_high_since = self._as_low_since = None
             return
         burn, queue = self._load_signals()
         live = len(self._live_unified())
@@ -593,42 +629,71 @@ class FleetRouter:
             name = cands[0].name
         elif name not in self.replicas or name in self._draining:
             return None
-        self._draining[name] = self.clock()
+        self.begin_drain(name)
         self._note_scale("down", name, reason)
         log_dist(f"fleet: SCALE-DOWN draining {name} ({reason}); "
                  f"{len(self._live_unified())} live replica(s) remain",
                  ranks=[0])
         return name
 
-    def _finalize_drains(self, now: float):
-        """Remove draining replicas whose work finished; force-evict
-        ones that blew ``drain_timeout_s`` (the failover path re-enqueues
-        their requests onto survivors — delivery stays exactly-once via
-        the delivered-position dedup)."""
-        if not self._draining:
-            return
+    def begin_drain(self, name: str, timeout_s=None) -> bool:
+        """The ONE drain entry scale-down AND rollout share: new traffic
+        stops routing to ``name`` immediately; ``_finalize_drains``
+        completes the removal once its running requests finish, or
+        force-evicts it past the drain timeout (the failover path
+        re-enqueues its requests onto survivors — delivery stays
+        exactly-once via the delivered-position dedup)."""
+        if name not in self.replicas or name in self._draining:
+            return False
         ac = getattr(self.config, "autoscale", None)
-        timeout = getattr(ac, "drain_timeout_s", 30.0) if ac else 30.0
-        for name, since in list(self._draining.items()):
-            r = self.replicas.get(name)
-            if r is None or r.failed:
-                self._draining.pop(name, None)
-                continue
-            busy = self._in_flight_on(name) or (
-                r.engine is not None and
-                (r.engine.active_requests or r.engine.queue_depth))
-            if not busy:
-                self._draining.pop(name, None)
-                del self.replicas[name]
-                if r.engine is not None:
-                    r.engine.shutdown()
-                log_dist(f"fleet: scale-down of {name} complete", ranks=[0])
-            elif now - since > timeout:
-                self._draining.pop(name, None)
-                self._evict(r, f"drain timeout after {timeout:g}s")
-                del self.replicas[name]
-                if r.engine is not None:
-                    self._dispose_failed(r.engine)
+        default = getattr(ac, "drain_timeout_s", 30.0) if ac else 30.0
+        self._draining[name] = self.clock()
+        self._drain_timeout_of[name] = float(
+            timeout_s if timeout_s is not None else default)
+        self._shadow.discard(name)
+        return True
+
+    def _finalize_drains(self, now: float):
+        """Resolve every draining replica that can be resolved NOW:
+        finished ones are removed cleanly, ones past their drain timeout
+        are force-evicted in this same sweep."""
+        for name in list(self._draining):
+            self._finalize_drain_one(name, now)
+
+    def _finalize_drain_one(self, name: str, now: float) -> bool:
+        """Finish or force-evict ONE draining replica. Returns True when
+        the drain resolved (clean completion, force-evict, or a stale
+        entry); False while the replica is still legitimately busy
+        inside its timeout window."""
+        since = self._draining.get(name)
+        if since is None:
+            return True
+        timeout = self._drain_timeout_of.get(name, 30.0)
+        r = self.replicas.get(name)
+        if r is None or r.failed:
+            self._draining.pop(name, None)
+            self._drain_timeout_of.pop(name, None)
+            return True
+        busy = self._in_flight_on(name) or (
+            r.engine is not None and
+            (r.engine.active_requests or r.engine.queue_depth))
+        if not busy:
+            self._draining.pop(name, None)
+            self._drain_timeout_of.pop(name, None)
+            del self.replicas[name]
+            if r.engine is not None:
+                r.engine.shutdown()
+            log_dist(f"fleet: drain of {name} complete", ranks=[0])
+            return True
+        if now - since > timeout:
+            self._draining.pop(name, None)
+            self._drain_timeout_of.pop(name, None)
+            self._evict(r, f"drain timeout after {timeout:g}s")
+            del self.replicas[name]
+            if r.engine is not None:
+                self._dispose_failed(r.engine)
+            return True
+        return False
 
     def _note_scale(self, kind: str, name: str, reason: str):
         if kind == "up":
@@ -670,6 +735,52 @@ class FleetRouter:
             last["age_s"] = round(max(0.0, time.time() - last["time"]), 1)
             out["last_scale"] = last
         return out
+
+    # -------------------------------------------------------------- rollout
+    def start_rollout(self, engine_view, config=None):
+        """Begin a zero-downtime rolling weight update to ``engine_view``
+        (an InferenceEngine — typically ``engine.load_version(dir, tag)``,
+        a shallow view sharing compiled programs but serving the new
+        checkpoint's params). Returns the live RolloutController; the
+        rollout advances inside ``step()`` — canary verify in shadow,
+        SLO-guarded traffic shift, vPrev drain — and rolls back
+        automatically on any gate breach."""
+        from .config import RolloutConfig
+        from .rollout import RolloutController
+        if self._shutdown:
+            raise RuntimeError("FleetRouter is shut down")
+        ro = config if config is not None else \
+            (getattr(self.config, "rollout", None) or RolloutConfig())
+        if not getattr(ro, "enabled", True):
+            raise RuntimeError(
+                "fleet.rollout.enabled is False; rollout refused")
+        if self.rollout is not None and self.rollout.active:
+            raise RuntimeError("a rollout is already in progress")
+        ctl = RolloutController(self, engine_view, ro)
+        self.rollout = ctl
+        ctl.start()
+        return ctl
+
+    def version_skew(self) -> dict:
+        """Live replicas' weights_version spread. ``skew`` is the number
+        of distinct versions beyond one — 0 means the whole fleet serves
+        the same weights (the steady state every rollout must return
+        to). A shadow canary counts: it IS skew until promoted or
+        drained."""
+        versions = {}
+        for name, r in self.replicas.items():
+            if r.failed or r.engine is None:
+                continue
+            versions[name] = int(
+                getattr(r.engine, "weights_version", 0) or 0)
+        distinct = len(set(versions.values())) if versions else 0
+        return {"versions": versions, "skew": max(0, distinct - 1)}
+
+    def rollout_summary(self) -> dict:
+        """The /statusz ``rollout`` section (and ds_tpu_top panel)."""
+        if self.rollout is None:
+            return {}
+        return self.rollout.summary()
 
     # -------------------------------------------------------------- results
     def result(self, fleet_id: int) -> FleetRequest:
@@ -763,6 +874,10 @@ class FleetRouter:
                 draining=len(self._draining),
                 min_replicas=ac.min_replicas,
                 max_replicas=ac.max_replicas)
+        if self.rollout is not None:
+            self.metrics.update_rollout(
+                skew=self.version_skew()["skew"],
+                **self.rollout.gauge_row())
 
     def tenant_summary(self) -> dict:
         """Fleet-wide per-tenant view: each live replica's tenant SLO
@@ -876,15 +991,15 @@ def build_fleet(engine, serving_config, clock=time.monotonic,
         router_rec = FlightRecorderConfig.from_dict(rec_cfg.to_dict())
         router_rec.dir = os.path.join(str(rec_cfg.dir), "router")
         recorder = FlightRecorder(router_rec)
-    autoscaling = getattr(fleet_cfg.autoscale, "enabled", False)
-    # id_stride spaces request-id streams so they stay fleet-unique. A
-    # fixed fleet strides by its size; an autoscaling fleet strides by a
-    # lifetime replica bound (replicas come and go — a new replica
-    # reusing a dead one's id lane would collide with requests the dead
-    # one minted)
-    stride = 1024 if autoscaling else n
+    # id_stride spaces request-id streams so they stay fleet-unique over
+    # the fleet's LIFETIME replica bound, not its launch size — replicas
+    # come and go (autoscale spawns, rollout stands up vNext members),
+    # and a new replica reusing a dead one's id lane would collide with
+    # requests the dead one minted
+    stride = 1024
 
-    def _make_replica(i: int, role: str) -> ReplicaHandle:
+    def _make_replica(i: int, role: str,
+                      engine_override=None) -> ReplicaHandle:
         cfg = ServingConfig.from_dict(serving_config.to_dict())
         cfg.role = role
         if getattr(cfg.statusz, "enabled", False):
@@ -892,7 +1007,9 @@ def build_fleet(engine, serving_config, clock=time.monotonic,
         if getattr(cfg.flight_recorder, "enabled", False):
             cfg.flight_recorder.dir = os.path.join(
                 str(rec_cfg.dir), f"r{i}")
-        srv = ServingEngine(engine, cfg, clock=clock, seed=seed + i,
+        srv = ServingEngine(engine_override if engine_override is not None
+                            else engine,
+                            cfg, clock=clock, seed=seed + i,
                             id_start=i, id_stride=stride,
                             replica_name=f"r{i}")
         return ReplicaHandle(
@@ -900,18 +1017,16 @@ def build_fleet(engine, serving_config, clock=time.monotonic,
 
     for i, role in enumerate(roles):
         replicas.append(_make_replica(i, role))
-    factory = None
-    if autoscaling:
-        serial = [n]
+    serial = [n]
 
-        def factory():
-            i = serial[0]
-            serial[0] += 1
-            if i >= stride:
-                raise RuntimeError(
-                    f"fleet exhausted its lifetime replica-id space "
-                    f"({stride}); restart the router")
-            return _make_replica(i, "unified")
+    def factory(engine_override=None):
+        i = serial[0]
+        serial[0] += 1
+        if i >= stride:
+            raise RuntimeError(
+                f"fleet exhausted its lifetime replica-id space "
+                f"({stride}); restart the router")
+        return _make_replica(i, "unified", engine_override=engine_override)
 
     router = FleetRouter(replicas, fleet_cfg, clock=clock,
                          recorder=recorder, replica_factory=factory)

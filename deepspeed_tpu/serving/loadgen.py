@@ -10,7 +10,8 @@ the door — the router rate limiter's reason to exist). This module
 turns a ``LoadgenConfig`` into a **trace**: a fully materialised,
 seeded schedule of ``LoadEvent``s plus the ``SoakConfig``'s scheduled
 ``ChaosEvent``s (mid-run replica kill through the failover path, an
-autoscale-forcing arrival burst).
+autoscale-forcing arrival burst, a mid-soak rolling weight update
+through the rollout plane).
 
 The trace is data, not behaviour: ``benchmarks/soak.py`` replays it
 against a live in-process fleet, and ``telemetry/scorecard.py`` checks
@@ -50,9 +51,11 @@ class ChaosEvent:
     PR-8 failover path (victims requeue, streams dedup on delivered
     position); ``burst`` marks the window whose extra arrivals (already
     in the event list, kind="burst") are meant to force the autoscaler
-    up."""
+    up; ``rollout`` starts a same-version rolling weight update through
+    the full rollout plane (bitwise canary verify, SLO-gated shift,
+    one-at-a-time replace) while the trace keeps arriving."""
     t_s: float
-    kind: str                       # kill_replica | burst
+    kind: str                       # kill_replica | burst | rollout
     detail: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
@@ -121,9 +124,11 @@ class SoakTrace:
         autoscaling is on."""
         kills = sum(1 for c in self.chaos if c.kind == "kill_replica")
         bursts = sum(1 for c in self.chaos if c.kind == "burst")
+        rollouts = sum(1 for c in self.chaos if c.kind == "rollout")
         return {"kills": kills, "bursts": bursts,
                 "failovers_min": kills,
                 "scale_ups_min": min(1, bursts),
+                "rollouts": rollouts,
                 "abuse_spikes": int(self.loadgen.abuse_spikes)}
 
 
@@ -175,6 +180,12 @@ def generate_trace(loadgen: LoadgenConfig,
                 t_s=t0, kind="burst",
                 detail={"duration_s": round(dur, 3),
                         "rate_mult": soak.burst_rate_mult}))
+        if getattr(soak, "rollout_at_frac", -1.0) >= 0:
+            chaos.append(ChaosEvent(
+                t_s=soak.rollout_at_frac * horizon,
+                kind="rollout",
+                detail={"via": "router.start_rollout",
+                        "mode": "same_version"}))
 
     # steady arrivals: inhomogeneous Poisson by thinning against the
     # diurnal peak rate

@@ -100,6 +100,8 @@ class ServingMetrics:
         self.ticks = 0
         self.handoffs_in = 0      # KV lanes received into this pool
         self.handoffs_out = 0     # KV lanes extracted and handed off
+        self.handoffs_refused = 0  # lanes rejected at a weights_version
+                                   # boundary (re-prefilled locally)
         # speculative decode (serving/scheduler.py _decode_speculative):
         # acceptance EMA + tokens/tick EMA + draft/verify wall split —
         # the dstpu_spec_* gauge family
@@ -275,6 +277,10 @@ class ServingMetrics:
     def record_handoff_out(self):
         self.handoffs_out += 1
         self._emit("serving/kv_handoffs_out", self.handoffs_out)
+
+    def record_handoff_refused(self):
+        self.handoffs_refused += 1
+        self._emit("serving/kv_handoffs_refused", self.handoffs_refused)
 
     def record_prefix_cache(self, cache):
         """Mirror the radix cache's counters into gauges (throttled to
@@ -482,6 +488,12 @@ class FleetMetrics:
         #: elasticity gauge space the training coordinator also writes
         self.scale_ups = 0
         self.scale_downs = 0
+        #: rollout plane (serving/fleet/rollout.py) — the dedicated
+        #: ``dstpu_rollout_*`` family: completed rollouts, automatic
+        #: rollbacks, canary failures
+        self.rollouts = 0
+        self.rollbacks = 0
+        self.canary_failures = 0
         #: per-tenant 429s (token-bucket rejections at the router) —
         #: the "who is being shed" half of the tenant table
         self.tenant_throttled: Dict[str, int] = {}
@@ -523,6 +535,23 @@ class FleetMetrics:
                          ("elastic/max_replicas", max_replicas),
                          ("elastic/scale_ups", self.scale_ups),
                          ("elastic/scale_downs", self.scale_downs)):
+            self.tracer.set_counter(tag, float(val), owner=self)
+
+    def update_rollout(self, *, active: int, phase: int, fraction: float,
+                       target_version: int, skew: int):
+        """The ``dstpu_rollout_*`` gauges: where the shift stands
+        (``fraction`` of entry traffic preferring vNext), what version
+        it is moving to, and the live version skew — the series the
+        soak scorecard's rollout invariant folds (skew must return to 0
+        within the recovery window)."""
+        for tag, val in (("rollout/active", active),
+                         ("rollout/phase", phase),
+                         ("rollout/shift_fraction", fraction),
+                         ("rollout/target_version", target_version),
+                         ("rollout/version_skew", skew),
+                         ("rollout/rollouts", self.rollouts),
+                         ("rollout/rollbacks", self.rollbacks),
+                         ("rollout/canary_failures", self.canary_failures)):
             self.tracer.set_counter(tag, float(val), owner=self)
 
     def close(self):

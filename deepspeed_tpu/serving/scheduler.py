@@ -833,6 +833,11 @@ class ContinuousBatchingScheduler:
                            "replica": self.replica_name,
                            **(ctx.span_args() if ctx is not None else {})}):
             lane = self.engine.slot_extract_lane(self.pool.cache, slot)
+        # the producing version rides both the trace and the frame: the
+        # decode side refuses a lane from a different model mid-rollout
+        version = int(getattr(self.engine, "weights_version", 0) or 0)
+        if ctx is not None:
+            ctx.weights_version = version
         handoff = KVHandoff(
             prompt=req.prompt, first_token=int(first),
             kv_len=int(req.prompt.size), lane=lane,
@@ -843,7 +848,8 @@ class ContinuousBatchingScheduler:
             eos_token_id=req.sampling.eos_token_id,
             request_id=req.request_id,
             tenant=req.tenant,
-            trace=ctx.to_header() if ctx is not None else None)
+            trace=ctx.to_header() if ctx is not None else None,
+            weights_version=version)
         if ctx is not None:
             ctx.mark("handoff_out")
         tr.async_end("request/decode", req.request_id, cat="serving",

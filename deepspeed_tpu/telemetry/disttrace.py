@@ -116,14 +116,16 @@ class TraceContext:
     """One request's identity and timeline across the fleet."""
 
     __slots__ = ("trace_id", "origin", "span_ids", "replays",
-                 "replay_parent", "hops", "marks", "sampling", "tenant")
+                 "replay_parent", "hops", "marks", "sampling", "tenant",
+                 "weights_version")
 
     def __init__(self, trace_id: str, origin: str,
                  span_ids: Optional[List[int]] = None, replays: int = 0,
                  replay_parent: Optional[int] = None,
                  hops: Optional[List[str]] = None,
                  sampling: Optional[Dict[str, Any]] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 weights_version: Optional[int] = None):
         self.trace_id = trace_id
         self.origin = origin
         self.span_ids = list(span_ids or [])
@@ -141,6 +143,11 @@ class TraceContext:
         #: ds_tpu_top and postmortem bundles can NAME the tenant that ate
         #: the TTFT budget instead of pointing at anonymous traffic
         self.tenant = tenant
+        #: the weights_version of the replica that last ran this request
+        #: (stamped at prefill/handoff time): a decode replica refuses a
+        #: KV handoff whose version differs from its own — mixing KV
+        #: from two models would be silent garbage, not a crash
+        self.weights_version = weights_version
 
     # ------------------------------------------------------------- minting
     @classmethod
@@ -206,7 +213,8 @@ class TraceContext:
                 "replay_parent": self.replay_parent,
                 "hops": list(self.hops),
                 "sampling": self.sampling,
-                "tenant": self.tenant}
+                "tenant": self.tenant,
+                "weights_version": self.weights_version}
 
     @classmethod
     def from_header(cls, header: Dict[str, Any]) -> "TraceContext":
@@ -217,7 +225,8 @@ class TraceContext:
                    replay_parent=header.get("replay_parent"),
                    hops=header.get("hops"),
                    sampling=header.get("sampling"),
-                   tenant=header.get("tenant"))
+                   tenant=header.get("tenant"),
+                   weights_version=header.get("weights_version"))
 
     # -------------------------------------------------------- critical path
     def total_ms(self) -> float:

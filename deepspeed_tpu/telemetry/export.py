@@ -290,6 +290,16 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
             host_lines.append(f"# TYPE {prefix}_spec_{name} gauge")
             host_lines.append(f"{prefix}_spec_{name} {fval}")
             continue
+        if tag.startswith("rollout/"):
+            # rollout plane gauges (serving/metrics.py update_rollout):
+            # dedicated dstpu_rollout_shift_fraction / _version_skew /
+            # _rollbacks series — a rollback is a paging event and
+            # nonzero steady-state skew is a stuck rollout, not a
+            # label-matched lookup
+            name = _prom(tag[len("rollout/"):])
+            host_lines.append(f"# TYPE {prefix}_rollout_{name} gauge")
+            host_lines.append(f"{prefix}_rollout_{name} {fval}")
+            continue
         lines.append(f'{prefix}_metric{{tag="{_prom(tag)}"}} {fval}')
     lines.extend(host_lines)
     for name in sorted(tenant_series):

@@ -64,8 +64,8 @@ __all__ = ["FlightRecorder", "TRIGGER_KINDS"]
 #: tuning decision stays auditable post-hoc.
 TRIGGER_KINDS = ("slow_step", "recompile", "sentinel", "slo_burn",
                  "preemption", "straggler", "failover", "overlap_drop",
-                 "acceptance_drop", "resize", "trial_best", "trial_worst",
-                 "manual")
+                 "acceptance_drop", "resize", "rollout_failed",
+                 "trial_best", "trial_worst", "manual")
 
 
 class FlightRecorder:

@@ -33,6 +33,11 @@ cross-subsystem invariants evaluated at fold time:
   every member's bundle dir holds <= keep bundles and <= keep
   cross-replica postmortems (the unbounded-growth failure this PR
   fixed), with per-kind counts recorded.
+- ``rollout_converges``         — when the trace injects a ``rollout``
+  chaos event, the rolling weight update completed (no rollback for the
+  soak's same-version rollout — its bitwise canary has a ground truth),
+  version skew returned to zero within ``recovery_window_s`` and ends
+  at zero, and the token audit stayed clean across the swap.
 
 This module is stdlib-only on purpose: ``bin/ds_tpu_soakdiff`` loads it
 by file path on machines with no jax/numpy, and ``check_invariants`` /
@@ -55,7 +60,8 @@ SCORECARD_VERSION = 1
 #: invariant names, in report order
 INVARIANTS = ("goodput_sums_to_wall", "exactly_once_streaming",
               "slo_burn_recovers", "autoscale_matches_load",
-              "critical_path_decomposes", "bundle_retention_bounded")
+              "critical_path_decomposes", "bundle_retention_bounded",
+              "rollout_converges")
 
 #: fold-time invariant tolerances (overridable per scorecard; the used
 #: values are embedded in the document so a reader sees what was checked)
@@ -81,6 +87,8 @@ DIFF_TOLERANCES: Dict[str, Tuple[str, float]] = {
     "token_audit.duplicated": ("max_abs", 0),
     "token_audit.mismatched": ("max_abs", 0),
     "token_audit.failed_requests": ("max_abs", 0),
+    "rollout.rollbacks": ("max_abs", 0),
+    "rollout.rollouts": ("abs_band", 0),
     "latency.ttft_ms_p99": ("max_ratio", 3.0),
     "latency.e2e_ms_p95": ("max_ratio", 3.0),
     "critical_path.e2e_ms_mean": ("max_ratio", 3.0),
@@ -226,6 +234,48 @@ def _inv_bundles(doc, tol) -> Tuple[bool, str]:
                   f"all within keep")
 
 
+def _inv_rollout(doc, tol) -> Tuple[bool, str]:
+    exp = doc.get("expected") or {}
+    need = int(exp.get("rollouts") or 0)
+    if need <= 0:
+        return True, "no rollout injected"
+    ro = doc.get("rollout") or {}
+    if not ro:
+        return False, "rollout injected but no rollout section folded"
+    done = int(ro.get("rollouts") or 0)
+    rollbacks = int(ro.get("rollbacks") or 0)
+    if done < need:
+        return False, (f"{done} rollout(s) completed vs >= {need} "
+                       f"injected by the trace")
+    if rollbacks:
+        return False, (f"{rollbacks} rollback(s) — the soak's "
+                       f"same-version rollout must pass its bitwise "
+                       f"canary")
+    window = tol["recovery_window_s"]
+    series = ro.get("skew_series") or []
+    final = int(series[-1][1]) if series \
+        else int(ro.get("version_skew") or 0)
+    if final != 0:
+        return False, f"final version skew {final} != 0"
+    last_bad = None
+    for t, s in series:
+        if int(s) != 0:
+            last_bad = float(t)
+    if last_bad is not None:
+        rec_at = next((float(t) for t, s in series
+                       if float(t) > last_bad and int(s) == 0), None)
+        if rec_at is None or rec_at - last_bad > window:
+            return False, (f"version skew did not return to 0 within "
+                           f"{window:g}s of its last excursion")
+    ta = doc.get("token_audit") or {}
+    bad = sum(int(ta.get(k) or 0)
+              for k in ("dropped", "duplicated", "mismatched"))
+    if bad:
+        return False, "token stream integrity violated across the swap"
+    return True, (f"{done} rollout(s), 0 rollbacks, version skew 0 "
+                  f"(canary {ro.get('canary_verdict')})")
+
+
 _CHECKS = {
     "goodput_sums_to_wall": _inv_goodput,
     "exactly_once_streaming": _inv_streaming,
@@ -233,6 +283,7 @@ _CHECKS = {
     "autoscale_matches_load": _inv_autoscale,
     "critical_path_decomposes": _inv_critical_path,
     "bundle_retention_bounded": _inv_bundles,
+    "rollout_converges": _inv_rollout,
 }
 
 
@@ -289,6 +340,7 @@ def fold_scorecard(router, *, wall_s: float,
                    latency: Optional[Dict[str, float]] = None,
                    trace_summary: Optional[Dict[str, Any]] = None,
                    tolerances: Optional[Dict[str, float]] = None,
+                   skew_series: Optional[List[List[float]]] = None,
                    ) -> Dict[str, Any]:
     """Fold one finished soak run into the scorecard document. The
     harness supplies what only it can know (wall clock, the streamed-
@@ -333,6 +385,20 @@ def fold_scorecard(router, *, wall_s: float,
         doc["latency"] = latency
     if trace_summary is not None:
         doc["load"] = trace_summary
+    ro = {"rollouts": int(getattr(m, "rollouts", 0)),
+          "rollbacks": int(getattr(m, "rollbacks", 0)),
+          "canary_failures": int(getattr(m, "canary_failures", 0))}
+    if hasattr(router, "version_skew"):
+        ro["version_skew"] = router.version_skew()["skew"]
+    ctl = getattr(router, "rollout", None)
+    if ctl is not None:
+        ro["phase"] = ctl.phase
+        ro["canary_verdict"] = ctl.canary_verdict
+        ro["target_version"] = ctl.target_version
+    if skew_series is not None:
+        ro["skew_series"] = [[round(float(t), 3), int(s)]
+                             for t, s in skew_series]
+    doc["rollout"] = ro
     agg = getattr(router, "aggregator", None)
     if agg is not None:
         doc["critical_path"] = agg.critical_path_summary()
@@ -397,6 +463,10 @@ def diff_scorecards(base: Dict[str, Any], cand: Dict[str, Any],
     for path, (mode, bound) in (tolerances or DIFF_TOLERANCES).items():
         b, c = _get(base, path), _get(cand, path)
         if c is None:
+            if b is None:            # optional section (e.g. rollout) ran
+                row(path, None, None, f"{mode} {bound:g}", True,
+                    "absent in both")
+                continue             # in neither run: nothing to compare
             row(path, b, None, f"{mode} {bound:g}", False,
                 "missing in candidate")
             continue

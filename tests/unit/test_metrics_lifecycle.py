@@ -136,6 +136,21 @@ def test_fleet_gauges_owned_and_released(tracer, tmp_path):
     assert "fleet/throttled" in counters
     assert "recorder/bundles" in counters
     _assert_all_owned(tracer, "fleet live")
+    # a live rollout registers the dstpu_rollout_* family the same way
+    # (run LAST: its replace phase drains the original replicas, which
+    # retracts their per-tenant windows)
+    from deepspeed_tpu.serving import RolloutConfig
+    ctl = router.start_rollout(
+        inf.with_params(inf.params, inf.weights_version),
+        config=RolloutConfig(canary_n=1, step_fraction=1.0, sustain_s=0.0))
+    for _ in range(2000):
+        router.step()
+        if not ctl.active and not router._draining:
+            break
+    assert ctl.phase == "done", ctl.failure
+    assert "rollout/shift_fraction" in tracer.counters()
+    assert "rollout/version_skew" in tracer.counters()
+    _assert_all_owned(tracer, "fleet live post-rollout")
     router.shutdown()
     configure_ledger(enabled=False)
     leftovers = {t for t in tracer.counters() if t not in OWNERLESS_ALLOWED}

@@ -131,7 +131,7 @@ def test_loadgen_abuse_spike_and_chaos_schedule():
     assert burst and all(b0 <= t <= b1 + 1e-6 for t in burst)
     assert trace.expected() == {"kills": 1, "bursts": 1,
                                 "failovers_min": 1, "scale_ups_min": 1,
-                                "abuse_spikes": 1}
+                                "rollouts": 0, "abuse_spikes": 1}
     summ = trace.summary()
     assert summ["requests"] == len(trace.events)
     assert sum(summ["arrivals_per_s"]) == len(trace.events)
