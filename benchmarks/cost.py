@@ -1,0 +1,204 @@
+"""Cost-plane benchmark: rigged 2-tenant attribution + radix savings.
+
+Runs a standalone ServingEngine (cost plane + prefix cache on) through
+two engineered phases and checks the chargeback answers the capacity
+loop depends on:
+
+- **Ratio phase**: tenant ``heavy`` submits 3x the requests of tenant
+  ``light``, every request the same shape (identical prompt length and
+  ``max_new_tokens``), interleaved so both tenants are co-resident.
+  Both prefill and decode work scale with request count, so the
+  engineered heavy:light token ratio is exactly ``--heavy/--light`` —
+  and the attributed chip_ms ratio must match it within 10%.
+- **Cohort phase**: tenant ``cohort`` sends one donor request followed
+  by followers sharing its prompt prefix. The donor's retired slot
+  seeds the radix cache; every follower lane-copies the shared prefix,
+  and the avoided prefill must show up as ``cache_savings_ms > 0``.
+
+Writes benchmarks/cost.json: the raw CostLedger fold, the
+``capacity_report`` (tokens per chip-second per tenant), and the two
+checks. Exits non-zero when a check fails (``--no-assert`` to record
+without gating).
+
+Runs on CPU: JAX_PLATFORMS=cpu python benchmarks/cost.py
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if os.environ.get("JAX_PLATFORMS", "").lower().startswith("cpu") or \
+        os.environ.get("DSTPU_ACCELERATOR", "").lower() == "cpu":
+    import importlib.util
+    _spec = importlib.util.spec_from_file_location(
+        "_dstpu_hermetic",
+        os.path.join(REPO, "deepspeed_tpu", "utils", "hermetic.py"))
+    _hermetic = importlib.util.module_from_spec(_spec)
+    _spec.loader.exec_module(_hermetic)
+    _hermetic.force_cpu()
+
+DEFAULT_OUT = os.path.join(REPO, "benchmarks", "cost.json")
+
+
+def _tiny_engine(dtype="float32"):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    model = GPT2Model(GPT2Config(vocab_size=256, n_positions=256,
+                                 n_embd=128, n_layer=4, n_head=4,
+                                 pad_vocab_to_multiple=1, dtype=dtype))
+    return deepspeed_tpu.init_inference(model, config={"dtype": dtype})
+
+
+def _serving_config():
+    return {
+        "num_slots": 4,
+        "max_model_len": 256,
+        "max_queue": 256,
+        "max_prefills_per_tick": 2,
+        "default_max_new_tokens": 16,
+        "telemetry": {"enabled": True},
+        "prefix_cache": {"enabled": True},
+        "cost": {"enabled": True},
+    }
+
+
+def _drain(srv):
+    while srv.queue_depth or srv.active_requests:
+        srv.step()
+
+
+def _interleave(heavy, light):
+    """heavy:light submission order that keeps both tenants co-resident
+    for the whole phase (h h h l, h h h l, ... at the default 3:1)."""
+    order = []
+    hi = li = 0
+    while hi < len(heavy) or li < len(light):
+        stride = max(1, len(heavy) // max(1, len(light)))
+        for _ in range(stride):
+            if hi < len(heavy):
+                order.append(heavy[hi])
+                hi += 1
+        if li < len(light):
+            order.append(light[li])
+            li += 1
+    return order
+
+
+def run(args):
+    from deepspeed_tpu.serving import SamplingParams, ServingEngine
+    from deepspeed_tpu.telemetry.costplane import capacity_report
+
+    engine = _tiny_engine()
+    srv = ServingEngine(engine, _serving_config())
+    rng = np.random.default_rng(args.seed)
+
+    # warmup: compile every prefill/decode shape both phases will hit,
+    # then zero the fold — compile walls would otherwise land on
+    # whichever tenant submitted first and swamp the engineered ratio
+    # (the soak harness resets after warmup for the same reason).
+    warm = SamplingParams(max_new_tokens=args.max_new, tenant="warmup")
+    for length in (args.prompt_len, args.shared_prefix + 8):
+        srv.submit(rng.integers(1, 255, size=length).astype(np.int32),
+                   warm)
+        _drain(srv)
+    srv.scheduler.cost.reset()
+
+    # ratio phase: identical request shapes, 3:1 request counts. Prompts
+    # are random with a distinct first token per request so the radix
+    # cache never shortcuts this phase's prefills.
+    def mk_prompt(idx):
+        p = rng.integers(1, 255, size=args.prompt_len).astype(np.int32)
+        p[0] = idx % 255 + 1
+        return p
+
+    sp = {t: SamplingParams(max_new_tokens=args.max_new, tenant=t)
+          for t in ("heavy", "light", "cohort")}
+    heavy = [(mk_prompt(i), sp["heavy"]) for i in range(args.heavy)]
+    light = [(mk_prompt(1000 + i), sp["light"]) for i in range(args.light)]
+    for prompt, params in _interleave(heavy, light):
+        srv.submit(prompt, params)
+    _drain(srv)
+
+    # cohort phase: the donor runs to completion alone so its retired
+    # slot donates the shared prefix to the radix cache; the followers
+    # then lane-copy it and only prefill their distinct suffixes.
+    prefix = rng.integers(1, 255, size=args.shared_prefix).astype(np.int32)
+    donor = np.concatenate(
+        [prefix, rng.integers(1, 255, size=8).astype(np.int32)])
+    srv.submit(donor, sp["cohort"])
+    _drain(srv)
+    for _ in range(args.followers):
+        suffix = rng.integers(1, 255, size=8).astype(np.int32)
+        srv.submit(np.concatenate([prefix, suffix]), sp["cohort"])
+    _drain(srv)
+
+    costs = srv.scheduler.cost.snapshot()
+    srv.shutdown()
+
+    report = capacity_report(
+        costs, target_tokens_per_s=args.target_tokens_per_s)
+    tenants = costs["tenants"]
+    engineered = args.heavy / args.light
+    chip_ratio = tenants["heavy"]["chip_ms"] / tenants["light"]["chip_ms"]
+    ratio_err = abs(chip_ratio - engineered) / engineered
+    savings_ms = tenants.get("cohort", {}).get("cache_savings_ms", 0.0)
+    saved_tokens = tenants.get("cohort", {}).get("cache_saved_tokens", 0)
+    checks = {
+        "engineered_token_ratio": engineered,
+        "chip_ms_ratio": round(chip_ratio, 4),
+        "ratio_rel_err": round(ratio_err, 4),
+        "ratio_ok": ratio_err <= args.ratio_tol,
+        "cohort_cache_savings_ms": round(savings_ms, 3),
+        "cohort_cache_saved_tokens": saved_tokens,
+        "savings_ok": savings_ms > 0.0,
+    }
+    return {"config": {"heavy": args.heavy, "light": args.light,
+                       "prompt_len": args.prompt_len,
+                       "max_new": args.max_new,
+                       "shared_prefix": args.shared_prefix,
+                       "followers": args.followers, "seed": args.seed},
+            "costs": costs, "report": report, "checks": checks}
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="rigged 2-tenant cost-attribution benchmark")
+    ap.add_argument("--heavy", type=int, default=9,
+                    help="tenant 'heavy' request count")
+    ap.add_argument("--light", type=int, default=3,
+                    help="tenant 'light' request count")
+    ap.add_argument("--prompt-len", type=int, default=24)
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--shared-prefix", type=int, default=48,
+                    help="cohort shared-prefix length (tokens)")
+    ap.add_argument("--followers", type=int, default=3,
+                    help="cohort requests after the donor")
+    ap.add_argument("--ratio-tol", type=float, default=0.10,
+                    help="relative chip_ms-ratio tolerance")
+    ap.add_argument("--target-tokens-per-s", type=float, default=200.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    ap.add_argument("--no-assert", action="store_true",
+                    help="record results without gating")
+    args = ap.parse_args()
+
+    doc = run(args)
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+    checks = doc["checks"]
+    print(json.dumps(checks, indent=2))
+    print(f"wrote {args.out}")
+    ok = checks["ratio_ok"] and checks["savings_ok"]
+    if not ok:
+        print("COST BENCHMARK CHECKS FAILED", file=sys.stderr)
+    return 0 if (ok or args.no_assert) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

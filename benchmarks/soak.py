@@ -86,6 +86,7 @@ def _serving_config(args, bundle_dir):
                             "keep": 4, "debounce_s": 1.0, "ring": 128,
                             "slo_burn_threshold": 2.0},
         "prefix_cache": {"enabled": True},
+        "cost": {"enabled": True},
         "speculative": {"enabled": True, "k": 4},
         "chunked_prefill": {"enabled": True, "chunk_tokens": 32},
         "tenants": {"enabled": True,
@@ -324,6 +325,9 @@ def run_soak(args):
                 SamplingParams(max_new_tokens=4))
             router.run_until_idle()
             assert router.result(fid).done
+        # zero the cost fold after warmup so the cost window matches the
+        # goodput window _drive measures (same steady-state interval)
+        router.reset_costs()
         data = _drive(router, trace, scfg.soak, tracer, ledger,
                       engine=engine)
         doc = fold_scorecard(

@@ -19,6 +19,14 @@ router aggregator folding completed paths into dstpu_fleet_path_*
 gauges, flight recorder recording every tick) — and asserts the armed
 fleet's median decode tick stays < 2% slower.
 
+An eighth interleaved comparison, "cost", isolates the cost plane: two
+identical single-replica serving stacks run the same request rounds,
+one with per-request chip-second attribution dark (``cost.enabled``
+false — the scheduler holds ``None`` and every hook is one ``is None``
+test), one with the CostLedger armed (per-tick weighted decode splits,
+prefill charges, HBM residency, the overhead residual) — and asserts
+the armed stack's median decode tick stays < 2% slower.
+
 Both loops block on the loss every step, so the comparison isolates the
 tracer's span machinery from the device sync it performs by design
 (`sync_spans` would otherwise make the "on" loop LOOK slower merely by
@@ -209,6 +217,73 @@ def _dt_mode():
         rounds * per_round
 
 
+def _cost_mode():
+    """The "cost" comparison: identical single-replica serving stacks,
+    cost plane dark vs armed. The armed stack pays the per-tick
+    attribution work — the weighted decode split over active slots, the
+    HBM residency accrual, the overhead residual bookkeeping — on every
+    fused decode tick; the dark stack's scheduler holds ``None``.
+    Returns (dark_ms_p50, cost_ms_p50, overhead_pct, requests)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.serving import SamplingParams, ServingEngine
+
+    rounds = int(os.environ.get("TEL_COST_ROUNDS", 5))
+    per_round = int(os.environ.get("TEL_COST_REQUESTS", 8))
+    max_new = int(os.environ.get("TEL_COST_NEW", 48))
+    model = GPT2Model(GPT2Config(
+        vocab_size=256, n_positions=96,
+        n_embd=int(os.environ.get("TEL_COST_EMBD", 256)),
+        n_layer=int(os.environ.get("TEL_COST_LAYERS", 4)),
+        n_head=4, pad_vocab_to_multiple=1, dtype="float32"))
+    engine = ds.init_inference(model, config={"dtype": "float32"})
+    base = {"num_slots": per_round, "max_model_len": 96,
+            "max_queue": per_round + 1,
+            "max_prefills_per_tick": per_round,
+            "telemetry": {"enabled": True, "mfu": False}}
+    servers = {
+        "dark": ServingEngine(engine, {**base,
+                                       "cost": {"enabled": False}}),
+        "cost": ServingEngine(engine, {**base,
+                                       "cost": {"enabled": True}}),
+    }
+    assert servers["dark"].scheduler.cost is None
+    assert servers["cost"].scheduler.cost is not None
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 256, (12,), dtype=np.int32)
+               for _ in range(per_round)]
+    sp = SamplingParams(max_new_tokens=max_new)
+
+    def run_round(srv, ticks):
+        for p in prompts:
+            srv.submit(p, sp)
+        while srv.queue_depth or srv.active_requests:
+            t0 = time.perf_counter()
+            srv.step()
+            if ticks is not None:
+                ticks.append(time.perf_counter() - t0)
+
+    ticks = {name: [] for name in servers}
+    for srv in servers.values():                   # compile + warmup
+        run_round(srv, None)
+    for _ in range(rounds):                        # interleaved rounds
+        for name, srv in servers.items():
+            run_round(srv, ticks[name])
+    snap = servers["cost"].scheduler.cost.snapshot()
+    # the armed ledger attributed every round and conserved wall-clock
+    assert snap["tenants"]["default"]["tokens"] >= \
+        rounds * per_round * max_new
+    attributed_s = snap["attributed_ms"] / 1e3
+    assert abs(attributed_s + snap["overhead_s"] -
+               snap["serving_wall_s"]) <= 0.02 * snap["serving_wall_s"]
+    for srv in servers.values():
+        srv.shutdown()
+    dark_ms = statistics.median(ticks["dark"]) * 1e3
+    cost_ms = statistics.median(ticks["cost"]) * 1e3
+    return dark_ms, cost_ms, 100.0 * (cost_ms - dark_ms) / dark_ms, \
+        rounds * per_round
+
+
 def main():
     import tempfile
     tracer = get_tracer()
@@ -272,6 +347,10 @@ def main():
     # armed vs dark, interleaved the same way
     dt_off_ms, dt_ms, overhead_dt_pct, dt_requests = _dt_mode()
 
+    # cost mode: the cost plane armed vs dark on the same serving
+    # stack, interleaved the same way
+    cost_off_ms, cost_ms, overhead_cost_pct, cost_requests = _cost_mode()
+
     off_ms = statistics.median(t_off) * 1e3
     on_ms = statistics.median(t_on) * 1e3
     full_ms = statistics.median(t_full) * 1e3
@@ -305,6 +384,10 @@ def main():
         "serving_tick_ms_disttrace_p50": round(dt_ms, 4),
         "overhead_disttrace_pct": round(overhead_dt_pct, 3),
         "disttrace_requests": dt_requests,
+        "serving_tick_ms_cost_dark_p50": round(cost_off_ms, 4),
+        "serving_tick_ms_cost_p50": round(cost_ms, 4),
+        "overhead_cost_pct": round(overhead_cost_pct, 3),
+        "cost_requests": cost_requests,
         "threshold_pct": THRESHOLD_PCT,
         "spans_recorded": len(tracer.spans()),
         "devices": jax.device_count(),
@@ -336,12 +419,17 @@ def main():
         f"serving observability overhead with distributed tracing + "
         f"fleet aggregation armed {overhead_dt_pct:.2f}% exceeds the "
         f"{THRESHOLD_PCT}% budget")
+    assert overhead_cost_pct < THRESHOLD_PCT, (
+        f"cost-plane overhead (per-tick chip-second attribution + HBM "
+        f"residency) {overhead_cost_pct:.2f}% exceeds the "
+        f"{THRESHOLD_PCT}% budget")
     print(f"OK: tracer-on overhead {overhead_pct:.2f}%, + goodput "
           f"ledger + statusz server {overhead_full_pct:.2f}%, + flight "
           f"recorder {overhead_rec_pct:.2f}%, + compile plane "
           f"{overhead_cp_pct:.2f}%, + dark elastic coordinator "
           f"{overhead_el_pct:.2f}%, serving fleet w/ distributed "
-          f"tracing {overhead_dt_pct:.2f}% — all < {THRESHOLD_PCT}%")
+          f"tracing {overhead_dt_pct:.2f}%, cost plane "
+          f"{overhead_cost_pct:.2f}% — all < {THRESHOLD_PCT}%")
 
 
 if __name__ == "__main__":

@@ -58,7 +58,14 @@ class MoeMetrics:
     - ``moe/dropped_token_fraction`` — routed tokens beyond capacity ÷
       routed tokens this record (the capacity-factor overflow rate);
     - ``moe/overflow_tokens`` / ``moe/overflow_steps`` — cumulative
-      overflow counters.
+      overflow counters;
+    - ``moe/dispatch_bytes_total`` / ``moe/combine_bytes_total`` /
+      ``moe/wire_bytes_per_step`` — the logical all-to-all payloads
+      behind the dispatch/combine einsums (``record_wire``, computed
+      host-side from static shapes: GSPMD emits the collective, so no
+      comm-dispatch accounting sees it — this seed is the cost plane's
+      handle on expert-parallel wire traffic until the einsums route
+      through ``comm/comm.py``).
 
     Gauges carry ``owner=`` this instance and are retracted by
     ``close()`` — the PR-4 gauge-lifecycle contract
@@ -70,6 +77,9 @@ class MoeMetrics:
         self.records = 0
         self.overflow_tokens = 0
         self.overflow_steps = 0
+        self.dispatch_bytes = 0
+        self.combine_bytes = 0
+        self.wire_records = 0
         self._closed = False
 
     def record(self, exp_counts, capacity: int,
@@ -105,11 +115,37 @@ class MoeMetrics:
                                     step, owner=self)
         return out
 
+    def record_wire(self, *, capacity: int, num_experts: int,
+                    model_dim: int, itemsize: int = 4,
+                    step: Optional[int] = None) -> Dict[str, float]:
+        """Attribute one step's LOGICAL dispatch/combine wire traffic.
+        Host-side arithmetic over static shapes — the dispatch einsum
+        reshards [S, M] tokens into expert-major [E, C, M] (the
+        all-to-all GSPMD emits) and combine moves the same [E, C, M]
+        back, so each direction's payload is E x C x M x itemsize
+        regardless of how many routed tokens actually filled the
+        capacity slots (the collective moves the padded tensor)."""
+        payload = int(num_experts) * int(capacity) * int(model_dim) \
+            * int(itemsize)
+        self.dispatch_bytes += payload
+        self.combine_bytes += payload
+        self.wire_records += 1
+        out = {
+            "dispatch_bytes_total": float(self.dispatch_bytes),
+            "combine_bytes_total": float(self.combine_bytes),
+            "wire_bytes_per_step": float(2 * payload),
+        }
+        for name, val in out.items():
+            self.tracer.set_counter(f"moe/{name}", val, step, owner=self)
+        return out
+
     def summary(self) -> Dict[str, Any]:
         """Statusz/bundle view of the cumulative overflow counters."""
         return {"records": self.records,
                 "overflow_tokens": self.overflow_tokens,
-                "overflow_steps": self.overflow_steps}
+                "overflow_steps": self.overflow_steps,
+                "dispatch_bytes": self.dispatch_bytes,
+                "combine_bytes": self.combine_bytes}
 
     def close(self):
         """Retract this family from the shared counter space — a closed

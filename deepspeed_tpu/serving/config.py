@@ -391,6 +391,36 @@ class SoakConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class CostConfig(DeepSpeedConfigModel):
+    """The ``"cost"`` block (telemetry/costplane.py): per-request /
+    per-tenant chip-second and HBM attribution. Every serving tick's
+    wall-clock is split across the requests occupying it (decode by
+    tokens emitted, prefill to its owner, the rest an explicit overhead
+    residual, so costs sum to serving wall by construction), HBM
+    byte-seconds accrue from slot footprint x residency, and radix-cache
+    hits record avoided prefill cost as savings. Folded per-tenant at
+    the FleetRouter into the ``dstpu_cost_*`` family, the ``/statusz``
+    costs table, and the soak scorecard's cost invariant. Off by
+    default: nothing is allocated and every scheduler hook is one
+    ``is None`` test."""
+    enabled: bool = False
+    #: EMA smoothing for the observed per-token prefill cost — the rate
+    #: radix-cache savings are priced at
+    ema_alpha: float = 0.25
+    #: accrue HBM-byte-seconds per occupied slot (footprint x residency)
+    hbm: bool = True
+    #: cap on distinct tenants with live cost totals; excess folds into
+    #: ``__other__`` (same bounded-cardinality rule as tenants.max_tracked)
+    max_tracked: int = 64
+
+    def validate(self):
+        if not (0.0 < self.ema_alpha <= 1.0):
+            raise ConfigError("cost.ema_alpha must be in (0, 1]")
+        if self.max_tracked < 1:
+            raise ConfigError("cost.max_tracked must be >= 1")
+
+
+@dataclasses.dataclass
 class ServingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving knobs (deepspeed_tpu/serving/)."""
 
@@ -485,6 +515,10 @@ class ServingConfig(DeepSpeedConfigModel):
     # for benchmarks/soak.py and telemetry/scorecard.py; inert at serve
     # time
     soak: Any = None
+
+    # cost (dict -> CostConfig): per-request / per-tenant chip-second +
+    # HBM attribution (telemetry/costplane.py) — the dstpu_cost_* family
+    cost: Any = None
 
     ALIASES = {"max_seq_len": "max_model_len"}
 
@@ -590,3 +624,8 @@ class ServingConfig(DeepSpeedConfigModel):
         elif self.soak is None:
             self.soak = SoakConfig()
         self.soak.validate()
+        if isinstance(self.cost, dict):
+            self.cost = CostConfig.from_dict(self.cost)
+        elif self.cost is None:
+            self.cost = CostConfig()
+        self.cost.validate()

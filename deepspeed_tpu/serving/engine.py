@@ -128,6 +128,10 @@ class ServingEngine:
         self.scheduler = ContinuousBatchingScheduler(
             engine, config, metrics=self.metrics, clock=clock, seed=seed,
             handoff_sink=handoff_sink, replica_name=self.replica)
+        if self.statusz is not None and self.scheduler.cost is not None:
+            # standalone engines surface their own ledger; in a fleet the
+            # router's fold is the authoritative per-tenant total
+            self.statusz.register("costs", self._cost_section)
         self._requests: Dict[int, Request] = {}
         self._next_id = self._id_start
         self._draining = False
@@ -588,6 +592,13 @@ class ServingEngine:
                for m in slo["metrics"].values()):
             out["slo_burn_rate"] = slo["burn_rate"]
         return out
+
+    def _cost_section(self) -> dict:
+        """The standalone engine's /statusz ``costs`` section: this
+        replica's cost-ledger snapshot (a fleet's router folds these
+        instead). Empty when the cost plane is off."""
+        cost = self.scheduler.cost
+        return cost.snapshot() if cost is not None else {}
 
     # ------------------------------------------------------------- inspection
     @property

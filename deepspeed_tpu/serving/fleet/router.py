@@ -202,6 +202,11 @@ class FleetRouter:
         if tcfg is not None and (tcfg.rate_tokens_per_s > 0 or tcfg.rates):
             self.limiter = TenantRateLimiter(tcfg, clock=clock)
         self._fleet_requests: Dict[int, FleetRequest] = {}
+        #: cost fold retained from replicas that left the fleet (failed,
+        #: drained, rolled out) — a replica's chip-seconds were spent
+        #: whether or not it survived, so the fleet fold must keep them
+        #: after the ledger's owner is disposed
+        self._cost_retired: dict = {}
         self._next_fid = 0
         self._pending: "deque[FleetRequest]" = deque()
         self._pending_handoffs: "deque" = deque()
@@ -226,6 +231,7 @@ class FleetRouter:
             self.statusz.register("tenants", self._tenant_section)
             self.statusz.register("autoscale", self.autoscale_summary)
             self.statusz.register("rollout", self.rollout_summary)
+            self.statusz.register("costs", self._cost_section)
             self.statusz.register_health("fleet", self._health_check)
             if self.aggregator is not None:
                 self.statusz.register("critical_path",
@@ -461,6 +467,10 @@ class FleetRouter:
     def _evict(self, replica: ReplicaHandle, reason: str):
         replica.failed = True
         replica.ready = False
+        # the dead replica's chip-seconds were spent: fold its ledger
+        # into the retired accumulator NOW, while the in-process object
+        # is still reachable (cost_summary skips failed replicas)
+        self._fold_replica_costs(replica)
         self._draining.pop(replica.name, None)
         self._drain_timeout_of.pop(replica.name, None)
         self._shadow.discard(replica.name)
@@ -680,6 +690,7 @@ class FleetRouter:
         if not busy:
             self._draining.pop(name, None)
             self._drain_timeout_of.pop(name, None)
+            self._fold_replica_costs(r)
             del self.replicas[name]
             if r.engine is not None:
                 r.engine.shutdown()
@@ -847,6 +858,67 @@ class FleetRouter:
         except Exception as e:
             logger.warning(f"fleet: disposing failed replica: {e}")
 
+    # ----------------------------------------------------------------- costs
+    def _fold_replica_costs(self, replica: ReplicaHandle):
+        """Fold a departing replica's cost ledger into ``_cost_retired``
+        exactly once — the ledger is reset after the fold, so a second
+        fold of the same object (kill of an already-failed replica, a
+        drain timeout's evict + dispose) adds zero."""
+        engine = replica.engine
+        cost = getattr(getattr(engine, "scheduler", None), "cost", None) \
+            if engine is not None else None
+        if cost is None:
+            return
+        from ...telemetry.costplane import merge_cost_totals
+        merge_cost_totals(self._cost_retired, cost.snapshot())
+        cost.reset()
+
+    def cost_summary(self) -> dict:
+        """Fleet-wide cost fold: every live replica's ``CostLedger``
+        snapshot plus the retired accumulator (failed/drained replicas
+        folded at departure). Per-tenant chip-ms / HBM-GiB-s / token
+        totals and the fleet serving-wall + overhead residual — by
+        construction tenant costs + overhead sum to the fleet's serving
+        wall-clock."""
+        from ...telemetry.costplane import merge_cost_totals
+        out: dict = {"enabled": False}
+        if self._cost_retired:
+            out["enabled"] = True
+            merge_cost_totals(out, self._cost_retired)
+        for r in self.replicas.values():
+            if r.failed or r.engine is None:
+                continue
+            cost = getattr(r.engine.scheduler, "cost", None)
+            if cost is None:
+                continue
+            out["enabled"] = True
+            merge_cost_totals(out, cost.snapshot())
+        return out
+
+    def reset_costs(self):
+        """Zero the fleet cost fold — live ledgers AND the retired
+        accumulator. Benchmarks call this after warmup so the cost
+        window matches the measured goodput window."""
+        self._cost_retired = {}
+        for r in self.replicas.values():
+            if r.engine is None:
+                continue
+            cost = getattr(r.engine.scheduler, "cost", None)
+            if cost is not None:
+                cost.reset()
+
+    def _cost_section(self) -> dict:
+        """The /statusz ``costs`` section (and ds_tpu_top panel): the
+        fleet cost fold plus the derived capacity view. Empty when no
+        replica runs a cost ledger — the panel degrades away."""
+        costs = self.cost_summary()
+        if not costs.get("enabled"):
+            return {}
+        from ...telemetry.costplane import capacity_report
+        costs["capacity"] = capacity_report(costs,
+                                            replicas=len(self.replicas))
+        return costs
+
     # -------------------------------------------------------------- statusz
     def _prefix_totals(self):
         hits = lookups = 0
@@ -878,6 +950,12 @@ class FleetRouter:
             self.metrics.update_rollout(
                 skew=self.version_skew()["skew"],
                 **self.rollout.gauge_row())
+        costs = self.cost_summary()
+        if costs.get("enabled"):
+            # the dstpu_cost_* family is emitted ONLY here: replicas
+            # share one in-process tracer, so per-replica emission would
+            # last-writer-win; the router fold is the one total
+            self.metrics.update_cost(costs)
 
     def tenant_summary(self) -> dict:
         """Fleet-wide per-tenant view: each live replica's tenant SLO
@@ -891,8 +969,8 @@ class FleetRouter:
         def row_of(tenant):
             return agg.setdefault(tenant, {
                 "submitted": 0, "completed": 0, "timeouts": 0,
-                "tokens_out": 0, "ttft_ms_p99": 0.0, "burn_rate": 0.0,
-                "throttled": 0})
+                "tokens_out": 0, "prompt_tokens": 0, "ttft_ms_p99": 0.0,
+                "burn_rate": 0.0, "throttled": 0})
 
         for r in self.replicas.values():
             if r.engine is None or r.failed:
@@ -900,8 +978,8 @@ class FleetRouter:
             for tenant, rep in r.engine.metrics.tenant_status().items():
                 a = row_of(tenant)
                 for key in ("submitted", "completed", "timeouts",
-                            "tokens_out"):
-                    a[key] += rep[key]
+                            "tokens_out", "prompt_tokens"):
+                    a[key] += rep.get(key, 0)
                 a["ttft_ms_p99"] = max(a["ttft_ms_p99"],
                                        rep["ttft_ms_p99"])
                 a["burn_rate"] = max(a["burn_rate"], rep["burn_rate"])

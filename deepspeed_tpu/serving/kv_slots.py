@@ -104,6 +104,17 @@ class SlotPool:
         self.seeds[slot] = getattr(sampling, "seed", 0)
 
     # ------------------------------------------------------------ queries
+    def slot_nbytes(self) -> int:
+        """HBM bytes ONE slot pins in this pool: total pool footprint /
+        num_slots, summed host-side over the cache pytree's leaves (no
+        device sync). Int8-aware by construction — a quantized pool's
+        leaves are the int8 q + f32 scales the device actually holds,
+        the same bytes the HBM ledger's ``kv_slots`` role reports. The
+        cost plane multiplies this by slot residency for per-request
+        HBM-byte-seconds."""
+        from ..telemetry.costplane import tree_nbytes
+        return tree_nbytes(self.cache) // max(1, self.num_slots)
+
     @property
     def free_count(self) -> int:
         return len(self._free)

@@ -47,7 +47,7 @@ class _TenantStats:
     what ``slo.decay_s`` ages the window by."""
 
     __slots__ = ("ttft_ms", "e2e_ms", "ttft_t", "e2e_t", "submitted",
-                 "completed", "tokens_out", "timeouts")
+                 "completed", "tokens_out", "prompt_tokens", "timeouts")
 
     def __init__(self, window: int):
         self.ttft_ms: "deque[float]" = deque(maxlen=window)
@@ -57,6 +57,9 @@ class _TenantStats:
         self.submitted = 0
         self.completed = 0
         self.tokens_out = 0
+        #: prompt tokens submitted under this tenant — with tokens_out,
+        #: the cost plane's per-tenant denominators
+        self.prompt_tokens = 0
         self.timeouts = 0
 
 
@@ -176,9 +179,11 @@ class ServingMetrics:
             stats = self.tenant_stats[name] = _TenantStats(self.window)
         return stats
 
-    def record_submit(self, tenant=None):
+    def record_submit(self, tenant=None, prompt_tokens: int = 0):
         self.submitted += 1
-        self._tenant(tenant).submitted += 1
+        t = self._tenant(tenant)
+        t.submitted += 1
+        t.prompt_tokens += int(prompt_tokens)
 
     def record_reject(self):
         self.rejected += 1
@@ -370,6 +375,7 @@ class ServingMetrics:
                 "completed": st.completed,
                 "timeouts": st.timeouts,
                 "tokens_out": st.tokens_out,
+                "prompt_tokens": st.prompt_tokens,
                 "token_share": round(st.tokens_out / total_tokens, 4),
                 "ttft_ms_p50": round(_percentile(ttft, 0.50), 3),
                 "ttft_ms_p99": round(_percentile(ttft, 0.99), 3),
@@ -391,7 +397,8 @@ class ServingMetrics:
         # closed replica's tenant gauges vanish with it
         for tenant, row in self.tenant_status().items():
             for metric in ("ttft_ms_p50", "ttft_ms_p99", "burn_rate",
-                           "completed", "tokens_out", "token_share"):
+                           "completed", "tokens_out", "prompt_tokens",
+                           "token_share"):
                 self._gauge(f"tenant/{tenant}/{metric}", row[metric])
 
     # ------------------------------------------------------------- fan-out
@@ -553,6 +560,26 @@ class FleetMetrics:
                          ("rollout/rollbacks", self.rollbacks),
                          ("rollout/canary_failures", self.canary_failures)):
             self.tracer.set_counter(tag, float(val), owner=self)
+
+    def update_cost(self, costs: dict):
+        """The ``dstpu_cost_*`` family: per-tenant chip-ms / HBM-GiB-s /
+        tokens / cache savings from the router's cost fold
+        (telemetry/costplane.py), one ``tenant=`` labeled series per
+        metric via the ``cost/`` tag prefix (telemetry/export.py). The
+        fleet-scalar residuals ride the existing ``fleet/`` family.
+        Owned by this instance: a shut-down router's costs vanish from
+        /metrics with it."""
+        for tenant, row in (costs.get("tenants") or {}).items():
+            for metric in ("chip_ms", "hbm_gib_s", "tokens",
+                           "cache_savings_ms"):
+                self.tracer.set_counter(
+                    f"cost/{tenant}/{metric}",
+                    round(float(row.get(metric, 0) or 0), 6), owner=self)
+        for tag, key in (("fleet/cost_overhead_ms", "overhead_s"),
+                         ("fleet/cost_serving_wall_ms", "serving_wall_s")):
+            self.tracer.set_counter(
+                tag, round(float(costs.get(key, 0.0)) * 1e3, 3),
+                owner=self)
 
     def close(self):
         if self._closed:

@@ -353,6 +353,20 @@ class ContinuousBatchingScheduler:
             self.draft = engine.init_draft(spec_cfg.draft)
             self.draft_cache = engine.init_draft_pool(
                 self.draft, config.num_slots, config.max_model_len)
+        # cost plane (telemetry/costplane.py): per-request / per-tenant
+        # chip-second + HBM attribution. None when disabled — every hook
+        # below is a single ``is None`` test, nothing allocated.
+        self.cost = None
+        cost_cfg = getattr(config, "cost", None)
+        if getattr(cost_cfg, "enabled", False):
+            from ..telemetry.costplane import CostLedger, tree_nbytes
+            self.cost = CostLedger(cost_cfg, clock=clock)
+            slot_bytes = self.pool.slot_nbytes()
+            if self.draft_cache is not None:
+                # the draft pool is per-slot KV state too — same residency
+                slot_bytes += tree_nbytes(self.draft_cache) \
+                    // max(1, config.num_slots)
+            self.cost.slot_bytes = slot_bytes
         self._tick_no = 0
         # per-request async spans (queue → prefill → decode → complete)
         # land in the same trace as train/comm spans
@@ -401,7 +415,8 @@ class ContinuousBatchingScheduler:
         tr.async_begin("request/queued", request.request_id, cat="serving",
                        args={"replica": self.replica_name,
                              "trace_id": ctx.trace_id})
-        self.metrics.record_submit(tenant=request.tenant)
+        self.metrics.record_submit(tenant=request.tenant,
+                                   prompt_tokens=int(request.prompt.size))
 
     def enqueue_handoff(self, handoff, request: Request):
         """Admission control for the handoff path (decode role): the
@@ -449,6 +464,17 @@ class ContinuousBatchingScheduler:
         self.metrics.record_tick(len(self.queue), self.pool.utilization)
         if self.prefix_cache is not None:
             self.metrics.record_prefix_cache(self.prefix_cache)
+        if self.cost is not None:
+            # close the tick's books: HBM residency for every occupied
+            # slot (decoding or mid-chunked-prefill), then the overhead
+            # residual — tick wall minus everything attributed above —
+            # so per-request costs + overhead sum to serving wall-clock
+            # by construction
+            occupants = [self.cost.record_for(self.pool.requests[s])
+                         for s in self.pool.active_slots]
+            occupants += [self.cost.record_for(r)
+                          for r in self.prefilling.values()]
+            self.cost.end_tick(self.clock() - now, occupants)
         return (len(self.queue) + len(self.handoff_queue) +
                 len(self.pool.active_slots) + len(self.prefilling))
 
@@ -554,6 +580,7 @@ class ContinuousBatchingScheduler:
             tr.async_begin("request/decode", req.request_id, cat="serving",
                            args={"slot": slot, "handoff": True,
                                  "replica": self.replica_name, **targs})
+            t0 = self.clock()
             with tr.span("kv_handoff_in", cat="serving",
                          args={"request_id": req.request_id, "slot": slot,
                                "kv_len": int(handoff.kv_len),
@@ -562,6 +589,13 @@ class ContinuousBatchingScheduler:
                                "replica": self.replica_name, **targs}):
                 self.pool.cache = self.engine.slot_insert_lane(
                     self.pool.cache, slot, handoff.lane)
+            if self.cost is not None:
+                # the lane insert is admission work owned by this request
+                # (its per-token cost is transport, not prefill compute,
+                # so it never feeds the savings-pricing EMA)
+                self.cost.charge_prefill(
+                    self.cost.record_for(req), self.clock() - t0,
+                    int(handoff.kv_len), update_rate=False)
             if ctx is not None:
                 ctx.mark("handoff_inserted")
             req.state = RequestState.RUNNING
@@ -670,6 +704,7 @@ class ContinuousBatchingScheduler:
             start = min(int(hit.matched), t - 1)
             if start > 0:
                 try:
+                    t0 = self.clock()
                     with tr.span("prefix_reuse", cat="serving",
                                  args={"request_id": req.request_id,
                                        "slot": slot, "src_slot": hit.slot,
@@ -682,6 +717,11 @@ class ContinuousBatchingScheduler:
                                           else {})}):
                         self.pool.cache = self.engine.slot_copy_lane(
                             self.pool.cache, hit.slot, slot)
+                    if self.cost is not None:
+                        rec = self.cost.record_for(req)
+                        self.cost.charge_prefill(rec, self.clock() - t0,
+                                                 start, update_rate=False)
+                        self.cost.note_cache_savings(rec, start)
                 finally:
                     self.prefix_cache.release(hit, used_tokens=start)
             else:
@@ -712,6 +752,7 @@ class ContinuousBatchingScheduler:
         req.prefill_tick = self._tick_no
         if rem > self.chunked.chunk_tokens:
             chunk = self.chunked.chunk_tokens
+            t0 = self.clock()
             with tr.span("prefill_chunk", cat="serving",
                          args={"request_id": req.request_id, "slot": slot,
                                "start": p, "chunk": chunk,
@@ -719,6 +760,9 @@ class ContinuousBatchingScheduler:
                                "replica": self.replica_name, **targs}):
                 self.pool.cache = self.engine.slot_chunk_prefill(
                     self.pool.cache, slot, req.prompt[p:p + chunk], p)
+            if self.cost is not None:
+                self.cost.charge_prefill(self.cost.record_for(req),
+                                         self.clock() - t0, chunk)
             req.prefill_pos = p + chunk
             self.pool.lengths[slot] = req.prefill_pos
             if ctx is not None:
@@ -730,6 +774,7 @@ class ContinuousBatchingScheduler:
         from .fleet.prefix_cache import reuse_plan
         offset, _sfx = reuse_plan(t, p, self.config.max_model_len)
         sp = req.sampling
+        t0 = self.clock()
         with tr.span("prefill", cat="serving",
                      args={"request_id": req.request_id, "slot": slot,
                            "prompt_len": t, "chunked": True,
@@ -739,6 +784,9 @@ class ContinuousBatchingScheduler:
                 self.pool.cache, slot, req.prompt[offset:], offset,
                 temperature=sp.temperature, top_k=sp.top_k,
                 top_p=sp.top_p, seed=sp.seed)
+        if self.cost is not None:
+            self.cost.charge_prefill(self.cost.record_for(req),
+                                     self.clock() - t0, t - offset)
         self.prefilling.pop(slot, None)
         self._complete_admission(slot, req, int(first))
         return rem
@@ -755,6 +803,13 @@ class ContinuousBatchingScheduler:
         req.first_token_time = t_first
         self.metrics.record_ttft(t_first - req.submit_time,
                                  tenant=req.tenant)
+        if self.cost is not None:
+            # the first token is sampled BY the prefill: its cost is in
+            # the prefill charge, but it still counts as an emitted
+            # token, so tokens-per-chip-second sees every token
+            rec = self.cost.record_for(req)
+            rec.tokens += 1
+            self.cost._tenant(rec.tenant).tokens += 1
         self._deliver(req, first)
         if self._should_finish(req, first):
             self._finish(req, RequestState.FINISHED, t_first)
@@ -781,6 +836,7 @@ class ContinuousBatchingScheduler:
                                          self.config.max_model_len)
             if offset > 0:
                 try:
+                    t0 = self.clock()
                     with tr.span("prefix_reuse", cat="serving",
                                  args={"request_id": req.request_id,
                                        "slot": slot, "src_slot": hit.slot,
@@ -800,10 +856,22 @@ class ContinuousBatchingScheduler:
                                 offset,
                                 temperature=sp.temperature, top_k=sp.top_k,
                                 top_p=sp.top_p, seed=sp.seed)
+                    if self.cost is not None:
+                        # the lane copy + suffix pass is what the request
+                        # actually cost; the reused prefix is prefill the
+                        # fleet did NOT pay — priced at the observed
+                        # per-token EMA and recorded as savings
+                        rec = self.cost.record_for(req)
+                        self.cost.charge_prefill(
+                            rec, self.clock() - t0,
+                            int(req.prompt.size) - offset,
+                            update_rate=False)
+                        self.cost.note_cache_savings(rec, offset)
                     return first
                 finally:
                     self.prefix_cache.release(hit, used_tokens=offset)
             self.prefix_cache.release(hit, used_tokens=0)
+        t0 = self.clock()
         with tr.span("prefill", cat="serving",
                      args={"request_id": req.request_id, "slot": slot,
                            "prompt_len": int(req.prompt.size),
@@ -816,6 +884,10 @@ class ContinuousBatchingScheduler:
                 self.pool.cache, slot, req.prompt,
                 temperature=sp.temperature, top_k=sp.top_k,
                 top_p=sp.top_p, seed=sp.seed)
+        if self.cost is not None:
+            self.cost.charge_prefill(self.cost.record_for(req),
+                                     self.clock() - t0,
+                                     int(req.prompt.size))
         return first
 
     def _hand_off(self, slot: int, req: Request, first: int):
@@ -885,6 +957,13 @@ class ContinuousBatchingScheduler:
                 top_ks=top_ks, top_ps=top_ps, seeds=seeds)
         dt = self.clock() - t0
         self.metrics.record_decode_step(dt, len(active))
+        if self.cost is not None:
+            # every active slot emits exactly one token this tick: the
+            # fused step's wall splits equally (weight 1 each). Charged
+            # BEFORE the retire loop, while every slot is still bound.
+            self.cost.charge_decode(
+                dt, [(self.cost.record_for(self.pool.requests[s]), 1)
+                     for s in active])
         now = self.clock()
         for slot in active:
             req = self.pool.requests[slot]
@@ -943,6 +1022,7 @@ class ContinuousBatchingScheduler:
                 req.trace.mark("spec_verify")
         now = self.clock()
         accepted_total = emitted_total = 0
+        cost_pairs = [] if self.cost is not None else None
         for slot in active:
             req = self.pool.requests[slot]
             a = int(accepts[slot])
@@ -965,11 +1045,20 @@ class ContinuousBatchingScheduler:
             accepted_total += a
             emitted_total += delivered
             self.metrics.record_tenant_tokens(req.tenant, delivered)
+            if cost_pairs is not None:
+                cost_pairs.append((self.cost.record_for(req), delivered))
             if finishing:
                 self._finish(req, RequestState.FINISHED, now)
                 self._release_slot(slot, req)
             else:
                 self.pool.pending[slot] = int(out_toks[slot, a])
+        if cost_pairs is not None:
+            # one weighted split of the whole tick wall by emitted
+            # tokens: accepted drafts credit their request, and the
+            # draft + verify overhead lands pro-rata in the same split
+            self.cost.charge_spec(now - t0, draft_s=t_draft - t0,
+                                  verify_s=t_verify - t_draft,
+                                  weighted=cost_pairs)
         self.metrics.record_spec_tick(
             step_s=now - t0, n_active=len(active), k=k,
             accepted=accepted_total, emitted=emitted_total,

@@ -117,7 +117,7 @@ class TraceContext:
 
     __slots__ = ("trace_id", "origin", "span_ids", "replays",
                  "replay_parent", "hops", "marks", "sampling", "tenant",
-                 "weights_version")
+                 "weights_version", "cost")
 
     def __init__(self, trace_id: str, origin: str,
                  span_ids: Optional[List[int]] = None, replays: int = 0,
@@ -148,6 +148,12 @@ class TraceContext:
         #: KV handoff whose version differs from its own — mixing KV
         #: from two models would be silent garbage, not a crash
         self.weights_version = weights_version
+        #: the request's CostRecord (telemetry/costplane.py), attached
+        #: lazily by the cost plane when enabled — riding the context is
+        #: what makes cost attribution survive KV handoffs (frame
+        #: header) and failover (the router's persistent context):
+        #: survivor attempts accumulate into the SAME record
+        self.cost = None
 
     # ------------------------------------------------------------- minting
     @classmethod
@@ -208,25 +214,32 @@ class TraceContext:
         """JSON-able identity for the KVHandoff frame header. Marks stay
         behind: they are ``perf_counter`` timestamps, meaningless in
         another process's clock domain."""
-        return {"trace_id": self.trace_id, "origin": self.origin,
-                "span_ids": list(self.span_ids), "replays": self.replays,
-                "replay_parent": self.replay_parent,
-                "hops": list(self.hops),
-                "sampling": self.sampling,
-                "tenant": self.tenant,
-                "weights_version": self.weights_version}
+        out = {"trace_id": self.trace_id, "origin": self.origin,
+               "span_ids": list(self.span_ids), "replays": self.replays,
+               "replay_parent": self.replay_parent,
+               "hops": list(self.hops),
+               "sampling": self.sampling,
+               "tenant": self.tenant,
+               "weights_version": self.weights_version}
+        if self.cost is not None:
+            out["cost"] = self.cost.to_dict()
+        return out
 
     @classmethod
     def from_header(cls, header: Dict[str, Any]) -> "TraceContext":
-        return cls(trace_id=str(header["trace_id"]),
-                   origin=str(header.get("origin", "?")),
-                   span_ids=header.get("span_ids"),
-                   replays=header.get("replays", 0),
-                   replay_parent=header.get("replay_parent"),
-                   hops=header.get("hops"),
-                   sampling=header.get("sampling"),
-                   tenant=header.get("tenant"),
-                   weights_version=header.get("weights_version"))
+        ctx = cls(trace_id=str(header["trace_id"]),
+                  origin=str(header.get("origin", "?")),
+                  span_ids=header.get("span_ids"),
+                  replays=header.get("replays", 0),
+                  replay_parent=header.get("replay_parent"),
+                  hops=header.get("hops"),
+                  sampling=header.get("sampling"),
+                  tenant=header.get("tenant"),
+                  weights_version=header.get("weights_version"))
+        if header.get("cost") is not None:
+            from .costplane import CostRecord
+            ctx.cost = CostRecord.from_dict(header["cost"])
+        return ctx
 
     # -------------------------------------------------------- critical path
     def total_ms(self) -> float:

@@ -218,6 +218,7 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
     lines: List[str] = []
     host_lines: List[str] = []
     tenant_series: Dict[str, List[str]] = {}
+    cost_series: Dict[str, List[str]] = {}
     lines.append(f"# TYPE {prefix}_metric gauge")
     for tag, (val, _step) in sorted(tracer.counters().items()):
         try:
@@ -259,6 +260,20 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
                 name = _prom(metric)
                 tenant_series.setdefault(name, []).append(
                     f'{prefix}_tenant_{name}{{tenant="{_prom(tname)}"}} '
+                    f"{fval}")
+                continue
+        if tag.startswith("cost/"):
+            # cost-plane attribution (serving/metrics.py update_cost,
+            # folded at the router from telemetry/costplane.py ledgers):
+            # cost/<tenant>/<metric> becomes a tenant=-labeled
+            # dstpu_cost_<metric> series — chargeback dashboards rank
+            # tenants by chip-milliseconds / HBM-GiB-seconds with one
+            # query instead of label-matching through the generic gauge
+            tname, _, metric = tag[len("cost/"):].partition("/")
+            if metric:
+                name = _prom(metric)
+                cost_series.setdefault(name, []).append(
+                    f'{prefix}_cost_{name}{{tenant="{_prom(tname)}"}} '
                     f"{fval}")
                 continue
         if tag.startswith("elastic/"):
@@ -307,6 +322,9 @@ def prometheus_dump(tracer: Optional[Tracer] = None,
         # exposition format (tenants vary only by label)
         lines.append(f"# TYPE {prefix}_tenant_{name} gauge")
         lines.extend(tenant_series[name])
+    for name in sorted(cost_series):
+        lines.append(f"# TYPE {prefix}_cost_{name} gauge")
+        lines.extend(cost_series[name])
     aggs = span_aggregates(tracer)
     if aggs:
         lines.append(f"# TYPE {prefix}_span_ms_total counter")

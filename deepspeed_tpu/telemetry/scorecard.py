@@ -38,6 +38,14 @@ cross-subsystem invariants evaluated at fold time:
   soak's same-version rollout — its bitwise canary has a ground truth),
   version skew returned to zero within ``recovery_window_s`` and ends
   at zero, and the token audit stayed clean across the swap.
+- ``cost_attribution_conserved`` — the cost plane's fold (per-tenant
+  chip-seconds + the explicit overhead residual) sums to the fleet's
+  serving wall-clock within ``cost_wall_rel``, tracks the goodput
+  ledger's serving buckets within ``cost_goodput_rel``, and the radix
+  cache's recorded savings never price a reused token above
+  ``cost_savings_slack`` x the paid per-token prefill rate (savings
+  must not overstate the cost they displaced). Lenient when the run
+  folded no ``costs`` section — the plane is opt-in.
 
 This module is stdlib-only on purpose: ``bin/ds_tpu_soakdiff`` loads it
 by file path on machines with no jax/numpy, and ``check_invariants`` /
@@ -61,7 +69,7 @@ SCORECARD_VERSION = 1
 INVARIANTS = ("goodput_sums_to_wall", "exactly_once_streaming",
               "slo_burn_recovers", "autoscale_matches_load",
               "critical_path_decomposes", "bundle_retention_bounded",
-              "rollout_converges")
+              "rollout_converges", "cost_attribution_conserved")
 
 #: fold-time invariant tolerances (overridable per scorecard; the used
 #: values are embedded in the document so a reader sees what was checked)
@@ -70,6 +78,9 @@ DEFAULT_TOLERANCES = {
     "recovery_window_s": 20.0,
     "critical_path_rel": 0.05,
     "critical_path_floor_ms": 0.5,
+    "cost_wall_rel": 0.02,           # tenant chip + overhead == serving wall
+    "cost_goodput_rel": 0.25,        # cost wall vs goodput serving buckets
+    "cost_savings_slack": 2.0,       # savings rate vs paid prefill rate
 }
 
 #: soak-diff noise tolerances: metric path -> (mode, bound). ``min_ratio``
@@ -93,6 +104,8 @@ DIFF_TOLERANCES: Dict[str, Tuple[str, float]] = {
     "latency.e2e_ms_p95": ("max_ratio", 3.0),
     "critical_path.e2e_ms_mean": ("max_ratio", 3.0),
     "wall_s": ("max_ratio", 2.0),
+    "costs.serving_wall_s": ("max_ratio", 2.0),
+    "costs.overhead_s": ("max_ratio", 3.0),
 }
 
 
@@ -276,6 +289,55 @@ def _inv_rollout(doc, tol) -> Tuple[bool, str]:
                   f"(canary {ro.get('canary_verdict')})")
 
 
+def _inv_cost(doc, tol) -> Tuple[bool, str]:
+    costs = doc.get("costs")
+    if not costs:
+        # the plane is opt-in: a run without it has nothing to conserve
+        return True, "no costs section (cost plane off)"
+    wall = float(costs.get("serving_wall_s") or 0.0)
+    if wall <= 0:
+        return False, "cost plane enabled but serving_wall_s is zero"
+    tenants = costs.get("tenants") or {}
+    chip_s = sum(float(r.get("chip_ms") or 0.0)
+                 for r in tenants.values()) / 1e3
+    overhead = float(costs.get("overhead_s") or 0.0)
+    total = chip_s + overhead
+    rel = tol["cost_wall_rel"]
+    if abs(total - wall) > rel * wall:
+        kind = "hole (unattributed serving time)" if total < wall \
+            else "overshoot (double-charged request)"
+        return False, (f"tenant chip {chip_s:.3f}s + overhead "
+                       f"{overhead:.3f}s = {total:.3f}s vs serving wall "
+                       f"{wall:.3f}s ({kind}, tol {rel:.0%})")
+    buckets = _get(doc, "goodput.buckets") or {}
+    serving = float(buckets.get("serving_step", 0.0)) \
+        + float(buckets.get("serving_drain", 0.0))
+    grel = tol["cost_goodput_rel"]
+    if serving > 0 and abs(wall - serving) > grel * max(serving, wall):
+        return False, (f"cost serving wall {wall:.3f}s vs goodput "
+                       f"serving buckets {serving:.3f}s (tol {grel:.0%})"
+                       f" — the two ledgers disagree")
+    saved_tok = sum(int(r.get("cache_saved_tokens") or 0)
+                    for r in tenants.values())
+    savings_ms = sum(float(r.get("cache_savings_ms") or 0.0)
+                     for r in tenants.values())
+    prefill_ms = sum(float(r.get("prefill_ms") or 0.0)
+                     for r in tenants.values())
+    prompt_tok = sum(int(r.get("prompt_tokens") or 0)
+                     for r in tenants.values())
+    if saved_tok > 0 and prefill_ms > 0:
+        paid_rate = prefill_ms / max(1, prompt_tok - saved_tok)
+        slack = tol["cost_savings_slack"]
+        if savings_ms / saved_tok > slack * paid_rate:
+            return False, (f"cache savings {savings_ms / saved_tok:.3f}"
+                           f"ms/token > {slack:g}x the paid prefill rate "
+                           f"{paid_rate:.3f}ms/token — savings overstate "
+                           f"the displaced cost")
+    return True, (f"tenant chip {chip_s:.3f}s + overhead {overhead:.3f}s"
+                  f" == serving wall {wall:.3f}s (+/-{rel:.0%}); savings "
+                  f"{savings_ms:.1f}ms over {saved_tok} reused token(s)")
+
+
 _CHECKS = {
     "goodput_sums_to_wall": _inv_goodput,
     "exactly_once_streaming": _inv_streaming,
@@ -284,6 +346,7 @@ _CHECKS = {
     "critical_path_decomposes": _inv_critical_path,
     "bundle_retention_bounded": _inv_bundles,
     "rollout_converges": _inv_rollout,
+    "cost_attribution_conserved": _inv_cost,
 }
 
 
@@ -402,6 +465,10 @@ def fold_scorecard(router, *, wall_s: float,
     agg = getattr(router, "aggregator", None)
     if agg is not None:
         doc["critical_path"] = agg.critical_path_summary()
+    if hasattr(router, "cost_summary"):
+        costs = router.cost_summary()
+        if costs.get("enabled"):
+            doc["costs"] = costs
     try:
         from ..comm.comm import comm_stats
         doc["comm"] = comm_stats()

@@ -1,0 +1,391 @@
+"""Cost plane: rigged attribution, conservation, and the capacity loop.
+
+The contract under test (telemetry/costplane.py): every second of
+serving wall-clock is split across the requests occupying it — decode
+ticks token-weighted (speculative accepted tokens credit their
+request), prefill charged whole to its owner, radix hits recorded as
+EMA-priced *avoided* cost, HBM GiB-seconds from slot footprint x
+residency — with an explicit overhead residual so per-replica request
+costs + overhead sum to serving wall BY CONSTRUCTION. The per-request
+CostRecord rides the TraceContext across handoff serialization and
+failover, accumulating by attempt. Disabled allocates nothing.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.serving import SamplingParams, ServingEngine
+from deepspeed_tpu.serving.config import CostConfig
+from deepspeed_tpu.telemetry.costplane import (CostLedger, CostRecord,
+                                               capacity_report,
+                                               merge_cost_totals,
+                                               tree_nbytes)
+from deepspeed_tpu.telemetry.disttrace import TraceContext
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+VOCAB = 96
+GIB = 1024 ** 3
+
+MODEL_CFG = dict(vocab_size=VOCAB, n_positions=64, n_embd=64, n_layer=2,
+                 n_head=4, pad_vocab_to_multiple=1, dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def engine():
+    model = GPT2Model(GPT2Config(**MODEL_CFG))
+    return deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+
+
+class _Req:
+    """The attribute surface record_for() reads off a Request."""
+
+    def __init__(self, rid, tenant="default", prompt_len=8, trace=None):
+        self.request_id = rid
+        self.tenant = tenant
+        self.prompt = np.zeros((prompt_len,), np.int32)
+        self.trace = trace
+
+
+# ------------------------------------------------------- rigged ledger math
+
+def test_decode_tick_known_split_and_conservation():
+    """A 0.4s decode tick over weights 1:3 splits 100/300ms; end_tick
+    books the 0.1s residual as overhead and one tick of HBM residency
+    per occupant — and the books balance to the wall exactly."""
+    led = CostLedger(CostConfig(enabled=True), slot_bytes=2 * GIB)
+    a = led.record_for(_Req(1, tenant="acme"))
+    b = led.record_for(_Req(2, tenant="zen"))
+    led.charge_decode(0.4, [(a, 1), (b, 3)])
+    led.end_tick(0.5, [a, b])
+    assert a.decode_ms == pytest.approx(100.0)
+    assert b.decode_ms == pytest.approx(300.0)
+    assert a.tokens == 1 and b.tokens == 4 - 1
+    snap = led.snapshot()
+    assert snap["serving_wall_s"] == pytest.approx(0.5)
+    assert snap["overhead_s"] == pytest.approx(0.1)
+    # conservation BY CONSTRUCTION: tenant chip + overhead == wall
+    chip_s = sum(t["chip_ms"] for t in snap["tenants"].values()) / 1e3
+    assert chip_s + snap["overhead_s"] == pytest.approx(
+        snap["serving_wall_s"])
+    # HBM: 2 GiB held for the 0.5s tick by each occupant
+    assert a.hbm_gib_s == pytest.approx(1.0)
+    assert snap["tenants"]["zen"]["hbm_gib_s"] == pytest.approx(1.0)
+    # an idle tick is pure overhead and counted as such
+    led.end_tick(0.2, [])
+    snap = led.snapshot()
+    assert snap["idle_ticks"] == 1
+    assert snap["overhead_s"] == pytest.approx(0.3)
+
+
+def test_zero_weight_and_empty_tick_charge_nothing():
+    led = CostLedger(CostConfig(enabled=True))
+    a = led.record_for(_Req(1))
+    led.charge_decode(0.4, [(a, 0)])
+    led.charge_decode(0.4, [])
+    assert a.decode_ms == 0.0 and a.tokens == 0
+
+
+def test_speculative_credit_prorata():
+    """One speculative tick: accepted draft tokens weight the split of
+    the WHOLE tick wall (draft + verify + bookkeeping ride pro-rata);
+    the aggregate draft/verify walls land in the snapshot."""
+    led = CostLedger(CostConfig(enabled=True))
+    a = led.record_for(_Req(1, tenant="acme"))
+    b = led.record_for(_Req(2, tenant="zen"))
+    led.charge_spec(0.2, 0.05, 0.1, [(a, 3), (b, 1)])
+    led.end_tick(0.2, [a, b])
+    assert a.decode_ms == pytest.approx(150.0)   # 3/4 of 200ms
+    assert b.decode_ms == pytest.approx(50.0)
+    assert a.tokens == 3 and b.tokens == 1
+    snap = led.snapshot()
+    assert snap["spec_draft_ms"] == pytest.approx(50.0)
+    assert snap["spec_verify_ms"] == pytest.approx(100.0)
+    assert snap["overhead_s"] == pytest.approx(0.0)
+
+
+def test_prefill_charged_whole_and_radix_savings_ema_priced():
+    led = CostLedger(CostConfig(enabled=True, ema_alpha=0.25))
+    a = led.record_for(_Req(1, tenant="acme", prompt_len=100))
+    # a hit before ANY paid prefill prices at nothing (nothing honest
+    # to price it with)
+    led.note_cache_savings(a, 50)
+    assert a.cache_savings_ms == 0.0 and a.cache_saved_tokens == 0
+    led.charge_prefill(a, 0.1, 100)              # 1.0 ms/token
+    assert a.prefill_ms == pytest.approx(100.0)
+    assert led.prefill_ms_per_token == pytest.approx(1.0)
+    b = led.record_for(_Req(2, tenant="acme", prompt_len=50))
+    led.charge_prefill(b, 0.1, 50)               # 2.0 ms/token observed
+    assert led.prefill_ms_per_token == pytest.approx(1.25)   # EMA step
+    # transport spans (lane copy, handoff insert) never feed the EMA
+    led.charge_prefill(b, 0.05, 50, update_rate=False)
+    assert led.prefill_ms_per_token == pytest.approx(1.25)
+    led.note_cache_savings(b, 40)                # priced at the EMA
+    assert b.cache_savings_ms == pytest.approx(50.0)
+    assert b.cache_saved_tokens == 40
+    row = led.snapshot()["tenants"]["acme"]
+    assert row["cache_savings_ms"] == pytest.approx(50.0)
+    assert row["prompt_tokens"] == 150 and row["requests"] == 2
+
+
+def test_tenant_cap_folds_overflow_into_other():
+    led = CostLedger(CostConfig(enabled=True, max_tracked=2))
+    for i, tenant in enumerate(("a", "b", "c", "d")):
+        rec = led.record_for(_Req(i, tenant=tenant))
+        led.charge_decode(0.1, [(rec, 1)])
+    tenants = led.snapshot()["tenants"]
+    assert set(tenants) == {"a", "b", "__other__"}
+    assert tenants["__other__"]["tokens"] == 2
+
+
+# ------------------------------------------- the record travels the fleet
+
+def test_failover_accumulates_into_same_record_by_attempt():
+    """Replica A prefills; the request hands off / fails over to
+    replica B, which decodes. One CostRecord crosses the serialized
+    frame header, keeps A's charges, and books B's under attempt 1."""
+    ledger_a = CostLedger(CostConfig(enabled=True))
+    ctx = TraceContext.mint(origin="router", tenant="acme")
+    rec = ledger_a.record_for(_Req(7, tenant="acme", prompt_len=32,
+                                   trace=ctx))
+    ledger_a.charge_prefill(rec, 0.1, 32)
+    ledger_a.end_tick(0.1, [rec])
+    assert ctx.cost is rec                     # the context carries it
+
+    header = json.loads(json.dumps(ctx.to_header()))   # the wire
+    ctx2 = TraceContext.from_header(header)
+    ctx2.replay()                              # failover requeue
+    ledger_b = CostLedger(CostConfig(enabled=True))
+    rec2 = ledger_b.record_for(_Req(7, tenant="acme", prompt_len=32,
+                                    trace=ctx2))
+    assert rec2 is not rec                     # revived, not shared
+    assert rec2.prefill_ms == pytest.approx(100.0)     # A's charge kept
+    assert rec2.attempt == 1
+    ledger_b.charge_decode(0.05, [(rec2, 1)])
+    ledger_b.end_tick(0.05, [rec2])
+    assert rec2.chip_ms == pytest.approx(150.0)
+    assert rec2.by_attempt == {0: pytest.approx(100.0),
+                               1: pytest.approx(50.0)}
+
+    # the fleet fold sums both replicas' ledgers; conservation holds
+    # across the fold exactly as per-replica
+    fold = {}
+    merge_cost_totals(fold, ledger_a.snapshot())
+    merge_cost_totals(fold, ledger_b.snapshot())
+    assert fold["serving_wall_s"] == pytest.approx(0.15)
+    chip_s = fold["tenants"]["acme"]["chip_ms"] / 1e3
+    assert chip_s + fold["overhead_s"] == pytest.approx(0.15)
+    # A minted the record; B revived it — one request, not two
+    assert fold["tenants"]["acme"]["requests"] == 1
+
+
+def test_capacity_report_math_and_projection():
+    costs = {"serving_wall_s": 10.0, "overhead_s": 1.0,
+             "tenants": {"acme": {"chip_ms": 6000.0, "tokens": 1200,
+                                  "hbm_gib_s": 2.0,
+                                  "cache_savings_ms": 30.0},
+                         "zen": {"chip_ms": 3000.0, "tokens": 300}}}
+    rep = capacity_report(costs, target_tokens_per_s=300.0, replicas=2)
+    assert rep["tenants"]["acme"]["tokens_per_chip_s"] == pytest.approx(
+        200.0)
+    assert rep["tenants"]["zen"]["tokens_per_chip_s"] == pytest.approx(
+        100.0)
+    assert rep["tenants"]["acme"]["cost_share"] == pytest.approx(0.6)
+    assert rep["effective_tokens_per_chip_s"] == pytest.approx(150.0)
+    # 300 tok/s at 150 tok/chip-s effective -> 2 chips
+    assert rep["projected_replicas"] == 2
+    assert rep["current_replicas"] == 2
+    assert "projected_replicas" not in capacity_report(costs)
+
+
+def test_tree_nbytes_is_int8_aware():
+    tree = {"q": np.zeros((4, 8), np.int8),
+            "scales": np.zeros((4,), np.float32)}
+    assert tree_nbytes(tree) == 4 * 8 + 4 * 4
+
+
+# ------------------------------------------------- the scorecard invariant
+
+def _cost_doc():
+    """A doc the cost invariant passes on; rigged tests perturb it."""
+    return {
+        "tolerances": {},
+        "goodput": {"buckets": {"serving_step": 9.5,
+                                "serving_drain": 0.4}},
+        "costs": {"enabled": True, "serving_wall_s": 10.0,
+                  "overhead_s": 0.5,
+                  "tenants": {
+                      "acme": {"chip_ms": 6000.0, "decode_ms": 4000.0,
+                               "prefill_ms": 2000.0, "tokens": 800,
+                               "prompt_tokens": 1000,
+                               "cache_savings_ms": 150.0,
+                               "cache_saved_tokens": 100},
+                      "zen": {"chip_ms": 3500.0, "decode_ms": 3500.0,
+                              "prefill_ms": 0.0, "tokens": 700,
+                              "prompt_tokens": 0}}},
+    }
+
+
+def _cost_inv(doc):
+    from deepspeed_tpu.telemetry.scorecard import check_invariants
+    return check_invariants(doc)["cost_attribution_conserved"]
+
+
+def test_cost_invariant_passes_and_is_lenient_when_off():
+    res = _cost_inv(_cost_doc())
+    assert res["ok"], res
+    res = _cost_inv({"tolerances": {}})      # plane off: nothing to check
+    assert res["ok"] and "off" in res["detail"]
+
+
+def test_cost_invariant_hole_and_overshoot_fail_by_name():
+    doc = _cost_doc()
+    doc["costs"]["tenants"]["acme"]["chip_ms"] = 4000.0   # lost 2s
+    res = _cost_inv(doc)
+    assert not res["ok"] and "hole" in res["detail"]
+    doc = _cost_doc()
+    doc["costs"]["tenants"]["acme"]["chip_ms"] = 9000.0   # double-charged
+    res = _cost_inv(doc)
+    assert not res["ok"] and "overshoot" in res["detail"]
+
+
+def test_cost_invariant_crosschecks_goodput_ledger():
+    doc = _cost_doc()
+    # the two ledgers disagree: goodput saw 4x the serving time
+    doc["goodput"]["buckets"] = {"serving_step": 40.0}
+    res = _cost_inv(doc)
+    assert not res["ok"] and "ledgers disagree" in res["detail"]
+
+
+def test_cost_invariant_rejects_overstated_savings():
+    doc = _cost_doc()
+    # 100 saved tokens claimed at 50ms/token vs a ~2.2ms/token paid rate
+    doc["costs"]["tenants"]["acme"]["cache_savings_ms"] = 5000.0
+    res = _cost_inv(doc)
+    assert not res["ok"] and "overstate" in res["detail"]
+
+
+def test_cost_invariant_enabled_but_empty_fails():
+    doc = _cost_doc()
+    doc["costs"]["serving_wall_s"] = 0.0
+    res = _cost_inv(doc)
+    assert not res["ok"] and "zero" in res["detail"]
+
+
+# ------------------------------------------------------- the real engine
+
+def test_sum_to_wall_on_real_engine(engine):
+    """A real serving run (prefix cache on, two tenants) conserves:
+    attributed chip time + overhead == serving wall within 2%, tenant
+    rows sum to the attributed total, and every request got a record."""
+    srv = ServingEngine(engine, {
+        "num_slots": 2, "max_model_len": 64, "max_queue": 16,
+        "cost": {"enabled": True},
+        "prefix_cache": {"enabled": True},
+        "telemetry": {"enabled": True}})
+    rng = np.random.default_rng(3)
+    sp = {t: SamplingParams(max_new_tokens=6, tenant=t)
+          for t in ("acme", "zen")}
+    for i in range(6):
+        srv.submit(rng.integers(0, VOCAB, (10,), dtype=np.int32),
+                   sp["acme" if i % 2 else "zen"])
+    while srv.queue_depth or srv.active_requests:
+        srv.step()
+    snap = srv.scheduler.cost.snapshot()
+    srv.shutdown()
+    assert snap["serving_wall_s"] > 0 and snap["ticks"] > 0
+    chip_s = snap["attributed_ms"] / 1e3
+    assert abs(chip_s + snap["overhead_s"] - snap["serving_wall_s"]) \
+        <= 0.02 * snap["serving_wall_s"]
+    rows = snap["tenants"]
+    assert sum(r["chip_ms"] for r in rows.values()) == pytest.approx(
+        snap["attributed_ms"], abs=0.01)
+    assert rows["acme"]["requests"] == 3 and rows["zen"]["requests"] == 3
+    assert rows["acme"]["tokens"] == 3 * 6
+    assert rows["acme"]["prompt_tokens"] == 3 * 10
+    assert all(r["hbm_gib_s"] > 0 for r in rows.values())
+    assert snap["slot_bytes"] > 0
+
+
+def test_disabled_allocates_nothing(engine):
+    """cost.enabled false (the default): the scheduler holds None, no
+    cost/ gauges register, no statusz section, zero per-request state —
+    and serving works exactly as before."""
+    from deepspeed_tpu.telemetry import get_tracer
+    srv = ServingEngine(engine, {
+        "num_slots": 2, "max_model_len": 64, "max_queue": 8,
+        "telemetry": {"enabled": True}})
+    assert srv.scheduler.cost is None
+    rid = srv.submit(np.arange(8, dtype=np.int32),
+                     SamplingParams(max_new_tokens=4))
+    while srv.queue_depth or srv.active_requests:
+        srv.step()
+    req = srv._requests[rid]
+    assert getattr(req, "cost", None) is None
+    assert req.trace is None or req.trace.cost is None
+    assert not [t for t in get_tracer().counters()
+                if t.startswith("cost/")]
+    srv.shutdown()
+
+
+# ------------------------------------------------------------ CLI smokes
+
+def _run_cost_cli(args, **kw):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_tpu_cost"), *args],
+        capture_output=True, text=True, cwd=REPO, timeout=120, **kw)
+
+
+def test_ds_tpu_cost_cli_smoke(tmp_path):
+    doc = {"kind": "soak_scorecard", "costs": _cost_doc()["costs"],
+           "fleet": {"replicas": 3}}
+    path = tmp_path / "scorecard.json"
+    path.write_text(json.dumps(doc))
+    res = _run_cost_cli([str(path), "--target-tokens-per-s", "300"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "acme" in res.stdout and "zen" in res.stdout
+    assert "serving wall 10.000s" in res.stdout
+    assert "projection: 2 replica(s)" in res.stdout
+    assert "(currently 3)" in res.stdout
+    # machine-readable mode emits the capacity report verbatim
+    res = _run_cost_cli([str(path), "--json"])
+    assert res.returncode == 0
+    rep = json.loads(res.stdout)
+    assert rep["tenants"]["acme"]["tokens_per_chip_s"] == pytest.approx(
+        800 / 6.0, rel=1e-3)
+
+
+def test_ds_tpu_cost_cli_errors(tmp_path):
+    res = _run_cost_cli([str(tmp_path / "missing.json")])
+    assert res.returncode == 1 and "does not exist" in res.stderr
+    bare = tmp_path / "no_costs.json"
+    bare.write_text(json.dumps({"kind": "soak_scorecard"}))
+    res = _run_cost_cli([str(bare)])
+    assert res.returncode == 1 and "cost plane was off" in res.stderr
+
+
+def test_ds_tpu_serve_cost_config_smoke(tmp_path):
+    """ds_tpu_serve --config with the shipped cost JSON: the CLI boots
+    the cost-armed fleet, serves real traffic, and finishes clean."""
+    with open(os.path.join(REPO, "examples", "configs",
+                           "serving_cost.json")) as f:
+        cfg = json.load(f)
+    cfg["statusz"]["port"] = 0           # ephemeral port under pytest
+    cfg_path = tmp_path / "serving_cost.json"
+    cfg_path.write_text(json.dumps(cfg))
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_tpu_serve"),
+         "--cpu", "--config", str(cfg_path),
+         "--requests", "3", "--rate", "50", "--prompt-len", "8",
+         "--max-new", "6"],
+        capture_output=True, text=True, cwd=REPO, timeout=420)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    summary = json.loads(res.stdout[res.stdout.index("{"):])
+    assert all(s == "finished" for s in summary["states"].values())

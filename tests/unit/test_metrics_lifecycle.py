@@ -106,6 +106,7 @@ def test_fleet_gauges_owned_and_released(tracer, tmp_path):
         "flight_recorder": {"enabled": True,
                             "dir": str(tmp_path / "fleet_rec")},
         "chunked_prefill": {"enabled": True, "chunk_tokens": 16},
+        "cost": {"enabled": True},
         "tenants": {"enabled": True, "rates": {"whale": 1.0},
                     "burst_tokens": 24},
         "fleet": {"enabled": True, "replicas": 2,
@@ -132,6 +133,12 @@ def test_fleet_gauges_owned_and_released(tracer, tmp_path):
     # the tenant dimension: per-tenant SLO windows + router throttles
     # must register owned (and vanish below) like every other family
     assert any(t.startswith("tenant/acme/") for t in counters)
+    assert "tenant/acme/prompt_tokens" in counters
+    assert "tenant/acme/tokens_out" in counters
+    # the dstpu_cost_* family (router cost fold) registers owned too
+    assert "cost/acme/chip_ms" in counters
+    assert "fleet/cost_serving_wall_ms" in counters
+    assert "fleet/cost_overhead_ms" in counters
     assert "tenant/whale/throttled" in counters
     assert "fleet/throttled" in counters
     assert "recorder/bundles" in counters
@@ -193,7 +200,17 @@ def test_moe_gauges_owned_and_released(tracer):
     assert out["dropped_token_fraction"] == 0.0
     assert out["overflow_tokens"] == 12.0
     assert m.summary()["records"] == 2
+    # wire accounting: logical all-to-all payload E x C x M x itemsize
+    # each direction — 4 * 4 * 8 * 4 = 512 bytes per step per leg
+    wire = m.record_wire(capacity=4, num_experts=4, model_dim=8,
+                         itemsize=4, step=2)
+    assert wire["dispatch_bytes_total"] == 512.0
+    assert wire["combine_bytes_total"] == 512.0
+    assert wire["wire_bytes_per_step"] == 1024.0
+    assert m.summary()["dispatch_bytes"] == 512
     dump = prometheus_dump(tracer)
+    assert "dstpu_moe_dispatch_bytes_total 512.0" in dump
+    assert "dstpu_moe_wire_bytes_per_step 1024.0" in dump
     assert "dstpu_moe_load_imbalance 1.0" in dump
     assert "dstpu_moe_dropped_token_fraction 0.0" in dump
     assert "dstpu_moe_overflow_tokens 12.0" in dump
