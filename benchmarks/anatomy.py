@@ -1,0 +1,245 @@
+"""Step/tick anatomy benchmark: the perf plane's regression gate input.
+
+Compiles the repo's real programs on a virtual 8-device CPU mesh — the
+bucketed + compressed ZeRO-3 train step, the fused decode tick (at
+max_len and 2x max_len, for the KV-scaling evidence), the speculative
+verify tick, the chunked-prefill tick, and the expert-parallel MoE step
+— runs each compiled HLO through the perf plane's static anatomy
+(telemetry/perfplane.py), and writes ``benchmarks/anatomy.json``:
+per-program bucket decompositions (each summing to its program total by
+construction), bytes attribution, and memory-bound fractions.
+
+``bin/ds_tpu_perfdiff`` diffs this against the checked-in
+``benchmarks/anatomy_baseline.json`` with per-bucket noise bands, so any
+future PR that silently de-overlaps a collective, bloats decode
+weight-streaming bytes, or regresses the memory-bound fraction fails
+BY BUCKET NAME in tier-1.
+
+Two satellite numbers ride in ``extras``:
+
+- decode ticks carry ``kv_read_bytes_per_tick`` (the full dense pool —
+  every decode tick streams the whole KV pool through the attention
+  reads) vs ``weight_stream_bytes_per_tick`` (int8-aware via
+  ``tree_nbytes``), and the doc's embedded invariant asserts KV read
+  bytes scale ~2x when ``max_len`` doubles — the checked-in number the
+  paged-pool PR must beat (ROADMAP item 2);
+- the MoE step's ``coll_all_to_all`` anatomy bucket rides next to the
+  PR-18 ``MoeMetrics.record_wire`` logical wire bytes, keeping the
+  GSPMD-emitted all-to-all accountable even though it never passes
+  through comm/comm.py (the HLO006 waiver's tracking note, ROADMAP
+  item 1).
+
+Rigged mode: ``--rig-overlap-off`` compiles the SAME train step with
+the overlap schedule disabled — the injected regression the tests use
+to prove the gate fails a de-overlapped program by collective bucket.
+
+Run (CPU): JAX_PLATFORMS=cpu python benchmarks/anatomy.py
+Knobs: --size tiny|bench (tiny is the tier-1 pin; STANDING CHIP DEBT:
+re-pin at bench size on hardware per ROADMAP item 5), --out,
+--rig-overlap-off.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import importlib.util  # noqa: E402
+
+_spec = importlib.util.spec_from_file_location(
+    "_dstpu_hermetic",
+    os.path.join(REPO, "deepspeed_tpu", "utils", "hermetic.py"))
+_hermetic = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_hermetic)
+_hermetic.force_cpu(device_count=8)
+
+OUT_PATH = os.path.join(REPO, "benchmarks", "anatomy.json")
+
+
+def _program_entry(anat, extras=None):
+    """One anatomy.json program record from a static anatomy: buckets
+    with ms/flops/bytes, the by-construction total, and the roofline
+    headline numbers the diff bands."""
+    entry = {
+        "buckets": {name: {"ms": b["ms"], "flops": b["flops"],
+                           "bytes": b["bytes"], "ops": b["ops"]}
+                    for name, b in sorted(anat["buckets"].items())},
+        "total_ms": anat["total_ms"],
+        "flops": anat["flops"],
+        "bytes": anat["bytes"],
+        "static_overlap_fraction": anat["static_overlap_fraction"],
+        "memory_bound_fraction": anat["memory_bound_fraction"],
+    }
+    if extras:
+        entry["extras"] = extras
+    return entry
+
+
+def _decode_program(pp, num_slots=4, max_len=32):
+    """The fused decode tick + its bytes attribution: KV-pool bytes read
+    per tick (the whole dense pool streams through attention every tick
+    — the max_len-proportional cost the paged pool attacks) vs weight
+    bytes streamed (tree_nbytes is int8-aware, so a quantized pool's
+    4x-smaller reads show up here unprompted)."""
+    import jax
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.analysis.artifacts import lower_decode_step, _reset_mesh
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.telemetry.costplane import tree_nbytes
+
+    art = lower_decode_step(num_slots=num_slots, max_len=max_len)
+    anat = pp.anatomy_from_hlo(art.hlo_texts[0])
+    # rebuild the pool/params shapes the lowered program ran over for the
+    # byte attribution (the artifact builder closed its engine)
+    _reset_mesh()
+    model = GPT2Model(GPT2Config(vocab_size=128, n_positions=max_len * 2,
+                                 n_embd=64, n_layer=2, n_head=4,
+                                 pad_vocab_to_multiple=1, dtype="float32"))
+    engine = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    pool = engine.init_slot_pool(num_slots, max_len)
+    extras = {
+        "num_slots": num_slots,
+        "max_len": max_len,
+        # every tick's attention reads stream the FULL dense pool
+        "kv_read_bytes_per_tick": float(tree_nbytes(pool)),
+        # and write exactly one token column of it back
+        "kv_write_bytes_per_tick": float(tree_nbytes(pool)) / max_len,
+        # dense weights stream once per tick regardless of batch
+        "weight_stream_bytes_per_tick": float(tree_nbytes(engine.params)),
+    }
+    return anat, extras
+
+
+def _chunk_prefill_program(pp, num_slots=4, max_len=32, chunk=8):
+    """The chunked-prefill tick: one fixed-size chunk of a prompt's K/V
+    written into a slot (serving/scheduler.py interleaves these with
+    decode ticks)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.analysis.artifacts import _reset_mesh
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    _reset_mesh()
+    model = GPT2Model(GPT2Config(vocab_size=128, n_positions=max_len * 2,
+                                 n_embd=64, n_layer=2, n_head=4,
+                                 pad_vocab_to_multiple=1, dtype="float32"))
+    engine = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    pool = engine.init_slot_pool(num_slots, max_len)
+    tokens = np.ones((chunk,), np.int32)
+    pool = engine.slot_chunk_prefill(pool, 0, tokens, 0)
+    fn = engine._slot_fns[("slot_chunk", num_slots, chunk, max_len)]
+    ids = np.zeros((1, chunk), np.int32)
+    args = (engine.params, jnp.asarray(ids), pool, jnp.int32(0),
+            jnp.int32(0))
+    with engine.mesh:
+        hlo = fn.lower(*args).compile().as_text()
+    return pp.anatomy_from_hlo(hlo), {"chunk_tokens": chunk}
+
+
+def _moe_program(pp):
+    """The expert-parallel MoE step: the GSPMD-emitted expert all-to-all
+    gets a first-class ``coll_all_to_all`` anatomy bucket, cross-checked
+    against the PR-18 logical wire accounting (MoeMetrics.record_wire:
+    E x C x M x itemsize per direction). Tracking note for the HLO006
+    waiver (ROADMAP item 1): this bucket is where the unreconciled
+    collective's cost stays visible."""
+    from deepspeed_tpu.analysis.artifacts import lower_moe_step, _SIZES
+    from deepspeed_tpu.moe.sharded_moe import MoeMetrics, _capacity
+
+    art = lower_moe_step(size="tiny", ep=4)
+    anat = pp.anatomy_from_hlo(art.hlo_texts[0])
+    # the lint artifact's static shapes (lower_moe_step): mbs 4, tiny
+    # seq, n_embd 64, E=4 experts, top-1, capacity_factor 1.25
+    _, n_embd, _, seq = _SIZES["tiny"]
+    tokens = 4 * seq
+    cap = _capacity(tokens, 4, 1, 1.25, 4, True)
+    mm = MoeMetrics()
+    wire = mm.record_wire(capacity=cap, num_experts=4, model_dim=n_embd,
+                          itemsize=4)
+    mm.close()
+    extras = {
+        "num_experts": 4,
+        "capacity": cap,
+        "record_wire_bytes_per_step": wire["wire_bytes_per_step"],
+        "note": "coll_all_to_all rides the HLO006 waiver (GSPMD-emitted "
+                "expert all-to-all, no comm/ dispatch) — ROADMAP item 1",
+    }
+    return anat, extras
+
+
+def build_doc(size="tiny", rig_overlap_off=False):
+    """Compile every gate program and fold the anatomy document."""
+    from deepspeed_tpu.telemetry import perfplane as pp
+    from deepspeed_tpu.analysis.artifacts import (lower_spec_verify_step,
+                                                  lower_train_step)
+
+    programs = {}
+
+    art = lower_train_step(size, overlap=not rig_overlap_off)
+    programs["train_step_zero3"] = _program_entry(
+        pp.anatomy_from_hlo(art.hlo_texts[0]),
+        {"overlap_schedule": not rig_overlap_off})
+
+    anat, extras = _decode_program(pp, num_slots=4, max_len=32)
+    programs["decode_tick"] = _program_entry(anat, extras)
+    anat, extras = _decode_program(pp, num_slots=4, max_len=64)
+    programs["decode_tick_x2"] = _program_entry(anat, extras)
+
+    art = lower_spec_verify_step()
+    programs["spec_verify_tick"] = _program_entry(
+        pp.anatomy_from_hlo(art.hlo_texts[0]), {"k": 2})
+
+    anat, extras = _chunk_prefill_program(pp)
+    programs["chunked_prefill_tick"] = _program_entry(anat, extras)
+
+    anat, extras = _moe_program(pp)
+    programs["moe_step"] = _program_entry(anat, extras)
+
+    doc = {
+        "kind": pp.ANATOMY_KIND,
+        "size": size,
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "device_model": dict(pp.DEVICE_MODEL),
+        "programs": programs,
+    }
+    doc["invariants"] = pp.check_anatomy_invariants(doc)
+    return doc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--size", choices=("tiny", "bench"), default="tiny")
+    ap.add_argument("--out", default=OUT_PATH)
+    ap.add_argument("--rig-overlap-off", action="store_true",
+                    help="compile the train step WITHOUT the overlap "
+                         "schedule (the injected regression the tests "
+                         "prove the gate catches)")
+    args = ap.parse_args(argv)
+
+    from deepspeed_tpu.telemetry import perfplane as pp
+    doc = build_doc(size=args.size, rig_overlap_off=args.rig_overlap_off)
+    pp.write_anatomy(doc, args.out)
+    bad = [name for name, inv in doc["invariants"].items()
+           if not inv["ok"]]
+    for name, prog in sorted(doc["programs"].items()):
+        top = sorted(prog["buckets"].items(),
+                     key=lambda kv: -kv[1]["ms"])[:3]
+        print(f"{name:<22} {prog['total_ms']:9.4f} ms predicted · "
+              f"mem-bound {prog['memory_bound_fraction']:.2f} · top: " +
+              ", ".join(f"{n} {b['ms']:.4f}" for n, b in top))
+    print(f"wrote {args.out}")
+    if bad:
+        print(f"INVARIANT FAILURES: {bad}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1016,9 +1016,12 @@ class InferenceEngine:
                 logits, fp = model.decode_with_slots(
                     params, toks[:, None], fp, positions)
                 # the sampled token will be FED at column positions + 1
-                keys = row_keys(seeds, positions + 1)
-                nxt = sample_rows(logits[:, -1], temps, top_ks, top_ps,
-                                  keys, vocab)
+                # ("sample" scope: the perf plane buckets this tail apart
+                # from the model forward it follows)
+                with jax.named_scope("sample"):
+                    keys = row_keys(seeds, positions + 1)
+                    nxt = sample_rows(logits[:, -1], temps, top_ks,
+                                      top_ps, keys, vocab)
                 # re-quantize on the way out: per-column scales make the
                 # round-trip of every column this step did not write exact,
                 # so old tokens never re-accumulate quantization error
@@ -1237,47 +1240,56 @@ class InferenceEngine:
                     params, block, fp_old, positions)      # [S, k+1, V]
                 # target's candidate at offset j would be FED at column
                 # positions + j + 1 — the same key the plain decode path
-                # (and the draft) derives for that position
-                cols = positions[:, None] + 1 + \
-                    jnp.arange(k + 1)[None, :]             # [S, k+1]
-                tgt = jax.vmap(
-                    lambda lg, cs: sample_rows(lg, temps, top_ks, top_ps,
-                                               row_keys(seeds, cs), vocab),
-                    in_axes=(1, 1), out_axes=1)(logits, cols)
-                match = (draft_toks == tgt[:, :k]).astype(jnp.int32)
-                accepts = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                # (and the draft) derives for that position. The "verify"
+                # scope covers sampling + accept math + rollback so the
+                # perf plane prices the whole accept/reject tail as one
+                # bucket distinct from the batched forward above.
+                with jax.named_scope("verify"):
+                    cols = positions[:, None] + 1 + \
+                        jnp.arange(k + 1)[None, :]         # [S, k+1]
+                    tgt = jax.vmap(
+                        lambda lg, cs: sample_rows(
+                            lg, temps, top_ks, top_ps,
+                            row_keys(seeds, cs), vocab),
+                        in_axes=(1, 1), out_axes=1)(logits, cols)
+                    match = (draft_toks == tgt[:, :k]).astype(jnp.int32)
+                    accepts = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
                 # rollback INSIDE the step: only columns this verify
                 # WROTE and the accept prefix covers keep their new
                 # values — everything else (untouched columns AND
                 # rejected writes) restores to the pre-verify lane
-                cols_ax = jnp.arange(max_len)[None, :]
-                keep = (cols_ax >= positions[:, None]) & \
-                    (cols_ax <= (positions + accepts)[:, None])   # [S, C]
-                if quantized:
-                    # restore in QUANTIZED space: original q/scale BYTES
-                    # are copied verbatim for every non-kept column, so
-                    # rolled-back int8 lanes are bit-exact — the
-                    # untouched-column guarantee by construction, immune
-                    # even to ulp-level requantization drift
-                    newq = quantize_pool(fp_new)
+                with jax.named_scope("verify"):
+                    cols_ax = jnp.arange(max_len)[None, :]
+                    keep = (cols_ax >= positions[:, None]) & \
+                        (cols_ax <= (positions + accepts)[:, None])  # [S, C]
+                    if quantized:
+                        # restore in QUANTIZED space: original q/scale
+                        # BYTES are copied verbatim for every non-kept
+                        # column, so rolled-back int8 lanes are bit-exact
+                        # — the untouched-column guarantee by
+                        # construction, immune even to ulp-level
+                        # requantization drift
+                        newq = quantize_pool(fp_new)
 
-                    def rbq(new, old):
-                        return jnp.where(keep[None, :, None, :, None],
-                                         new, old)
+                        def rbq(new, old):
+                            return jnp.where(keep[None, :, None, :, None],
+                                             new, old)
 
-                    def rbs(new, old):
-                        return jnp.where(keep[None, :, None, :], new, old)
+                        def rbs(new, old):
+                            return jnp.where(keep[None, :, None, :],
+                                             new, old)
 
-                    from .kv_quant import QuantizedSlotPool
-                    out_pool = QuantizedSlotPool(
-                        q=jax.tree.map(rbq, newq.q, pool.q),
-                        scales=jax.tree.map(rbs, newq.scales, pool.scales))
-                else:
-                    def rb(new, old):
-                        return jnp.where(keep[None, :, None, :, None],
-                                         new, old)
+                        from .kv_quant import QuantizedSlotPool
+                        out_pool = QuantizedSlotPool(
+                            q=jax.tree.map(rbq, newq.q, pool.q),
+                            scales=jax.tree.map(rbs, newq.scales,
+                                                pool.scales))
+                    else:
+                        def rb(new, old):
+                            return jnp.where(keep[None, :, None, :, None],
+                                             new, old)
 
-                    out_pool = jax.tree.map(rb, fp_new, fp_old)
+                        out_pool = jax.tree.map(rb, fp_new, fp_old)
                 return out_pool, tgt, accepts.astype(jnp.int32)
 
             fn = self._slot_fns[fkey] = jax.jit(ver, in_shardings=(

@@ -651,16 +651,23 @@ class GPT2Model(ModelSpec):
             new_kv = {}
 
             def cached_attn(q, k, v):
-                kc = lax.dynamic_update_slice(
-                    k_cache, k.astype(k_cache.dtype), (0, 0, start_pos, 0))
-                vc = lax.dynamic_update_slice(
-                    v_cache, v.astype(v_cache.dtype), (0, 0, start_pos, 0))
+                # kv_write / kv_read scopes nest inside "attn" and take
+                # precedence in the perf plane's bucket classifier, so
+                # cache traffic is attributed as bytes, not attention math
+                with jax.named_scope("kv_write"):
+                    kc = lax.dynamic_update_slice(
+                        k_cache, k.astype(k_cache.dtype),
+                        (0, 0, start_pos, 0))
+                    vc = lax.dynamic_update_slice(
+                        v_cache, v.astype(v_cache.dtype),
+                        (0, 0, start_pos, 0))
                 new_kv["k"], new_kv["v"] = kc, vc
-                kq, vq = kc.astype(q.dtype), vc.astype(q.dtype)
-                if q.shape[1] != kq.shape[1]:        # GQA: repeat kv heads
-                    rep = q.shape[1] // kq.shape[1]
-                    kq = jnp.repeat(kq, rep, axis=1)
-                    vq = jnp.repeat(vq, rep, axis=1)
+                with jax.named_scope("kv_read"):
+                    kq, vq = kc.astype(q.dtype), vc.astype(q.dtype)
+                    if q.shape[1] != kq.shape[1]:    # GQA: repeat kv heads
+                        rep = q.shape[1] // kq.shape[1]
+                        kq = jnp.repeat(kq, rep, axis=1)
+                        vq = jnp.repeat(vq, rep, axis=1)
                 return reference_attention(q, kq, vq, causal=False, mask=mask,
                                            bias=bias)
 
@@ -737,14 +744,20 @@ class GPT2Model(ModelSpec):
             new_kv = {}
 
             def cached_attn(q, k, v):
-                kc = jnp.where(write, k.astype(k_cache.dtype), k_cache)
-                vc = jnp.where(write, v.astype(v_cache.dtype), v_cache)
+                # per-row masked-select write touches the WHOLE pool lane;
+                # the kv_write/kv_read scopes let the perf plane price it
+                # as HBM bytes (ROADMAP item 2's decode-is-bandwidth-bound
+                # evidence) instead of folding it into attention math
+                with jax.named_scope("kv_write"):
+                    kc = jnp.where(write, k.astype(k_cache.dtype), k_cache)
+                    vc = jnp.where(write, v.astype(v_cache.dtype), v_cache)
                 new_kv["k"], new_kv["v"] = kc, vc
-                kq, vq = kc.astype(q.dtype), vc.astype(q.dtype)
-                if q.shape[1] != kq.shape[1]:        # GQA: repeat kv heads
-                    rep = q.shape[1] // kq.shape[1]
-                    kq = jnp.repeat(kq, rep, axis=1)
-                    vq = jnp.repeat(vq, rep, axis=1)
+                with jax.named_scope("kv_read"):
+                    kq, vq = kc.astype(q.dtype), vc.astype(q.dtype)
+                    if q.shape[1] != kq.shape[1]:    # GQA: repeat kv heads
+                        rep = q.shape[1] // kq.shape[1]
+                        kq = jnp.repeat(kq, rep, axis=1)
+                        vq = jnp.repeat(vq, rep, axis=1)
                 return reference_attention(q, kq, vq, causal=False, mask=mask,
                                            bias=bias)
 
@@ -816,21 +829,23 @@ class GPT2Model(ModelSpec):
 
             def cached_attn(q, k, v):
                 # k/v [S, H, T, hd] -> scatter-free block write [S, H, C, hd]
-                kin = jnp.einsum("stc,shtd->shcd",
-                                 write.astype(jnp.float32),
-                                 k.astype(jnp.float32)).astype(k_cache.dtype)
-                vin = jnp.einsum("stc,shtd->shcd",
-                                 write.astype(jnp.float32),
-                                 v.astype(jnp.float32)).astype(v_cache.dtype)
-                sel = wrote[:, None, :, None]
-                kc = jnp.where(sel, kin, k_cache)
-                vc = jnp.where(sel, vin, v_cache)
+                with jax.named_scope("kv_write"):
+                    kin = jnp.einsum(
+                        "stc,shtd->shcd", write.astype(jnp.float32),
+                        k.astype(jnp.float32)).astype(k_cache.dtype)
+                    vin = jnp.einsum(
+                        "stc,shtd->shcd", write.astype(jnp.float32),
+                        v.astype(jnp.float32)).astype(v_cache.dtype)
+                    sel = wrote[:, None, :, None]
+                    kc = jnp.where(sel, kin, k_cache)
+                    vc = jnp.where(sel, vin, v_cache)
                 new_kv["k"], new_kv["v"] = kc, vc
-                kq, vq = kc.astype(q.dtype), vc.astype(q.dtype)
-                if q.shape[1] != kq.shape[1]:        # GQA: repeat kv heads
-                    rep = q.shape[1] // kq.shape[1]
-                    kq = jnp.repeat(kq, rep, axis=1)
-                    vq = jnp.repeat(vq, rep, axis=1)
+                with jax.named_scope("kv_read"):
+                    kq, vq = kc.astype(q.dtype), vc.astype(q.dtype)
+                    if q.shape[1] != kq.shape[1]:    # GQA: repeat kv heads
+                        rep = q.shape[1] // kq.shape[1]
+                        kq = jnp.repeat(kq, rep, axis=1)
+                        vq = jnp.repeat(vq, rep, axis=1)
                 return reference_attention(q, kq, vq, causal=False, mask=mask,
                                            bias=bias)
 

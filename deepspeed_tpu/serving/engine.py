@@ -112,6 +112,21 @@ class ServingEngine:
                 self._hbm_interval = int(cpcfg.hbm_interval_steps)
             if self._recorder is not None:
                 self._recorder.attach_compile_plane(self._compile_plane)
+        # perf plane: tick anatomy per compile event (decode/verify/
+        # chunked-prefill buckets), anat/* gauges, perf_regression
+        # trigger — rides the compile ledger's HLO capture
+        self._perf_plane = None
+        ppcfg = getattr(config, "perf_plane", None)
+        if getattr(ppcfg, "enabled", False) and \
+                self._compile_plane is not None:
+            from ..telemetry.perfplane import PerfPlane
+            self._perf_plane = PerfPlane(ppcfg, tracer=self.tracer,
+                                         owner=self,
+                                         recorder=self._recorder)
+            self._compile_plane.attach_perf_plane(self._perf_plane)
+            if self._recorder is not None:
+                self._recorder.add_provider(
+                    "anatomy", self._perf_plane.bundle_section)
         self.statusz = None
         if getattr(config.statusz, "enabled", False):
             from ..telemetry.statusz import StatuszServer
@@ -123,6 +138,8 @@ class ServingEngine:
             if self._compile_plane is not None:
                 self.statusz.register("compile_plane",
                                       self._compile_plane.summary)
+            if self._perf_plane is not None:
+                self.statusz.register("anatomy", self._perf_plane.summary)
             if self._hbm is not None:
                 self.statusz.register("memory", self._hbm.summary)
         self.scheduler = ContinuousBatchingScheduler(
